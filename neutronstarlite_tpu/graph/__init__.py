@@ -1,0 +1,19 @@
+from neutronstarlite_tpu.graph.storage import (
+    CSCGraph,
+    build_graph,
+    load_edges_binary,
+    gcn_norm_weights,
+    partition_offsets,
+)
+from neutronstarlite_tpu.graph.dataset import GNNDatum
+from neutronstarlite_tpu.graph.synthetic import synthetic_power_law_graph
+
+__all__ = [
+    "CSCGraph",
+    "build_graph",
+    "load_edges_binary",
+    "gcn_norm_weights",
+    "partition_offsets",
+    "GNNDatum",
+    "synthetic_power_law_graph",
+]

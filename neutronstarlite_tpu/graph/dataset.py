@@ -1,0 +1,123 @@
+"""Feature / label / mask IO — the GNNDatum equivalent.
+
+Reference: core/ntsDataloador.hpp:29-305. File formats (readFeature_Label_Mask,
+:156-303): feature file lines are ``ID f0 f1 ... f_{d-1}``; label file lines
+``ID label``; mask file lines ``ID train|val|eval|test`` with train=0,
+val/eval=1, test=2. ``random_generate`` (:63) fills ones-features, random
+labels, and mask = id % 3 when files are absent.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+import numpy as np
+
+MASK_TRAIN = 0
+MASK_VAL = 1
+MASK_TEST = 2
+
+_MASK_NAMES = {"train": MASK_TRAIN, "val": MASK_VAL, "eval": MASK_VAL, "test": MASK_TEST}
+
+
+@dataclasses.dataclass
+class GNNDatum:
+    """Per-vertex features, labels, masks for the full graph (host NumPy)."""
+
+    feature: np.ndarray  # [V, f0] float32
+    label: np.ndarray  # [V] int32
+    mask: np.ndarray  # [V] int32 in {0=train, 1=val, 2=test}
+
+    @property
+    def v_num(self) -> int:
+        return self.feature.shape[0]
+
+    @property
+    def feature_size(self) -> int:
+        return self.feature.shape[1]
+
+    @staticmethod
+    def random_generate(
+        v_num: int, feature_size: int, label_num: int, seed: int = 0
+    ) -> "GNNDatum":
+        """Deterministic stand-in data (reference: random_generate, :63-76
+        uses ones-features, rand labels, mask = i % 3)."""
+        rng = np.random.default_rng(seed)
+        feature = rng.standard_normal((v_num, feature_size), dtype=np.float32) * 0.1
+        label = rng.integers(0, label_num, size=v_num, dtype=np.int32)
+        mask = (np.arange(v_num) % 3).astype(np.int32)
+        return GNNDatum(feature=feature, label=label, mask=mask)
+
+    @staticmethod
+    def read_feature_label_mask(
+        feature_file: str,
+        label_file: str,
+        mask_file: str,
+        v_num: int,
+        feature_size: int,
+        seed: int = 0,
+    ) -> "GNNDatum":
+        """Load the three text files; any missing file falls back to the
+        random_generate fill for that field (the reference prints "open ...
+        fail!" and returns; we degrade per-field instead so real labels can be
+        paired with generated features when a dataset ships without features)."""
+        rng = np.random.default_rng(seed)
+
+        if feature_file and os.path.exists(feature_file):
+            feature = _read_feature_table(feature_file, v_num, feature_size)
+        else:
+            feature = rng.standard_normal((v_num, feature_size), dtype=np.float32) * 0.1
+
+        if label_file and os.path.exists(label_file):
+            label = _read_id_value_table(label_file, v_num).astype(np.int32)
+        else:
+            label = rng.integers(0, 2, size=v_num, dtype=np.int32)
+
+        if mask_file and os.path.exists(mask_file):
+            mask = _read_mask_table(mask_file, v_num)
+        else:
+            mask = (np.arange(v_num) % 3).astype(np.int32)
+
+        return GNNDatum(feature=feature, label=label, mask=mask)
+
+    def label_num(self) -> int:
+        return int(self.label.max()) + 1
+
+    def mask_tensor(self, which: int) -> np.ndarray:
+        return (self.mask == which).astype(np.float32)
+
+
+def _read_feature_table(path: str, v_num: int, feature_size: int) -> np.ndarray:
+    data = np.loadtxt(path, dtype=np.float32)
+    if data.ndim == 1:
+        data = data.reshape(1, -1)
+    if data.shape[1] != feature_size + 1:
+        raise ValueError(
+            f"{path}: expected {feature_size + 1} columns (ID + features), got {data.shape[1]}"
+        )
+    out = np.zeros((v_num, feature_size), dtype=np.float32)
+    ids = data[:, 0].astype(np.int64)
+    out[ids] = data[:, 1:]
+    return out
+
+
+def _read_id_value_table(path: str, v_num: int) -> np.ndarray:
+    data = np.loadtxt(path, dtype=np.int64)
+    if data.ndim == 1:
+        data = data.reshape(1, -1)
+    out = np.zeros(v_num, dtype=np.int64)
+    out[data[:, 0]] = data[:, 1]
+    return out
+
+
+def _read_mask_table(path: str, v_num: int) -> np.ndarray:
+    out = np.full(v_num, MASK_TEST, dtype=np.int32)
+    with open(path) as fh:
+        for line in fh:
+            parts = line.split()
+            if len(parts) < 2:
+                continue
+            out[int(parts[0])] = _MASK_NAMES.get(parts[1].strip().lower(), MASK_TEST)
+    return out

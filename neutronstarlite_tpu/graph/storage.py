@@ -1,0 +1,186 @@
+"""Host-side graph storage: CSC/CSR build, GCN normalization, partitioning.
+
+This replaces the reference's Graph<EdgeData> loading/partitioning machinery
+(core/graph.hpp:1127-1827 ``load_directed``, :4203 ``generate_backward_structure``)
+and CSC_segment construction (core/GraphSegment.cpp:45-220,
+core/PartitionedGraph.hpp:324-420 ``PartitionToChunks``) with vectorized NumPy
+preprocessing. Where the reference builds per-socket NUMA copies and MPI-shuffles
+edges to owner ranks, a TPU has a single HBM domain per chip, so preprocessing
+happens once on the host and the resulting flat arrays are shipped to device
+(optionally sharded over a mesh — see neutronstarlite_tpu.parallel.dist_graph).
+
+Conventions:
+- Edges are directed src -> dst; forward aggregation pulls from in-neighbors
+  (CSC, edges sorted by dst), backward pushes gradients along out-edges
+  (CSR, edges sorted by src) — mirroring the reference's forward CSC chunks
+  (``incoming_adj_*``) and backward CSR (``incoming_adj_*_backward``,
+  graph.hpp:127-153).
+- Zero degrees are clamped to 1 for normalization, matching
+  generate_backward_structure's clamp (graph.hpp:4396-4401).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+# Reference: CHUNKSIZE (1<<20) edges per IO read (dep/gemini/constants.hpp:20).
+# NumPy reads the whole file; kept only as the streaming chunk for huge files.
+IO_CHUNK_EDGES = 1 << 24
+
+
+def load_edges_binary(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    """Read a Gemini binary edge list: pairs of little-endian uint32 (src, dst).
+
+    Reference: chunked binary reads in ``load_directed`` (graph.hpp:1160-1181);
+    8 bytes/edge per data/README.md.
+    """
+    size = os.path.getsize(path)
+    if size % 8 != 0:
+        raise ValueError(f"{path}: size {size} is not a multiple of 8 bytes/edge")
+    raw = np.fromfile(path, dtype="<u4").reshape(-1, 2)
+    return np.ascontiguousarray(raw[:, 0]), np.ascontiguousarray(raw[:, 1])
+
+
+def gcn_norm_weights(
+    src: np.ndarray, dst: np.ndarray, out_degree: np.ndarray, in_degree: np.ndarray
+) -> np.ndarray:
+    """Per-edge GCN weight 1/sqrt(d_out(src) * d_in(dst)).
+
+    Reference: ``nts_norm_degree`` (core/ntsBaseOp.hpp:194-197) and the
+    ``weight_compute`` callback passed to PartitionToChunks
+    (PartitionedGraph.hpp:324).
+    """
+    d_out = np.maximum(out_degree[src], 1).astype(np.float64)
+    d_in = np.maximum(in_degree[dst], 1).astype(np.float64)
+    return (1.0 / np.sqrt(d_out * d_in)).astype(np.float32)
+
+
+@dataclasses.dataclass
+class CSCGraph:
+    """Dual CSC/CSR adjacency with per-edge weights, host (NumPy) resident.
+
+    CSC view (forward, dst-sorted):   column_offset [V+1], row_indices [E]
+      (source of each edge), dst_of_edge [E], edge_weight_forward [E].
+    CSR view (backward, src-sorted):  row_offset [V+1], column_indices [E]
+      (destination of each edge), src_of_edge [E], edge_weight_backward [E].
+
+    Reference: CSC_segment_pinned (core/GraphSegment.h:52-139) holds the same
+    dual structure per (src-partition, dst-partition) chunk; here the
+    single-chip graph is one flat chunk and the distributed build slices it.
+    """
+
+    v_num: int
+    e_num: int
+    # CSC (forward)
+    column_offset: np.ndarray
+    row_indices: np.ndarray
+    dst_of_edge: np.ndarray
+    edge_weight_forward: np.ndarray
+    # CSR (backward)
+    row_offset: np.ndarray
+    column_indices: np.ndarray
+    src_of_edge: np.ndarray
+    edge_weight_backward: np.ndarray
+    # degrees
+    out_degree: np.ndarray
+    in_degree: np.ndarray
+
+    @property
+    def avg_degree(self) -> float:
+        return self.e_num / max(self.v_num, 1)
+
+
+def build_graph(
+    src: np.ndarray,
+    dst: np.ndarray,
+    v_num: int,
+    weight: str = "gcn_norm",
+    edge_weight: Optional[np.ndarray] = None,
+) -> CSCGraph:
+    """Build dual CSC/CSR from an edge list.
+
+    ``weight``: "gcn_norm" (1/sqrt(dd), the GCN toolkits' choice), "ones"
+    (GIN/GAT-style unweighted sum), or "custom" with ``edge_weight`` given.
+    """
+    src = np.asarray(src, dtype=np.uint32)
+    dst = np.asarray(dst, dtype=np.uint32)
+    e_num = src.shape[0]
+
+    out_degree = np.bincount(src, minlength=v_num).astype(np.int32)
+    in_degree = np.bincount(dst, minlength=v_num).astype(np.int32)
+
+    if weight == "gcn_norm":
+        w = gcn_norm_weights(src, dst, out_degree, in_degree)
+    elif weight == "ones":
+        w = np.ones(e_num, dtype=np.float32)
+    elif weight == "custom":
+        if edge_weight is None:
+            raise ValueError("custom weight requires edge_weight")
+        w = np.asarray(edge_weight, dtype=np.float32)
+    else:
+        raise ValueError(f"unknown weight mode {weight}")
+
+    # CSC: stable sort by dst so each vertex's in-edges are contiguous and
+    # dst_of_edge is globally non-decreasing (segment-sum friendly).
+    csc_perm = np.argsort(dst, kind="stable")
+    csc_src = src[csc_perm]
+    csc_dst = dst[csc_perm]
+    column_offset = np.zeros(v_num + 1, dtype=np.int64)
+    np.cumsum(in_degree, out=column_offset[1:])
+
+    # CSR: stable sort by src.
+    csr_perm = np.argsort(src, kind="stable")
+    csr_src = src[csr_perm]
+    csr_dst = dst[csr_perm]
+    row_offset = np.zeros(v_num + 1, dtype=np.int64)
+    np.cumsum(out_degree, out=row_offset[1:])
+
+    return CSCGraph(
+        v_num=v_num,
+        e_num=e_num,
+        column_offset=column_offset,
+        row_indices=csc_src.astype(np.int32),
+        dst_of_edge=csc_dst.astype(np.int32),
+        edge_weight_forward=w[csc_perm],
+        row_offset=row_offset,
+        column_indices=csr_dst.astype(np.int32),
+        src_of_edge=csr_src.astype(np.int32),
+        edge_weight_backward=w[csr_perm],
+        out_degree=out_degree,
+        in_degree=in_degree,
+    )
+
+
+def partition_offsets(
+    v_num: int,
+    in_degree: np.ndarray,
+    partitions: int,
+    alpha: Optional[float] = None,
+    page_size: int = 1,
+) -> np.ndarray:
+    """Locality-aware contiguous vertex partition boundaries.
+
+    Balances ``edges + alpha * |V|`` per partition with
+    ``alpha = 12 * (partitions + 1)`` by default and page-aligned boundaries —
+    the reference's chunking scheme (graph.hpp:408, :1186-1211, PAGESIZE
+    alignment :1203). Returns offsets of shape [partitions + 1].
+    """
+    if alpha is None:
+        alpha = 12.0 * (partitions + 1)
+    weights = in_degree.astype(np.float64) + alpha
+    cum = np.concatenate([[0.0], np.cumsum(weights)])
+    total = cum[-1]
+    offsets = np.zeros(partitions + 1, dtype=np.int64)
+    offsets[partitions] = v_num
+    for p in range(1, partitions):
+        target = total * p / partitions
+        pos = int(np.searchsorted(cum, target))
+        if page_size > 1:
+            pos = (pos // page_size) * page_size
+        pos = min(max(pos, offsets[p - 1]), v_num)
+        offsets[p] = pos
+    return offsets

@@ -1,0 +1,80 @@
+"""Synthetic graph generators for tests and benchmarks.
+
+The reference benchmarks on Reddit (V=232965, |E|~=114.6M binary edges,
+gcn_reddit_full.cfg) but ships only the conversion scripts, not the data.
+For benchmarking at the same scale we generate a power-law graph with matching
+vertex/edge counts, plus a small community (planted-partition) graph whose
+labels are recoverable by a GCN — the accuracy-convergence oracle the
+reference gets from Cora (SURVEY.md section 4.7).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+
+def synthetic_power_law_graph(
+    v_num: int, e_num: int, seed: int = 0, self_loops: bool = True
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Edge list with power-law-ish degree distribution (preferential-attachment
+    flavored, vectorized): endpoints drawn from a Zipf-like distribution over
+    vertices. Returns (src, dst) uint32 arrays, self-loops appended when asked
+    (the reference trains on `.edge.self` files which include them)."""
+    rng = np.random.default_rng(seed)
+    n_rand = e_num - (v_num if self_loops else 0)
+    if n_rand < 0:
+        raise ValueError("e_num smaller than self-loop count")
+    # Zipf-ish endpoint sampling via inverse-CDF on u^a mapping; a<1 skews mass
+    # toward low vertex ids, giving hub vertices like real social graphs.
+    a = 3.0
+    src = (v_num * rng.random(n_rand) ** a).astype(np.uint32)
+    dst = (v_num * rng.random(n_rand) ** a).astype(np.uint32)
+    # random permutation of vertex ids decorrelates hubs from partition ranges
+    perm = rng.permutation(v_num).astype(np.uint32)
+    src, dst = perm[src], perm[dst]
+    if self_loops:
+        loops = np.arange(v_num, dtype=np.uint32)
+        src = np.concatenate([src, loops])
+        dst = np.concatenate([dst, loops])
+    return src, dst
+
+
+def planted_partition_graph(
+    v_num: int,
+    classes: int,
+    avg_degree: float,
+    p_in: float = 0.9,
+    feature_size: int = 16,
+    feature_noise: float = 1.0,
+    seed: int = 0,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Community graph + noisy class-indicator features.
+
+    Returns (src, dst, feature [V,f], label [V]). Within-class edges with
+    probability mass p_in; features are a class embedding + Gaussian noise, so
+    a 2-layer GCN reaches high accuracy quickly — the convergence oracle.
+    """
+    rng = np.random.default_rng(seed)
+    label = rng.integers(0, classes, size=v_num, dtype=np.int32)
+    e_num = int(v_num * avg_degree)
+    src = rng.integers(0, v_num, size=e_num, dtype=np.uint32)
+    same = rng.random(e_num) < p_in
+    # choose dst: same-class vertex when `same` else uniform
+    by_class = [np.where(label == c)[0] for c in range(classes)]
+    dst = rng.integers(0, v_num, size=e_num, dtype=np.uint32)
+    for c in range(classes):
+        idx = np.where(same & (label[src] == c))[0]
+        members = by_class[c]
+        if len(members) and len(idx):
+            dst[idx] = members[rng.integers(0, len(members), size=len(idx))]
+    loops = np.arange(v_num, dtype=np.uint32)
+    src = np.concatenate([src, loops])
+    dst = np.concatenate([dst, loops])
+
+    class_emb = rng.standard_normal((classes, feature_size)).astype(np.float32)
+    feature = class_emb[label] + feature_noise * rng.standard_normal(
+        (v_num, feature_size)
+    ).astype(np.float32)
+    return src, dst, feature, label

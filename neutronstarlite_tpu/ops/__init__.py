@@ -1,0 +1,29 @@
+from neutronstarlite_tpu.ops.device_graph import DeviceGraph
+from neutronstarlite_tpu.ops.aggregate import (
+    gather_dst_from_src,
+    gather_src_from_dst,
+    aggregate_dst_max,
+    aggregate_dst_min,
+)
+from neutronstarlite_tpu.ops.edge import (
+    scatter_src_to_edge,
+    scatter_dst_to_edge,
+    scatter_src_dst_to_edge,
+    aggregate_edge_to_dst,
+    aggregate_edge_to_dst_weighted,
+    edge_softmax,
+)
+
+__all__ = [
+    "DeviceGraph",
+    "gather_dst_from_src",
+    "gather_src_from_dst",
+    "aggregate_dst_max",
+    "aggregate_dst_min",
+    "scatter_src_to_edge",
+    "scatter_dst_to_edge",
+    "scatter_src_dst_to_edge",
+    "aggregate_edge_to_dst",
+    "aggregate_edge_to_dst_weighted",
+    "edge_softmax",
+]

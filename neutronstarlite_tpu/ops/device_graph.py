@@ -1,0 +1,110 @@
+"""HBM-resident graph representation — the device twin of CSCGraph.
+
+Reference: CSC_segment_pinned's GPU twins (``*_gpu`` arrays,
+core/GraphSegment.cpp:78-115 pinned alloc, :178-212 CopyGraphToDevice).
+On TPU there is no pinned/device distinction — the arrays live in HBM and the
+structure is a JAX pytree so it can flow through jit/shard_map unchanged.
+
+Edge arrays are padded to a multiple of the edge-chunk size so the chunked
+aggregation loop (ops/aggregate.py) sees static shapes. Padded edges carry
+weight 0 and mask 0 and point at vertex 0, so weighted sums ignore them;
+masked ops (edge softmax, min/max) use ``edge_mask``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neutronstarlite_tpu.graph.storage import CSCGraph
+
+# Default edge-chunk length for the blocked aggregation loop. 256Ki edges
+# keeps the per-chunk gathered activation block (chunk x feature) well under
+# 1 GB for feature widths up to ~1k while amortizing scan overhead.
+DEFAULT_EDGE_CHUNK = 1 << 18
+
+
+def _pad_to(arr: np.ndarray, n: int, fill) -> np.ndarray:
+    if arr.shape[0] == n:
+        return arr
+    out = np.full((n,) + arr.shape[1:], fill, dtype=arr.dtype)
+    out[: arr.shape[0]] = arr
+    return out
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class DeviceGraph:
+    """Dual CSC/CSR edge arrays on device.
+
+    CSC view (dst-sorted, forward aggregation):
+      ``csc_src``  [Ep] source vertex of each edge
+      ``csc_dst``  [Ep] destination (non-decreasing)
+      ``csc_weight`` [Ep] forward edge weight (0 on padding)
+    CSR view (src-sorted, backward/gradient push):
+      ``csr_src``  [Ep] source (non-decreasing)
+      ``csr_dst``  [Ep] destination
+      ``csr_weight`` [Ep]
+    ``edge_mask`` [Ep] 1.0 on real edges, 0.0 on padding (CSC order).
+    ``in_degree`` / ``out_degree`` [V] float32 (zero-clamped available via ops).
+    """
+
+    csc_src: jax.Array
+    csc_dst: jax.Array
+    csc_weight: jax.Array
+    csr_src: jax.Array
+    csr_dst: jax.Array
+    csr_weight: jax.Array
+    edge_mask: jax.Array
+    in_degree: jax.Array
+    out_degree: jax.Array
+    v_num: int = dataclasses.field(metadata=dict(static=True))
+    e_num: int = dataclasses.field(metadata=dict(static=True))
+    edge_chunk: int = dataclasses.field(metadata=dict(static=True))
+
+    @property
+    def e_pad(self) -> int:
+        return self.csc_src.shape[0]
+
+    @property
+    def num_chunks(self) -> int:
+        return self.e_pad // self.edge_chunk
+
+    @staticmethod
+    def from_host(
+        g: CSCGraph,
+        edge_chunk: Optional[int] = None,
+        dtype=jnp.float32,
+    ) -> "DeviceGraph":
+        """Ship a host CSCGraph to device, padding edge arrays to a chunk
+        multiple (CopyGraphToDevice analog)."""
+        if edge_chunk is None:
+            edge_chunk = min(DEFAULT_EDGE_CHUNK, max(128, int(g.e_num)))
+        e_pad = ((g.e_num + edge_chunk - 1) // edge_chunk) * edge_chunk
+        e_pad = max(e_pad, edge_chunk)
+
+        mask = np.zeros(e_pad, dtype=np.float32)
+        mask[: g.e_num] = 1.0
+
+        return DeviceGraph(
+            csc_src=jnp.asarray(_pad_to(g.row_indices, e_pad, 0)),
+            csc_dst=jnp.asarray(_pad_to(g.dst_of_edge, e_pad, 0)),
+            csc_weight=jnp.asarray(
+                _pad_to(g.edge_weight_forward, e_pad, 0.0), dtype=dtype
+            ),
+            csr_src=jnp.asarray(_pad_to(g.src_of_edge, e_pad, 0)),
+            csr_dst=jnp.asarray(_pad_to(g.column_indices, e_pad, 0)),
+            csr_weight=jnp.asarray(
+                _pad_to(g.edge_weight_backward, e_pad, 0.0), dtype=dtype
+            ),
+            edge_mask=jnp.asarray(mask),
+            in_degree=jnp.asarray(g.in_degree, dtype=jnp.float32),
+            out_degree=jnp.asarray(g.out_degree, dtype=jnp.float32),
+            v_num=int(g.v_num),
+            e_num=int(g.e_num),
+            edge_chunk=int(edge_chunk),
+        )

@@ -1,0 +1,122 @@
+"""Edge-level operators: V->E scatter, E->V aggregate, per-dst edge softmax.
+
+TPU counterparts of the reference's edge-op family used by the GAT/GIN chains:
+
+- ``scatter_src_to_edge`` / ``scatter_dst_to_edge`` / ``scatter_src_dst_to_edge``
+  mirror SingleCPUSrcScatterOp / DistScatterSrc / DistScatterDst /
+  SingleCPUSrcDstScatterOp (core/ntsSingleCPUGraphOp.hpp:34/:94,
+  core/ntsDistCPUGraphOp.hpp:127/:186). V->E gather; the autodiff transpose is
+  the scatter-add the reference hand-writes as the backward.
+- ``aggregate_edge_to_dst`` mirrors SingleCPUDstAggregateOp /
+  DistAggregateDst (E->V sum; backward broadcasts the gradient to edges).
+- ``aggregate_edge_to_dst_weighted`` is the two-input op
+  DistAggregateDstFuseWeight (core/ntsDistCPUGraphOp.hpp:499): out[dst] +=
+  w_e * x[src]; gradients flow to BOTH the edge weights (dot product, :581,
+  the reference returns it via get_additional_grad) and the features — jax
+  autodiff produces exactly that pair from the einsum form.
+- ``edge_softmax`` mirrors SingleEdgeSoftMax / DistEdgeSoftMax /
+  edge_softmax_forward_block (core/ntsSingleCPUGraphOp.hpp:343,
+  cuda/ntsCUDADistKernel.cuh:100): per-destination softmax over incident-edge
+  scores, with the softmax-Jacobian backward s*(g - sum_dst(s*g)) hand-paired
+  via custom_vjp (reference backward at ntsSingleCPUGraphOp.hpp:397).
+
+All edge tensors are in CSC (dst-sorted) order and padded; ``edge_mask``
+zeroes padding so softmax normalization and scatters ignore it.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from neutronstarlite_tpu.ops.device_graph import DeviceGraph
+from neutronstarlite_tpu.ops.segment import (
+    segment_max_sorted,
+    segment_sum_sorted,
+    zero_cotangent,
+)
+
+
+def scatter_src_to_edge(graph: DeviceGraph, x: jax.Array) -> jax.Array:
+    """[V, f] -> [Ep, f]: edge e gets x[src(e)] (zero on padding)."""
+    return x[graph.csc_src] * graph.edge_mask[:, None].astype(x.dtype)
+
+
+def scatter_dst_to_edge(graph: DeviceGraph, x: jax.Array) -> jax.Array:
+    """[V, f] -> [Ep, f]: edge e gets x[dst(e)] (zero on padding)."""
+    return x[graph.csc_dst] * graph.edge_mask[:, None].astype(x.dtype)
+
+
+def scatter_src_dst_to_edge(graph: DeviceGraph, x: jax.Array) -> jax.Array:
+    """[V, f] -> [Ep, 2f]: edge e gets [x[src(e)] || x[dst(e)]] — the 2f-wide
+    layout of SingleCPUSrcDstScatterOp."""
+    return jnp.concatenate(
+        [scatter_src_to_edge(graph, x), scatter_dst_to_edge(graph, x)], axis=1
+    )
+
+
+def aggregate_edge_to_dst(graph: DeviceGraph, edge_vals: jax.Array) -> jax.Array:
+    """[Ep, f] -> [V, f]: out[v] = sum of edge_vals over in-edges of v."""
+    masked = edge_vals * graph.edge_mask[:, None].astype(edge_vals.dtype)
+    return segment_sum_sorted(masked, graph.csc_dst, graph.v_num)
+
+
+def aggregate_edge_to_dst_weighted(
+    graph: DeviceGraph, edge_weight: jax.Array, x: jax.Array
+) -> jax.Array:
+    """Two-input op: out[v] = sum over in-edges e of edge_weight[e] * x[src(e)].
+
+    ``edge_weight`` is [Ep] or [Ep, 1]. Differentiable in both inputs
+    (DistAggregateDstFuseWeight semantics incl. its get_additional_grad path).
+    """
+    if edge_weight.ndim == 1:
+        edge_weight = edge_weight[:, None]
+    vals = x[graph.csc_src] * edge_weight * graph.edge_mask[:, None].astype(x.dtype)
+    return segment_sum_sorted(vals, graph.csc_dst, graph.v_num)
+
+
+def _edge_softmax_impl(v_num, csc_dst, mask, score):
+    neg = jnp.asarray(-jnp.inf, dtype=score.dtype)
+    masked = jnp.where(mask[:, None] > 0, score, neg)
+    m = segment_max_sorted(masked, csc_dst, v_num)
+    m = jnp.where(jnp.isfinite(m), m, 0.0)  # vertices with no in-edges
+    e = jnp.exp(masked - m[csc_dst])
+    e = jnp.where(mask[:, None] > 0, e, 0.0)
+    denom = segment_sum_sorted(e, csc_dst, v_num)
+    denom = jnp.maximum(denom, jnp.asarray(1e-38, dtype=score.dtype))
+    return e / denom[csc_dst]
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _edge_softmax(v_num, csc_dst, mask, score):
+    return _edge_softmax_impl(v_num, csc_dst, mask, score)
+
+
+def _edge_softmax_fwd(v_num, csc_dst, mask, score):
+    s = _edge_softmax_impl(v_num, csc_dst, mask, score)
+    return s, (csc_dst, mask, s)
+
+
+def _edge_softmax_bwd(v_num, res, g):
+    csc_dst, mask, s = res
+    # softmax Jacobian per destination segment: ds = s * (g - sum_seg(s*g))
+    sg = s * g
+    tot = segment_sum_sorted(sg, csc_dst, v_num)
+    grad = s * (g - tot[csc_dst])
+    grad = grad * mask[:, None].astype(grad.dtype)
+    return (zero_cotangent(csc_dst), zero_cotangent(mask), grad)
+
+
+_edge_softmax.defvjp(_edge_softmax_fwd, _edge_softmax_bwd)
+
+
+def edge_softmax(graph: DeviceGraph, score: jax.Array) -> jax.Array:
+    """[Ep, h] -> [Ep, h]: per-destination softmax over incident-edge scores
+    (h = attention heads). Numerically stabilized by per-segment max."""
+    squeeze = score.ndim == 1
+    if squeeze:
+        score = score[:, None]
+    out = _edge_softmax(graph.v_num, graph.csc_dst, graph.edge_mask, score)
+    return out[:, 0] if squeeze else out

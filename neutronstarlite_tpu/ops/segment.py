@@ -1,0 +1,37 @@
+"""Segment-op helpers shared by the aggregation/edge operators."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def zero_cotangent(x):
+    """Zero cotangent for a primal of any dtype (float0 for integer arrays) —
+    used by custom_vjp backwards whose extra operands (graph indices/weights)
+    carry no gradient."""
+    if jnp.issubdtype(jnp.result_type(x), jnp.floating) or jnp.issubdtype(
+        jnp.result_type(x), jnp.complexfloating
+    ):
+        return jnp.zeros_like(x)
+    return np.zeros(jnp.shape(x), dtype=jax.dtypes.float0)
+
+
+def segment_sum_sorted(data, segment_ids, num_segments):
+    """segment_sum with the sorted-indices promise (CSC/CSR order gives it)."""
+    return jax.ops.segment_sum(
+        data, segment_ids, num_segments=num_segments, indices_are_sorted=True
+    )
+
+
+def segment_max_sorted(data, segment_ids, num_segments):
+    return jax.ops.segment_max(
+        data, segment_ids, num_segments=num_segments, indices_are_sorted=True
+    )
+
+
+def segment_min_sorted(data, segment_ids, num_segments):
+    return jax.ops.segment_min(
+        data, segment_ids, num_segments=num_segments, indices_are_sorted=True
+    )

@@ -1,0 +1,12 @@
+from neutronstarlite_tpu.utils.config import InputInfo, GNNContext, RuntimeInfo
+from neutronstarlite_tpu.utils.logging import get_logger
+from neutronstarlite_tpu.utils.timing import Timer, PhaseTimers
+
+__all__ = [
+    "InputInfo",
+    "GNNContext",
+    "RuntimeInfo",
+    "get_logger",
+    "Timer",
+    "PhaseTimers",
+]

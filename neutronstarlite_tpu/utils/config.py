@@ -1,0 +1,231 @@
+"""Flat KEY:VALUE config system, compatible with the reference .cfg format.
+
+Reference: ``InputInfo::readFromCfgFile`` (core/GraphSegment.cpp:222-292) parses
+a flat file of ``KEY:VALUE`` lines; ``Graph::init_gnnctx[_fanout]``
+(core/graph.hpp:293-336) parses the dash-separated LAYERS / FANOUT strings;
+``RuntimeInfo`` (core/GraphSegment.h:148) carries the per-run execution flags.
+
+This module keeps the exact same on-disk format (the reference's shipped
+``gcn_cora.cfg`` etc. parse unchanged) but the runtime flags map to TPU
+concepts: PROC_CUDA becomes a generic "accelerate" switch, PROC_OVERLAP keeps
+its meaning (overlap ring communication with aggregation), and partitioning is
+taken from the JAX mesh rather than an MPI world size.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import List, Optional
+
+
+@dataclasses.dataclass
+class GNNContext:
+    """Layer-size / fan-out metadata (reference: GNNContext, GraphSegment.h:175)."""
+
+    layer_size: List[int] = dataclasses.field(default_factory=list)
+    fanout: List[int] = dataclasses.field(default_factory=list)
+    label_num: int = 0
+    p_id: int = 0
+    p_v_s: int = 0
+    p_v_e: int = 0
+
+    @property
+    def max_layer(self) -> int:
+        return len(self.layer_size) - 1
+
+
+@dataclasses.dataclass
+class RuntimeInfo:
+    """Execution flags (reference: RuntimeInfo, GraphSegment.h:148-174)."""
+
+    process_local: bool = False
+    process_overlap: bool = False
+    with_weight: bool = True
+    with_cuda: bool = False  # kept for cfg compat; on TPU: "use accelerator"
+    process_rep: bool = False
+    reduce_comm: bool = False
+    copy_data: bool = False
+    lock_free: bool = False
+    optim_kernel_enable: bool = False
+    epoch: int = -1
+    curr_layer: int = -1
+    embedding_size: int = -1
+
+
+_INT_KEYS = {"VERTICES", "EPOCHS", "BATCH_SIZE", "DECAY_EPOCH"}
+_FLOAT_KEYS = {"LEARN_RATE", "WEIGHT_DECAY", "DECAY_RATE", "DROP_RATE"}
+_BOOL_KEYS = {
+    "PROC_OVERLAP",
+    "PROC_LOCAL",
+    "PROC_CUDA",
+    "PROC_REP",
+    "LOCK_FREE",
+    "OPTIM_KERNEL",
+}
+_STR_KEYS = {
+    "ALGORITHM",
+    "EDGE_FILE",
+    "FEATURE_FILE",
+    "LABEL_FILE",
+    "MASK_FILE",
+    "LAYERS",
+    "FANOUT",
+}
+
+
+@dataclasses.dataclass
+class InputInfo:
+    """Parsed config (reference: InputInfo, GraphSegment.h:186-220)."""
+
+    algorithm: str = ""
+    vertices: int = 0
+    epochs: int = 10
+    batch_size: int = 64
+    layer_string: str = ""
+    fanout_string: str = ""
+    edge_file: str = ""
+    feature_file: str = ""
+    label_file: str = ""
+    mask_file: str = ""
+    learn_rate: float = 0.01
+    weight_decay: float = 0.0001
+    decay_rate: float = 0.97
+    decay_epoch: int = 100
+    drop_rate: float = 0.5
+    process_overlap: bool = False
+    process_local: bool = False
+    with_cuda: bool = False
+    process_rep: bool = False
+    lock_free: bool = False
+    optim_kernel: bool = False
+    # nts-tpu extensions (default values keep reference cfgs parsing unchanged)
+    partitions: int = 0  # 0 = use all devices in the mesh
+    precision: str = "float32"  # or "bfloat16" for the aggregation path
+
+    @staticmethod
+    def read_from_cfg_file(path: str) -> "InputInfo":
+        """Parse a flat KEY:VALUE cfg file (GraphSegment.cpp:222-292)."""
+        cfg = InputInfo()
+        with open(path, "r") as fh:
+            for raw in fh:
+                line = raw.strip()
+                if not line or line.startswith("#"):
+                    continue
+                if ":" not in line:
+                    continue
+                key, _, value = line.partition(":")
+                key = key.strip().upper()
+                value = value.strip()
+                cfg._apply(key, value)
+        return cfg
+
+    # keep the reference's camel-ish name available too
+    readFromCfgFile = read_from_cfg_file
+
+    def _apply(self, key: str, value: str) -> None:
+        if key == "ALGORITHM":
+            self.algorithm = value
+        elif key == "VERTICES":
+            self.vertices = int(value)
+        elif key == "EPOCHS":
+            self.epochs = int(value)
+        elif key == "BATCH_SIZE":
+            self.batch_size = int(value)
+        elif key == "LAYERS":
+            self.layer_string = value
+        elif key == "FANOUT":
+            self.fanout_string = value
+        elif key == "EDGE_FILE":
+            self.edge_file = value
+        elif key == "FEATURE_FILE":
+            self.feature_file = value
+        elif key == "LABEL_FILE":
+            self.label_file = value
+        elif key == "MASK_FILE":
+            self.mask_file = value
+        elif key == "LEARN_RATE":
+            self.learn_rate = float(value)
+        elif key == "WEIGHT_DECAY":
+            self.weight_decay = float(value)
+        elif key == "DECAY_RATE":
+            self.decay_rate = float(value)
+        elif key == "DECAY_EPOCH":
+            self.decay_epoch = int(value)
+        elif key == "DROP_RATE":
+            self.drop_rate = float(value)
+        elif key == "PROC_OVERLAP":
+            self.process_overlap = bool(int(value))
+        elif key == "PROC_LOCAL":
+            self.process_local = bool(int(value))
+        elif key == "PROC_CUDA":
+            self.with_cuda = bool(int(value))
+        elif key == "PROC_REP":
+            self.process_rep = bool(int(value))
+        elif key == "LOCK_FREE":
+            self.lock_free = bool(int(value))
+        elif key == "OPTIM_KERNEL":
+            self.optim_kernel = bool(int(value))
+        elif key == "PARTITIONS":
+            self.partitions = int(value)
+        elif key == "PRECISION":
+            self.precision = value
+        # unknown keys ignored, matching the reference's else-silence
+
+    def layer_sizes(self) -> List[int]:
+        """Parse "1433-128-7" -> [1433, 128, 7] (graph.hpp:293-318)."""
+        if not self.layer_string:
+            return []
+        return [int(tok) for tok in self.layer_string.split("-") if tok]
+
+    def fanouts(self) -> List[int]:
+        """Parse "5-10-10" -> [5, 10, 10] (graph.hpp:319-336)."""
+        if not self.fanout_string:
+            return []
+        return [int(tok) for tok in self.fanout_string.split("-") if tok]
+
+    def gnn_context(self) -> GNNContext:
+        sizes = self.layer_sizes()
+        return GNNContext(layer_size=sizes, fanout=self.fanouts())
+
+    def runtime_info(self) -> RuntimeInfo:
+        return RuntimeInfo(
+            process_local=self.process_local,
+            process_overlap=self.process_overlap,
+            with_cuda=self.with_cuda,
+            process_rep=self.process_rep,
+            lock_free=self.lock_free,
+            optim_kernel_enable=self.optim_kernel,
+            epoch=self.epochs,
+        )
+
+    def resolve_path(self, path: str, base_dir: Optional[str] = None) -> str:
+        """Resolve data paths relative to the cfg file's directory."""
+        if os.path.isabs(path) or not base_dir:
+            return path
+        return os.path.normpath(os.path.join(base_dir, path))
+
+    def print(self) -> str:
+        """Config echo (reference: InputInfo::print, GraphSegment.cpp:294-318)."""
+        lines = [
+            f"ALGORITHM: {self.algorithm}",
+            f"VERTICES: {self.vertices}",
+            f"LAYERS: {self.layer_string}",
+            f"FANOUT: {self.fanout_string}",
+            f"EPOCHS: {self.epochs}",
+            f"BATCH_SIZE: {self.batch_size}",
+            f"EDGE_FILE: {self.edge_file}",
+            f"FEATURE_FILE: {self.feature_file}",
+            f"LABEL_FILE: {self.label_file}",
+            f"MASK_FILE: {self.mask_file}",
+            f"LEARN_RATE: {self.learn_rate}",
+            f"WEIGHT_DECAY: {self.weight_decay}",
+            f"DECAY_RATE: {self.decay_rate}",
+            f"DECAY_EPOCH: {self.decay_epoch}",
+            f"DROP_RATE: {self.drop_rate}",
+            f"PROC_OVERLAP: {int(self.process_overlap)}",
+            f"PROC_LOCAL: {int(self.process_local)}",
+            f"PROC_CUDA: {int(self.with_cuda)}",
+            f"LOCK_FREE: {int(self.lock_free)}",
+        ]
+        return "\n".join(lines)

@@ -1,0 +1,45 @@
+"""Leveled logging (reference: comm/logger.h LOG_ERROR/WARN/INFO/DEBUG/TRACE).
+
+The reference uses compile-time-leveled printf macros; here a thin wrapper over
+the stdlib logger keeps the same level vocabulary and a similar one-line format,
+controlled by the NTS_LOG_LEVEL environment variable.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_LEVELS = {
+    "ERROR": logging.ERROR,
+    "WARN": logging.WARNING,
+    "INFO": logging.INFO,
+    "DEBUG": logging.DEBUG,
+    "TRACE": logging.DEBUG,  # stdlib has no TRACE; map to DEBUG
+}
+
+_configured = False
+
+
+def _configure() -> None:
+    global _configured
+    if _configured:
+        return
+    level = _LEVELS.get(os.environ.get("NTS_LOG_LEVEL", "INFO").upper(), logging.INFO)
+    handler = logging.StreamHandler(sys.stdout)
+    handler.setFormatter(
+        logging.Formatter("[%(levelname)s] %(asctime)s %(name)s - %(message)s", "%H:%M:%S")
+    )
+    root = logging.getLogger("nts")
+    root.setLevel(level)
+    root.addHandler(handler)
+    root.propagate = False
+    _configured = True
+
+
+def get_logger(name: str = "nts") -> logging.Logger:
+    _configure()
+    if name == "nts":
+        return logging.getLogger("nts")
+    return logging.getLogger(f"nts.{name}")

@@ -1,0 +1,74 @@
+"""Per-phase wall-clock accumulators and the DEBUGINFO-style report.
+
+Reference: the Graph timer fields (core/graph.hpp:210-222) and each toolkit's
+``DEBUGINFO()`` breakdown of compute / copy / wait / comm time
+(toolkits/GCN.hpp:308-353). On TPU the async dispatch model means host-side
+wall-clock only bounds a phase; for kernel-level truth use
+``jax.profiler.trace`` (see neutronstarlite_tpu.utils.profiling).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from typing import Dict, Iterator
+
+
+def get_time() -> float:
+    """Monotonic seconds (reference: dep/gemini/time.hpp get_time)."""
+    return time.perf_counter()
+
+
+class Timer:
+    """Accumulating timer: ``t.start(); ...; t.stop()`` sums elapsed time."""
+
+    def __init__(self) -> None:
+        self.total = 0.0
+        self._t0 = 0.0
+        self.count = 0
+
+    def start(self) -> None:
+        self._t0 = get_time()
+
+    def stop(self) -> float:
+        dt = get_time() - self._t0
+        self.total += dt
+        self.count += 1
+        return dt
+
+    def reset(self) -> None:
+        self.total = 0.0
+        self.count = 0
+
+
+class PhaseTimers:
+    """Named phase accumulators + DEBUGINFO-style report (GCN.hpp:308-353)."""
+
+    def __init__(self) -> None:
+        self._timers: Dict[str, Timer] = defaultdict(Timer)
+
+    @contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        t = self._timers[name]
+        t.start()
+        try:
+            yield
+        finally:
+            t.stop()
+
+    def total(self, name: str) -> float:
+        return self._timers[name].total
+
+    def report(self) -> str:
+        lines = ["--------------------finish algorithm !"]
+        for name, t in sorted(self._timers.items()):
+            avg = t.total / max(t.count, 1)
+            lines.append(
+                f"#{name}_time={t.total * 1000:.3f}(ms) count={t.count} avg={avg * 1000:.3f}(ms)"
+            )
+        return "\n".join(lines)
+
+    def reset(self) -> None:
+        for t in self._timers.values():
+            t.reset()
