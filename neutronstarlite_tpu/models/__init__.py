@@ -1,0 +1,4 @@
+from neutronstarlite_tpu.models.base import ToolkitBase, register_algorithm, get_algorithm
+import neutronstarlite_tpu.models.gcn  # noqa: F401  (registers GCN variants)
+
+__all__ = ["ToolkitBase", "register_algorithm", "get_algorithm"]

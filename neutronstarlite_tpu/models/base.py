@@ -1,0 +1,154 @@
+"""Toolkit base: the init_graph / init_nn / run lifecycle every model follows.
+
+Reference: each toolkit (toolkits/GCN_CPU.hpp etc.) implements
+``init_graph()`` (build partitioned graph + context), ``init_nn()`` (read
+hyperparams, load GNNDatum, create Parameters), and ``run()`` (epoch loop:
+Forward, Test(0/1/2), Loss, backward, Update), registered by ALGORITHM string
+in toolkits/main.cpp:53-187. This base class reproduces that lifecycle; the
+device placement difference disappears (XLA runs on whatever jax.devices()
+offers), so reference names like GCNCPU and GCN (GPU) map to the same
+TPU implementation — the registry accepts all of them.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, Optional, Type
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neutronstarlite_tpu.graph.dataset import GNNDatum
+from neutronstarlite_tpu.graph.storage import CSCGraph, build_graph, load_edges_binary
+from neutronstarlite_tpu.ops.device_graph import DeviceGraph
+from neutronstarlite_tpu.utils.config import InputInfo
+from neutronstarlite_tpu.utils.logging import get_logger
+from neutronstarlite_tpu.utils.timing import PhaseTimers
+
+log = get_logger("models")
+
+_REGISTRY: Dict[str, Type["ToolkitBase"]] = {}
+
+
+def register_algorithm(*names: str):
+    """Register a toolkit under its ALGORITHM string(s) (main.cpp:53-187)."""
+
+    def deco(cls):
+        for n in names:
+            _REGISTRY[n.upper()] = cls
+        return cls
+
+    return deco
+
+
+def get_algorithm(name: str) -> Type["ToolkitBase"]:
+    try:
+        return _REGISTRY[name.upper()]
+    except KeyError:
+        raise KeyError(
+            f"unknown ALGORITHM {name!r}; known: {sorted(_REGISTRY)}"
+        ) from None
+
+
+class ToolkitBase:
+    """Shared lifecycle: graph + datum loading, accuracy reporting, timing."""
+
+    # subclasses override: edge-weight mode for the aggregation operator
+    weight_mode = "gcn_norm"
+
+    def __init__(self, cfg: InputInfo, base_dir: Optional[str] = None, seed: int = 0):
+        self.cfg = cfg
+        self.base_dir = base_dir
+        self.seed = seed
+        self.timers = PhaseTimers()
+        self.host_graph: Optional[CSCGraph] = None
+        self.graph: Optional[DeviceGraph] = None
+        self.datum: Optional[GNNDatum] = None
+        self.epoch_times = []
+
+    # ---- init_graph ------------------------------------------------------
+    def init_graph(self) -> None:
+        cfg = self.cfg
+        edge_path = cfg.resolve_path(cfg.edge_file, self.base_dir)
+        with self.timers.phase("graph_load"):
+            src, dst = load_edges_binary(edge_path)
+            self.host_graph = build_graph(
+                src, dst, cfg.vertices, weight=self.weight_mode
+            )
+            self.graph = DeviceGraph.from_host(self.host_graph)
+        log.info(
+            "loaded graph |V|=%d |E|=%d avg_deg=%.1f",
+            self.host_graph.v_num,
+            self.host_graph.e_num,
+            self.host_graph.avg_degree,
+        )
+
+    # ---- init_nn ---------------------------------------------------------
+    def init_nn(self) -> None:
+        cfg = self.cfg
+        sizes = cfg.layer_sizes()
+        with self.timers.phase("datum_load"):
+            self.datum = GNNDatum.read_feature_label_mask(
+                cfg.resolve_path(cfg.feature_file, self.base_dir),
+                cfg.resolve_path(cfg.label_file, self.base_dir),
+                cfg.resolve_path(cfg.mask_file, self.base_dir),
+                cfg.vertices,
+                sizes[0],
+                seed=self.seed,
+            )
+        self._finalize_datum()
+
+    def _finalize_datum(self) -> None:
+        self.feature = jnp.asarray(self.datum.feature)
+        self.label = jnp.asarray(self.datum.label.astype(np.int32))
+        self.mask = jnp.asarray(self.datum.mask)
+        self.build_model()
+
+    @classmethod
+    def from_arrays(
+        cls,
+        cfg: InputInfo,
+        src: np.ndarray,
+        dst: np.ndarray,
+        datum: GNNDatum,
+        seed: int = 0,
+    ) -> "ToolkitBase":
+        """Construct directly from in-memory edge list + datum (tests/bench)."""
+        t = cls(cfg, seed=seed)
+        t.host_graph = build_graph(src, dst, cfg.vertices, weight=cls.weight_mode)
+        t.graph = DeviceGraph.from_host(t.host_graph)
+        t.datum = datum
+        t._finalize_datum()
+        return t
+
+    def build_model(self) -> None:
+        raise NotImplementedError
+
+    # ---- accuracy / loss helpers ----------------------------------------
+    @staticmethod
+    def masked_nll_loss(logits: jax.Array, label: jax.Array, mask01: jax.Array):
+        """nll_loss on masked log_softmax (GCN_CPU.hpp:187-196)."""
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        picked = jnp.take_along_axis(logp, label[:, None], axis=-1)[:, 0]
+        denom = jnp.maximum(mask01.sum(), 1.0)
+        return -(picked * mask01).sum() / denom
+
+    def test(self, logits: np.ndarray, which: int) -> float:
+        """Accuracy over mask class `which` (Test(0/1/2), GCN_CPU.hpp:142-171)."""
+        sel = self.datum.mask == which
+        n = int(sel.sum())
+        if n == 0:
+            return 0.0
+        correct = int((logits[sel].argmax(axis=1) == self.datum.label[sel]).sum())
+        acc = correct / n
+        name = {0: "Train", 1: "Eval", 2: "Test"}[which]
+        log.info("%s Acc: %f %d %d", name, acc, n, correct)
+        return acc
+
+    # ---- run -------------------------------------------------------------
+    def run(self):
+        raise NotImplementedError
+
+    def report(self) -> str:
+        return self.timers.report()

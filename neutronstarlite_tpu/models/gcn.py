@@ -1,0 +1,156 @@
+"""GCN toolkits: full-batch GCN and the EAGER (transform-then-propagate) variant.
+
+Reference: toolkits/GCN_CPU.hpp / GCN.hpp — per layer a fused graph op
+(ForwardCPUfuseOp / ForwardGPUfuseOp: normalized neighbor aggregation) followed
+by the NN op ``dropout(relu(W * bn(n)))`` (last layer: just ``W``)
+(GCN_CPU.hpp:215-228); loss is nll on masked log_softmax (:187-196); update is
+gradient allreduce + hand-rolled Adam (:198-206). The EAGER variants
+(GCN_CPU_EAGER.hpp:200-206) swap the order: NN first, then aggregation.
+
+TPU design: the whole epoch is one jitted step — aggregation (chunked
+segment-sum with custom_vjp, ops/aggregate.py), matmuls on the MXU, jax.grad
+through the tape the reference hand-maintains (ntsContext.hpp:276-356), and
+Adam fused in. Single-chip here; the distributed version is
+models/gcn_dist.py via parallel/.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neutronstarlite_tpu.models.base import ToolkitBase, register_algorithm
+from neutronstarlite_tpu.nn.layers import batch_norm_apply, batch_norm_init, dropout
+from neutronstarlite_tpu.nn.param import (
+    AdamConfig,
+    adam_init,
+    adam_update,
+    xavier_uniform,
+)
+from neutronstarlite_tpu.ops.aggregate import gather_dst_from_src
+from neutronstarlite_tpu.utils.logging import get_logger
+from neutronstarlite_tpu.utils.timing import get_time
+
+log = get_logger("gcn")
+
+
+def init_gcn_params(key, sizes: List[int], with_bn: bool = True):
+    params = []
+    for i in range(len(sizes) - 1):
+        key, sub = jax.random.split(key)
+        layer: Dict[str, Any] = {"W": xavier_uniform(sub, sizes[i], sizes[i + 1])}
+        if with_bn and i < len(sizes) - 2:
+            layer["bn"] = batch_norm_init(sizes[i])
+        params.append(layer)
+    return params
+
+
+def gcn_forward(
+    graph,
+    params,
+    x,
+    key,
+    drop_rate: float,
+    train: bool,
+    eager: bool = False,
+):
+    """Logits for all vertices. ``eager`` swaps aggregate/NN order."""
+    n_layers = len(params)
+    for i, layer in enumerate(params):
+        last = i == n_layers - 1
+
+        def nn(h):
+            if last:
+                return h @ layer["W"]
+            h = batch_norm_apply(layer["bn"], h) if "bn" in layer else h
+            h = jax.nn.relu(h @ layer["W"])
+            return dropout(jax.random.fold_in(key, i), h, drop_rate, train)
+
+        if eager:
+            x = gather_dst_from_src(graph, nn(x))
+        else:
+            x = nn(gather_dst_from_src(graph, x))
+    return x
+
+
+@register_algorithm("GCNCPU", "GCN", "GCNTPU")
+class GCNTrainer(ToolkitBase):
+    weight_mode = "gcn_norm"
+    eager = False
+    with_bn = True
+
+    def build_model(self) -> None:
+        cfg = self.cfg
+        sizes = cfg.layer_sizes()
+        key = jax.random.PRNGKey(self.seed)
+        self.params = init_gcn_params(key, sizes, with_bn=self.with_bn)
+        self.adam_cfg = AdamConfig(
+            alpha=cfg.learn_rate,
+            weight_decay=cfg.weight_decay,
+            decay_rate=cfg.decay_rate,
+            decay_epoch=cfg.decay_epoch,
+        )
+        self.opt_state = adam_init(self.params)
+        train_mask01 = jnp.asarray((self.datum.mask == 0).astype(np.float32))
+        drop_rate = cfg.drop_rate
+        eager = self.eager
+        masked_nll = self.masked_nll_loss
+
+        @jax.jit
+        def train_step(params, opt_state, graph, feature, label, key):
+            def loss_fn(p):
+                logits = gcn_forward(
+                    graph, p, feature, key, drop_rate, True, eager=eager
+                )
+                return masked_nll(logits, label, train_mask01), logits
+
+            (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            params, opt_state = adam_update(params, grads, opt_state, self.adam_cfg)
+            return params, opt_state, loss, logits
+
+        @jax.jit
+        def eval_logits(params, graph, feature, key):
+            return gcn_forward(graph, params, feature, key, 0.0, False, eager=eager)
+
+        self._train_step = train_step
+        self._eval_logits = eval_logits
+
+    def run(self) -> Dict[str, Any]:
+        cfg = self.cfg
+        key = jax.random.PRNGKey(self.seed + 1)
+        log.info("GNNmini::Engine[TPU.GCNimpl] running [%d] Epochs", cfg.epochs)
+        loss = None
+        for epoch in range(cfg.epochs):
+            ekey = jax.random.fold_in(key, epoch)
+            t0 = get_time()
+            self.params, self.opt_state, loss, logits = self._train_step(
+                self.params, self.opt_state, self.graph, self.feature, self.label, ekey
+            )
+            jax.block_until_ready(loss)
+            self.epoch_times.append(get_time() - t0)
+            if epoch % max(1, cfg.epochs // 20) == 0 or epoch == cfg.epochs - 1:
+                log.info("Epoch %d loss %f", epoch, float(loss))
+
+        logits = np.asarray(
+            self._eval_logits(self.params, self.graph, self.feature, key)
+        )
+        accs = {
+            "train": self.test(logits, 0),
+            "eval": self.test(logits, 1),
+            "test": self.test(logits, 2),
+        }
+        avg = float(np.mean(self.epoch_times[1:])) if len(self.epoch_times) > 1 else 0.0
+        log.info("--avg epoch time %.4f s (first %.2f s incl. compile)",
+                 avg, self.epoch_times[0] if self.epoch_times else 0.0)
+        return {"loss": float(loss), "acc": accs, "avg_epoch_s": avg}
+
+
+@register_algorithm("GCNCPUEAGER", "GCNEAGER", "GCNEAGERSINGLE", "GCN_CPU_EAGER")
+class GCNEagerTrainer(GCNTrainer):
+    """Transform-then-propagate order (GCN_CPU_EAGER.hpp:200-206)."""
+
+    eager = True

@@ -1,0 +1,21 @@
+from neutronstarlite_tpu.nn.param import (
+    AdamConfig,
+    AdamState,
+    adam_init,
+    adam_update,
+    sgd_update,
+    xavier_uniform,
+)
+from neutronstarlite_tpu.nn.layers import batch_norm_init, batch_norm_apply, dropout
+
+__all__ = [
+    "AdamConfig",
+    "AdamState",
+    "adam_init",
+    "adam_update",
+    "sgd_update",
+    "xavier_uniform",
+    "batch_norm_init",
+    "batch_norm_apply",
+    "dropout",
+]

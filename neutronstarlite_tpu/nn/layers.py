@@ -1,0 +1,40 @@
+"""NN-op building blocks used by the toolkits: batchnorm, dropout.
+
+Reference: the toolkits' vertexForward closures apply
+``drpmodel(relu(W * bn1d(x)))`` on hidden layers (toolkits/GCN_CPU.hpp:215-228)
+with torch::nn::BatchNorm1d and torch::nn::Dropout. Matmul/relu need no
+wrappers in JAX; batchnorm and dropout are provided here as pure functions.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+
+def batch_norm_init(width: int) -> Dict[str, jax.Array]:
+    return {
+        "gamma": jnp.ones((width,), jnp.float32),
+        "beta": jnp.zeros((width,), jnp.float32),
+    }
+
+
+def batch_norm_apply(
+    p: Dict[str, jax.Array], x: jax.Array, eps: float = 1e-5
+) -> jax.Array:
+    """Full-batch batchnorm over the vertex axis (training-mode statistics;
+    the reference's full-batch toolkits never switch BN to eval mode either)."""
+    mean = jnp.mean(x, axis=0, keepdims=True)
+    var = jnp.var(x, axis=0, keepdims=True)
+    xn = (x - mean) * jax.lax.rsqrt(var + eps)
+    return xn * p["gamma"] + p["beta"]
+
+
+def dropout(key: jax.Array, x: jax.Array, rate: float, train: bool) -> jax.Array:
+    if not train or rate <= 0.0:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0)

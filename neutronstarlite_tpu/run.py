@@ -1,0 +1,40 @@
+"""CLI dispatcher: ``python -m neutronstarlite_tpu.run file.cfg``.
+
+Reference: toolkits/main.cpp:34-199 — reads the cfg, loads the graph, and
+dispatches on the ALGORITHM string. The reference launches under
+``mpiexec -np N`` (run_nts.sh); here distribution comes from the JAX mesh
+(all visible devices by default, or PARTITIONS:n in the cfg).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+from neutronstarlite_tpu.models import get_algorithm
+from neutronstarlite_tpu.utils.config import InputInfo
+from neutronstarlite_tpu.utils.logging import get_logger
+
+log = get_logger("main")
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    if len(argv) < 1:
+        print("usage: python -m neutronstarlite_tpu.run <config.cfg>", file=sys.stderr)
+        return 2
+    cfg_path = argv[0]
+    cfg = InputInfo.read_from_cfg_file(cfg_path)
+    print(cfg.print())
+    cls = get_algorithm(cfg.algorithm)
+    toolkit = cls(cfg, base_dir=os.path.dirname(os.path.abspath(cfg_path)))
+    toolkit.init_graph()
+    toolkit.init_nn()
+    result = toolkit.run()
+    print(toolkit.report())
+    log.info("result: %s", result)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
