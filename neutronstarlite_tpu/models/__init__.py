@@ -1,4 +1,5 @@
 from neutronstarlite_tpu.models.base import ToolkitBase, register_algorithm, get_algorithm
 import neutronstarlite_tpu.models.gcn  # noqa: F401  (registers GCN variants)
+import neutronstarlite_tpu.models.gcn_dist  # noqa: F401  (registers GCNDIST)
 
 __all__ = ["ToolkitBase", "register_algorithm", "get_algorithm"]

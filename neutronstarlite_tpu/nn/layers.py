@@ -22,12 +22,21 @@ def batch_norm_init(width: int) -> Dict[str, jax.Array]:
 
 
 def batch_norm_apply(
-    p: Dict[str, jax.Array], x: jax.Array, eps: float = 1e-5
+    p: Dict[str, jax.Array], x: jax.Array, eps: float = 1e-5, valid_mask=None
 ) -> jax.Array:
     """Full-batch batchnorm over the vertex axis (training-mode statistics;
-    the reference's full-batch toolkits never switch BN to eval mode either)."""
-    mean = jnp.mean(x, axis=0, keepdims=True)
-    var = jnp.var(x, axis=0, keepdims=True)
+    the reference's full-batch toolkits never switch BN to eval mode either).
+
+    ``valid_mask`` [V] excludes padded vertex rows from the statistics in the
+    distributed (padded-shard) layout."""
+    if valid_mask is None:
+        mean = jnp.mean(x, axis=0, keepdims=True)
+        var = jnp.var(x, axis=0, keepdims=True)
+    else:
+        m = valid_mask[:, None].astype(x.dtype)
+        n = jnp.maximum(m.sum(), 1.0)
+        mean = (x * m).sum(axis=0, keepdims=True) / n
+        var = (jnp.square(x - mean) * m).sum(axis=0, keepdims=True) / n
     xn = (x - mean) * jax.lax.rsqrt(var + eps)
     return xn * p["gamma"] + p["beta"]
 

@@ -41,16 +41,20 @@ from neutronstarlite_tpu.ops.segment import (
 )
 
 
-def _scatter_accumulate(src, dst, weight, x, v_num: int, edge_chunk: int, acc_dtype):
+def _scatter_accumulate(
+    src, dst, weight, x, v_num: int, edge_chunk: int, acc_dtype, acc=None
+):
     """sum over edges of weight_e * x[src_e] into [v_num, f], chunked.
 
     ``src``/``dst``/``weight`` are [Ep] with Ep a multiple of edge_chunk and
-    indices sorted by ``dst``.
+    indices sorted by ``dst``. An existing accumulator may be passed (the
+    distributed ring adds one partial per ring step into the same output).
     """
     e_pad = src.shape[0]
     f = x.shape[1]
     n_chunks = e_pad // edge_chunk
-    acc = jnp.zeros((v_num, f), dtype=acc_dtype)
+    if acc is None:
+        acc = jnp.zeros((v_num, f), dtype=acc_dtype)
 
     if n_chunks <= 1:
         vals = x[src] * weight[:, None].astype(x.dtype)

@@ -1,0 +1,16 @@
+from neutronstarlite_tpu.parallel.mesh import make_mesh, PARTITION_AXIS
+from neutronstarlite_tpu.parallel.dist_graph import DistGraph
+from neutronstarlite_tpu.parallel.dist_ops import (
+    dist_gather_dst_from_src,
+    replicated,
+    vertex_sharded,
+)
+
+__all__ = [
+    "make_mesh",
+    "PARTITION_AXIS",
+    "DistGraph",
+    "dist_gather_dst_from_src",
+    "replicated",
+    "vertex_sharded",
+]

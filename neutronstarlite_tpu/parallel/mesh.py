@@ -1,0 +1,27 @@
+"""Device mesh construction — the TPU analog of the MPI world.
+
+Reference: MPI_Instance RAII init (dep/gemini/mpi.hpp:48) and the
+partitions/rank topology carried by Graph (core/graph.hpp:98-105). Here the
+"world" is a 1-D jax.sharding.Mesh over the partition axis ``p``; ICI
+collectives replace the MPI ring. Multi-host scale-out keeps the same axis —
+jax.distributed + a larger mesh, no code change in the ops.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+PARTITION_AXIS = "p"
+
+
+def make_mesh(partitions: Optional[int] = None) -> Mesh:
+    """1-D mesh over the first ``partitions`` visible devices (default: all)."""
+    devices = jax.devices()
+    n = partitions or len(devices)
+    if n > len(devices):
+        raise ValueError(f"requested {n} partitions but only {len(devices)} devices")
+    return Mesh(np.asarray(devices[:n]), (PARTITION_AXIS,))

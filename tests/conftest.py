@@ -16,6 +16,14 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import jax  # noqa: E402
+
+# A TPU-plugin sitecustomize (if present) may have pinned jax_platforms to the
+# accelerator platform before this file runs; the config value overrides the
+# env var, so force it back to cpu — otherwise every test would initialize the
+# accelerator client.
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
