@@ -1,0 +1,111 @@
+"""North-star benchmark: GCN full-batch epoch time at Reddit scale.
+
+Workload (BASELINE.md / gcn_reddit_full.cfg): V=232,965, |E|~=114.6M edges
+(8-byte binary edges incl. self loops), layers 602-128-41, full-batch training
+epochs. The reference dataset itself isn't shipped (only conversion scripts),
+so the graph is synthesized at the same scale with a power-law degree
+distribution (graph/synthetic.py) — same |V|, |E|, feature width, layer
+widths, loss, and optimizer as the reference config.
+
+Metric: epoch time (forward + backward + Adam update, full graph). Derived
+metric: aggregated edges/sec/chip = |E| * layers * 2 / (epoch_time * chips)
+(BASELINE.md). vs_baseline: the reference publishes no numbers
+(BASELINE.json.published == {}); per BASELINE.json the target is "v5e-8 epoch
+time <= the 8-worker CUDA baseline". We document the assumption
+BASELINE_EPOCH_S = 1.0 s for the 8-worker CUDA reference on this workload
+(SIGMOD'22-era V100-class numbers are order ~1 s/epoch for Reddit GCN
+full-batch) and report vs_baseline = BASELINE_EPOCH_S / epoch_time, i.e.
+>1.0 means faster than the assumed reference.
+
+Usage: python bench.py [--scale S] [--epochs N]
+Prints ONE JSON line: {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_EPOCH_S = 1.0  # assumed 8-worker CUDA reference epoch time (see above)
+
+REDDIT_V = 232965
+REDDIT_E = 114615892  # ~8-byte binary edges incl. self loops (data/README.md)
+LAYERS = "602-128-41"
+N_LABELS = 41
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=float, default=1.0, help="graph size multiplier")
+    ap.add_argument("--epochs", type=int, default=5)
+    ap.add_argument("--warmup", type=int, default=2)
+    args = ap.parse_args(argv)
+
+    import jax
+
+    from neutronstarlite_tpu.graph.dataset import GNNDatum
+    from neutronstarlite_tpu.graph.storage import build_graph
+    from neutronstarlite_tpu.graph.synthetic import synthetic_power_law_graph
+    from neutronstarlite_tpu.models.gcn import GCNTrainer
+    from neutronstarlite_tpu.utils.config import InputInfo
+
+    v_num = max(int(REDDIT_V * args.scale), 64)
+    e_num = max(int(REDDIT_E * args.scale), 512)
+
+    t0 = time.time()
+    src, dst = synthetic_power_law_graph(v_num, e_num, seed=7)
+    sizes = [int(s) for s in LAYERS.split("-")]
+    datum = GNNDatum.random_generate(v_num, sizes[0], N_LABELS, seed=7)
+    gen_s = time.time() - t0
+
+    cfg = InputInfo()
+    cfg.algorithm = "GCNCPU"
+    cfg.vertices = v_num
+    cfg.layer_string = LAYERS
+    cfg.epochs = args.warmup + args.epochs
+    cfg.learn_rate = 0.01
+    cfg.weight_decay = 0.0001
+    cfg.decay_epoch = -1
+    cfg.drop_rate = 0.5
+
+    t0 = time.time()
+    trainer = GCNTrainer.from_arrays(cfg, src, dst, datum)
+    build_s = time.time() - t0
+
+    result = trainer.run()
+    times = trainer.epoch_times[args.warmup :]
+    epoch_s = float(np.median(times))
+
+    n_chips = 1
+    layers = len(sizes) - 1
+    edges_per_sec_per_chip = e_num * layers * 2 / (epoch_s * n_chips)
+
+    out = {
+        "metric": "gcn_reddit_full_batch_epoch_time",
+        "value": round(epoch_s, 4),
+        "unit": "s",
+        "vs_baseline": round(BASELINE_EPOCH_S / epoch_s, 3),
+        "extra": {
+            "v_num": v_num,
+            "e_num": e_num,
+            "layers": LAYERS,
+            "scale": args.scale,
+            "chips": n_chips,
+            "edges_per_sec_per_chip": round(edges_per_sec_per_chip, 0),
+            "final_loss": result["loss"],
+            "graph_gen_s": round(gen_s, 1),
+            "graph_build_s": round(build_s, 1),
+            "device": str(jax.devices()[0]),
+            "baseline_assumption_s": BASELINE_EPOCH_S,
+        },
+    }
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
