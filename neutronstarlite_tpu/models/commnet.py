@@ -1,0 +1,56 @@
+"""CommNet toolkit: aggregate + communication-step NN.
+
+Reference (toolkits/COMMNET_GPU.hpp:181-198): per layer two Parameters C and H
+(both [d_l, d_{l+1}], :118-122) combined as
+``y = relu(C . agg + H . x)`` — the "communication step" mixes the neighbor
+aggregate with the vertex's own hidden state.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+
+from neutronstarlite_tpu.models.base import register_algorithm
+from neutronstarlite_tpu.models.fullbatch import FullBatchTrainer
+from neutronstarlite_tpu.nn.layers import dropout
+from neutronstarlite_tpu.nn.param import xavier_uniform
+from neutronstarlite_tpu.ops.aggregate import gather_dst_from_src
+
+
+def init_commnet_params(key, sizes: List[int]):
+    params = []
+    for i in range(len(sizes) - 1):
+        key, k1, k2 = jax.random.split(key, 3)
+        params.append(
+            {
+                "C": xavier_uniform(k1, sizes[i], sizes[i + 1]),
+                "H": xavier_uniform(k2, sizes[i], sizes[i + 1]),
+            }
+        )
+    return params
+
+
+def commnet_forward(graph, params, x, key, drop_rate: float, train: bool):
+    n = len(params)
+    for i, layer in enumerate(params):
+        agg = gather_dst_from_src(graph, x)
+        h = jax.nn.relu(agg @ layer["C"] + x @ layer["H"])
+        if train and i < n - 1:
+            h = dropout(jax.random.fold_in(key, i), h, drop_rate, train)
+        x = h
+    return x
+
+
+@register_algorithm("COMMNETGPU", "COMMNETCPU", "COMMNET")
+class CommNetTrainer(FullBatchTrainer):
+    weight_mode = "gcn_norm"
+
+    def init_params(self, key):
+        return init_commnet_params(key, self.cfg.layer_sizes())
+
+    def model_forward(self, params, x, key, train):
+        return commnet_forward(
+            self.graph, params, x, key, self.cfg.drop_rate if train else 0.0, train
+        )

@@ -1,0 +1,100 @@
+"""Shared full-batch trainer: jitted train step + epoch loop.
+
+Every full-batch toolkit in the reference repeats the same run() skeleton
+(epoch loop: Forward, Test(0/1/2), Loss, self_backward, Update — e.g.
+GCN_CPU.hpp:232-259, GAT_CPU.hpp, GIN_CPU.hpp). Here the skeleton lives once;
+models supply ``init_params`` and ``model_forward``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neutronstarlite_tpu.models.base import ToolkitBase
+from neutronstarlite_tpu.nn.param import AdamConfig, adam_init, adam_update
+from neutronstarlite_tpu.utils.logging import get_logger
+from neutronstarlite_tpu.utils.timing import get_time
+
+log = get_logger("fullbatch")
+
+
+class FullBatchTrainer(ToolkitBase):
+    """Template for single-mesh full-batch models (GCN/GAT/GIN/CommNet...)."""
+
+    def init_params(self, key):
+        raise NotImplementedError
+
+    def model_forward(self, params, x, key, train: bool):
+        """[V, f0] -> [V, n_classes] logits."""
+        raise NotImplementedError
+
+    def build_model(self) -> None:
+        cfg = self.cfg
+        key = jax.random.PRNGKey(self.seed)
+        self.params = self.init_params(key)
+        self.adam_cfg = AdamConfig(
+            alpha=cfg.learn_rate,
+            weight_decay=cfg.weight_decay,
+            decay_rate=cfg.decay_rate,
+            decay_epoch=cfg.decay_epoch,
+        )
+        self.opt_state = adam_init(self.params)
+        train_mask01 = jnp.asarray((self.datum.mask == 0).astype(np.float32))
+        masked_nll = self.masked_nll_loss
+        model_forward = self.model_forward
+        adam_cfg = self.adam_cfg
+
+        @jax.jit
+        def train_step(params, opt_state, feature, label, key):
+            def loss_fn(p):
+                logits = model_forward(p, feature, key, True)
+                return masked_nll(logits, label, train_mask01), logits
+
+            (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            params, opt_state = adam_update(params, grads, opt_state, adam_cfg)
+            return params, opt_state, loss, logits
+
+        @jax.jit
+        def eval_logits(params, feature, key):
+            return model_forward(params, feature, key, False)
+
+        self._train_step = train_step
+        self._eval_logits = eval_logits
+
+    def run(self) -> Dict[str, Any]:
+        cfg = self.cfg
+        key = jax.random.PRNGKey(self.seed + 1)
+        log.info(
+            "GNNmini::Engine[TPU.%s] running [%d] Epochs",
+            type(self).__name__,
+            cfg.epochs,
+        )
+        loss = None
+        for epoch in range(cfg.epochs):
+            ekey = jax.random.fold_in(key, epoch)
+            t0 = get_time()
+            self.params, self.opt_state, loss, _ = self._train_step(
+                self.params, self.opt_state, self.feature, self.label, ekey
+            )
+            jax.block_until_ready(loss)
+            self.epoch_times.append(get_time() - t0)
+            if epoch % max(1, cfg.epochs // 20) == 0 or epoch == cfg.epochs - 1:
+                log.info("Epoch %d loss %f", epoch, float(loss))
+
+        logits = np.asarray(self._eval_logits(self.params, self.feature, key))
+        accs = {
+            "train": self.test(logits, 0),
+            "eval": self.test(logits, 1),
+            "test": self.test(logits, 2),
+        }
+        avg = float(np.mean(self.epoch_times[1:])) if len(self.epoch_times) > 1 else 0.0
+        log.info(
+            "--avg epoch time %.4f s (first %.2f s incl. compile)",
+            avg,
+            self.epoch_times[0] if self.epoch_times else 0.0,
+        )
+        return {"loss": float(loss), "acc": accs, "avg_epoch_s": avg}

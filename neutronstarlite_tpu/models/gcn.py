@@ -23,17 +23,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from neutronstarlite_tpu.models.base import ToolkitBase, register_algorithm
+from neutronstarlite_tpu.models.base import register_algorithm
+from neutronstarlite_tpu.models.fullbatch import FullBatchTrainer
 from neutronstarlite_tpu.nn.layers import batch_norm_apply, batch_norm_init, dropout
-from neutronstarlite_tpu.nn.param import (
-    AdamConfig,
-    adam_init,
-    adam_update,
-    xavier_uniform,
-)
+from neutronstarlite_tpu.nn.param import xavier_uniform
 from neutronstarlite_tpu.ops.aggregate import gather_dst_from_src
 from neutronstarlite_tpu.utils.logging import get_logger
-from neutronstarlite_tpu.utils.timing import get_time
 
 log = get_logger("gcn")
 
@@ -78,75 +73,19 @@ def gcn_forward(
 
 
 @register_algorithm("GCNCPU", "GCN", "GCNTPU")
-class GCNTrainer(ToolkitBase):
+class GCNTrainer(FullBatchTrainer):
     weight_mode = "gcn_norm"
     eager = False
     with_bn = True
 
-    def build_model(self) -> None:
-        cfg = self.cfg
-        sizes = cfg.layer_sizes()
-        key = jax.random.PRNGKey(self.seed)
-        self.params = init_gcn_params(key, sizes, with_bn=self.with_bn)
-        self.adam_cfg = AdamConfig(
-            alpha=cfg.learn_rate,
-            weight_decay=cfg.weight_decay,
-            decay_rate=cfg.decay_rate,
-            decay_epoch=cfg.decay_epoch,
+    def init_params(self, key):
+        return init_gcn_params(key, self.cfg.layer_sizes(), with_bn=self.with_bn)
+
+    def model_forward(self, params, x, key, train):
+        return gcn_forward(
+            self.graph, params, x, key,
+            self.cfg.drop_rate if train else 0.0, train, eager=self.eager,
         )
-        self.opt_state = adam_init(self.params)
-        train_mask01 = jnp.asarray((self.datum.mask == 0).astype(np.float32))
-        drop_rate = cfg.drop_rate
-        eager = self.eager
-        masked_nll = self.masked_nll_loss
-
-        @jax.jit
-        def train_step(params, opt_state, graph, feature, label, key):
-            def loss_fn(p):
-                logits = gcn_forward(
-                    graph, p, feature, key, drop_rate, True, eager=eager
-                )
-                return masked_nll(logits, label, train_mask01), logits
-
-            (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
-            params, opt_state = adam_update(params, grads, opt_state, self.adam_cfg)
-            return params, opt_state, loss, logits
-
-        @jax.jit
-        def eval_logits(params, graph, feature, key):
-            return gcn_forward(graph, params, feature, key, 0.0, False, eager=eager)
-
-        self._train_step = train_step
-        self._eval_logits = eval_logits
-
-    def run(self) -> Dict[str, Any]:
-        cfg = self.cfg
-        key = jax.random.PRNGKey(self.seed + 1)
-        log.info("GNNmini::Engine[TPU.GCNimpl] running [%d] Epochs", cfg.epochs)
-        loss = None
-        for epoch in range(cfg.epochs):
-            ekey = jax.random.fold_in(key, epoch)
-            t0 = get_time()
-            self.params, self.opt_state, loss, logits = self._train_step(
-                self.params, self.opt_state, self.graph, self.feature, self.label, ekey
-            )
-            jax.block_until_ready(loss)
-            self.epoch_times.append(get_time() - t0)
-            if epoch % max(1, cfg.epochs // 20) == 0 or epoch == cfg.epochs - 1:
-                log.info("Epoch %d loss %f", epoch, float(loss))
-
-        logits = np.asarray(
-            self._eval_logits(self.params, self.graph, self.feature, key)
-        )
-        accs = {
-            "train": self.test(logits, 0),
-            "eval": self.test(logits, 1),
-            "test": self.test(logits, 2),
-        }
-        avg = float(np.mean(self.epoch_times[1:])) if len(self.epoch_times) > 1 else 0.0
-        log.info("--avg epoch time %.4f s (first %.2f s incl. compile)",
-                 avg, self.epoch_times[0] if self.epoch_times else 0.0)
-        return {"loss": float(loss), "acc": accs, "avg_epoch_s": avg}
 
 
 @register_algorithm("GCNCPUEAGER", "GCNEAGER", "GCNEAGERSINGLE", "GCN_CPU_EAGER")

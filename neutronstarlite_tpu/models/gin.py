@@ -1,0 +1,63 @@
+"""GIN toolkit: neighbor aggregation + per-layer 2-matmul MLP.
+
+Reference (toolkits/GIN_CPU.hpp): the same fused aggregation chain as GCN,
+with the GIN vertexForward (GIN_CPU.hpp:176-186):
+hidden layers  y = bn(relu(W2 . relu(W1 . (agg + x))))
+last layer     y = bn(W2 . relu(W1 . (agg + x)))
+i.e. MLP((1 + eps) x + sum-aggregate) with eps = 0 and two Parameters per
+layer (W1 [d_l, d_{l+1}], W2 [d_{l+1}, d_{l+1}], GIN_CPU.hpp:115-118).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+
+from neutronstarlite_tpu.models.base import register_algorithm
+from neutronstarlite_tpu.models.fullbatch import FullBatchTrainer
+from neutronstarlite_tpu.nn.layers import batch_norm_apply, batch_norm_init, dropout
+from neutronstarlite_tpu.nn.param import xavier_uniform
+from neutronstarlite_tpu.ops.aggregate import gather_dst_from_src
+
+
+def init_gin_params(key, sizes: List[int]):
+    params = []
+    for i in range(len(sizes) - 1):
+        key, k1, k2 = jax.random.split(key, 3)
+        params.append(
+            {
+                "W1": xavier_uniform(k1, sizes[i], sizes[i + 1]),
+                "W2": xavier_uniform(k2, sizes[i + 1], sizes[i + 1]),
+                "bn": batch_norm_init(sizes[i + 1]),
+            }
+        )
+    return params
+
+
+def gin_forward(graph, params, x, key, drop_rate: float, train: bool):
+    n = len(params)
+    for i, layer in enumerate(params):
+        agg = gather_dst_from_src(graph, x)
+        h = jax.nn.relu((agg + x) @ layer["W1"])
+        h = h @ layer["W2"]
+        if i < n - 1:
+            h = jax.nn.relu(h)
+        h = batch_norm_apply(layer["bn"], h)
+        if train and i < n - 1:
+            h = dropout(jax.random.fold_in(key, i), h, drop_rate, train)
+        x = h
+    return x
+
+
+@register_algorithm("GINCPU", "GINGPU", "GIN")
+class GINTrainer(FullBatchTrainer):
+    weight_mode = "gcn_norm"  # the shared PartitionedGraph weighting
+
+    def init_params(self, key):
+        return init_gin_params(key, self.cfg.layer_sizes())
+
+    def model_forward(self, params, x, key, train):
+        return gin_forward(
+            self.graph, params, x, key, self.cfg.drop_rate if train else 0.0, train
+        )

@@ -65,6 +65,20 @@ def test_gcn_eager_converges_on_planted_partition():
     assert result["acc"]["test"] > 0.8
 
 
+@pytest.mark.parametrize("algo,min_test_acc", [
+    ("GATCPU", 0.75),
+    ("GINCPU", 0.75),
+    ("COMMNETGPU", 0.8),
+])
+def test_model_family_converges_on_planted_partition(algo, min_test_acc):
+    cfg = _planted_cfg(epochs=80)
+    cfg.algorithm = algo
+    src, dst, datum = _planted_data(seed=7)
+    trainer = get_algorithm(algo).from_arrays(cfg, src, dst, datum)
+    result = trainer.run()
+    assert result["acc"]["test"] > min_test_acc, result
+
+
 @pytest.mark.slow
 def test_gcn_on_real_cora_structure():
     """Real Cora edges/labels/masks, random features (none shipped). Structure
