@@ -4,5 +4,6 @@ import neutronstarlite_tpu.models.gcn_dist  # noqa: F401  (registers GCNDIST)
 import neutronstarlite_tpu.models.gat  # noqa: F401  (registers GAT variants)
 import neutronstarlite_tpu.models.gin  # noqa: F401  (registers GIN variants)
 import neutronstarlite_tpu.models.commnet  # noqa: F401  (registers CommNet)
+import neutronstarlite_tpu.models.gcn_sample  # noqa: F401  (registers GCNSAMPLE)
 
 __all__ = ["ToolkitBase", "register_algorithm", "get_algorithm"]

@@ -1,0 +1,178 @@
+"""Mini-batch sampled GCN (the GCN_CPU_SAMPLE toolkit).
+
+Reference (toolkits/GCN_CPU_SAMPLE.hpp): per epoch, reservoir-sample all
+batches (:191-195); per batch, gather input features/labels by sampled ids,
+run one MiniBatchFuseOp + NN per hop (:208-223), then loss/backward/update
+per batch (:224-229); train/val/test samplers are built from mask nids
+(:251-265). Model sync is only the per-update gradient allreduce (here: the
+replicated-parameter psum under pjit when a mesh is used).
+
+TPU shape discipline: every batch is padded to the same capacities
+(sample/sampler.py), so ``_train_batch`` compiles once and replays for every
+batch of every epoch.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neutronstarlite_tpu.models.base import ToolkitBase, register_algorithm
+from neutronstarlite_tpu.nn.layers import dropout
+from neutronstarlite_tpu.nn.param import (
+    AdamConfig,
+    adam_init,
+    adam_update,
+    xavier_uniform,
+)
+from neutronstarlite_tpu.ops.minibatch import get_feature, get_label, minibatch_gather
+from neutronstarlite_tpu.sample.sampler import SampledBatch, Sampler
+from neutronstarlite_tpu.utils.logging import get_logger
+from neutronstarlite_tpu.utils.timing import get_time
+
+log = get_logger("gcn_sample")
+
+
+def _batch_arrays(b: SampledBatch):
+    """Flatten a SampledBatch into jit-friendly device arrays."""
+    return (
+        [jnp.asarray(n) for n in b.nodes],
+        [(jnp.asarray(h.src_local), jnp.asarray(h.dst_local), jnp.asarray(h.weight))
+         for h in b.hops],
+        jnp.asarray(b.seed_mask),
+        jnp.asarray(b.seeds),
+    )
+
+
+@register_algorithm("GCNSAMPLESINGLE", "GCNSAMPLE", "GCNCPUSAMPLE")
+class GCNSampleTrainer(ToolkitBase):
+    weight_mode = "gcn_norm"
+
+    def build_model(self) -> None:
+        cfg = self.cfg
+        sizes = cfg.layer_sizes()
+        fanouts = cfg.fanouts()
+        if not fanouts:
+            raise ValueError("GCNSAMPLE requires FANOUT in the cfg")
+        # the cfg may list more fanout entries than NN layers (gcn_cora_sample
+        # ships FANOUT:5-10-10 with LAYERS:1433-256-7); use the last n_layers
+        n_layers = len(sizes) - 1
+        self.fanouts = fanouts[-n_layers:]
+        key = jax.random.PRNGKey(self.seed)
+        params = []
+        for i in range(n_layers):
+            key, sub = jax.random.split(key)
+            params.append({"W": xavier_uniform(sub, sizes[i], sizes[i + 1])})
+        self.params = params
+        self.adam_cfg = AdamConfig(
+            alpha=cfg.learn_rate,
+            weight_decay=cfg.weight_decay,
+            decay_rate=cfg.decay_rate,
+            decay_epoch=cfg.decay_epoch,
+        )
+        self.opt_state = adam_init(self.params)
+
+        # train/val/test samplers from mask nids (GCN_CPU_SAMPLE.hpp:251-265)
+        self.samplers = {
+            which: Sampler(
+                self.host_graph,
+                np.where(self.datum.mask == which)[0],
+                cfg.batch_size,
+                self.fanouts,
+                seed=self.seed + which,
+            )
+            for which in (0, 1, 2)
+        }
+        drop_rate = cfg.drop_rate
+        adam_cfg = self.adam_cfg
+        caps = self.samplers[0].node_caps
+
+        def batch_forward(params, feature, nodes, hops, key, train):
+            x = get_feature(feature, nodes[0])
+            for i, (p, (src_l, dst_l, w)) in enumerate(zip(params, hops)):
+                agg = minibatch_gather(src_l, dst_l, w, x, caps[i + 1])
+                h = agg @ p["W"]
+                if i < len(params) - 1:
+                    h = jax.nn.relu(h)
+                    if train:
+                        h = dropout(jax.random.fold_in(key, i), h, drop_rate, train)
+                x = h
+            return x  # [B, n_classes]
+
+        def batch_loss(params, feature, label, nodes, hops, seed_mask, seeds, key):
+            logits = batch_forward(params, feature, nodes, hops, key, True)
+            target = get_label(label, seeds)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            picked = jnp.take_along_axis(logp, target[:, None], axis=-1)[:, 0]
+            return -(picked * seed_mask).sum() / jnp.maximum(seed_mask.sum(), 1.0)
+
+        @jax.jit
+        def train_batch(params, opt_state, feature, label, nodes, hops,
+                        seed_mask, seeds, key):
+            loss, grads = jax.value_and_grad(batch_loss)(
+                params, feature, label, nodes, hops, seed_mask, seeds, key
+            )
+            params, opt_state = adam_update(params, grads, opt_state, adam_cfg)
+            return params, opt_state, loss
+
+        @jax.jit
+        def eval_batch(params, feature, nodes, hops, key):
+            return batch_forward(params, feature, nodes, hops, key, False)
+
+        self._train_batch = train_batch
+        self._eval_batch = eval_batch
+
+    def _evaluate(self, which: int, key) -> float:
+        correct = total = 0
+        for b in self.samplers[which].sample_epoch(shuffle=False):
+            nodes, hops, seed_mask, seeds = _batch_arrays(b)
+            logits = np.asarray(
+                self._eval_batch(self.params, self.feature, nodes, hops, key)
+            )
+            real = b.seed_mask > 0
+            pred = logits.argmax(axis=1)[real]
+            target = self.datum.label[b.seeds[real]]
+            correct += int((pred == target).sum())
+            total += int(real.sum())
+        acc = correct / max(total, 1)
+        name = {0: "Train", 1: "Eval", 2: "Test"}[which]
+        log.info("%s Acc: %f %d %d", name, acc, total, correct)
+        return acc
+
+    def run(self) -> Dict[str, Any]:
+        cfg = self.cfg
+        key = jax.random.PRNGKey(self.seed + 1)
+        log.info(
+            "GNNmini::Engine[TPU.GCNSampleimpl] B=%d fanout=%s [%d] Epochs",
+            cfg.batch_size, self.fanouts, cfg.epochs,
+        )
+        loss = None
+        for epoch in range(cfg.epochs):
+            t0 = get_time()
+            losses = []
+            for bi, b in enumerate(self.samplers[0].sample_epoch()):
+                nodes, hops, seed_mask, seeds = _batch_arrays(b)
+                bkey = jax.random.fold_in(key, epoch * 100003 + bi)
+                self.params, self.opt_state, loss = self._train_batch(
+                    self.params, self.opt_state, self.feature, self.label,
+                    nodes, hops, seed_mask, seeds, bkey,
+                )
+                losses.append(loss)
+            jax.block_until_ready(loss)
+            self.epoch_times.append(get_time() - t0)
+            if epoch % max(1, cfg.epochs // 10) == 0 or epoch == cfg.epochs - 1:
+                log.info(
+                    "Epoch %d loss %f (%d batches)",
+                    epoch, float(np.mean([float(l) for l in losses])), len(losses),
+                )
+        accs = {
+            "train": self._evaluate(0, key),
+            "eval": self._evaluate(1, key),
+            "test": self._evaluate(2, key),
+        }
+        avg = float(np.mean(self.epoch_times[1:])) if len(self.epoch_times) > 1 else 0.0
+        log.info("--avg epoch time %.4f s", avg)
+        return {"loss": float(loss), "acc": accs, "avg_epoch_s": avg}

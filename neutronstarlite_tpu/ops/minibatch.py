@@ -1,0 +1,36 @@
+"""Mini-batch aggregation over sampled subgraphs.
+
+Reference: ``MiniBatchFuseOp`` (core/ntsMiniBatchGraphOp.hpp:61-129): weighted
+gather over a batch-local sampCSC in the forward, weighted scatter-add in the
+backward, plus the ``get_feature``/``get_label`` row gathers (:36-60). Here
+the op is a segment-sum over the padded batch CSC and jax.grad supplies the
+paired scatter; feature/label gathers are plain device indexing.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def minibatch_gather(
+    src_local: jax.Array,
+    dst_local: jax.Array,
+    weight: jax.Array,
+    x: jax.Array,
+    n_dst_cap: int,
+) -> jax.Array:
+    """out[dst] = sum over batch edges of w * x[src].  [Ncap_in, f] ->
+    [n_dst_cap, f]. Padding edges have weight 0 and indices 0."""
+    vals = x[src_local] * weight[:, None].astype(x.dtype)
+    return jax.ops.segment_sum(vals, dst_local, num_segments=n_dst_cap)
+
+
+def get_feature(feature: jax.Array, node_ids: jax.Array) -> jax.Array:
+    """Gather input rows for the innermost sampled layer
+    (ntsMiniBatchGraphOp.hpp:36)."""
+    return feature[node_ids]
+
+
+def get_label(label: jax.Array, seed_ids: jax.Array) -> jax.Array:
+    return label[seed_ids]

@@ -1,0 +1,3 @@
+from neutronstarlite_tpu.sample.sampler import Sampler, SampledBatch
+
+__all__ = ["Sampler", "SampledBatch"]

@@ -1,0 +1,164 @@
+"""Fan-out neighbor sampler producing padded, static-shape batch subgraphs.
+
+Reference: ``Sampler::reservoir_sample`` (core/ntsSampler.hpp:113-172) walks a
+work queue of seed vertices in batches; per layer it reservoir-samples up to
+``fanout[l]`` in-neighbors per destination over the replicated whole-graph CSC
+(``FullyRepGraph``), then ``sampCSC::postprocessing`` dedups and remaps source
+ids to batch-local indices via std::map (core/coocsc.hpp:62-89).
+
+TPU re-design: sampling is host-side vectorized NumPy (per-dst top-fanout by
+random priority == uniform without replacement, the reservoir's distribution),
+and every batch is padded to fixed capacities derived from batch_size x
+fanout products, so the device step compiles ONCE and replays for every batch
+(XLA static shapes; SURVEY.md "hard parts": "pad to fanout capacity ... to
+avoid recompilation"). Padding edges carry weight 0; padding vertices index
+row 0 and are masked out of the loss.
+
+Layer ordering: ``hops[0]`` is the innermost (input) hop; seeds are the
+destinations of the last hop. nodes[0] are the input vertices whose features
+feed the network (``get_feature``'s gather, ntsMiniBatchGraphOp.hpp:36-60).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from neutronstarlite_tpu.graph.storage import CSCGraph
+
+
+@dataclasses.dataclass
+class SampledHop:
+    """One hop's batch-local CSC (the sampCSC analog, coocsc.hpp:26)."""
+
+    src_local: np.ndarray  # [Ecap] index into previous layer's node list
+    dst_local: np.ndarray  # [Ecap] index into this layer's node list
+    weight: np.ndarray  # [Ecap] float32, 0 on padding
+    n_dst: int  # real destination count (<= dst capacity)
+
+
+@dataclasses.dataclass
+class SampledBatch:
+    """Padded multi-hop subgraph for one seed batch."""
+
+    nodes: List[np.ndarray]  # per layer: padded global vertex ids
+    hops: List[SampledHop]  # len == n_layers; hops[l]: nodes[l] -> nodes[l+1]
+    seed_mask: np.ndarray  # [B] 1.0 on real seeds, 0.0 on padding
+    seeds: np.ndarray  # [B] padded global seed ids
+
+
+class Sampler:
+    """Per-epoch batch sampler over a set of seed vertices.
+
+    The reference builds three of these (train/val/test from mask nids,
+    GCN_CPU_SAMPLE.hpp:251-265); do the same here.
+    """
+
+    def __init__(
+        self,
+        graph: CSCGraph,
+        seed_nids: np.ndarray,
+        batch_size: int,
+        fanouts: Sequence[int],
+        seed: int = 0,
+    ):
+        self.graph = graph
+        self.seed_nids = np.asarray(seed_nids, dtype=np.int64)
+        self.batch_size = batch_size
+        # fanouts listed outermost-first in the cfg (FANOUT:5-10-10); hop h
+        # (input -> output) uses fanouts[h] reversed so the seed-adjacent hop
+        # gets the last entry, matching init_gnnctx_fanout's layer indexing.
+        self.fanouts = list(fanouts)
+        self.rng = np.random.default_rng(seed)
+        # per-layer node capacities, seeds outward
+        n_hops = len(self.fanouts)
+        caps = [batch_size]
+        for f in reversed(self.fanouts):
+            caps.append(caps[-1] * f)
+        self.node_caps = list(reversed(caps))  # node_caps[-1] == batch_size
+
+    # -- vectorized per-dst uniform sampling without replacement ----------
+    def _sample_neighbors(self, dsts: np.ndarray, fanout: int):
+        """Return (src, dst_idx) pairs: for each dst, up to ``fanout``
+        distinct in-neighbors chosen uniformly (reservoir distribution)."""
+        g = self.graph
+        deg = g.in_degree[dsts].astype(np.int64)
+        starts = g.column_offset[dsts]
+        total = int(deg.sum())
+        if total == 0:
+            return np.empty(0, np.int64), np.empty(0, np.int64)
+        # candidate edge list: all in-edges of all dsts
+        dst_idx = np.repeat(np.arange(len(dsts)), deg)
+        within = np.arange(total) - np.repeat(np.cumsum(deg) - deg, deg)
+        cand_src = g.row_indices[(np.repeat(starts, deg) + within).astype(np.int64)]
+        # random priority per candidate; take top-fanout within each segment
+        prio = self.rng.random(total)
+        order = np.lexsort((prio, dst_idx))
+        seg_start = np.repeat(np.cumsum(deg) - deg, deg)
+        rank = np.arange(total) - seg_start  # position within segment, post-sort
+        keep = order[rank < fanout]
+        return cand_src[keep].astype(np.int64), dst_idx[keep]
+
+    def _make_batch(self, seeds: np.ndarray) -> SampledBatch:
+        B = self.batch_size
+        n_real = len(seeds)
+        seeds_pad = np.zeros(B, dtype=np.int64)
+        seeds_pad[:n_real] = seeds
+        seed_mask = np.zeros(B, dtype=np.float32)
+        seed_mask[:n_real] = 1.0
+
+        g = self.graph
+        nodes: List[np.ndarray] = [None] * (len(self.fanouts) + 1)
+        hops: List[Optional[SampledHop]] = [None] * len(self.fanouts)
+        nodes[-1] = seeds_pad
+        cur_nodes = seeds  # real (unpadded) dst set, outermost layer
+        cur_count = n_real
+        for h in range(len(self.fanouts) - 1, -1, -1):
+            fanout = self.fanouts[h]
+            src, dst_idx = self._sample_neighbors(cur_nodes, fanout)
+            # dedup + batch-local remap (sampCSC::postprocessing's role,
+            # std::map replaced by np.unique + searchsorted)
+            uniq = np.unique(src)
+            src_local = np.searchsorted(uniq, src)
+            # per-edge weight: full-graph GCN norm (nts_norm_degree over the
+            # original degrees, ntsBaseOp.hpp:194)
+            d_out = np.maximum(g.out_degree[src], 1).astype(np.float64)
+            d_in = np.maximum(g.in_degree[cur_nodes[dst_idx]], 1).astype(np.float64)
+            w = (1.0 / np.sqrt(d_out * d_in)).astype(np.float32)
+
+            ecap = self.node_caps[h + 1] * fanout
+            hop = SampledHop(
+                src_local=_pad(src_local, ecap),
+                dst_local=_pad(dst_idx, ecap),
+                weight=_pad(w, ecap),
+                n_dst=cur_count,
+            )
+            hops[h] = hop
+            ncap = self.node_caps[h]
+            if len(uniq) > ncap:
+                raise AssertionError(
+                    f"hop {h}: {len(uniq)} unique sources exceed capacity {ncap}"
+                )
+            nodes[h] = _pad(uniq, ncap)
+            cur_nodes = uniq
+            cur_count = len(uniq)
+        return SampledBatch(
+            nodes=list(nodes), hops=list(hops), seed_mask=seed_mask, seeds=seeds_pad
+        )
+
+    def sample_epoch(self, shuffle: bool = True):
+        """Yield SampledBatch for every seed batch (the work-queue walk,
+        ntsSampler.hpp:125-137)."""
+        nids = self.seed_nids.copy()
+        if shuffle:
+            self.rng.shuffle(nids)
+        for lo in range(0, len(nids), self.batch_size):
+            yield self._make_batch(nids[lo : lo + self.batch_size])
+
+
+def _pad(arr: np.ndarray, n: int, fill=0) -> np.ndarray:
+    out = np.full((n,) + arr.shape[1:], fill, dtype=arr.dtype)
+    out[: min(len(arr), n)] = arr[:n] if len(arr) > n else arr
+    return out

@@ -1,0 +1,79 @@
+"""Sampler + mini-batch path tests (the testcsr.cpp role, SURVEY.md 4.1)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tests.conftest import tiny_graph
+from neutronstarlite_tpu.graph.dataset import GNNDatum
+from neutronstarlite_tpu.models import get_algorithm
+from neutronstarlite_tpu.models.gcn_sample import GCNSampleTrainer
+from neutronstarlite_tpu.ops.minibatch import minibatch_gather
+from neutronstarlite_tpu.sample.sampler import Sampler
+from neutronstarlite_tpu.utils.config import InputInfo
+from tests.test_models import _planted_cfg, _planted_data
+
+
+def test_sampler_respects_fanout_and_shapes(rng):
+    g, _ = tiny_graph(rng, v_num=80, e_num=600)
+    seeds = rng.choice(80, size=30, replace=False)
+    s = Sampler(g, seeds, batch_size=8, fanouts=[3, 5], seed=1)
+    batches = list(s.sample_epoch())
+    assert len(batches) == 4  # ceil(30/8)
+    for b in batches:
+        # static shapes across batches
+        assert b.seeds.shape == (8,)
+        assert [n.shape[0] for n in b.nodes] == s.node_caps
+        for h, hop in enumerate(b.hops):
+            assert hop.src_local.shape[0] == s.node_caps[h + 1] * s.fanouts[h]
+        # per-dst sampled degree <= fanout
+        for h, hop in enumerate(b.hops):
+            real = hop.weight > 0
+            if real.any():
+                counts = np.bincount(hop.dst_local[real])
+                assert counts.max() <= s.fanouts[h]
+        # sampled edges are real graph edges
+        hop = b.hops[-1]  # seed-adjacent hop
+        real = hop.weight > 0
+        srcs = b.nodes[-2][hop.src_local[real]]
+        dsts = b.nodes[-1][hop.dst_local[real]]
+        edge_set = set(zip(g.row_indices.tolist(), g.dst_of_edge.tolist()))
+        for u, v in zip(srcs, dsts):
+            assert (u, v) in edge_set
+
+
+def test_sampler_full_fanout_equals_exact_aggregation(rng):
+    """With fanout >= max in-degree, one sampled hop must equal the exact
+    weighted neighbor sum (the testcsr ones-tensor check, test/testcsr.cpp)."""
+    g, dense = tiny_graph(rng, v_num=40, e_num=200)
+    seeds = np.arange(40)
+    fan = int(g.in_degree.max())
+    s = Sampler(g, seeds, batch_size=40, fanouts=[fan], seed=0)
+    (b,) = list(s.sample_epoch(shuffle=False))
+    x = rng.standard_normal((40, 6)).astype(np.float32)
+    hop = b.hops[0]
+    x_in = x[b.nodes[0]]
+    out = np.asarray(
+        minibatch_gather(
+            jnp.asarray(hop.src_local), jnp.asarray(hop.dst_local),
+            jnp.asarray(hop.weight), jnp.asarray(x_in), s.node_caps[1],
+        )
+    )
+    expected = dense @ x.astype(np.float64)
+    real = b.seed_mask > 0
+    np.testing.assert_allclose(
+        out[real], expected[b.seeds[real]], rtol=1e-4, atol=1e-4
+    )
+
+
+def test_gcn_sample_converges_on_planted_partition():
+    cfg = _planted_cfg(epochs=30)
+    cfg.algorithm = "GCNSAMPLESINGLE"
+    cfg.fanout_string = "5-5"
+    cfg.batch_size = 32
+    src, dst, datum = _planted_data(seed=11)
+    trainer = GCNSampleTrainer.from_arrays(cfg, src, dst, datum)
+    result = trainer.run()
+    assert result["acc"]["test"] > 0.75, result
+    assert get_algorithm("GCNSAMPLESINGLE") is GCNSampleTrainer
