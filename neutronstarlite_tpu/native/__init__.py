@@ -1,0 +1,142 @@
+"""ctypes bindings for the native preprocessing runtime (libnts_native.so).
+
+Builds the shared library on first use if the toolchain is available
+(one g++ invocation, cached beside this file); everything degrades to the
+NumPy implementations when the library can't be built (NTS_NO_NATIVE=1
+forces the fallback).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional, Tuple
+
+import numpy as np
+
+from neutronstarlite_tpu.utils.logging import get_logger
+
+log = get_logger("native")
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libnts_native.so")
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    src = os.path.join(_DIR, "graph_native.cpp")
+    cmd = [
+        os.environ.get("CXX", "g++"),
+        "-O3", "-march=native", "-fPIC", "-shared", "-fopenmp", "-std=c++17",
+        "-o", _SO, src,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=300)
+        return True
+    except Exception as e:  # toolchain missing / compile error -> fallback
+        log.warning("native build failed (%s); using NumPy fallback", e)
+        return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if os.environ.get("NTS_NO_NATIVE", "0") == "1":
+        return None
+    if not os.path.exists(_SO) and not _build():
+        return None
+    try:
+        lib = ctypes.CDLL(_SO)
+    except OSError as e:
+        log.warning("failed to load %s: %s", _SO, e)
+        return None
+
+    i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    u32p = np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")
+    f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+
+    lib.nts_count_degrees.argtypes = [
+        u32p, u32p, ctypes.c_int64, ctypes.c_int32, i32p, i32p,
+    ]
+    lib.nts_build_adjacency.argtypes = [
+        u32p, u32p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int,
+        i32p, i32p, i64p, i32p, i32p, f32p, i64p, i32p, i32p, f32p,
+    ]
+    lib.nts_sample_hop.argtypes = [
+        i64p, i32p, i64p, ctypes.c_int64, ctypes.c_int32, ctypes.c_uint64,
+        i32p, i32p, i32p,
+    ]
+    lib.nts_native_version.restype = ctypes.c_int
+    _lib = lib
+    log.info("native runtime loaded (v%d)", lib.nts_native_version())
+    return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def build_adjacency(
+    src: np.ndarray, dst: np.ndarray, v_num: int, weight_mode: int
+) -> Tuple[np.ndarray, ...]:
+    """Counting-sort CSC+CSR build. Returns (column_offset, csc_src, csc_dst,
+    csc_w, row_offset, csr_src, csr_dst, csr_w, out_degree, in_degree).
+    Edge order within a vertex's group is unspecified (grouped, dst-/src-
+    sorted across groups) — sufficient for the segment ops' sorted promise."""
+    lib = get_lib()
+    assert lib is not None
+    e_num = src.shape[0]
+    src = np.ascontiguousarray(src, dtype=np.uint32)
+    dst = np.ascontiguousarray(dst, dtype=np.uint32)
+    out_degree = np.empty(v_num, np.int32)
+    in_degree = np.empty(v_num, np.int32)
+    lib.nts_count_degrees(src, dst, e_num, v_num, out_degree, in_degree)
+    column_offset = np.zeros(v_num + 1, np.int64)
+    np.cumsum(in_degree, out=column_offset[1:])
+    row_offset = np.zeros(v_num + 1, np.int64)
+    np.cumsum(out_degree, out=row_offset[1:])
+    csc_src = np.empty(e_num, np.int32)
+    csc_dst = np.empty(e_num, np.int32)
+    csc_w = np.empty(e_num, np.float32)
+    csr_src = np.empty(e_num, np.int32)
+    csr_dst = np.empty(e_num, np.int32)
+    csr_w = np.empty(e_num, np.float32)
+    lib.nts_build_adjacency(
+        src, dst, e_num, v_num, weight_mode, out_degree, in_degree,
+        column_offset, csc_src, csc_dst, csc_w,
+        row_offset, csr_src, csr_dst, csr_w,
+    )
+    return (
+        column_offset, csc_src, csc_dst, csc_w,
+        row_offset, csr_src, csr_dst, csr_w, out_degree, in_degree,
+    )
+
+
+def sample_hop(
+    column_offset: np.ndarray,
+    row_indices: np.ndarray,
+    dsts: np.ndarray,
+    fanout: int,
+    seed: int,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Reservoir fan-out sampling; returns (src, dst_idx) compacted arrays."""
+    lib = get_lib()
+    assert lib is not None
+    n = len(dsts)
+    out_src = np.empty(n * fanout, np.int32)
+    out_dst_idx = np.empty(n * fanout, np.int32)
+    out_counts = np.empty(n, np.int32)
+    lib.nts_sample_hop(
+        np.ascontiguousarray(column_offset, np.int64),
+        np.ascontiguousarray(row_indices, np.int32),
+        np.ascontiguousarray(dsts, np.int64),
+        n, fanout, seed, out_src, out_dst_idx, out_counts,
+    )
+    # compact: keep the first counts[i] entries of each dst's slot
+    keep = (np.arange(n * fanout) % fanout) < np.repeat(out_counts, fanout)
+    return out_src[keep].astype(np.int64), out_dst_idx[keep].astype(np.int64)

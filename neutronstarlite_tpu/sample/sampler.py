@@ -63,10 +63,17 @@ class Sampler:
         batch_size: int,
         fanouts: Sequence[int],
         seed: int = 0,
+        use_native: Optional[bool] = None,
     ):
         self.graph = graph
         self.seed_nids = np.asarray(seed_nids, dtype=np.int64)
         self.batch_size = batch_size
+        if use_native is None:
+            from neutronstarlite_tpu import native
+
+            use_native = native.available()
+        self.use_native = bool(use_native)
+        self._native_seed = seed
         # fanouts listed outermost-first in the cfg (FANOUT:5-10-10); hop h
         # (input -> output) uses fanouts[h] reversed so the seed-adjacent hop
         # gets the last entry, matching init_gnnctx_fanout's layer indexing.
@@ -84,6 +91,14 @@ class Sampler:
         """Return (src, dst_idx) pairs: for each dst, up to ``fanout``
         distinct in-neighbors chosen uniformly (reservoir distribution)."""
         g = self.graph
+        if self.use_native:
+            from neutronstarlite_tpu import native
+
+            self._native_seed += 1
+            return native.sample_hop(
+                g.column_offset, g.row_indices, np.asarray(dsts, np.int64),
+                fanout, self._native_seed,
+            )
         deg = g.in_degree[dsts].astype(np.int64)
         starts = g.column_offset[dsts]
         total = int(deg.sum())
