@@ -65,6 +65,28 @@ class FullBatchTrainer(ToolkitBase):
         self._train_step = train_step
         self._eval_logits = eval_logits
 
+    # ---- checkpoint / resume (SURVEY.md section 5 gap-fill) --------------
+    def checkpoint_state(self):
+        return {"params": self.params, "opt": self.opt_state}
+
+    def save(self, path: str, epoch: int) -> None:
+        from neutronstarlite_tpu.utils.checkpoint import save_checkpoint
+
+        save_checkpoint(path, self.checkpoint_state(), epoch)
+
+    def restore(self, path: str) -> int:
+        """Returns the epoch to resume from (0 when no checkpoint exists)."""
+        from neutronstarlite_tpu.utils.checkpoint import restore_checkpoint
+
+        got = restore_checkpoint(path, self.checkpoint_state())
+        if got is None:
+            return 0
+        state, step = got
+        self.params = jax.tree.map(jnp.asarray, state["params"])
+        self.opt_state = jax.tree.map(jnp.asarray, state["opt"])
+        log.info("restored checkpoint at epoch %d from %s", step, path)
+        return step
+
     def run(self) -> Dict[str, Any]:
         cfg = self.cfg
         key = jax.random.PRNGKey(self.seed + 1)
@@ -73,8 +95,9 @@ class FullBatchTrainer(ToolkitBase):
             type(self).__name__,
             cfg.epochs,
         )
+        start_epoch = self.restore(cfg.checkpoint_dir) if cfg.checkpoint_dir else 0
         loss = None
-        for epoch in range(cfg.epochs):
+        for epoch in range(start_epoch, cfg.epochs):
             ekey = jax.random.fold_in(key, epoch)
             t0 = get_time()
             self.params, self.opt_state, loss, _ = self._train_step(
@@ -84,6 +107,14 @@ class FullBatchTrainer(ToolkitBase):
             self.epoch_times.append(get_time() - t0)
             if epoch % max(1, cfg.epochs // 20) == 0 or epoch == cfg.epochs - 1:
                 log.info("Epoch %d loss %f", epoch, float(loss))
+            if (
+                cfg.checkpoint_dir
+                and cfg.checkpoint_every > 0
+                and (epoch + 1) % cfg.checkpoint_every == 0
+            ):
+                self.save(cfg.checkpoint_dir, epoch + 1)
+        if cfg.checkpoint_dir:
+            self.save(cfg.checkpoint_dir, cfg.epochs)
 
         logits = np.asarray(self._eval_logits(self.params, self.feature, key))
         accs = {

@@ -102,6 +102,8 @@ class InputInfo:
     # nts-tpu extensions (default values keep reference cfgs parsing unchanged)
     partitions: int = 0  # 0 = use all devices in the mesh
     precision: str = "float32"  # or "bfloat16" for the aggregation path
+    checkpoint_dir: str = ""  # enable checkpoint/resume when set
+    checkpoint_every: int = 0  # epochs between checkpoints (0 = end only)
 
     @staticmethod
     def read_from_cfg_file(path: str) -> "InputInfo":
@@ -170,6 +172,10 @@ class InputInfo:
             self.partitions = int(value)
         elif key == "PRECISION":
             self.precision = value
+        elif key == "CHECKPOINT_DIR":
+            self.checkpoint_dir = value
+        elif key == "CHECKPOINT_EVERY":
+            self.checkpoint_every = int(value)
         # unknown keys ignored, matching the reference's else-silence
 
     def layer_sizes(self) -> List[int]:

@@ -1,0 +1,50 @@
+"""Checkpoint/resume tests (gap-fill subsystem, SURVEY.md section 5)."""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from neutronstarlite_tpu.models.gcn import GCNTrainer
+from neutronstarlite_tpu.utils.checkpoint import (
+    dump_vertex_array,
+    restore_checkpoint,
+    restore_vertex_array,
+    save_checkpoint,
+)
+from tests.test_models import _planted_cfg, _planted_data
+
+
+def test_save_restore_roundtrip(tmp_path):
+    state = {
+        "params": [{"W": jnp.arange(6.0).reshape(2, 3)}],
+        "opt": {"m": jnp.zeros((2, 3)), "step": jnp.asarray(5, jnp.int32)},
+    }
+    save_checkpoint(str(tmp_path), state, step=7)
+    got, step = restore_checkpoint(str(tmp_path), state)
+    assert step == 7
+    np.testing.assert_array_equal(got["params"][0]["W"], np.arange(6.0).reshape(2, 3))
+    assert int(got["opt"]["step"]) == 5
+
+
+def test_vertex_array_dump_restore(tmp_path, rng):
+    arr = rng.standard_normal((10, 3)).astype(np.float32)
+    dump_vertex_array(str(tmp_path), "emb", arr)
+    np.testing.assert_array_equal(restore_vertex_array(str(tmp_path), "emb"), arr)
+    assert restore_vertex_array(str(tmp_path), "nope") is None
+
+
+def test_trainer_resume_continues(tmp_path):
+    """Train 20 epochs with checkpointing, then resume: the second run must
+    restore at epoch 20 and only run the remainder."""
+    src, dst, datum = _planted_data(seed=5)
+    cfg = _planted_cfg(epochs=20)
+    cfg.checkpoint_dir = str(tmp_path / "ckpt")
+    t1 = GCNTrainer.from_arrays(cfg, src, dst, datum)
+    t1.run()
+
+    cfg2 = _planted_cfg(epochs=30)
+    cfg2.checkpoint_dir = cfg.checkpoint_dir
+    t2 = GCNTrainer.from_arrays(cfg2, src, dst, datum)
+    result = t2.run()
+    assert len(t2.epoch_times) == 10  # only epochs 20..29 ran
+    assert result["acc"]["train"] > 0.85
