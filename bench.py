@@ -43,6 +43,10 @@ def main(argv=None) -> int:
     ap.add_argument("--scale", type=float, default=1.0, help="graph size multiplier")
     ap.add_argument("--epochs", type=int, default=5)
     ap.add_argument("--warmup", type=int, default=2)
+    ap.add_argument(
+        "--precision", default="bfloat16", choices=["float32", "bfloat16"],
+        help="compute precision (bfloat16 = TPU-native default)",
+    )
     args = ap.parse_args(argv)
 
     import jax
@@ -71,6 +75,7 @@ def main(argv=None) -> int:
     cfg.weight_decay = 0.0001
     cfg.decay_epoch = -1
     cfg.drop_rate = 0.5
+    cfg.precision = args.precision
 
     t0 = time.time()
     trainer = GCNTrainer.from_arrays(cfg, src, dst, datum)
@@ -94,6 +99,7 @@ def main(argv=None) -> int:
             "e_num": e_num,
             "layers": LAYERS,
             "scale": args.scale,
+            "precision": args.precision,
             "chips": n_chips,
             "edges_per_sec_per_chip": round(edges_per_sec_per_chip, 0),
             "final_loss": result["loss"],

@@ -57,6 +57,17 @@ def test_gcn_converges_on_planted_partition():
     assert result["loss"] < 0.5
 
 
+def test_gcn_bf16_converges_on_planted_partition():
+    """The TPU-native bfloat16 compute path must converge like float32."""
+    cfg = _planted_cfg()
+    cfg.precision = "bfloat16"
+    src, dst, datum = _planted_data(seed=2)
+    trainer = GCNTrainer.from_arrays(cfg, src, dst, datum)
+    result = trainer.run()
+    assert result["acc"]["test"] > 0.85
+    assert result["loss"] < 0.6
+
+
 def test_gcn_eager_converges_on_planted_partition():
     cfg = _planted_cfg(epochs=80)
     src, dst, datum = _planted_data(seed=3)
