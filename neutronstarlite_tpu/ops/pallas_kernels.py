@@ -1,0 +1,102 @@
+"""Pallas TPU kernels — experimental fused aggregation prototype.
+
+The reference's CUDA analog is aggregate_kernel_from_src_with_weight[_optim]
+(cuda/ntsCUDAFuseKernel.cuh:147-293): one fused kernel doing gather ->
+scale-by-edge-weight -> per-dst accumulate over CSC chunks, shared-memory
+tiled. This module provides the Pallas counterpart.
+
+Performance notes (why this is a prototype, and what the production path is):
+
+- The op is HBM-bandwidth-bound random access: out[dst] += w * x[src] over
+  dst-sorted edges. XLA:TPU lowers ``.at[].add`` with ``indices_are_sorted``
+  to its native sorted-scatter, and the gather x[src] to the hardware gather
+  path; the chunked lax.scan in ops/aggregate.py already avoids any [E, f]
+  HBM intermediate. A Pallas kernel must beat that by pipelining per-edge row
+  DMAs against the accumulate — a serial-DMA schedule whose win must be
+  measured on hardware, not assumed.
+- This prototype therefore targets the VMEM-resident regime (x and the
+  output tile fit on chip, V*f <= ~2M elements): the whole fused
+  gather+scale+accumulate happens in one kernel with zero HBM round-trips
+  for intermediates. The large-graph regime stays on the XLA path
+  (ops/aggregate.py) until kernel profiling on real chips justifies a
+  scalar-prefetch + double-buffered-DMA variant.
+- Grid: one program per edge chunk; the output accumulates across grid steps
+  (out block index_map is constant, so the block stays resident in VMEM).
+
+Enable with gather_dst_from_src_pallas(...); tests run it in interpret mode.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend may be absent on pure-CPU builds
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PLTPU = True
+except Exception:  # pragma: no cover
+    _HAS_PLTPU = False
+
+
+def _agg_kernel(src_ref, dst_ref, w_ref, x_ref, out_ref, *, edge_chunk: int):
+    """One grid step: accumulate this edge chunk into the full [V, f] output.
+
+    x_ref/out_ref hold the full arrays in VMEM; src/dst/w hold this chunk.
+    """
+    c = pl.program_id(0)
+
+    @pl.when(c == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    def body(e, _):
+        s = src_ref[e]
+        d = dst_ref[e]
+        w = w_ref[e]
+        out_ref[d, :] += w * x_ref[s, :]
+        return _
+
+    jax.lax.fori_loop(0, edge_chunk, body, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("v_num", "edge_chunk", "interpret"))
+def gather_dst_from_src_pallas(
+    csc_src: jax.Array,
+    csc_dst: jax.Array,
+    csc_weight: jax.Array,
+    x: jax.Array,
+    v_num: int,
+    edge_chunk: int = 1024,
+    interpret: bool = False,
+) -> jax.Array:
+    """Fused CSC aggregation: out[v] = sum_{(u->v)} w_uv * x[u].
+
+    VMEM-resident prototype; see module docstring. Padding edges must carry
+    weight 0 (they hit row 0 harmlessly).
+    """
+    e_pad = csc_src.shape[0]
+    assert e_pad % edge_chunk == 0, "edge arrays must be chunk-padded"
+    n_chunks = e_pad // edge_chunk
+    f = x.shape[1]
+
+    grid = (n_chunks,)
+    in_specs = [
+        pl.BlockSpec((edge_chunk,), lambda c: (c,)),
+        pl.BlockSpec((edge_chunk,), lambda c: (c,)),
+        pl.BlockSpec((edge_chunk,), lambda c: (c,)),
+        pl.BlockSpec((v_num, f), lambda c: (0, 0)),  # full x resident
+    ]
+    out_specs = pl.BlockSpec((v_num, f), lambda c: (0, 0))  # accumulated
+
+    return pl.pallas_call(
+        functools.partial(_agg_kernel, edge_chunk=edge_chunk),
+        out_shape=jax.ShapeDtypeStruct((v_num, f), x.dtype),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        interpret=interpret,
+    )(csc_src, csc_dst, csc_weight, x)
