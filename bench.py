@@ -47,6 +47,13 @@ def main(argv=None) -> int:
         "--precision", default="bfloat16", choices=["float32", "bfloat16"],
         help="compute precision (bfloat16 = TPU-native default)",
     )
+    ap.add_argument(
+        "--order", default="eager", choices=["standard", "eager"],
+        help="eager = transform-then-propagate (the reference's GCN_EAGER "
+        "variant, GCN_CPU_EAGER.hpp:200-206): aggregation runs at the "
+        "narrow post-matmul width, the right order for a bandwidth-bound "
+        "TPU when d_out < d_in",
+    )
     args = ap.parse_args(argv)
 
     import jax
@@ -54,7 +61,7 @@ def main(argv=None) -> int:
     from neutronstarlite_tpu.graph.dataset import GNNDatum
     from neutronstarlite_tpu.graph.storage import build_graph
     from neutronstarlite_tpu.graph.synthetic import synthetic_power_law_graph
-    from neutronstarlite_tpu.models.gcn import GCNTrainer
+    from neutronstarlite_tpu.models.gcn import GCNEagerTrainer, GCNTrainer
     from neutronstarlite_tpu.utils.config import InputInfo
 
     v_num = max(int(REDDIT_V * args.scale), 64)
@@ -78,7 +85,8 @@ def main(argv=None) -> int:
     cfg.precision = args.precision
 
     t0 = time.time()
-    trainer = GCNTrainer.from_arrays(cfg, src, dst, datum)
+    cls = GCNEagerTrainer if args.order == "eager" else GCNTrainer
+    trainer = cls.from_arrays(cfg, src, dst, datum)
     build_s = time.time() - t0
 
     result = trainer.run()
@@ -100,6 +108,7 @@ def main(argv=None) -> int:
             "layers": LAYERS,
             "scale": args.scale,
             "precision": args.precision,
+            "order": args.order,
             "chips": n_chips,
             "edges_per_sec_per_chip": round(edges_per_sec_per_chip, 0),
             "final_loss": result["loss"],
