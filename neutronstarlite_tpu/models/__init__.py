@@ -2,6 +2,7 @@ from neutronstarlite_tpu.models.base import ToolkitBase, register_algorithm, get
 import neutronstarlite_tpu.models.gcn  # noqa: F401  (registers GCN variants)
 import neutronstarlite_tpu.models.gcn_dist  # noqa: F401  (registers GCNDIST)
 import neutronstarlite_tpu.models.gat  # noqa: F401  (registers GAT variants)
+import neutronstarlite_tpu.models.gat_dist  # noqa: F401  (registers GATDIST)
 import neutronstarlite_tpu.models.gin  # noqa: F401  (registers GIN variants)
 import neutronstarlite_tpu.models.commnet  # noqa: F401  (registers CommNet)
 import neutronstarlite_tpu.models.gcn_sample  # noqa: F401  (registers GCNSAMPLE)

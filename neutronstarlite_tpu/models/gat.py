@@ -74,7 +74,7 @@ def gat_forward(graph, params, x, key, drop_rate: float, train: bool):
     return x
 
 
-@register_algorithm("GATCPU", "GATCPUDIST", "GATGPUDIST", "GAT")
+@register_algorithm("GATCPU", "GAT", "GATSINGLE")
 class GATTrainer(FullBatchTrainer):
     # the softmax supplies edge weights; the underlying scatter is unweighted
     weight_mode = "ones"

@@ -85,7 +85,9 @@ def _edge_softmax_impl(v_num, csc_dst, mask, score):
     e = jnp.exp(masked - m[csc_dst])
     e = jnp.where(mask[:, None] > 0, e, 0.0)
     denom = segment_sum_sorted(e, csc_dst, v_num)
-    denom = jnp.maximum(denom, jnp.asarray(1e-38, dtype=score.dtype))
+    # empty segments (padding vertices with no in-edges) sum to 0; 1e-38 is
+    # subnormal in f32 and XLA flushes it to zero, so guard with where
+    denom = jnp.where(denom > 0, denom, jnp.ones_like(denom))
     return e / denom[csc_dst]
 
 

@@ -5,12 +5,30 @@ from neutronstarlite_tpu.parallel.dist_ops import (
     replicated,
     vertex_sharded,
 )
+from neutronstarlite_tpu.parallel.mirror import MirrorGraph
+from neutronstarlite_tpu.parallel.dist_edge_ops import (
+    dist_aggregate_dst,
+    dist_aggregate_dst_fuse_weight,
+    dist_edge_softmax,
+    dist_gather_dst_from_src_mirror,
+    dist_get_dep_nbr,
+    dist_scatter_dst,
+    dist_scatter_src,
+)
 
 __all__ = [
     "make_mesh",
     "PARTITION_AXIS",
     "DistGraph",
+    "MirrorGraph",
     "dist_gather_dst_from_src",
+    "dist_get_dep_nbr",
+    "dist_scatter_src",
+    "dist_scatter_dst",
+    "dist_edge_softmax",
+    "dist_aggregate_dst",
+    "dist_aggregate_dst_fuse_weight",
+    "dist_gather_dst_from_src_mirror",
     "replicated",
     "vertex_sharded",
 ]

@@ -34,14 +34,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from neutronstarlite_tpu.graph.storage import CSCGraph, partition_offsets
+from neutronstarlite_tpu.parallel.vertex_space import PaddedVertexSpace, round_up
 
-
-def _round_up(x: int, m: int) -> int:
-    return ((x + m - 1) // m) * m
+_round_up = round_up  # layout helper shared with MirrorGraph
 
 
 @dataclasses.dataclass
-class DistGraph:
+class DistGraph(PaddedVertexSpace):
     """Host-side container; ``device_blocks()`` ships the block arrays."""
 
     partitions: int
@@ -60,10 +59,6 @@ class DistGraph:
     @property
     def eb(self) -> int:
         return self.block_src.shape[2]
-
-    @property
-    def padded_v(self) -> int:
-        return self.partitions * self.vp
 
     @staticmethod
     def build(
@@ -129,29 +124,6 @@ class DistGraph:
             v_num=g.v_num,
             edge_chunk=int(edge_chunk),
         )
-
-    # ---- padded vertex-space helpers ------------------------------------
-    def pad_vertex_array(self, arr: np.ndarray, fill=0) -> np.ndarray:
-        """Re-lay a [V, ...] array into the padded [P*vp, ...] space."""
-        out_shape = (self.padded_v,) + arr.shape[1:]
-        out = np.full(out_shape, fill, dtype=arr.dtype)
-        for p in range(self.partitions):
-            lo, hi = self.offsets[p], self.offsets[p + 1]
-            out[p * self.vp : p * self.vp + (hi - lo)] = arr[lo:hi]
-        return out
-
-    def unpad_vertex_array(self, arr: np.ndarray) -> np.ndarray:
-        """Inverse of pad_vertex_array (gather_vertex_array's role,
-        graph.hpp:583)."""
-        out = np.zeros((self.v_num,) + arr.shape[1:], dtype=arr.dtype)
-        for p in range(self.partitions):
-            lo, hi = self.offsets[p], self.offsets[p + 1]
-            out[lo:hi] = arr[p * self.vp : p * self.vp + (hi - lo)]
-        return out
-
-    def valid_mask(self) -> np.ndarray:
-        """[P*vp] 1.0 on real vertices, 0.0 on shard padding."""
-        return self.pad_vertex_array(np.ones(self.v_num, dtype=np.float32))
 
     def shard(self, mesh) -> Tuple[jax.Array, jax.Array, jax.Array]:
         """Device-put the block arrays sharded over the dst-partition axis."""

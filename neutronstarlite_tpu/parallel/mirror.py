@@ -1,0 +1,152 @@
+"""Mirror-index graph: fixed-capacity mirror slots + local edge lists.
+
+The TPU re-design of the reference's mirror machinery
+(PartitionedGraph::generateMirrorIndex, PartitionedGraph.hpp:295-305, the
+prefix-sum ``MirrorIndex`` / ``owned_mirrors`` tables) and of the compacted
+master->mirror messages the MPI ring ships (only *active* sources travel,
+network.cpp:505-518). XLA needs static shapes, so the variable-length message
+sets become **fixed-capacity mirror slots** precomputed at preprocessing time
+(SURVEY.md section 7 "hard parts": "fixed-capacity mirror slots precomputed
+from MirrorIndex (preferred; shapes known at trace time)"):
+
+- For each (consumer partition p, producer partition q) the set of q-owned
+  vertices referenced as a source by p's in-edges is deduplicated and padded
+  to a common capacity ``Mb``. ``need_ids[q, p]`` holds those q-local ids —
+  sharded over q, it is the gather table each producer device applies to its
+  feature shard before the one-shot ``all_to_all`` exchange
+  (dist_edge_ops.dist_get_dep_nbr, the DistGetDepNbrOp equivalent).
+- Each device p's in-edges are merged across q into ONE dst-sorted local edge
+  list (the role of GenerateWholeGraphTopo's local CSC over masters +
+  compressed CSR over mirrors, PartitionedGraph.hpp:105-143): ``edge_dst`` is
+  p-local, ``edge_src_slot`` indexes the [P*Mb] mirror space ``q*Mb + slot``.
+  Dst-sortedness lets every downstream edge op use sorted segment reductions.
+
+Comm volume per device per layer is P*Mb rows instead of the P*vp rows the
+dense ppermute ring ships (dist_ops.py) — the same saving the reference gets
+from sending only active mirrors instead of whole partitions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neutronstarlite_tpu.graph.storage import CSCGraph, partition_offsets
+from neutronstarlite_tpu.parallel.vertex_space import PaddedVertexSpace, round_up
+
+
+@dataclasses.dataclass
+class MirrorGraph(PaddedVertexSpace):
+    """Host-side mirror-slot tables; ``shard()`` ships them to the mesh."""
+
+    partitions: int
+    vp: int  # padded vertices per partition (static)
+    mb: int  # mirror slots per (p, q) pair (static)
+    offsets: np.ndarray  # [P+1] original-id partition boundaries
+    # [P(q), P(p), Mb] q-local vertex ids that consumer p needs from producer q
+    need_ids: np.ndarray
+    # [P, El] per-consumer local edge list, dst-sorted:
+    edge_src_slot: np.ndarray  # int32 into the [P*Mb] mirror space
+    edge_dst: np.ndarray  # int32 p-local dst
+    edge_weight: np.ndarray  # float32, 0 on padding
+    edge_mask: np.ndarray  # float32 {0, 1}
+    e_num: int
+    v_num: int
+
+    @property
+    def el(self) -> int:
+        return self.edge_dst.shape[1]
+
+    @staticmethod
+    def build(g: CSCGraph, partitions: int, lane_pad: int = 8) -> "MirrorGraph":
+        P = partitions
+        offsets = partition_offsets(g.v_num, g.in_degree, P)
+        sizes = np.diff(offsets)
+        vp = round_up(max(int(sizes.max()), 1), lane_pad)
+
+        owner = np.searchsorted(offsets, np.arange(g.v_num), side="right") - 1
+        src = g.row_indices.astype(np.int64)  # global CSC order: dst-sorted
+        dst = g.dst_of_edge.astype(np.int64)
+        w = g.edge_weight_forward.astype(np.float32)
+        p_of_edge = owner[dst]
+        q_of_edge = owner[src]
+
+        # pass 1: per-(p, q) deduplicated source sets -> capacity Mb. One
+        # sorted-unique over the composite key (p, q, src) replaces a P*P
+        # full-array scan: (p*P + q)*V + src sorts by pair then source, so
+        # each pair's unique sources are a contiguous sorted run.
+        key_pq = p_of_edge * P + q_of_edge
+        pair = key_pq * g.v_num + src
+        u = np.unique(pair)
+        u_pq = u // g.v_num
+        pq_counts = np.bincount(u_pq, minlength=P * P)
+        mb = round_up(max(int(pq_counts.max()) if pq_counts.size else 1, 1), lane_pad)
+        u_starts = np.concatenate([[0], np.cumsum(pq_counts)])
+        u_src_local = (u % g.v_num) - offsets[u_pq % P]
+
+        need_ids = np.zeros((P, P, mb), dtype=np.int32)
+        for k in np.nonzero(pq_counts)[0]:
+            p, q = divmod(int(k), P)
+            lo, hi = u_starts[k], u_starts[k + 1]
+            need_ids[q, p, : hi - lo] = u_src_local[lo:hi].astype(np.int32)
+
+        # every edge's slot = its position inside its pair's unique run
+        slot_in_pair = np.searchsorted(u, pair) - u_starts[key_pq]
+        slot_global = q_of_edge * mb + slot_in_pair
+
+        # pass 2: per-consumer dst-sorted edge list in mirror-slot coordinates
+        # (stable grouping by p preserves the global CSC dst order per group)
+        p_counts = np.bincount(p_of_edge, minlength=P)
+        el = round_up(max(int(p_counts.max()), 1), 8)
+        order = np.argsort(p_of_edge, kind="stable")
+        p_starts = np.concatenate([[0], np.cumsum(p_counts)])
+        edge_src_slot = np.zeros((P, el), dtype=np.int32)
+        edge_dst = np.full((P, el), vp - 1, dtype=np.int32)  # keep sorted tail
+        edge_weight = np.zeros((P, el), dtype=np.float32)
+        edge_mask = np.zeros((P, el), dtype=np.float32)
+        for p in range(P):
+            sel = order[p_starts[p] : p_starts[p + 1]]
+            n = len(sel)
+            if n == 0:
+                continue
+            edge_src_slot[p, :n] = slot_global[sel].astype(np.int32)
+            edge_dst[p, :n] = (dst[sel] - offsets[p]).astype(np.int32)
+            edge_weight[p, :n] = w[sel]
+            edge_mask[p, :n] = 1.0
+
+        return MirrorGraph(
+            partitions=P,
+            vp=vp,
+            mb=mb,
+            offsets=offsets,
+            need_ids=need_ids,
+            edge_src_slot=edge_src_slot,
+            edge_dst=edge_dst,
+            edge_weight=edge_weight,
+            edge_mask=edge_mask,
+            e_num=g.e_num,
+            v_num=g.v_num,
+        )
+
+    def shard(self, mesh) -> Tuple[jax.Array, ...]:
+        """Device-put (need_ids, edge_src_slot, edge_dst, edge_weight,
+        edge_mask) sharded over their leading partition axis."""
+        from jax.sharding import NamedSharding, PartitionSpec as PS
+
+        from neutronstarlite_tpu.parallel.mesh import PARTITION_AXIS
+
+        def put(a):
+            spec = PS(PARTITION_AXIS, *([None] * (a.ndim - 1)))
+            return jax.device_put(jnp.asarray(a), NamedSharding(mesh, spec))
+
+        return (
+            put(self.need_ids),
+            put(self.edge_src_slot),
+            put(self.edge_dst),
+            put(self.edge_weight),
+            put(self.edge_mask),
+        )
