@@ -1,7 +1,9 @@
 from neutronstarlite_tpu.graph.storage import (
     CSCGraph,
     build_graph,
+    load_edges,
     load_edges_binary,
+    load_edges_text,
     gcn_norm_weights,
     partition_offsets,
 )
@@ -11,7 +13,9 @@ from neutronstarlite_tpu.graph.synthetic import synthetic_power_law_graph
 __all__ = [
     "CSCGraph",
     "build_graph",
+    "load_edges",
     "load_edges_binary",
+    "load_edges_text",
     "gcn_norm_weights",
     "partition_offsets",
     "GNNDatum",

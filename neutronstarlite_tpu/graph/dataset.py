@@ -90,6 +90,15 @@ class GNNDatum:
 
 
 def _read_feature_table(path: str, v_num: int, feature_size: int) -> np.ndarray:
+    if path.endswith(".npy"):
+        # binary fast path for large feature tables (Reddit-scale text
+        # tables are >1 GB; prep.py emits .npy for them)
+        out = np.load(path).astype(np.float32, copy=False)
+        if out.shape != (v_num, feature_size):
+            raise ValueError(
+                f"{path}: expected shape {(v_num, feature_size)}, got {out.shape}"
+            )
+        return out
     data = np.loadtxt(path, dtype=np.float32)
     if data.ndim == 1:
         data = data.reshape(1, -1)

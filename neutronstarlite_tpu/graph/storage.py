@@ -45,6 +45,40 @@ def load_edges_binary(path: str) -> Tuple[np.ndarray, np.ndarray]:
     return np.ascontiguousarray(raw[:, 0]), np.ascontiguousarray(raw[:, 1])
 
 
+def load_edges_text(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    """Read a whitespace text edge list: one ``src dst`` pair per line (the
+    ``*.edge.txt`` files generate_nts_dataset.py emits). '#' comments and
+    extra columns (per-edge weights) are ignored; negative ids are an error
+    rather than a uint32 wraparound."""
+    data = np.loadtxt(path, dtype=np.int64, usecols=(0, 1), comments="#", ndmin=2)
+    if data.size and data.min() < 0:
+        raise ValueError(f"{path}: negative vertex id {data.min()} in edge list")
+    return (
+        np.ascontiguousarray(data[:, 0].astype(np.uint32)),
+        np.ascontiguousarray(data[:, 1].astype(np.uint32)),
+    )
+
+
+# text edge files may carry comments, float weight columns, sci notation
+_TEXT_EDGE_BYTES = frozenset(b"0123456789 \t\r\n-+.eE#,")
+
+
+def load_edges(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    """Load an edge list, sniffing text vs Gemini-binary format.
+
+    The reference ships both (.edge.txt and .edge.txt.bin); its loader is
+    told by the caller, ours inspects the first bytes: an all-ASCII
+    digits/whitespace/numeric-punctuation prefix means text. A text file a
+    user feeds in with other content fails loudly in the text parser rather
+    than being silently reinterpreted as binary uint32 pairs.
+    """
+    with open(path, "rb") as fh:
+        head = fh.read(4096)
+    if head and all(b in _TEXT_EDGE_BYTES for b in head):
+        return load_edges_text(path)
+    return load_edges_binary(path)
+
+
 def gcn_norm_weights(
     src: np.ndarray, dst: np.ndarray, out_degree: np.ndarray, in_degree: np.ndarray
 ) -> np.ndarray:
@@ -114,6 +148,12 @@ def build_graph(
     src = np.asarray(src, dtype=np.uint32)
     dst = np.asarray(dst, dtype=np.uint32)
     e_num = src.shape[0]
+    if e_num and (int(src.max()) >= v_num or int(dst.max()) >= v_num):
+        # guard before ids reach bincount / the native counting-sort builder
+        raise ValueError(
+            f"edge list references vertex {max(int(src.max()), int(dst.max()))} "
+            f">= VERTICES {v_num}"
+        )
 
     if use_native is not False and weight in ("gcn_norm", "ones"):
         from neutronstarlite_tpu import native

@@ -20,7 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from neutronstarlite_tpu.graph.dataset import GNNDatum
-from neutronstarlite_tpu.graph.storage import CSCGraph, build_graph, load_edges_binary
+from neutronstarlite_tpu.graph.storage import CSCGraph, build_graph, load_edges
 from neutronstarlite_tpu.ops.device_graph import DeviceGraph
 from neutronstarlite_tpu.utils.config import InputInfo
 from neutronstarlite_tpu.utils.logging import get_logger
@@ -72,7 +72,7 @@ class ToolkitBase:
         cfg = self.cfg
         edge_path = cfg.resolve_path(cfg.edge_file, self.base_dir)
         with self.timers.phase("graph_load"):
-            src, dst = load_edges_binary(edge_path)
+            src, dst = load_edges(edge_path)
             self.host_graph = build_graph(
                 src, dst, cfg.vertices, weight=self.weight_mode
             )
