@@ -74,7 +74,27 @@ def load_edges(path: str) -> Tuple[np.ndarray, np.ndarray]:
     """
     with open(path, "rb") as fh:
         head = fh.read(4096)
-    if head and all(b in _TEXT_EDGE_BYTES for b in head):
+    # '#' comment lines may contain arbitrary text; drop them (and a
+    # trailing partial line) before the byte-class check so a commented
+    # text file isn't misrouted to the binary parser. An empty residue is
+    # NOT treated as text (a binary file whose first byte happens to be
+    # 0x23 with no newline in the head must stay binary) unless the whole
+    # head itself decodes as comment-leading ASCII lines.
+    lines = head.split(b"\n")
+    if len(lines) > 1:
+        lines = lines[:-1]
+    data_lines = [ln for ln in lines if not ln.lstrip().startswith(b"#")]
+    sniff = b"\n".join(data_lines)
+    if sniff:
+        is_text = all(b in _TEXT_EDGE_BYTES for b in sniff)
+    else:
+        # only comments in the head: text iff it is printable ASCII lines
+        is_text = (
+            len(lines) > 0
+            and all(32 <= b < 127 or b in (9, 10, 13) for b in head)
+            and all(ln.lstrip().startswith(b"#") for ln in lines)
+        )
+    if head and is_text:
         return load_edges_text(path)
     return load_edges_binary(path)
 

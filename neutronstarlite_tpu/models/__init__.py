@@ -6,5 +6,6 @@ import neutronstarlite_tpu.models.gat_dist  # noqa: F401  (registers GATDIST)
 import neutronstarlite_tpu.models.gin  # noqa: F401  (registers GIN variants)
 import neutronstarlite_tpu.models.commnet  # noqa: F401  (registers CommNet)
 import neutronstarlite_tpu.models.gcn_sample  # noqa: F401  (registers GCNSAMPLE)
+import neutronstarlite_tpu.models.test_getdep  # noqa: F401  (registers TEST_GETDEP*)
 
 __all__ = ["ToolkitBase", "register_algorithm", "get_algorithm"]

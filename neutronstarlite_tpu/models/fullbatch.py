@@ -28,8 +28,14 @@ class FullBatchTrainer(ToolkitBase):
     def init_params(self, key):
         raise NotImplementedError
 
-    def model_forward(self, params, x, key, train: bool):
-        """[V, f0] -> [V, n_classes] logits."""
+    def model_forward(self, params, graph, x, key, train: bool):
+        """[V, f0] -> [V, n_classes] logits.
+
+        ``graph`` (the DeviceGraph pytree) is threaded through the jit
+        boundary as an ARGUMENT, never closed over: closure-captured arrays
+        are inlined into the HLO as constants, and at Reddit scale that is
+        a gigabyte-sized program (remote-compile paths reject it outright).
+        """
         raise NotImplementedError
 
     def build_model(self) -> None:
@@ -49,18 +55,20 @@ class FullBatchTrainer(ToolkitBase):
         adam_cfg = self.adam_cfg
 
         @jax.jit
-        def train_step(params, opt_state, feature, label, key):
+        def train_step(params, opt_state, graph, feature, label, train01, key):
             def loss_fn(p):
-                logits = model_forward(p, feature, key, True)
-                return masked_nll(logits, label, train_mask01), logits
+                logits = model_forward(p, graph, feature, key, True)
+                return masked_nll(logits, label, train01), logits
 
             (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
             params, opt_state = adam_update(params, grads, opt_state, adam_cfg)
             return params, opt_state, loss, logits
 
         @jax.jit
-        def eval_logits(params, feature, key):
-            return model_forward(params, feature, key, False)
+        def eval_logits(params, graph, feature, key):
+            return model_forward(params, graph, feature, key, False)
+
+        self._train_mask01 = train_mask01
 
         self._train_step = train_step
         self._eval_logits = eval_logits
@@ -101,7 +109,8 @@ class FullBatchTrainer(ToolkitBase):
             ekey = jax.random.fold_in(key, epoch)
             t0 = get_time()
             self.params, self.opt_state, loss, _ = self._train_step(
-                self.params, self.opt_state, self.feature, self.label, ekey
+                self.params, self.opt_state, self.graph, self.feature,
+                self.label, self._train_mask01, ekey,
             )
             jax.block_until_ready(loss)
             self.epoch_times.append(get_time() - t0)
@@ -116,7 +125,9 @@ class FullBatchTrainer(ToolkitBase):
         if cfg.checkpoint_dir:
             self.save(cfg.checkpoint_dir, cfg.epochs)
 
-        logits = np.asarray(self._eval_logits(self.params, self.feature, key))
+        logits = np.asarray(
+            self._eval_logits(self.params, self.graph, self.feature, key)
+        )
         accs = {
             "train": self.test(logits, 0),
             "eval": self.test(logits, 1),

@@ -126,8 +126,12 @@ class DistGATTrainer(ToolkitBase):
         masked_nll = self.masked_nll_loss
         adam_cfg = self.adam_cfg
 
+        # ``tables`` (O(E) sharded slot/dst/weight/mask arrays) rides the
+        # jit boundary as an ARGUMENT — closure capture would inline it
+        # into the HLO as constants (gigabyte programs at scale). The sim
+        # path (tables=None) closes over mg's small numpy tables only.
         @jax.jit
-        def train_step(params, opt_state, feature, label, train01, key):
+        def train_step(params, opt_state, tables, feature, label, train01, key):
             def loss_fn(p):
                 logits = dist_gat_forward(
                     mesh, mg, tables, p, feature, key, drop_rate, True
@@ -139,7 +143,7 @@ class DistGATTrainer(ToolkitBase):
             return params, opt_state, loss, logits
 
         @jax.jit
-        def eval_logits(params, feature, key):
+        def eval_logits(params, tables, feature, key):
             return dist_gat_forward(mesh, mg, tables, params, feature, key, 0.0, False)
 
         self._train_step = train_step
@@ -162,6 +166,7 @@ class DistGATTrainer(ToolkitBase):
             self.params, self.opt_state, loss, _ = self._train_step(
                 self.params,
                 self.opt_state,
+                self.tables,
                 self.feature_p,
                 self.label_p,
                 self.train01_p,
@@ -172,7 +177,7 @@ class DistGATTrainer(ToolkitBase):
             if epoch % max(1, cfg.epochs // 20) == 0 or epoch == cfg.epochs - 1:
                 log.info("Epoch %d loss %f", epoch, float(loss))
 
-        logits_p = self._eval_logits(self.params, self.feature_p, key)
+        logits_p = self._eval_logits(self.params, self.tables, self.feature_p, key)
         logits = self.mg.unpad_vertex_array(np.asarray(logits_p))
         accs = {
             "train": self.test(logits, 0),

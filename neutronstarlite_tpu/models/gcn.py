@@ -97,10 +97,10 @@ class GCNTrainer(FullBatchTrainer):
     def init_params(self, key):
         return init_gcn_params(key, self.cfg.layer_sizes(), with_bn=self.with_bn)
 
-    def model_forward(self, params, x, key, train):
+    def model_forward(self, params, graph, x, key, train):
         dtype = jnp.bfloat16 if self.cfg.precision == "bfloat16" else None
         return gcn_forward(
-            self.graph, params, x, key,
+            graph, params, x, key,
             self.cfg.drop_rate if train else 0.0, train, eager=self.eager,
             compute_dtype=dtype,
         )

@@ -106,8 +106,12 @@ class DistGCNTrainer(ToolkitBase):
         masked_nll = self.masked_nll_loss
         adam_cfg = self.adam_cfg
 
+        # ``blocks`` (the O(E) sharded edge arrays) is a jit ARGUMENT, not a
+        # closure: captured arrays are inlined into the HLO as constants,
+        # which at scale produces gigabyte programs (and remote-compile
+        # paths reject them).
         @jax.jit
-        def train_step(params, opt_state, feature, label, train01, valid, key):
+        def train_step(params, opt_state, blocks, feature, label, train01, valid, key):
             def loss_fn(p):
                 logits = dist_gcn_forward(
                     mesh, dist, blocks, p, feature, valid, key, drop_rate, True
@@ -119,7 +123,7 @@ class DistGCNTrainer(ToolkitBase):
             return params, opt_state, loss, logits
 
         @jax.jit
-        def eval_logits(params, feature, valid, key):
+        def eval_logits(params, blocks, feature, valid, key):
             return dist_gcn_forward(
                 mesh, dist, blocks, params, feature, valid, key, 0.0, False
             )
@@ -142,6 +146,7 @@ class DistGCNTrainer(ToolkitBase):
             self.params, self.opt_state, loss, _ = self._train_step(
                 self.params,
                 self.opt_state,
+                self.blocks,
                 self.feature_p,
                 self.label_p,
                 self.train01_p,
@@ -153,7 +158,7 @@ class DistGCNTrainer(ToolkitBase):
             if epoch % max(1, cfg.epochs // 20) == 0 or epoch == cfg.epochs - 1:
                 log.info("Epoch %d loss %f", epoch, float(loss))
 
-        logits_p = self._eval_logits(self.params, self.feature_p, self.valid_p, key)
+        logits_p = self._eval_logits(self.params, self.blocks, self.feature_p, self.valid_p, key)
         logits = self.dist.unpad_vertex_array(np.asarray(logits_p))
         accs = {
             "train": self.test(logits, 0),

@@ -57,7 +57,7 @@ class GINTrainer(FullBatchTrainer):
     def init_params(self, key):
         return init_gin_params(key, self.cfg.layer_sizes())
 
-    def model_forward(self, params, x, key, train):
+    def model_forward(self, params, graph, x, key, train):
         return gin_forward(
-            self.graph, params, x, key, self.cfg.drop_rate if train else 0.0, train
+            graph, params, x, key, self.cfg.drop_rate if train else 0.0, train
         )

@@ -1,0 +1,96 @@
+"""Correctness pseudo-models for the mirror exchange (test_getdep).
+
+Reference: toolkits/test_getdepneighbor_cpu.hpp / _gpu.hpp, runnable via
+``ALGORITHM:test_getdep1`` / ``test_getdep`` (toolkits/main.cpp:110-127).
+They set vertex features to known constants, run DistGetDepNbrOp forward and
+backward, and print the mirror tensors so the exchange can be verified
+(test_getdepneighbor_cpu.hpp:215-230).
+
+Here the check is automated: feature row of global vertex ``v`` is the
+constant ``v``, so after ``dist_get_dep_nbr`` the mirror slot (q, s) on
+consumer p must hold ``offsets[q] + need_ids[q, p, s]``; the backward of
+``sum(mirrors)`` must deliver to each master exactly the number of slots
+that reference it (the reference's mirror->master gradient sum,
+ntsDistCPUGraphOp.hpp:85-124). PASS/FAIL is logged and returned.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neutronstarlite_tpu.models.base import ToolkitBase, register_algorithm
+from neutronstarlite_tpu.parallel import dist_edge_ops as deo
+from neutronstarlite_tpu.parallel.mesh import make_mesh
+from neutronstarlite_tpu.parallel.mirror import MirrorGraph
+from neutronstarlite_tpu.utils.logging import get_logger
+
+log = get_logger("test_getdep")
+
+
+@register_algorithm("TEST_GETDEP1", "TEST_GETDEP", "TESTGETDEP")
+class GetDepNbrCheck(ToolkitBase):
+    """Verifies the mirror-slot exchange forward and backward."""
+
+    weight_mode = "ones"
+    simulate = None
+
+    def build_model(self) -> None:
+        if self.simulate is None:
+            self.simulate = os.environ.get("NTS_DIST_SIMULATE", "0") == "1"
+        if self.simulate:
+            self.mesh = None
+            P = self.cfg.partitions or 2
+        else:
+            self.mesh = make_mesh(self.cfg.partitions or None)
+            P = self.mesh.devices.size
+        self.mg = MirrorGraph.build(self.host_graph, P)
+        self.tables = self.mg.shard(self.mesh) if self.mesh is not None else None
+
+    def run(self) -> Dict[str, Any]:
+        mg, f = self.mg, 4
+        P, mb = mg.partitions, mg.mb
+        v_ids = np.arange(mg.v_num, dtype=np.float32)[:, None].repeat(f, axis=1)
+        x = jnp.asarray(mg.pad_vertex_array(v_ids))
+
+        if self.mesh is None:
+            fwd = lambda x: deo.dist_get_dep_nbr_sim(mg, x)
+        else:
+            fwd = lambda x: deo.dist_get_dep_nbr(self.mesh, mg, self.tables, x)
+
+        mirrors = np.asarray(jax.jit(fwd)(x))  # [P, P*Mb, f]
+
+        # expected: consumer p, producer q, slot s -> global master id
+        offsets = mg.offsets
+        expect = np.zeros((P, P * mb), dtype=np.float32)
+        for p in range(P):
+            for q in range(P):
+                expect[p, q * mb : (q + 1) * mb] = (
+                    offsets[q] + mg.need_ids[q, p]
+                ).astype(np.float32)
+        fwd_err = float(np.abs(mirrors[:, :, 0] - expect).max())
+        fwd_ok = fwd_err == 0.0
+
+        grad = np.asarray(jax.jit(jax.grad(lambda x: fwd(x).sum()))(x))
+        counts = np.zeros(mg.padded_v, dtype=np.float32)
+        for p in range(P):
+            for q in range(P):
+                np.add.at(counts, q * mg.vp + mg.need_ids[q, p], float(f))
+        bwd_err = float(np.abs(grad.sum(axis=1) - counts).max())
+        bwd_ok = bwd_err == 0.0
+
+        status = "PASS" if (fwd_ok and bwd_ok) else "FAIL"
+        log.info(
+            "test_getdep [%s] P=%d Mb=%d fwd_err=%g bwd_err=%g",
+            status, P, mb, fwd_err, bwd_err,
+        )
+        return {
+            "pass": fwd_ok and bwd_ok,
+            "fwd_err": fwd_err,
+            "bwd_err": bwd_err,
+            "partitions": P,
+        }

@@ -47,7 +47,13 @@ def get_lib() -> Optional[ctypes.CDLL]:
     _tried = True
     if os.environ.get("NTS_NO_NATIVE", "0") == "1":
         return None
-    if not os.path.exists(_SO) and not _build():
+    # rebuild when missing or staler than its source (-march=native output
+    # is machine-specific, so the .so is never shipped, only built here)
+    src = os.path.join(_DIR, "graph_native.cpp")
+    stale = not os.path.exists(_SO) or (
+        os.path.exists(src) and os.path.getmtime(src) > os.path.getmtime(_SO)
+    )
+    if stale and not _build():
         return None
     try:
         lib = ctypes.CDLL(_SO)

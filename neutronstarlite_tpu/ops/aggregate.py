@@ -34,11 +34,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from neutronstarlite_tpu.ops.device_graph import DeviceGraph
-from neutronstarlite_tpu.ops.segment import (
-    segment_max_sorted,
-    segment_min_sorted,
-    zero_cotangent,
-)
+from neutronstarlite_tpu.ops.segment import zero_cotangent
 
 
 def _scatter_accumulate(
@@ -139,63 +135,26 @@ def gather_src_from_dst(graph: DeviceGraph, y: jax.Array) -> jax.Array:
     )
 
 
-def _extreme_fwd_impl(v_num, is_min, csc_src, csc_dst, mask, x):
-    """Elementwise min/max over in-neighbors + the winning-edge ``record``.
-
-    Not chunked: materializes [Ep, f] edge values; intended for the edge-op
-    model family (API parity), not the Reddit-scale hot path.
-    """
-    e_pad = csc_src.shape[0]
-    vals = x[csc_src]
-    fill = jnp.inf if is_min else -jnp.inf
-    masked = jnp.where(mask[:, None] > 0, vals, fill)
-    seg = (
-        segment_min_sorted(masked, csc_dst, v_num)
-        if is_min
-        else segment_max_sorted(masked, csc_dst, v_num)
-    )
-    # record: first edge attaining the extreme, per (vertex, feature) —
-    # the reference's `record` array (ntsSingleCPUGraphOp.hpp:209).
-    eidx = jnp.arange(e_pad, dtype=jnp.int32)[:, None]
-    hit = (masked == seg[csc_dst]) & (mask[:, None] > 0)
-    cand = jnp.where(hit, eidx, e_pad)
-    record = segment_min_sorted(cand, csc_dst, v_num)
-    out = jnp.where(jnp.isfinite(seg), seg, 0.0).astype(x.dtype)
-    return out, record
-
-
-@partial(jax.custom_vjp, nondiff_argnums=(0, 1))
-def _aggregate_extreme(v_num, is_min, csc_src, csc_dst, mask, x):
-    out, _ = _extreme_fwd_impl(v_num, is_min, csc_src, csc_dst, mask, x)
-    return out
-
-
-def _extreme_fwd(v_num, is_min, csc_src, csc_dst, mask, x):
-    out, record = _extreme_fwd_impl(v_num, is_min, csc_src, csc_dst, mask, x)
-    return out, (csc_src, csc_dst, mask, record)
-
-
-def _extreme_bwd(v_num, is_min, res, g):
-    csc_src, csc_dst, mask, record = res
-    e_pad = csc_src.shape[0]
-    valid = record < e_pad
-    safe = jnp.minimum(record, e_pad - 1)
-    rows = csc_src[safe]  # [V, f] winning source per element
-    cols = jnp.broadcast_to(jnp.arange(g.shape[1], dtype=jnp.int32)[None, :], rows.shape)
-    grad_x = jnp.zeros_like(g).at[rows, cols].add(jnp.where(valid, g, 0.0))
-    return (zero_cotangent(csc_src), zero_cotangent(csc_dst), zero_cotangent(mask), grad_x)
-
-
-_aggregate_extreme.defvjp(_extreme_fwd, _extreme_bwd)
-
-
 def aggregate_dst_max(graph: DeviceGraph, x: jax.Array) -> jax.Array:
-    return _aggregate_extreme(
-        graph.v_num, False, graph.csc_src, graph.csc_dst, graph.edge_mask, x
+    """Elementwise max over in-neighbors; gradient routed to the winning
+    edge's source (SingleCPUDstAggregateOpMax + its ``record`` routing,
+    core/ntsSingleCPUGraphOp.hpp:274). Composition of the V->E gather with
+    the shared masked-extreme core (ops/edge.py); the gather's autodiff
+    transpose is the edge->source scatter-add. Not chunked: materializes
+    [Ep, f] edge values — the edge-op model family path, not the
+    Reddit-scale hot path."""
+    from neutronstarlite_tpu.ops.edge import _edge_extreme
+
+    return _edge_extreme(
+        graph.v_num, False, graph.csc_dst, graph.edge_mask, x[graph.csc_src]
     )
 
 
 def aggregate_dst_min(graph: DeviceGraph, x: jax.Array) -> jax.Array:
-    return _aggregate_extreme(
-        graph.v_num, True, graph.csc_src, graph.csc_dst, graph.edge_mask, x
+    """Elementwise min over in-neighbors (SingleCPUDstAggregateOpMin,
+    core/ntsSingleCPUGraphOp.hpp:206)."""
+    from neutronstarlite_tpu.ops.edge import _edge_extreme
+
+    return _edge_extreme(
+        graph.v_num, True, graph.csc_dst, graph.edge_mask, x[graph.csc_src]
     )

@@ -34,6 +34,7 @@ import jax.numpy as jnp
 from neutronstarlite_tpu.ops.device_graph import DeviceGraph
 from neutronstarlite_tpu.ops.segment import (
     segment_max_sorted,
+    segment_min_sorted,
     segment_sum_sorted,
     zero_cotangent,
 )
@@ -75,6 +76,72 @@ def aggregate_edge_to_dst_weighted(
         edge_weight = edge_weight[:, None]
     vals = x[graph.csc_src] * edge_weight * graph.edge_mask[:, None].astype(x.dtype)
     return segment_sum_sorted(vals, graph.csc_dst, graph.v_num)
+
+
+def _edge_extreme_impl(v_num, is_min, dst, mask, ev):
+    """Per-dst elementwise extreme over edge values + winning-edge record.
+
+    The shared core of SingleCPUDstAggregateOpMin/Max
+    (core/ntsSingleCPUGraphOp.hpp:206/:274) and DistAggregateDstMin/Max
+    (core/ntsDistCPUGraphOp.hpp:306/:374): ``record`` holds the first edge
+    attaining the extreme per (vertex, feature), the backward routes the
+    gradient to exactly that edge (the reference's nts_assign routing).
+    """
+    el = dst.shape[0]
+    fill = jnp.inf if is_min else -jnp.inf
+    masked = jnp.where(mask[:, None] > 0, ev, fill)
+    seg = (
+        segment_min_sorted(masked, dst, v_num)
+        if is_min
+        else segment_max_sorted(masked, dst, v_num)
+    )
+    eidx = jnp.arange(el, dtype=jnp.int32)[:, None]
+    hit = (masked == seg[dst]) & (mask[:, None] > 0)
+    record = segment_min_sorted(jnp.where(hit, eidx, el), dst, v_num)
+    out = jnp.where(jnp.isfinite(seg), seg, 0.0).astype(ev.dtype)
+    return out, record
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _edge_extreme(v_num, is_min, dst, mask, ev):
+    out, _ = _edge_extreme_impl(v_num, is_min, dst, mask, ev)
+    return out
+
+
+def _edge_extreme_fwd(v_num, is_min, dst, mask, ev):
+    out, record = _edge_extreme_impl(v_num, is_min, dst, mask, ev)
+    return out, (dst, mask, record)
+
+
+def _edge_extreme_bwd(v_num, is_min, res, g):
+    dst, mask, record = res
+    el = dst.shape[0]
+    valid = record < el
+    safe = jnp.minimum(record, el - 1)  # [v_num, f] winning edge per element
+    cols = jnp.broadcast_to(
+        jnp.arange(g.shape[1], dtype=jnp.int32)[None, :], safe.shape
+    )
+    grad_ev = (
+        jnp.zeros((el, g.shape[1]), dtype=g.dtype)
+        .at[safe, cols]
+        .add(jnp.where(valid, g, 0.0))
+    )
+    return (zero_cotangent(dst), zero_cotangent(mask), grad_ev)
+
+
+_edge_extreme.defvjp(_edge_extreme_fwd, _edge_extreme_bwd)
+
+
+def aggregate_edge_to_dst_max(graph: DeviceGraph, edge_vals: jax.Array) -> jax.Array:
+    """[Ep, f] -> [V, f]: per-dst elementwise max; gradient routed to the
+    winning edge (SingleCPUDstAggregateOpMax, core/ntsSingleCPUGraphOp.hpp:274)."""
+    return _edge_extreme(graph.v_num, False, graph.csc_dst, graph.edge_mask, edge_vals)
+
+
+def aggregate_edge_to_dst_min(graph: DeviceGraph, edge_vals: jax.Array) -> jax.Array:
+    """[Ep, f] -> [V, f]: per-dst elementwise min (SingleCPUDstAggregateOpMin,
+    core/ntsSingleCPUGraphOp.hpp:206)."""
+    return _edge_extreme(graph.v_num, True, graph.csc_dst, graph.edge_mask, edge_vals)
 
 
 def _edge_softmax_impl(v_num, csc_dst, mask, score):

@@ -9,6 +9,8 @@ from neutronstarlite_tpu.parallel.mirror import MirrorGraph
 from neutronstarlite_tpu.parallel.dist_edge_ops import (
     dist_aggregate_dst,
     dist_aggregate_dst_fuse_weight,
+    dist_aggregate_dst_max,
+    dist_aggregate_dst_min,
     dist_edge_softmax,
     dist_gather_dst_from_src_mirror,
     dist_get_dep_nbr,
@@ -28,6 +30,8 @@ __all__ = [
     "dist_edge_softmax",
     "dist_aggregate_dst",
     "dist_aggregate_dst_fuse_weight",
+    "dist_aggregate_dst_max",
+    "dist_aggregate_dst_min",
     "dist_gather_dst_from_src_mirror",
     "replicated",
     "vertex_sharded",

@@ -1,0 +1,45 @@
+"""Honor JAX platform requests made via environment variables.
+
+A TPU-plugin sitecustomize may pin ``jax_platforms`` via ``jax.config``
+at interpreter start; the config value overrides the ``JAX_PLATFORMS``
+env var, and ``--xla_force_host_platform_device_count`` in ``XLA_FLAGS``
+is then silently ignored. Entry points call :func:`honor_platform_env`
+before any backend initializes to force the caller's choice back.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Optional
+
+
+def honor_platform_env(min_devices: Optional[int] = None) -> None:
+    """Apply JAX_PLATFORMS / XLA_FLAGS device-count env requests via
+    jax.config (no-op once backends are initialized).
+
+    ``min_devices``: ensure at least this many virtual CPU devices when the
+    caller's env selects the cpu platform (used by the multichip dryrun).
+    """
+    want = os.environ.get("JAX_PLATFORMS", "")
+    m = re.search(
+        r"xla_force_host_platform_device_count=(\d+)",
+        os.environ.get("XLA_FLAGS", ""),
+    )
+    if not want and m:
+        want = "cpu"  # the flag is only meaningful on the host platform
+    if not want:
+        return
+
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", want)
+        if want == "cpu":
+            n = int(m.group(1)) if m else 0
+            if min_devices:
+                n = max(n, min_devices)
+            if n:
+                jax.config.update("jax_num_cpu_devices", n)
+    except RuntimeError:
+        pass  # backends already live; use whatever exists

@@ -155,6 +155,77 @@ def test_dist_gat_layer_gradients_match_single_chip(rng):
     np.testing.assert_allclose(np.asarray(gd[1]), np.asarray(gs[1]), rtol=1e-4, atol=1e-4)
 
 
+def test_dist_aggregate_extremes_match_single_chip(rng):
+    """DistAggregateDstMin/Max (ntsDistCPUGraphOp.hpp:306/:374): the dist
+    extreme over scattered mirror values must equal the single-chip
+    per-in-neighbor extreme, forward and argext-routed gradient."""
+    from neutronstarlite_tpu.ops.aggregate import aggregate_dst_max, aggregate_dst_min
+
+    g, mg = _ones_rig(rng)
+    graph = DeviceGraph.from_host(g)
+    x = rng.standard_normal((g.v_num, 5)).astype(np.float32)
+    cot = rng.standard_normal((g.v_num, 5)).astype(np.float32)
+    cotp = jnp.asarray(mg.pad_vertex_array(cot))
+
+    for is_min in (False, True):
+        single_op = aggregate_dst_min if is_min else aggregate_dst_max
+        dist_op = (
+            deo.dist_aggregate_dst_min_sim if is_min else deo.dist_aggregate_dst_max_sim
+        )
+
+        def dist_out(xp):
+            mir = deo.dist_get_dep_nbr_sim(mg, xp)
+            ev = deo.dist_scatter_src_sim(mg, mir)
+            return dist_op(mg, ev)
+
+        ref = np.asarray(single_op(graph, jnp.asarray(x)))
+        got = mg.unpad_vertex_array(
+            np.asarray(dist_out(jnp.asarray(mg.pad_vertex_array(x))))
+        )
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+        gs = np.asarray(
+            jax.grad(lambda xx: jnp.sum(single_op(graph, xx) * jnp.asarray(cot)))(
+                jnp.asarray(x)
+            )
+        )
+        gd = mg.unpad_vertex_array(
+            np.asarray(
+                jax.grad(lambda xp: jnp.sum(dist_out(xp) * cotp))(
+                    jnp.asarray(mg.pad_vertex_array(x))
+                )
+            )
+        )
+        np.testing.assert_allclose(gd, gs, rtol=1e-4, atol=1e-4)
+
+
+def test_getdep_pseudo_model_passes(rng):
+    """The TEST_GETDEP correctness pseudo-model (test_getdepneighbor_cpu.hpp)."""
+    from neutronstarlite_tpu.graph.dataset import GNNDatum
+    from neutronstarlite_tpu.models.test_getdep import GetDepNbrCheck
+    from neutronstarlite_tpu.utils.config import InputInfo
+
+    v_num = 53
+    src = rng.integers(0, v_num, size=300, dtype=np.uint32)
+    dst = rng.integers(0, v_num, size=300, dtype=np.uint32)
+    datum = GNNDatum(
+        feature=rng.standard_normal((v_num, 4)).astype(np.float32),
+        label=np.zeros(v_num, dtype=np.int32),
+        mask=np.zeros(v_num, dtype=np.int32),
+    )
+    cfg = InputInfo()
+    cfg.vertices = v_num
+    cfg.layer_string = "4-4"
+    cfg.partitions = 3
+
+    class Sim(GetDepNbrCheck):
+        simulate = True
+
+    t = Sim.from_arrays(cfg, src, dst, datum)
+    result = t.run()
+    assert result["pass"], result
+
+
 def test_dist_gat_trainer_converges_simulated(rng):
     """End-to-end DistGATTrainer (simulate mode) on a planted-partition graph."""
     from neutronstarlite_tpu.graph.dataset import GNNDatum
