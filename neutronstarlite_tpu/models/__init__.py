@@ -1,6 +1,7 @@
 from neutronstarlite_tpu.models.base import ToolkitBase, register_algorithm, get_algorithm
 import neutronstarlite_tpu.models.gcn  # noqa: F401  (registers GCN variants)
 import neutronstarlite_tpu.models.gcn_dist  # noqa: F401  (registers GCNDIST)
+import neutronstarlite_tpu.models.gcn_dist_cache  # noqa: F401  (registers GCNDISTMIRROR/CACHE)
 import neutronstarlite_tpu.models.gat  # noqa: F401  (registers GAT variants)
 import neutronstarlite_tpu.models.gat_dist  # noqa: F401  (registers GATDIST)
 import neutronstarlite_tpu.models.gin  # noqa: F401  (registers GIN variants)

@@ -125,6 +125,23 @@ class ToolkitBase:
     def build_model(self) -> None:
         raise NotImplementedError
 
+    # ---- dist-trainer mesh resolution ------------------------------------
+    simulate: Optional[bool] = None  # None -> read NTS_DIST_SIMULATE
+
+    def resolve_mesh(self):
+        """(mesh, partitions) for dist trainers. ``simulate`` (class attr or
+        NTS_DIST_SIMULATE=1) selects the collective-free sim ops with
+        ``mesh=None`` — the single-core test rig; otherwise a real mesh over
+        PARTITIONS (or all) devices."""
+        from neutronstarlite_tpu.parallel.mesh import make_mesh
+
+        if self.simulate is None:
+            self.simulate = os.environ.get("NTS_DIST_SIMULATE", "0") == "1"
+        if self.simulate:
+            return None, (self.cfg.partitions or 2)
+        mesh = make_mesh(self.cfg.partitions or None)
+        return mesh, mesh.devices.size
+
     # ---- accuracy / loss helpers ----------------------------------------
     @staticmethod
     def masked_nll_loss(logits: jax.Array, label: jax.Array, mask01: jax.Array):

@@ -139,4 +139,10 @@ class FullBatchTrainer(ToolkitBase):
             avg,
             self.epoch_times[0] if self.epoch_times else 0.0,
         )
-        return {"loss": float(loss), "acc": accs, "avg_epoch_s": avg}
+        # loss is None when a checkpoint restore resumed at/after cfg.epochs
+        # (zero epochs ran): still report the restored model's accuracy
+        return {
+            "loss": float(loss) if loss is not None else float("nan"),
+            "acc": accs,
+            "avg_epoch_s": avg,
+        }

@@ -19,7 +19,6 @@ path is exercised by dryrun_multichip and NTS_MULTIDEVICE=1 tests.
 
 from __future__ import annotations
 
-import os
 from typing import Any, Dict
 
 import jax
@@ -32,7 +31,7 @@ from neutronstarlite_tpu.models.gat import LEAKY_SLOPE, init_gat_params
 from neutronstarlite_tpu.nn.layers import dropout
 from neutronstarlite_tpu.nn.param import AdamConfig, adam_init, adam_update
 from neutronstarlite_tpu.parallel import dist_edge_ops as deo
-from neutronstarlite_tpu.parallel.mesh import PARTITION_AXIS, make_mesh
+from neutronstarlite_tpu.parallel.mesh import PARTITION_AXIS
 from neutronstarlite_tpu.parallel.mirror import MirrorGraph
 from neutronstarlite_tpu.utils.logging import get_logger
 from neutronstarlite_tpu.utils.timing import get_time
@@ -79,18 +78,10 @@ class DistGATTrainer(ToolkitBase):
     """Vertex-sharded full-batch GAT (PARTITIONS cfg key picks the mesh)."""
 
     weight_mode = "ones"  # softmax supplies the edge weights
-    simulate = None  # None -> read NTS_DIST_SIMULATE at build time
 
     def build_model(self) -> None:
         cfg = self.cfg
-        if self.simulate is None:
-            self.simulate = os.environ.get("NTS_DIST_SIMULATE", "0") == "1"
-        if self.simulate:
-            self.mesh = None
-            P = cfg.partitions or 2
-        else:
-            self.mesh = make_mesh(cfg.partitions or None)
-            P = self.mesh.devices.size
+        self.mesh, P = self.resolve_mesh()
         self.mg = MirrorGraph.build(self.host_graph, P)
         # the *_sim ops re-derive the tables from mg; only the sharded path
         # consumes device-put tables

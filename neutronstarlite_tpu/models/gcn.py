@@ -53,6 +53,7 @@ def gcn_forward(
     train: bool,
     eager: bool = False,
     compute_dtype=None,
+    sublinear: bool = False,
 ):
     """Logits for all vertices. ``eager`` swaps aggregate/NN order.
 
@@ -60,6 +61,12 @@ def gcn_forward(
     TPU-native precision: halves HBM traffic for the edge-bound aggregation
     and doubles MXU throughput) while parameters and the returned logits stay
     float32 — the reference is float32-only (ValueType, dep/gemini/type.hpp:30).
+
+    ``sublinear`` rematerializes each non-final layer in the backward pass
+    instead of saving its activations — the reference's activation-
+    recomputation NN op (SubLinearMemCostNNOP, core/ntsSubLinearNNOP.hpp:32),
+    expressed as ``jax.checkpoint`` (SURVEY.md section 5: trade FLOPs for
+    HBM). Gradients are bit-identical; only peak memory changes.
     """
     if compute_dtype is not None:
         x = x.astype(compute_dtype)
@@ -71,7 +78,7 @@ def gcn_forward(
     for i, layer in enumerate(params):
         last = i == n_layers - 1
 
-        def nn(h):
+        def nn(h, layer=layer, i=i, last=last):
             if last:
                 return h @ cast(layer["W"])
             if "bn" in layer:
@@ -81,10 +88,15 @@ def gcn_forward(
             h = jax.nn.relu(h @ cast(layer["W"]))
             return dropout(jax.random.fold_in(key, i), h, drop_rate, train)
 
-        if eager:
-            x = gather_dst_from_src(graph, nn(x))
+        def layer_step(h, nn=nn):
+            return gather_dst_from_src(graph, nn(h)) if eager else nn(
+                gather_dst_from_src(graph, h)
+            )
+
+        if sublinear and not last:
+            x = jax.checkpoint(layer_step)(x)
         else:
-            x = nn(gather_dst_from_src(graph, x))
+            x = layer_step(x)
     return x.astype(jnp.float32)
 
 
@@ -102,7 +114,7 @@ class GCNTrainer(FullBatchTrainer):
         return gcn_forward(
             graph, params, x, key,
             self.cfg.drop_rate if train else 0.0, train, eager=self.eager,
-            compute_dtype=dtype,
+            compute_dtype=dtype, sublinear=self.cfg.sublinear,
         )
 
 

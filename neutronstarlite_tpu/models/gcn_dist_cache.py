@@ -1,0 +1,272 @@
+"""Distributed GCN over the compacted mirror exchange, with DepCache.
+
+The TPU completion of the reference's cached GPU engine
+``sync_compute_decoupled_from_cached`` (core/graph.hpp:3723) + ``FeatureCache``
+(core/NtsScheduler.hpp:556-637): GCN where each layer materializes mirror rows
+through the fixed-capacity slot exchange (parallel/mirror.py) and hot rows are
+served from local HBM instead of the interconnect
+(parallel/feature_cache.py):
+
+- **layer 0** aggregates raw input features, which are constant across
+  epochs, so hot mirror rows are *replicated* once at preprocessing — exact,
+  zero communication for the cached fraction, every epoch;
+- **deeper layers** aggregate activations that change per epoch; with
+  ``CACHE_REFRESH: R`` > 1 hot rows are served from a *historical* cache
+  refreshed every R epochs (the refresh epoch's full fetch doubles as the
+  cache fill — no extra exchange). Gradients don't flow through stale rows,
+  the standard historical-embedding trade. R = 1 (default) fetches fresh
+  every epoch — pure "communication" mode, exact.
+
+Enable with ``PROC_REP: 1`` + ``REP_THRESHOLD: d`` (cache rows whose source
+out-degree >= d; the reference's replication_threshold, core/graph.hpp:179).
+With PROC_REP off this trainer is the plain compacted-mirror GCN — the
+communication-only point of the reference's communication/replication/caching
+design space.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as PS
+
+from neutronstarlite_tpu.models.base import ToolkitBase, register_algorithm
+from neutronstarlite_tpu.models.gcn import init_gcn_params
+from neutronstarlite_tpu.nn.layers import batch_norm_apply, dropout
+from neutronstarlite_tpu.nn.param import AdamConfig, adam_init, adam_update
+from neutronstarlite_tpu.parallel import dist_edge_ops as deo
+from neutronstarlite_tpu.parallel import feature_cache as fc
+from neutronstarlite_tpu.parallel.feature_cache import CachedMirrorGraph
+from neutronstarlite_tpu.parallel.mesh import PARTITION_AXIS
+from neutronstarlite_tpu.utils.logging import get_logger
+from neutronstarlite_tpu.utils.timing import get_time
+
+log = get_logger("gcn_dist_cache")
+
+
+def _extract_hot(cmg: CachedMirrorGraph, mirrors: jax.Array) -> jax.Array:
+    """Slice the hot slots out of a full mirror tensor: the refresh epoch's
+    fetch doubles as the cache fill. [P, P*mb, f] -> [P, P*mc, f]."""
+    P, mb, mc = cmg.partitions, cmg.mb, cmg.mc
+    f = mirrors.shape[-1]
+    return mirrors.reshape(P, P, mb, f)[:, :, :mc].reshape(P, P * mc, f)
+
+
+def _materialize(mesh, cmg, tables, cache_tables, x, cached_rows):
+    """Mirror tensor for one layer: partial fetch when a cache is given,
+    full fetch otherwise."""
+    if cached_rows is not None and cmg.mc > 0:
+        if mesh is None:
+            return fc.dist_get_dep_nbr_partial_sim(cmg, x, cached_rows)
+        return fc.dist_get_dep_nbr_partial(mesh, cmg, cache_tables[0], x, cached_rows)
+    if mesh is None:
+        return deo.dist_get_dep_nbr_sim(cmg, x)
+    return deo.dist_get_dep_nbr(mesh, cmg, tables, x)
+
+
+def dist_gcn_cache_forward(
+    mesh,
+    cmg: CachedMirrorGraph,
+    tables,
+    cache_tables,
+    params,
+    x,
+    cached0: Optional[jax.Array],
+    caches: Optional[List[jax.Array]],
+    valid_mask,
+    key,
+    drop_rate: float,
+    train: bool,
+    fill_caches: bool,
+):
+    """Standard GCN order (aggregate -> transform), mirror-exchange variant.
+
+    Returns (logits, new_caches). ``caches[i-1]`` serves layer i's hot rows
+    when given; ``fill_caches`` makes full-fetch layers emit their hot slice
+    as the new cache (refresh epochs)."""
+    n_layers = len(params)
+    weight = jnp.asarray(cmg.edge_weight) if mesh is None else tables[3]
+    new_caches: List[jax.Array] = []
+    for i, layer in enumerate(params):
+        cr = cached0 if i == 0 else (caches[i - 1] if caches is not None else None)
+        mir = _materialize(mesh, cmg, tables, cache_tables, x, cr)
+        if i > 0 and fill_caches:
+            # only refresh steps emit caches; returning the input caches on
+            # cached steps would round-trip [P, P*mc, f] copies through the
+            # jit boundary for nothing
+            new_caches.append(_extract_hot(cmg, mir))
+        if mesh is None:
+            h = deo.dist_aggregate_dst_fuse_weight_sim(cmg, weight, mir)
+        else:
+            h = deo.dist_aggregate_dst_fuse_weight(mesh, cmg, tables, weight, mir)
+        if i == n_layers - 1:
+            x = h @ layer["W"]
+        else:
+            if "bn" in layer:
+                h = batch_norm_apply(layer["bn"], h, valid_mask=valid_mask)
+            h = jax.nn.relu(h @ layer["W"])
+            x = dropout(jax.random.fold_in(key, i), h, drop_rate, train)
+    return x, new_caches
+
+
+@register_algorithm("GCNDISTMIRROR", "GCNDISTCACHE", "GCNDISTREP")
+class DistGCNCacheTrainer(ToolkitBase):
+    """GCN over the mirror-slot exchange with hybrid dependency management."""
+
+    weight_mode = "gcn_norm"
+    with_bn = True
+
+    def build_model(self) -> None:
+        cfg = self.cfg
+        self.mesh, P = self.resolve_mesh()
+
+        # PROC_REP off => threshold above any degree => no hot slots, pure
+        # communication; the build degenerates to the plain MirrorGraph.
+        threshold = (
+            cfg.rep_threshold
+            if cfg.process_rep
+            else int(self.host_graph.out_degree.max()) + 1
+        )
+        self.cmg = CachedMirrorGraph.build(self.host_graph, P, threshold)
+        self.cache_refresh = max(int(cfg.cache_refresh), 1)
+        if self.mesh is not None:
+            self.tables = self.cmg.shard(self.mesh)
+            self.cache_tables = self.cmg.shard_cache_tables(self.mesh)
+        else:
+            self.tables = self.cache_tables = None
+
+        pad = self.cmg.pad_vertex_array
+        if self.mesh is not None:
+            vsh = NamedSharding(self.mesh, PS(PARTITION_AXIS, None))
+            vsh1 = NamedSharding(self.mesh, PS(PARTITION_AXIS))
+            csh = NamedSharding(self.mesh, PS(PARTITION_AXIS, None, None))
+            rsh = NamedSharding(self.mesh, PS())
+            put = jax.device_put
+        else:
+            put = lambda a, s: jnp.asarray(a)
+            vsh = vsh1 = csh = rsh = None
+        self.feature_p = put(pad(self.datum.feature), vsh)
+        self.label_p = put(pad(self.datum.label.astype(np.int32)), vsh1)
+        self.valid_p = put(self.cmg.valid_mask(), vsh1)
+        train01 = (self.datum.mask == 0).astype(np.float32)
+        self.train01_p = put(pad(train01), vsh1)
+
+        # layer-0 replication: raw features of hot rows, gathered host-side
+        # once — the padded vertex space indexes via pad_vertex_array ids, so
+        # replicate from the ORIGINAL [V, f] feature table (cached_global
+        # holds original ids).
+        if self.cmg.mc > 0:
+            self.cached0 = put(self.cmg.replicate_rows(self.datum.feature), csh)
+            log.info(
+                "DepCache: %d%% of mirror slots replicated (threshold %d, "
+                "mc=%d mf=%d vs dense mb=%d)",
+                int(100 * self.cmg.cached_fraction),
+                threshold,
+                self.cmg.mc,
+                self.cmg.mf,
+                self.cmg.mb,
+            )
+        else:
+            self.cached0 = None
+        self.caches: Optional[List[jax.Array]] = None  # deep-layer historical
+
+        key = jax.random.PRNGKey(self.seed)
+        params = init_gcn_params(key, cfg.layer_sizes(), with_bn=self.with_bn)
+        self.params = jax.tree.map(lambda a: put(a, rsh), params)
+        self.adam_cfg = AdamConfig(
+            alpha=cfg.learn_rate,
+            weight_decay=cfg.weight_decay,
+            decay_rate=cfg.decay_rate,
+            decay_epoch=cfg.decay_epoch,
+        )
+        self.opt_state = jax.tree.map(lambda a: put(a, rsh), adam_init(params))
+
+        mesh, cmg = self.mesh, self.cmg
+        drop_rate = cfg.drop_rate
+        masked_nll = self.masked_nll_loss
+        adam_cfg = self.adam_cfg
+
+        # O(E) tables ride the jit boundary as ARGUMENTS (not closures) so
+        # they aren't inlined into the HLO as constants.
+        def make_step(use_caches: bool, fill: bool):
+            @jax.jit
+            def step(params, opt_state, tables, cache_tables, feature, label,
+                     train01, valid, cached0, caches, key):
+                def loss_fn(p):
+                    logits, nc = dist_gcn_cache_forward(
+                        mesh, cmg, tables, cache_tables, p, feature, cached0,
+                        caches if use_caches else None, valid, key, drop_rate,
+                        True, fill,
+                    )
+                    return masked_nll(logits, label, train01), (logits, nc)
+
+                (loss, (logits, nc)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True
+                )(params)
+                params, opt_state = adam_update(params, grads, opt_state, adam_cfg)
+                return params, opt_state, loss, nc
+
+            return step
+
+        # fill only matters when historical caching is on; otherwise the
+        # fresh step would materialize hot-cache tensors just to drop them
+        self._use_hist = self.cache_refresh > 1 and self.cmg.mc > 0
+        self._step_fresh = make_step(False, fill=self._use_hist)
+        self._step_cached = make_step(True, fill=False)  # partial fetch
+
+        @jax.jit
+        def eval_logits(params, tables, cache_tables, feature, valid, cached0, key):
+            logits, _ = dist_gcn_cache_forward(
+                mesh, cmg, tables, cache_tables, params, feature, cached0,
+                None, valid, key, 0.0, False, False,
+            )
+            return logits
+
+        self._eval_logits = eval_logits
+
+    def run(self) -> Dict[str, Any]:
+        cfg = self.cfg
+        key = jax.random.PRNGKey(self.seed + 1)
+        use_hist = self._use_hist
+        log.info(
+            "GNNmini::Engine[Dist.TPU.GCNimpl.cached] %d partitions "
+            "(mc=%d mf=%d el=%d), refresh=%d, [%d] Epochs",
+            self.cmg.partitions, self.cmg.mc, self.cmg.mf, self.cmg.el,
+            self.cache_refresh, cfg.epochs,
+        )
+        loss = None
+        for epoch in range(cfg.epochs):
+            ekey = jax.random.fold_in(key, epoch)
+            t0 = get_time()
+            refresh = (not use_hist) or (epoch % self.cache_refresh == 0) or (
+                self.caches is None
+            )
+            step = self._step_fresh if refresh else self._step_cached
+            self.params, self.opt_state, loss, new_caches = step(
+                self.params, self.opt_state, self.tables, self.cache_tables,
+                self.feature_p, self.label_p, self.train01_p, self.valid_p,
+                self.cached0, None if refresh else self.caches, ekey,
+            )
+            if use_hist and refresh:
+                self.caches = new_caches
+            jax.block_until_ready(loss)
+            self.epoch_times.append(get_time() - t0)
+            if epoch % max(1, cfg.epochs // 20) == 0 or epoch == cfg.epochs - 1:
+                log.info("Epoch %d loss %f", epoch, float(loss))
+
+        logits_p = self._eval_logits(
+            self.params, self.tables, self.cache_tables, self.feature_p,
+            self.valid_p, self.cached0, key,
+        )
+        logits = self.cmg.unpad_vertex_array(np.asarray(logits_p))
+        accs = {
+            "train": self.test(logits, 0),
+            "eval": self.test(logits, 1),
+            "test": self.test(logits, 2),
+        }
+        avg = float(np.mean(self.epoch_times[1:])) if len(self.epoch_times) > 1 else 0.0
+        log.info("--avg epoch time %.4f s", avg)
+        return {"loss": float(loss), "acc": accs, "avg_epoch_s": avg}

@@ -16,7 +16,6 @@ ntsDistCPUGraphOp.hpp:85-124). PASS/FAIL is logged and returned.
 
 from __future__ import annotations
 
-import os
 from typing import Any, Dict
 
 import jax
@@ -37,17 +36,9 @@ class GetDepNbrCheck(ToolkitBase):
     """Verifies the mirror-slot exchange forward and backward."""
 
     weight_mode = "ones"
-    simulate = None
 
     def build_model(self) -> None:
-        if self.simulate is None:
-            self.simulate = os.environ.get("NTS_DIST_SIMULATE", "0") == "1"
-        if self.simulate:
-            self.mesh = None
-            P = self.cfg.partitions or 2
-        else:
-            self.mesh = make_mesh(self.cfg.partitions or None)
-            P = self.mesh.devices.size
+        self.mesh, P = self.resolve_mesh()
         self.mg = MirrorGraph.build(self.host_graph, P)
         self.tables = self.mg.shard(self.mesh) if self.mesh is not None else None
 

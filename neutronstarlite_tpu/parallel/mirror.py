@@ -39,6 +39,30 @@ from neutronstarlite_tpu.graph.storage import CSCGraph, partition_offsets
 from neutronstarlite_tpu.parallel.vertex_space import PaddedVertexSpace, round_up
 
 
+def build_local_edge_lists(P, vp, offsets, p_of_edge, slot_global, dst, w):
+    """Pass 2 shared by MirrorGraph and CachedMirrorGraph: per-consumer
+    dst-sorted edge lists in mirror-slot coordinates (stable grouping by p
+    preserves the global CSC dst order per group)."""
+    p_counts = np.bincount(p_of_edge, minlength=P)
+    el = round_up(max(int(p_counts.max()), 1), 8)
+    order = np.argsort(p_of_edge, kind="stable")
+    p_starts = np.concatenate([[0], np.cumsum(p_counts)])
+    edge_src_slot = np.zeros((P, el), dtype=np.int32)
+    edge_dst = np.full((P, el), vp - 1, dtype=np.int32)  # keep sorted tail
+    edge_weight = np.zeros((P, el), dtype=np.float32)
+    edge_mask = np.zeros((P, el), dtype=np.float32)
+    for p in range(P):
+        sel = order[p_starts[p] : p_starts[p + 1]]
+        n = len(sel)
+        if n == 0:
+            continue
+        edge_src_slot[p, :n] = slot_global[sel].astype(np.int32)
+        edge_dst[p, :n] = (dst[sel] - offsets[p]).astype(np.int32)
+        edge_weight[p, :n] = w[sel]
+        edge_mask[p, :n] = 1.0
+    return edge_src_slot, edge_dst, edge_weight, edge_mask
+
+
 @dataclasses.dataclass
 class MirrorGraph(PaddedVertexSpace):
     """Host-side mirror-slot tables; ``shard()`` ships them to the mesh."""
@@ -98,25 +122,9 @@ class MirrorGraph(PaddedVertexSpace):
         slot_in_pair = np.searchsorted(u, pair) - u_starts[key_pq]
         slot_global = q_of_edge * mb + slot_in_pair
 
-        # pass 2: per-consumer dst-sorted edge list in mirror-slot coordinates
-        # (stable grouping by p preserves the global CSC dst order per group)
-        p_counts = np.bincount(p_of_edge, minlength=P)
-        el = round_up(max(int(p_counts.max()), 1), 8)
-        order = np.argsort(p_of_edge, kind="stable")
-        p_starts = np.concatenate([[0], np.cumsum(p_counts)])
-        edge_src_slot = np.zeros((P, el), dtype=np.int32)
-        edge_dst = np.full((P, el), vp - 1, dtype=np.int32)  # keep sorted tail
-        edge_weight = np.zeros((P, el), dtype=np.float32)
-        edge_mask = np.zeros((P, el), dtype=np.float32)
-        for p in range(P):
-            sel = order[p_starts[p] : p_starts[p + 1]]
-            n = len(sel)
-            if n == 0:
-                continue
-            edge_src_slot[p, :n] = slot_global[sel].astype(np.int32)
-            edge_dst[p, :n] = (dst[sel] - offsets[p]).astype(np.int32)
-            edge_weight[p, :n] = w[sel]
-            edge_mask[p, :n] = 1.0
+        edge_src_slot, edge_dst, edge_weight, edge_mask = build_local_edge_lists(
+            P, vp, offsets, p_of_edge, slot_global, dst, w
+        )
 
         return MirrorGraph(
             partitions=P,

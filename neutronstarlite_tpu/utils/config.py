@@ -104,6 +104,12 @@ class InputInfo:
     precision: str = "float32"  # or "bfloat16" for the aggregation path
     checkpoint_dir: str = ""  # enable checkpoint/resume when set
     checkpoint_every: int = 0  # epochs between checkpoints (0 = end only)
+    # DepCache hybrid dependency management (parallel/feature_cache.py;
+    # reference replication_threshold graph.hpp:179, FeatureCache
+    # NtsScheduler.hpp:556). Active when PROC_REP:1.
+    rep_threshold: int = 0  # out-degree >= threshold => replicate/cache row
+    cache_refresh: int = 1  # epochs between deep-layer cache refreshes
+    sublinear: bool = False  # activation recomputation (ntsSubLinearNNOP)
 
     @staticmethod
     def read_from_cfg_file(path: str) -> "InputInfo":
@@ -176,6 +182,12 @@ class InputInfo:
             self.checkpoint_dir = value
         elif key == "CHECKPOINT_EVERY":
             self.checkpoint_every = int(value)
+        elif key == "REP_THRESHOLD":
+            self.rep_threshold = int(value)
+        elif key == "CACHE_REFRESH":
+            self.cache_refresh = int(value)
+        elif key == "SUBLINEAR":
+            self.sublinear = bool(int(value))
         # unknown keys ignored, matching the reference's else-silence
 
     def layer_sizes(self) -> List[int]:
@@ -206,8 +218,10 @@ class InputInfo:
         )
 
     def resolve_path(self, path: str, base_dir: Optional[str] = None) -> str:
-        """Resolve data paths relative to the cfg file's directory."""
-        if os.path.isabs(path) or not base_dir:
+        """Resolve data paths relative to the cfg file's directory. An empty
+        path stays empty (= "not provided": the datum loader's per-field
+        random fallback)."""
+        if not path or os.path.isabs(path) or not base_dir:
             return path
         return os.path.normpath(os.path.join(base_dir, path))
 

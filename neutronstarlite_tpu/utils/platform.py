@@ -26,10 +26,15 @@ def honor_platform_env(min_devices: Optional[int] = None) -> None:
         r"xla_force_host_platform_device_count=(\d+)",
         os.environ.get("XLA_FLAGS", ""),
     )
-    if not want and m:
-        want = "cpu"  # the flag is only meaningful on the host platform
+    # Only an explicit JAX_PLATFORMS choice moves the platform. A leftover
+    # --xla_force_host_platform_device_count alone must NOT silently demote
+    # an accelerator host to cpu (the flag is inert off-host in stock JAX).
     if not want:
-        return
+        if m and min_devices:
+            # dryrun callers that insist on a cpu mesh pass min_devices
+            want = "cpu"
+        else:
+            return
 
     import jax
 

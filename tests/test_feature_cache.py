@@ -1,0 +1,182 @@
+"""DepCache hybrid dependency management tests (parallel/feature_cache.py).
+
+The correctness contract: whatever fraction of mirror slots is served from
+replication/caching, the materialized mirror tensor — and therefore the
+aggregation — must equal the pure-communication path exactly (layer-0 rows
+are static; deep-layer staleness is exercised separately through the
+trainer's refresh schedule).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tests.conftest import tiny_graph
+from neutronstarlite_tpu.parallel import dist_edge_ops as deo
+from neutronstarlite_tpu.parallel import feature_cache as fc
+from neutronstarlite_tpu.parallel.feature_cache import CachedMirrorGraph
+from neutronstarlite_tpu.parallel.mirror import MirrorGraph
+
+
+def _median_threshold(g):
+    return int(np.median(g.out_degree[g.out_degree > 0]))
+
+
+@pytest.mark.parametrize("threshold_kind", ["none", "median", "all"])
+def test_cached_build_aggregation_matches_dense(rng, threshold_kind):
+    """Hot-first slot reordering must not change the aggregation semantics."""
+    g, dense = tiny_graph(rng, v_num=71, e_num=520)
+    for P in (2, 4):
+        thr = {
+            "none": int(g.out_degree.max()) + 1,  # nothing cached
+            "median": _median_threshold(g),
+            "all": 0,  # everything cached
+        }[threshold_kind]
+        cmg = CachedMirrorGraph.build(g, P, thr)
+        x = rng.standard_normal((g.v_num, 7)).astype(np.float32)
+        xp = jnp.asarray(cmg.pad_vertex_array(x))
+        out = cmg.unpad_vertex_array(
+            np.asarray(deo.dist_gather_dst_from_src_mirror_sim(cmg, xp))
+        )
+        np.testing.assert_allclose(out, dense @ x.astype(np.float64), rtol=1e-4, atol=1e-4)
+
+
+def test_cached_fraction_bounds(rng):
+    g, _ = tiny_graph(rng, v_num=50, e_num=400)
+    all_cached = CachedMirrorGraph.build(g, 2, 0)
+    none_cached = CachedMirrorGraph.build(g, 2, int(g.out_degree.max()) + 1)
+    assert all_cached.cached_fraction == 1.0
+    assert none_cached.cached_fraction == 0.0
+    assert none_cached.mc == 0
+    mid = CachedMirrorGraph.build(g, 2, _median_threshold(g))
+    assert 0.0 < mid.cached_fraction < 1.0
+
+
+def test_partial_fetch_equals_full_fetch(rng):
+    """Partial fetch (cached hot rows + communicated cold rows) must produce
+    the exact mirror tensor of the full fetch when the cache holds current
+    values — the layer-0 replication case."""
+    g, _ = tiny_graph(rng, v_num=64, e_num=500)
+    P = 4
+    cmg = CachedMirrorGraph.build(g, P, _median_threshold(g))
+    assert cmg.mc > 0 and cmg.mf > 0
+    x = rng.standard_normal((g.v_num, 5)).astype(np.float32)
+    xp = jnp.asarray(cmg.pad_vertex_array(x))
+
+    full = np.asarray(deo.dist_get_dep_nbr_sim(cmg, xp))
+    cached_rows = jnp.asarray(cmg.replicate_rows(x))
+    partial = np.asarray(fc.dist_get_dep_nbr_partial_sim(cmg, xp, cached_rows))
+
+    # padding slots differ by construction (full fetch gathers shard row 0,
+    # replication leaves zeros) and are never referenced by any edge —
+    # compare the real slots only...
+    P, mb, mc, mf = cmg.partitions, cmg.mb, cmg.mc, cmg.mf
+    real = np.zeros((P, P, mb), dtype=bool)
+    real[:, :, :mc] = cmg.cached_global >= 0
+    real[:, :, mc:] = np.swapaxes(cmg.fetch_ids_mask(), 0, 1)
+    real = real.reshape(P, P * mb)
+    np.testing.assert_allclose(partial[real], full[real], rtol=1e-6, atol=1e-6)
+
+    # ...and the aggregation over the partial mirrors end-to-end.
+    w = jnp.asarray(cmg.edge_weight)
+    agg_partial = np.asarray(
+        deo.dist_aggregate_dst_fuse_weight_sim(cmg, w, jnp.asarray(partial))
+    )
+    agg_full = np.asarray(
+        deo.dist_aggregate_dst_fuse_weight_sim(cmg, w, jnp.asarray(full))
+    )
+    np.testing.assert_allclose(agg_partial, agg_full, rtol=1e-5, atol=1e-5)
+
+
+def test_refresh_fetch_matches_replicate_rows(rng):
+    """dist_fetch_cached_rows (the on-device refresh exchange) must agree
+    with the host-side replication gather."""
+    g, _ = tiny_graph(rng, v_num=40, e_num=300)
+    cmg = CachedMirrorGraph.build(g, 2, _median_threshold(g))
+    x = rng.standard_normal((g.v_num, 6)).astype(np.float32)
+    xp = jnp.asarray(cmg.pad_vertex_array(x))
+    fetched = np.asarray(fc.dist_fetch_cached_rows_sim(cmg, xp))
+    host = cmg.replicate_rows(x)
+    # padding slots: fetched gathers row 0 of the shard, host leaves zeros —
+    # compare only real slots
+    P, mc = cmg.partitions, cmg.mc
+    real = (cmg.cached_global.reshape(P, P * mc) >= 0)
+    np.testing.assert_allclose(fetched[real], host[real], rtol=1e-6, atol=1e-6)
+
+
+def test_slot_capacity_saving(rng):
+    """The point of the exercise: the communicated capacity mf shrinks as the
+    threshold drops (more rows served from HBM)."""
+    g, _ = tiny_graph(rng, v_num=80, e_num=700)
+    plain = MirrorGraph.build(g, 4)
+    half = CachedMirrorGraph.build(g, 4, _median_threshold(g))
+    assert half.mf < plain.mb
+    assert half.mc + half.mf >= plain.mb  # groups padded separately
+
+
+def test_dist_gcn_cache_trainer_converges(rng):
+    """End-to-end DistGCNCacheTrainer (simulate mode): replication +
+    historical caching (refresh every 3 epochs) still converges."""
+    from neutronstarlite_tpu.graph.dataset import GNNDatum
+    from neutronstarlite_tpu.graph.synthetic import planted_partition_graph
+    from neutronstarlite_tpu.models.gcn_dist_cache import DistGCNCacheTrainer
+    from neutronstarlite_tpu.utils.config import InputInfo
+
+    v_num, classes, f = 150, 3, 12
+    src, dst, feature, label = planted_partition_graph(
+        v_num, classes, avg_degree=10, feature_size=f, seed=11
+    )
+    mask = (np.arange(v_num) % 3).astype(np.int32)
+    datum = GNNDatum(feature=feature, label=label.astype(np.int32), mask=mask)
+    cfg = InputInfo()
+    cfg.vertices = v_num
+    cfg.layer_string = f"{f}-16-{classes}"
+    cfg.epochs = 60
+    cfg.learn_rate = 0.02
+    cfg.drop_rate = 0.0
+    cfg.decay_epoch = -1
+    cfg.partitions = 4
+    cfg.process_rep = True
+    cfg.rep_threshold = 8
+    cfg.cache_refresh = 3
+
+    class SimTrainer(DistGCNCacheTrainer):
+        simulate = True
+
+    t = SimTrainer.from_arrays(cfg, src, dst, datum)
+    assert t.cmg.mc > 0, "threshold should cache some rows on this graph"
+    result = t.run()
+    assert result["acc"]["train"] > 0.8, result
+
+
+def test_dist_gcn_cache_trainer_pure_comm_matches_plain_gcn(rng):
+    """With PROC_REP off the cached trainer is the plain mirror GCN; it must
+    converge the same way (communication-only point of the design space)."""
+    from neutronstarlite_tpu.graph.dataset import GNNDatum
+    from neutronstarlite_tpu.graph.synthetic import planted_partition_graph
+    from neutronstarlite_tpu.models.gcn_dist_cache import DistGCNCacheTrainer
+    from neutronstarlite_tpu.utils.config import InputInfo
+
+    v_num, classes, f = 150, 3, 12
+    src, dst, feature, label = planted_partition_graph(
+        v_num, classes, avg_degree=10, feature_size=f, seed=13
+    )
+    mask = (np.arange(v_num) % 3).astype(np.int32)
+    datum = GNNDatum(feature=feature, label=label.astype(np.int32), mask=mask)
+    cfg = InputInfo()
+    cfg.vertices = v_num
+    cfg.layer_string = f"{f}-16-{classes}"
+    cfg.epochs = 50
+    cfg.learn_rate = 0.02
+    cfg.drop_rate = 0.0
+    cfg.decay_epoch = -1
+    cfg.partitions = 2
+
+    class SimTrainer(DistGCNCacheTrainer):
+        simulate = True
+
+    t = SimTrainer.from_arrays(cfg, src, dst, datum)
+    assert t.cmg.mc == 0
+    result = t.run()
+    assert result["acc"]["train"] > 0.8, result
