@@ -12,10 +12,11 @@ served from local HBM instead of the interconnect
   zero communication for the cached fraction, every epoch;
 - **deeper layers** aggregate activations that change per epoch; with
   ``CACHE_REFRESH: R`` > 1 hot rows are served from a *historical* cache
-  refreshed every R epochs (the refresh epoch's full fetch doubles as the
-  cache fill — no extra exchange). Gradients don't flow through stale rows,
-  the standard historical-embedding trade. R = 1 (default) fetches fresh
-  every epoch — pure "communication" mode, exact.
+  refilled every R epochs by an eval-mode forward (dropout off — caching a
+  train step's activations would freeze one epoch's dropout mask into the
+  hot rows for R-1 epochs). Gradients don't flow through stale rows, the
+  standard historical-embedding trade. R = 1 (default) fetches fresh every
+  epoch — pure "communication" mode, exact.
 
 Enable with ``PROC_REP: 1`` + ``REP_THRESHOLD: d`` (cache rows whose source
 out-degree >= d; the reference's replication_threshold, core/graph.hpp:179).
@@ -211,10 +212,8 @@ class DistGCNCacheTrainer(ToolkitBase):
 
             return step
 
-        # fill only matters when historical caching is on; otherwise the
-        # fresh step would materialize hot-cache tensors just to drop them
         self._use_hist = self.cache_refresh > 1 and self.cmg.mc > 0
-        self._step_fresh = make_step(False, fill=self._use_hist)
+        self._step_fresh = make_step(False, fill=False)  # full fetch
         self._step_cached = make_step(True, fill=False)  # partial fetch
 
         @jax.jit
@@ -226,6 +225,20 @@ class DistGCNCacheTrainer(ToolkitBase):
             return logits
 
         self._eval_logits = eval_logits
+
+        # cache refresh runs an EVAL-mode forward (no dropout): caching the
+        # train step's activations would freeze one epoch's dropout mask
+        # into the hot rows for the next R-1 epochs, biasing them relative
+        # to the fresh-fetched rows
+        @jax.jit
+        def refresh_caches(params, tables, cache_tables, feature, valid, cached0, key):
+            _, nc = dist_gcn_cache_forward(
+                mesh, cmg, tables, cache_tables, params, feature, cached0,
+                None, valid, key, 0.0, False, True,
+            )
+            return nc
+
+        self._refresh_caches = refresh_caches
 
     def run(self) -> Dict[str, Any]:
         cfg = self.cfg
@@ -241,17 +254,21 @@ class DistGCNCacheTrainer(ToolkitBase):
         for epoch in range(cfg.epochs):
             ekey = jax.random.fold_in(key, epoch)
             t0 = get_time()
-            refresh = (not use_hist) or (epoch % self.cache_refresh == 0) or (
-                self.caches is None
+            refresh = use_hist and (
+                epoch % self.cache_refresh == 0 or self.caches is None
             )
-            step = self._step_fresh if refresh else self._step_cached
-            self.params, self.opt_state, loss, new_caches = step(
+            if refresh:
+                self.caches = self._refresh_caches(
+                    self.params, self.tables, self.cache_tables,
+                    self.feature_p, self.valid_p, self.cached0, ekey,
+                )
+            use_cached = use_hist and self.caches is not None
+            step = self._step_cached if use_cached else self._step_fresh
+            self.params, self.opt_state, loss, _ = step(
                 self.params, self.opt_state, self.tables, self.cache_tables,
                 self.feature_p, self.label_p, self.train01_p, self.valid_p,
-                self.cached0, None if refresh else self.caches, ekey,
+                self.cached0, self.caches if use_cached else None, ekey,
             )
-            if use_hist and refresh:
-                self.caches = new_caches
             jax.block_until_ready(loss)
             self.epoch_times.append(get_time() - t0)
             if epoch % max(1, cfg.epochs // 20) == 0 or epoch == cfg.epochs - 1:
@@ -269,4 +286,8 @@ class DistGCNCacheTrainer(ToolkitBase):
         }
         avg = float(np.mean(self.epoch_times[1:])) if len(self.epoch_times) > 1 else 0.0
         log.info("--avg epoch time %.4f s", avg)
-        return {"loss": float(loss), "acc": accs, "avg_epoch_s": avg}
+        return {
+            "loss": float(loss) if loss is not None else float("nan"),
+            "acc": accs,
+            "avg_epoch_s": avg,
+        }

@@ -24,7 +24,6 @@ import numpy as np
 
 from neutronstarlite_tpu.models.base import ToolkitBase, register_algorithm
 from neutronstarlite_tpu.parallel import dist_edge_ops as deo
-from neutronstarlite_tpu.parallel.mesh import make_mesh
 from neutronstarlite_tpu.parallel.mirror import MirrorGraph
 from neutronstarlite_tpu.utils.logging import get_logger
 

@@ -80,6 +80,7 @@ def test_gcn_eager_converges_on_planted_partition():
     ("GATCPU", 0.75),
     ("GINCPU", 0.75),
     ("COMMNETGPU", 0.8),
+    ("GGCNCPU", 0.75),
 ])
 def test_model_family_converges_on_planted_partition(algo, min_test_acc):
     cfg = _planted_cfg(epochs=80)
