@@ -45,6 +45,7 @@ def commnet_forward(graph, params, x, key, drop_rate: float, train: bool):
 
 @register_algorithm("COMMNETGPU", "COMMNETCPU", "COMMNET")
 class CommNetTrainer(FullBatchTrainer):
+    supports_optim_kernel = True
     weight_mode = "gcn_norm"
 
     def init_params(self, key):

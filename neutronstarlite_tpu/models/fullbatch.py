@@ -25,6 +25,11 @@ log = get_logger("fullbatch")
 class FullBatchTrainer(ToolkitBase):
     """Template for single-mesh full-batch models (GCN/GAT/GIN/CommNet...)."""
 
+    # models whose only graph op is the fused weighted aggregation can run
+    # it over the gather-only ELL layout (OPTIM_KERNEL:1, ops/ell.py); edge-
+    # op chains (GAT/GGCN) need the CSC edge arrays and keep DeviceGraph
+    supports_optim_kernel = False
+
     def init_params(self, key):
         raise NotImplementedError
 
@@ -40,6 +45,18 @@ class FullBatchTrainer(ToolkitBase):
 
     def build_model(self) -> None:
         cfg = self.cfg
+        self.compute_graph = self.graph
+        if cfg.optim_kernel and self.supports_optim_kernel:
+            from neutronstarlite_tpu.ops.ell import EllPair
+
+            self.compute_graph = EllPair.from_host(self.host_graph)
+            # the DeviceGraph edge arrays are unused on this path — free
+            # their HBM (O(E), hundreds of MB at Reddit scale)
+            self.graph = None
+            log.info(
+                "OPTIM_KERNEL: ELL gather-only aggregation (%d fwd buckets)",
+                len(self.compute_graph.fwd.nbr),
+            )
         key = jax.random.PRNGKey(self.seed)
         self.params = self.init_params(key)
         self.adam_cfg = AdamConfig(
@@ -109,7 +126,7 @@ class FullBatchTrainer(ToolkitBase):
             ekey = jax.random.fold_in(key, epoch)
             t0 = get_time()
             self.params, self.opt_state, loss, _ = self._train_step(
-                self.params, self.opt_state, self.graph, self.feature,
+                self.params, self.opt_state, self.compute_graph, self.feature,
                 self.label, self._train_mask01, ekey,
             )
             jax.block_until_ready(loss)
@@ -126,7 +143,7 @@ class FullBatchTrainer(ToolkitBase):
             self.save(cfg.checkpoint_dir, cfg.epochs)
 
         logits = np.asarray(
-            self._eval_logits(self.params, self.graph, self.feature, key)
+            self._eval_logits(self.params, self.compute_graph, self.feature, key)
         )
         accs = {
             "train": self.test(logits, 0),

@@ -102,6 +102,7 @@ def gcn_forward(
 
 @register_algorithm("GCNCPU", "GCN", "GCNTPU")
 class GCNTrainer(FullBatchTrainer):
+    supports_optim_kernel = True
     weight_mode = "gcn_norm"
     eager = False
     with_bn = True

@@ -49,8 +49,8 @@ log = get_logger("gcn_dist_cache")
 
 
 def _extract_hot(cmg: CachedMirrorGraph, mirrors: jax.Array) -> jax.Array:
-    """Slice the hot slots out of a full mirror tensor: the refresh epoch's
-    fetch doubles as the cache fill. [P, P*mb, f] -> [P, P*mc, f]."""
+    """Slice the hot slots out of a full mirror tensor — the cache fill
+    inside the eval-mode refresh forward. [P, P*mb, f] -> [P, P*mc, f]."""
     P, mb, mc = cmg.partitions, cmg.mb, cmg.mc
     f = mirrors.shape[-1]
     return mirrors.reshape(P, P, mb, f)[:, :, :mc].reshape(P, P * mc, f)
@@ -192,29 +192,30 @@ class DistGCNCacheTrainer(ToolkitBase):
 
         # O(E) tables ride the jit boundary as ARGUMENTS (not closures) so
         # they aren't inlined into the HLO as constants.
-        def make_step(use_caches: bool, fill: bool):
+        def make_step(use_caches: bool):
+            # the train step never fills caches (fill_caches=False): refills
+            # happen in the separate eval-mode _refresh_caches forward so no
+            # dropout realization is frozen into the hot rows
             @jax.jit
             def step(params, opt_state, tables, cache_tables, feature, label,
                      train01, valid, cached0, caches, key):
                 def loss_fn(p):
-                    logits, nc = dist_gcn_cache_forward(
+                    logits, _ = dist_gcn_cache_forward(
                         mesh, cmg, tables, cache_tables, p, feature, cached0,
                         caches if use_caches else None, valid, key, drop_rate,
-                        True, fill,
+                        True, False,
                     )
-                    return masked_nll(logits, label, train01), (logits, nc)
+                    return masked_nll(logits, label, train01), logits
 
-                (loss, (logits, nc)), grads = jax.value_and_grad(
-                    loss_fn, has_aux=True
-                )(params)
+                (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
                 params, opt_state = adam_update(params, grads, opt_state, adam_cfg)
-                return params, opt_state, loss, nc
+                return params, opt_state, loss
 
             return step
 
         self._use_hist = self.cache_refresh > 1 and self.cmg.mc > 0
-        self._step_fresh = make_step(False, fill=False)  # full fetch
-        self._step_cached = make_step(True, fill=False)  # partial fetch
+        self._step_fresh = make_step(False)  # full fetch
+        self._step_cached = make_step(True)  # partial fetch
 
         @jax.jit
         def eval_logits(params, tables, cache_tables, feature, valid, cached0, key):
@@ -264,7 +265,7 @@ class DistGCNCacheTrainer(ToolkitBase):
                 )
             use_cached = use_hist and self.caches is not None
             step = self._step_cached if use_cached else self._step_fresh
-            self.params, self.opt_state, loss, _ = step(
+            self.params, self.opt_state, loss = step(
                 self.params, self.opt_state, self.tables, self.cache_tables,
                 self.feature_p, self.label_p, self.train01_p, self.valid_p,
                 self.cached0, self.caches if use_cached else None, ekey,

@@ -52,6 +52,7 @@ def gin_forward(graph, params, x, key, drop_rate: float, train: bool):
 
 @register_algorithm("GINCPU", "GINGPU", "GIN")
 class GINTrainer(FullBatchTrainer):
+    supports_optim_kernel = True
     weight_mode = "gcn_norm"  # the shared PartitionedGraph weighting
 
     def init_params(self, key):

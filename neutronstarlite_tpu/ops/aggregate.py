@@ -104,8 +104,17 @@ def _aggregate_bwd(v_num, edge_chunk, res, g):
 _aggregate.defvjp(_aggregate_fwd, _aggregate_bwd)
 
 
-def gather_dst_from_src(graph: DeviceGraph, x: jax.Array) -> jax.Array:
-    """out[v] = sum over in-edges (u -> v) of w_uv * x[u].  [V, f] -> [V, f]."""
+def gather_dst_from_src(graph, x: jax.Array) -> jax.Array:
+    """out[v] = sum over in-edges (u -> v) of w_uv * x[u].  [V, f] -> [V, f].
+
+    ``graph`` is a DeviceGraph (chunked sorted-scatter path) or an
+    ops.ell.EllPair (gather-only ELL path, the OPTIM_KERNEL cfg flag — the
+    TPU analog of the reference's optimized aggregation kernel toggle,
+    cuda/ntsCUDAFuseKernel.cuh:154)."""
+    from neutronstarlite_tpu.ops.ell import EllPair, ell_gather_dst_from_src
+
+    if isinstance(graph, EllPair):
+        return ell_gather_dst_from_src(graph, x)
     return _aggregate(
         graph.v_num,
         graph.edge_chunk,
@@ -119,9 +128,13 @@ def gather_dst_from_src(graph: DeviceGraph, x: jax.Array) -> jax.Array:
     )
 
 
-def gather_src_from_dst(graph: DeviceGraph, y: jax.Array) -> jax.Array:
+def gather_src_from_dst(graph, y: jax.Array) -> jax.Array:
     """out[u] = sum over out-edges (u -> v) of w_uv * y[v] — the CSR direction
     (the reference's backward engine, exposed as a forward op)."""
+    from neutronstarlite_tpu.ops.ell import EllPair, ell_gather_src_from_dst
+
+    if isinstance(graph, EllPair):
+        return ell_gather_src_from_dst(graph, y)
     return _aggregate(
         graph.v_num,
         graph.edge_chunk,

@@ -69,5 +69,8 @@ class NbrTable:
         return vals * self.mask[..., None].astype(vals.dtype)
 
     def reduce_sum(self, blocks: jax.Array) -> jax.Array:
-        """[V, K, f] -> [V, f] masked sum over the neighborhood axis."""
-        return (blocks * self.mask[..., None].astype(blocks.dtype)).sum(axis=1)
+        """[V, K, f] -> [V, f] sum over the neighborhood axis. Blocks from
+        edge_view/vertex_view are already padding-masked; NN-transformed
+        blocks whose padding rows became nonzero (e.g. a bias add) should be
+        re-masked by the caller via ``blocks * mask[..., None]`` first."""
+        return blocks.sum(axis=1)

@@ -95,11 +95,16 @@ def process_vertices(
     v_num = active.v_num
     ids = jnp.arange(v_num)
     vals = fn(ids)
-    ident = {
-        "sum": jnp.zeros((), vals.dtype),
-        "max": jnp.asarray(jnp.finfo(vals.dtype).min if jnp.issubdtype(vals.dtype, jnp.floating) else jnp.iinfo(vals.dtype).min, vals.dtype),
-        "min": jnp.asarray(jnp.finfo(vals.dtype).max if jnp.issubdtype(vals.dtype, jnp.floating) else jnp.iinfo(vals.dtype).max, vals.dtype),
-    }[reducer]
+    if reducer == "sum":
+        ident = jnp.zeros((), vals.dtype)
+    else:
+        if jnp.issubdtype(vals.dtype, jnp.floating):
+            lo, hi = jnp.finfo(vals.dtype).min, jnp.finfo(vals.dtype).max
+        elif vals.dtype == jnp.bool_:
+            lo, hi = False, True
+        else:
+            lo, hi = jnp.iinfo(vals.dtype).min, jnp.iinfo(vals.dtype).max
+        ident = jnp.asarray(lo if reducer == "max" else hi, vals.dtype)
     masked = jnp.where(active.mask, vals, ident)
     local = {
         "sum": jnp.sum,
