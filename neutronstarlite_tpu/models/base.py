@@ -68,6 +68,13 @@ class ToolkitBase:
         self.epoch_times = []
 
     # ---- init_graph ------------------------------------------------------
+    def _wants_ell(self) -> bool:
+        """True when build_model will replace the DeviceGraph with ELL tables
+        (OPTIM_KERNEL) — skip the O(E) device upload in that case."""
+        return bool(
+            self.cfg.optim_kernel and getattr(type(self), "supports_optim_kernel", False)
+        )
+
     def init_graph(self) -> None:
         cfg = self.cfg
         edge_path = cfg.resolve_path(cfg.edge_file, self.base_dir)
@@ -76,7 +83,8 @@ class ToolkitBase:
             self.host_graph = build_graph(
                 src, dst, cfg.vertices, weight=self.weight_mode
             )
-            self.graph = DeviceGraph.from_host(self.host_graph)
+            if not self._wants_ell():
+                self.graph = DeviceGraph.from_host(self.host_graph)
         log.info(
             "loaded graph |V|=%d |E|=%d avg_deg=%.1f",
             self.host_graph.v_num,
@@ -117,7 +125,8 @@ class ToolkitBase:
         """Construct directly from in-memory edge list + datum (tests/bench)."""
         t = cls(cfg, seed=seed)
         t.host_graph = build_graph(src, dst, cfg.vertices, weight=cls.weight_mode)
-        t.graph = DeviceGraph.from_host(t.host_graph)
+        if not t._wants_ell():
+            t.graph = DeviceGraph.from_host(t.host_graph)
         t.datum = datum
         t._finalize_datum()
         return t

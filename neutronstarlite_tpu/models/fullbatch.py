@@ -8,6 +8,7 @@ models supply ``init_params`` and ``model_forward``.
 
 from __future__ import annotations
 
+import os
 from typing import Any, Dict
 
 import jax
@@ -49,10 +50,12 @@ class FullBatchTrainer(ToolkitBase):
         if cfg.optim_kernel and self.supports_optim_kernel:
             from neutronstarlite_tpu.ops.ell import EllPair
 
-            self.compute_graph = EllPair.from_host(self.host_graph)
-            # the DeviceGraph edge arrays are unused on this path — free
-            # their HBM (O(E), hundreds of MB at Reddit scale)
+            # drop the (unused on this path) DeviceGraph edge arrays BEFORE
+            # shipping the ELL tables so peak HBM never holds both O(E)
+            # structures (base.init_graph also skips the device upload when
+            # it sees this path coming)
             self.graph = None
+            self.compute_graph = EllPair.from_host(self.host_graph)
             log.info(
                 "OPTIM_KERNEL: ELL gather-only aggregation (%d fwd buckets)",
                 len(self.compute_graph.fwd.nbr),
@@ -89,6 +92,60 @@ class FullBatchTrainer(ToolkitBase):
 
         self._train_step = train_step
         self._eval_logits = eval_logits
+
+        # DEBUGINFO decomposition (toolkits/GCN.hpp:308-353): separately
+        # jitted forward and forward+grad let the breakdown attribute epoch
+        # time to forward / backward / optimizer phases
+        @jax.jit
+        def fwd_only(params, graph, feature, label, train01, key):
+            logits = model_forward(params, graph, feature, key, True)
+            return masked_nll(logits, label, train01)
+
+        @jax.jit
+        def fwd_bwd(params, graph, feature, label, train01, key):
+            return jax.value_and_grad(
+                lambda p: masked_nll(
+                    model_forward(p, graph, feature, key, True), label, train01
+                )
+            )(params)
+
+        self._fwd_only = fwd_only
+        self._fwd_bwd = fwd_bwd
+
+    def debug_info(self, key, n: int = 3) -> str:
+        """Per-phase epoch breakdown, DEBUGINFO's role (GCN.hpp:308-353).
+
+        Times the forward, forward+grad, and full step as separate programs
+        (warm) and reports forward / backward / update attribution. Enabled
+        in run() by NTS_DEBUGINFO=1."""
+        args = (
+            self.params, self.compute_graph, self.feature, self.label,
+            self._train_mask01, key,
+        )
+
+        def med(fn, *a):
+            jax.block_until_ready(fn(*a))
+            ts = []
+            for _ in range(n):
+                t0 = get_time()
+                jax.block_until_ready(fn(*a))
+                ts.append(get_time() - t0)
+            return float(np.median(ts))
+
+        t_fwd = med(self._fwd_only, *args)
+        t_grad = med(self._fwd_bwd, *args)
+        t_step = med(
+            self._train_step, self.params, self.opt_state, self.compute_graph,
+            self.feature, self.label, self._train_mask01, key,
+        )
+        lines = [
+            "DEBUGINFO:",
+            f"#forward_time={t_fwd * 1000:.3f}(ms)",
+            f"#backward_time={max(t_grad - t_fwd, 0.0) * 1000:.3f}(ms)",
+            f"#update_time={max(t_step - t_grad, 0.0) * 1000:.3f}(ms)",
+            f"#all_train_step_time={t_step * 1000:.3f}(ms)",
+        ]
+        return "\n".join(lines)
 
     # ---- checkpoint / resume (SURVEY.md section 5 gap-fill) --------------
     def checkpoint_state(self):
@@ -141,6 +198,9 @@ class FullBatchTrainer(ToolkitBase):
                 self.save(cfg.checkpoint_dir, epoch + 1)
         if cfg.checkpoint_dir:
             self.save(cfg.checkpoint_dir, cfg.epochs)
+
+        if os.environ.get("NTS_DEBUGINFO", "0") == "1":
+            log.info("%s", self.debug_info(key))
 
         logits = np.asarray(
             self._eval_logits(self.params, self.compute_graph, self.feature, key)

@@ -3,25 +3,99 @@
 Reference: MPI_Instance RAII init (dep/gemini/mpi.hpp:48) and the
 partitions/rank topology carried by Graph (core/graph.hpp:98-105). Here the
 "world" is a 1-D jax.sharding.Mesh over the partition axis ``p``; ICI
-collectives replace the MPI ring. Multi-host scale-out keeps the same axis —
-jax.distributed + a larger mesh, no code change in the ops.
+collectives replace the MPI ring. Multi-host scale-out keeps the same axis:
+``maybe_initialize_distributed`` (MPI_Init's role) joins the processes, the
+mesh spans all global devices ordered host-major so that ring neighbors are
+intra-host except at host boundaries — the ppermute ring rides ICI within a
+host and crosses DCN exactly (hosts - 1) times per rotation, the same
+boundary structure as the reference's rank ring over machines
+(comm/network.cpp:612-633, ranks laid out one per machine in hostfile).
 """
 
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 import jax
 import numpy as np
 from jax.sharding import Mesh
 
+from neutronstarlite_tpu.utils.logging import get_logger
+
 PARTITION_AXIS = "p"
+log = get_logger("mesh")
+_dist_initialized = False
+
+
+def maybe_initialize_distributed() -> None:
+    """Join a multi-process JAX world when the environment asks for one —
+    the MPI_Instance RAII equivalent (dep/gemini/mpi.hpp:48-56).
+
+    Triggers: ``NTS_COORDINATOR`` (host:port) + ``NTS_NUM_PROCESSES`` +
+    ``NTS_PROCESS_ID`` set explicitly (the mpiexec-style launch), or
+    ``NTS_MULTIHOST=1`` for TPU-pod auto-detection (jax.distributed reads
+    the pod metadata itself). Single-process runs are untouched.
+    """
+    global _dist_initialized
+    if _dist_initialized:
+        return
+    coord = os.environ.get("NTS_COORDINATOR", "")
+    auto = os.environ.get("NTS_MULTIHOST", "0") == "1"
+    if not coord and not auto:
+        return
+    kwargs = {}
+    if coord:
+        kwargs = dict(
+            coordinator_address=coord,
+            num_processes=int(os.environ["NTS_NUM_PROCESSES"]),
+            process_id=int(os.environ["NTS_PROCESS_ID"]),
+        )
+    jax.distributed.initialize(**kwargs)
+    _dist_initialized = True
+    log.info(
+        "distributed world: process %d/%d, %d global devices",
+        jax.process_index(),
+        jax.process_count(),
+        len(jax.devices()),
+    )
+
+
+def _host_major(devices):
+    """Order devices host-major (process, then local id): ring neighbors stay
+    on ICI inside each host; DCN is crossed only at host boundaries."""
+    return sorted(devices, key=lambda d: (d.process_index, d.id))
 
 
 def make_mesh(partitions: Optional[int] = None) -> Mesh:
-    """1-D mesh over the first ``partitions`` visible devices (default: all)."""
-    devices = jax.devices()
+    """1-D mesh over ``partitions`` global devices (default: all), host-major
+    ordered (see module docstring).
+
+    Multi-process: a partial mesh must contain addressable devices of EVERY
+    process (each process shards onto the same global mesh), so the selection
+    takes partitions/process_count devices from each host; a prefix of the
+    host-major order would hand later hosts a mesh they own nothing of.
+    """
+    devices = _host_major(jax.devices())
     n = partitions or len(devices)
     if n > len(devices):
         raise ValueError(f"requested {n} partitions but only {len(devices)} devices")
+    procs = jax.process_count()
+    if procs > 1 and n < len(devices):
+        if n % procs != 0:
+            raise ValueError(
+                f"PARTITIONS={n} must be a multiple of process count {procs}"
+            )
+        per = n // procs
+        by_proc = {}
+        for d in devices:
+            by_proc.setdefault(d.process_index, []).append(d)
+        chosen = []
+        for pid in sorted(by_proc):
+            if len(by_proc[pid]) < per:
+                raise ValueError(
+                    f"process {pid} has {len(by_proc[pid])} devices < {per}"
+                )
+            chosen.extend(by_proc[pid][:per])
+        return Mesh(np.asarray(chosen), (PARTITION_AXIS,))
     return Mesh(np.asarray(devices[:n]), (PARTITION_AXIS,))

@@ -19,9 +19,11 @@ log = get_logger("main")
 
 
 def main(argv=None) -> int:
+    from neutronstarlite_tpu.parallel.mesh import maybe_initialize_distributed
     from neutronstarlite_tpu.utils.platform import honor_platform_env
 
     honor_platform_env()
+    maybe_initialize_distributed()
     argv = argv if argv is not None else sys.argv[1:]
     if len(argv) < 1:
         print("usage: python -m neutronstarlite_tpu.run <config.cfg>", file=sys.stderr)

@@ -126,3 +126,20 @@ def test_dist_gather_gradient_is_reverse_ring(rng):
     grad = dg.unpad_vertex_array(np.asarray(jax.grad(loss)(xp)))
     expected = dense.T @ cot.astype(np.float64)
     np.testing.assert_allclose(grad, expected, rtol=1e-4, atol=1e-4)
+
+
+def test_host_major_device_order_and_noop_distributed():
+    """Multi-host plumbing: host-major ordering is stable, and
+    maybe_initialize_distributed is a no-op without the env triggers."""
+    from neutronstarlite_tpu.parallel.mesh import (
+        _host_major,
+        make_mesh,
+        maybe_initialize_distributed,
+    )
+
+    maybe_initialize_distributed()  # no env -> must not touch jax.distributed
+    devs = _host_major(jax.devices())
+    keys = [(d.process_index, d.id) for d in devs]
+    assert keys == sorted(keys)
+    mesh = make_mesh(None)
+    assert mesh.devices.size == len(jax.devices())
