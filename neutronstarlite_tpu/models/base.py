@@ -151,6 +151,60 @@ class ToolkitBase:
         mesh = make_mesh(self.cfg.partitions or None)
         return mesh, mesh.devices.size
 
+    # ---- checkpoint / resume (SURVEY.md section 5 gap-fill) --------------
+    # params/opt_state live on every trainer (replicated on dist meshes, so
+    # a host-side pytree save works everywhere)
+    def checkpoint_state(self):
+        return {"params": self.params, "opt": self.opt_state}
+
+    def save(self, path: str, epoch: int) -> None:
+        from neutronstarlite_tpu.utils.checkpoint import save_checkpoint
+
+        # params are replicated: one writer suffices, and concurrent writers
+        # on a shared checkpoint dir would race on the tmp file
+        if jax.process_index() != 0:
+            return
+        save_checkpoint(path, self.checkpoint_state(), epoch)
+
+    @staticmethod
+    def _restore_like(template, arr):
+        """Put a restored host array back with the template leaf's sharding
+        (dist params are NamedSharding-replicated over the global mesh; a
+        bare jnp.asarray would be process-local and break the next step)."""
+        a = jnp.asarray(arr)
+        sh = getattr(template, "sharding", None)
+        return jax.device_put(a, sh) if sh is not None else a
+
+    def restore(self, path: str) -> int:
+        """Returns the epoch to resume from (0 when no checkpoint exists)."""
+        from neutronstarlite_tpu.utils.checkpoint import restore_checkpoint
+
+        got = restore_checkpoint(path, self.checkpoint_state())
+        if got is None:
+            return 0
+        state, step = got
+        self.params = jax.tree.map(self._restore_like, self.params, state["params"])
+        self.opt_state = jax.tree.map(self._restore_like, self.opt_state, state["opt"])
+        log.info("restored checkpoint at epoch %d from %s", step, path)
+        return step
+
+    def ckpt_begin(self) -> int:
+        """Resume epoch for the run loop (0 without CHECKPOINT_DIR)."""
+        return self.restore(self.cfg.checkpoint_dir) if self.cfg.checkpoint_dir else 0
+
+    def ckpt_epoch_end(self, epoch: int) -> None:
+        cfg = self.cfg
+        if (
+            cfg.checkpoint_dir
+            and cfg.checkpoint_every > 0
+            and (epoch + 1) % cfg.checkpoint_every == 0
+        ):
+            self.save(cfg.checkpoint_dir, epoch + 1)
+
+    def ckpt_final(self) -> None:
+        if self.cfg.checkpoint_dir:
+            self.save(self.cfg.checkpoint_dir, self.cfg.epochs)
+
     # ---- accuracy / loss helpers ----------------------------------------
     @staticmethod
     def masked_nll_loss(logits: jax.Array, label: jax.Array, mask01: jax.Array):

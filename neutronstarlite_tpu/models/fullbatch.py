@@ -47,7 +47,7 @@ class FullBatchTrainer(ToolkitBase):
     def build_model(self) -> None:
         cfg = self.cfg
         self.compute_graph = self.graph
-        if cfg.optim_kernel and self.supports_optim_kernel:
+        if self._wants_ell():
             from neutronstarlite_tpu.ops.ell import EllPair
 
             # drop the (unused on this path) DeviceGraph edge arrays BEFORE
@@ -147,28 +147,6 @@ class FullBatchTrainer(ToolkitBase):
         ]
         return "\n".join(lines)
 
-    # ---- checkpoint / resume (SURVEY.md section 5 gap-fill) --------------
-    def checkpoint_state(self):
-        return {"params": self.params, "opt": self.opt_state}
-
-    def save(self, path: str, epoch: int) -> None:
-        from neutronstarlite_tpu.utils.checkpoint import save_checkpoint
-
-        save_checkpoint(path, self.checkpoint_state(), epoch)
-
-    def restore(self, path: str) -> int:
-        """Returns the epoch to resume from (0 when no checkpoint exists)."""
-        from neutronstarlite_tpu.utils.checkpoint import restore_checkpoint
-
-        got = restore_checkpoint(path, self.checkpoint_state())
-        if got is None:
-            return 0
-        state, step = got
-        self.params = jax.tree.map(jnp.asarray, state["params"])
-        self.opt_state = jax.tree.map(jnp.asarray, state["opt"])
-        log.info("restored checkpoint at epoch %d from %s", step, path)
-        return step
-
     def run(self) -> Dict[str, Any]:
         cfg = self.cfg
         key = jax.random.PRNGKey(self.seed + 1)
@@ -177,27 +155,28 @@ class FullBatchTrainer(ToolkitBase):
             type(self).__name__,
             cfg.epochs,
         )
-        start_epoch = self.restore(cfg.checkpoint_dir) if cfg.checkpoint_dir else 0
+        start_epoch = self.ckpt_begin()
         loss = None
         for epoch in range(start_epoch, cfg.epochs):
             ekey = jax.random.fold_in(key, epoch)
             t0 = get_time()
-            self.params, self.opt_state, loss, _ = self._train_step(
+            self.params, self.opt_state, loss, logits = self._train_step(
                 self.params, self.opt_state, self.compute_graph, self.feature,
                 self.label, self._train_mask01, ekey,
             )
             jax.block_until_ready(loss)
             self.epoch_times.append(get_time() - t0)
             if epoch % max(1, cfg.epochs // 20) == 0 or epoch == cfg.epochs - 1:
+                # per-epoch Train/Eval/Test accuracy from the training
+                # forward's logits, the reference's oracle cadence
+                # (Test(0/1/2) each epoch on X[last], GCN_CPU.hpp:241-248)
+                h = np.asarray(logits)
+                self.test(h, 0)
+                self.test(h, 1)
+                self.test(h, 2)
                 log.info("Epoch %d loss %f", epoch, float(loss))
-            if (
-                cfg.checkpoint_dir
-                and cfg.checkpoint_every > 0
-                and (epoch + 1) % cfg.checkpoint_every == 0
-            ):
-                self.save(cfg.checkpoint_dir, epoch + 1)
-        if cfg.checkpoint_dir:
-            self.save(cfg.checkpoint_dir, cfg.epochs)
+            self.ckpt_epoch_end(epoch)
+        self.ckpt_final()
 
         if os.environ.get("NTS_DEBUGINFO", "0") == "1":
             log.info("%s", self.debug_info(key))

@@ -150,8 +150,9 @@ class DistGATTrainer(ToolkitBase):
             self.mg.el,
             cfg.epochs,
         )
+        start_epoch = self.ckpt_begin()
         loss = None
-        for epoch in range(cfg.epochs):
+        for epoch in range(start_epoch, cfg.epochs):
             ekey = jax.random.fold_in(key, epoch)
             t0 = get_time()
             self.params, self.opt_state, loss, _ = self._train_step(
@@ -165,9 +166,11 @@ class DistGATTrainer(ToolkitBase):
             )
             jax.block_until_ready(loss)
             self.epoch_times.append(get_time() - t0)
+            self.ckpt_epoch_end(epoch)
             if epoch % max(1, cfg.epochs // 20) == 0 or epoch == cfg.epochs - 1:
                 log.info("Epoch %d loss %f", epoch, float(loss))
 
+        self.ckpt_final()
         logits_p = self._eval_logits(self.params, self.tables, self.feature_p, key)
         logits = self.mg.unpad_vertex_array(np.asarray(logits_p))
         accs = {

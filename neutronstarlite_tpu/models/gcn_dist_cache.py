@@ -251,8 +251,9 @@ class DistGCNCacheTrainer(ToolkitBase):
             self.cmg.partitions, self.cmg.mc, self.cmg.mf, self.cmg.el,
             self.cache_refresh, cfg.epochs,
         )
+        start_epoch = self.ckpt_begin()
         loss = None
-        for epoch in range(cfg.epochs):
+        for epoch in range(start_epoch, cfg.epochs):
             ekey = jax.random.fold_in(key, epoch)
             t0 = get_time()
             refresh = use_hist and (
@@ -272,9 +273,11 @@ class DistGCNCacheTrainer(ToolkitBase):
             )
             jax.block_until_ready(loss)
             self.epoch_times.append(get_time() - t0)
+            self.ckpt_epoch_end(epoch)
             if epoch % max(1, cfg.epochs // 20) == 0 or epoch == cfg.epochs - 1:
                 log.info("Epoch %d loss %f", epoch, float(loss))
 
+        self.ckpt_final()
         logits_p = self._eval_logits(
             self.params, self.tables, self.cache_tables, self.feature_p,
             self.valid_p, self.cached0, key,

@@ -48,3 +48,44 @@ def test_trainer_resume_continues(tmp_path):
     result = t2.run()
     assert len(t2.epoch_times) == 10  # only epochs 20..29 ran
     assert result["acc"]["train"] > 0.85
+
+
+def test_dist_trainer_checkpoint_resume(rng, tmp_path):
+    """Dist trainers share the ToolkitBase checkpoint path: run 30 epochs
+    with CHECKPOINT_EVERY, kill, resume — final state matches the epochs."""
+    import numpy as np
+    from neutronstarlite_tpu.graph.dataset import GNNDatum
+    from neutronstarlite_tpu.graph.synthetic import planted_partition_graph
+    from neutronstarlite_tpu.models.gcn_dist_cache import DistGCNCacheTrainer
+    from neutronstarlite_tpu.utils.config import InputInfo
+
+    v_num, classes, f = 90, 3, 8
+    src, dst, feature, label = planted_partition_graph(
+        v_num, classes, avg_degree=8, feature_size=f, seed=31
+    )
+    mask = (np.arange(v_num) % 3).astype(np.int32)
+    datum = GNNDatum(feature=feature, label=label.astype(np.int32), mask=mask)
+
+    def make_cfg(epochs):
+        cfg = InputInfo()
+        cfg.vertices = v_num
+        cfg.layer_string = f"{f}-16-{classes}"
+        cfg.epochs = epochs
+        cfg.learn_rate = 0.02
+        cfg.drop_rate = 0.0
+        cfg.decay_epoch = -1
+        cfg.partitions = 2
+        cfg.checkpoint_dir = str(tmp_path / "ck")
+        cfg.checkpoint_every = 10
+        return cfg
+
+    class SimTrainer(DistGCNCacheTrainer):
+        simulate = True
+
+    t1 = SimTrainer.from_arrays(make_cfg(12), src, dst, datum)
+    t1.run()  # saves at epoch 10 (cadence) and 12 (final)
+
+    t2 = SimTrainer.from_arrays(make_cfg(30), src, dst, datum)
+    result = t2.run()  # resumes from 12
+    assert len(t2.epoch_times) == 30 - 12
+    assert result["acc"]["train"] > 0.8, result
