@@ -50,11 +50,21 @@ def dist_gcn_forward(
     drop_rate: float,
     train: bool,
 ):
+    """``blocks`` is either the [P, P, Eb] ring block tuple (ppermute ring
+    path) or a DistEllPair (OPTIM_KERNEL gather-only path)."""
+    from neutronstarlite_tpu.parallel.dist_ell import (
+        DistEllPair,
+        dist_ell_gather_dst_from_src,
+    )
+
     n_layers = len(params)
     for i, layer in enumerate(params):
-        h = dist_gather_dst_from_src(
-            mesh, dist.partitions, dist.vp, dist.edge_chunk, blocks, x
-        )
+        if isinstance(blocks, DistEllPair):
+            h = dist_ell_gather_dst_from_src(mesh, blocks, x)
+        else:
+            h = dist_gather_dst_from_src(
+                mesh, dist.partitions, dist.vp, dist.edge_chunk, blocks, x
+            )
         if i == n_layers - 1:
             x = h @ layer["W"]
         else:
@@ -77,7 +87,17 @@ class DistGCNTrainer(ToolkitBase):
         self.mesh = make_mesh(cfg.partitions or None)
         P = self.mesh.devices.size
         self.dist = DistGraph.build(self.host_graph, P)
-        self.blocks = self.dist.shard(self.mesh)
+        if cfg.optim_kernel:
+            from neutronstarlite_tpu.parallel.dist_ell import DistEllPair
+
+            self.blocks = DistEllPair.build(self.dist).shard(self.mesh)
+            log.info(
+                "OPTIM_KERNEL: dist gather-only aggregation "
+                "(all_gather + %d-level ELL tables)",
+                len(self.blocks.fwd.nbr),
+            )
+        else:
+            self.blocks = self.dist.shard(self.mesh)
 
         # padded, sharded vertex-space data
         pad = self.dist.pad_vertex_array
