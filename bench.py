@@ -17,7 +17,20 @@ BASELINE_EPOCH_S = 1.0 s for the 8-worker CUDA reference on this workload
 full-batch) and report vs_baseline = BASELINE_EPOCH_S / epoch_time, i.e.
 >1.0 means faster than the assumed reference.
 
-Usage: python bench.py [--scale S] [--epochs N]
+Robustness (round-1 postmortem: the TPU backend init crashed/hung deep inside
+the first device_put, producing no diagnostics): before any real work the
+backend is probed in a SUBPROCESS with a hard timeout and retried with
+backoff; on persistent failure we fail fast with the probe's stderr tail. A
+watchdog thread bounds total wall time and dumps all thread stacks before
+exiting, so a hang inside a collective or compile still yields a diagnosable
+tail instead of silence.
+
+By default the benchmark SWEEPS the implementation space the framework
+offers — {standard, eager propagation order} x {scatter, ELL gather kernel}
+— with short runs, then measures the winner properly. The printed JSON line
+carries the winner; per-config sweep timings ride in "extra".
+
+Usage: python bench.py [--scale S] [--epochs N] [--sweep {auto,off,full}]
 Prints ONE JSON line: {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
 """
 
@@ -25,7 +38,10 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
+import threading
 import time
 
 import numpy as np
@@ -36,6 +52,121 @@ REDDIT_V = 232965
 REDDIT_E = 114615892  # ~8-byte binary edges incl. self loops (data/README.md)
 LAYERS = "602-128-41"
 N_LABELS = 41
+
+_PROBE_SRC = r"""
+import json, sys, time
+t0 = time.time()
+from neutronstarlite_tpu.utils.platform import honor_platform_env
+honor_platform_env()  # a sitecustomize may pin the platform via jax.config;
+# an explicit JAX_PLATFORMS env choice (e.g. cpu for local smoke tests) wins
+import jax
+devs = jax.devices()
+import numpy as np
+x = jax.device_put(np.ones((256, 256), np.float32))
+y = (x @ x).sum()
+y.block_until_ready()
+print(json.dumps({
+    "ok": True,
+    "devices": [str(d) for d in devs],
+    "platform": jax.default_backend(),
+    "init_s": round(time.time() - t0, 1),
+}))
+"""
+
+
+def probe_backend(timeout_s: float, attempts: int, backoff_s: float):
+    """Run the backend probe in a subprocess (isolates a hung/poisoned PJRT
+    init from this process) with a hard timeout; retry with backoff.
+
+    Returns the probe's parsed JSON on success; raises SystemExit(1) with
+    the last failure's diagnostics on stderr otherwise."""
+    last = ""
+    for attempt in range(1, attempts + 1):
+        t0 = time.time()
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", _PROBE_SRC],
+                capture_output=True, text=True, timeout=timeout_s,
+            )
+        except subprocess.TimeoutExpired as e:
+            last = (
+                f"probe attempt {attempt}/{attempts}: TIMEOUT after "
+                f"{timeout_s:.0f}s (backend init hang). "
+                f"stderr tail: {(e.stderr or '')[-2000:]}"
+            )
+            print(last, file=sys.stderr, flush=True)
+            continue
+        if r.returncode == 0 and r.stdout.strip():
+            try:
+                info = json.loads(r.stdout.strip().splitlines()[-1])
+                print(
+                    f"backend probe ok in {time.time()-t0:.1f}s: "
+                    f"{info['platform']} {info['devices']}",
+                    file=sys.stderr, flush=True,
+                )
+                return info
+            except (json.JSONDecodeError, KeyError):
+                pass
+        last = (
+            f"probe attempt {attempt}/{attempts}: rc={r.returncode}. "
+            f"stderr tail: {r.stderr[-2000:]}"
+        )
+        print(last, file=sys.stderr, flush=True)
+        if attempt < attempts:
+            time.sleep(backoff_s)
+    print(
+        "FATAL: TPU/JAX backend unavailable after "
+        f"{attempts} probe attempts. Last failure:\n{last}",
+        file=sys.stderr, flush=True,
+    )
+    raise SystemExit(1)
+
+
+def start_watchdog(deadline_s: float):
+    """Bound total wall time: on expiry, dump every thread's stack to stderr
+    and hard-exit — a hang inside a collective/compile must still leave a
+    diagnosable tail."""
+
+    def fire():
+        import faulthandler
+
+        print(
+            f"WATCHDOG: bench exceeded {deadline_s:.0f}s; dumping stacks",
+            file=sys.stderr, flush=True,
+        )
+        faulthandler.dump_traceback(file=sys.stderr)
+        sys.stderr.flush()
+        os._exit(3)
+
+    t = threading.Timer(deadline_s, fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
+def _make_trainer(order, path, precision, src, dst, datum, v_num, epochs, warmup):
+    from neutronstarlite_tpu.models.gcn import GCNEagerTrainer, GCNTrainer
+    from neutronstarlite_tpu.utils.config import InputInfo
+
+    cfg = InputInfo()
+    cfg.algorithm = "GCNCPU"
+    cfg.vertices = v_num
+    cfg.layer_string = LAYERS
+    cfg.epochs = warmup + epochs
+    cfg.learn_rate = 0.01
+    cfg.weight_decay = 0.0001
+    cfg.decay_epoch = -1
+    cfg.drop_rate = 0.5
+    cfg.precision = precision
+    cfg.optim_kernel = path == "ell"
+    cls = GCNEagerTrainer if order == "eager" else GCNTrainer
+    return cls.from_arrays(cfg, src, dst, datum)
+
+
+def _timed_run(trainer, warmup):
+    result = trainer.run()
+    times = trainer.epoch_times[warmup:]
+    return float(np.median(times)), result
 
 
 def main(argv=None) -> int:
@@ -54,15 +185,41 @@ def main(argv=None) -> int:
         "narrow post-matmul width, the right order for a bandwidth-bound "
         "TPU when d_out < d_in",
     )
+    ap.add_argument(
+        "--path", default="scatter", choices=["scatter", "ell"],
+        help="aggregation backend: chunked sorted-scatter or ELL gather "
+        "(the OPTIM_KERNEL toggle)",
+    )
+    ap.add_argument(
+        "--sweep", default="auto", choices=["auto", "off", "full"],
+        help="auto: short-run sweep of order x path at --precision, then "
+        "measure the winner; full: adds the other precision; off: run "
+        "--order/--path/--precision as given",
+    )
+    ap.add_argument("--sweep-epochs", type=int, default=2)
+    ap.add_argument(
+        "--probe-timeout", type=float,
+        default=float(os.environ.get("NTS_PROBE_TIMEOUT_S", 300)),
+    )
+    ap.add_argument("--probe-attempts", type=int, default=3)
+    ap.add_argument(
+        "--deadline", type=float,
+        default=float(os.environ.get("NTS_BENCH_DEADLINE_S", 3000)),
+        help="hard wall-time bound; on expiry dump stacks and exit 3",
+    )
     args = ap.parse_args(argv)
+
+    start_watchdog(args.deadline)
+    probe = probe_backend(args.probe_timeout, args.probe_attempts, backoff_s=15.0)
+
+    from neutronstarlite_tpu.utils.platform import honor_platform_env
+
+    honor_platform_env()
 
     import jax
 
     from neutronstarlite_tpu.graph.dataset import GNNDatum
-    from neutronstarlite_tpu.graph.storage import build_graph
     from neutronstarlite_tpu.graph.synthetic import synthetic_power_law_graph
-    from neutronstarlite_tpu.models.gcn import GCNEagerTrainer, GCNTrainer
-    from neutronstarlite_tpu.utils.config import InputInfo
 
     v_num = max(int(REDDIT_V * args.scale), 64)
     e_num = max(int(REDDIT_E * args.scale), 512)
@@ -73,25 +230,61 @@ def main(argv=None) -> int:
     datum = GNNDatum.random_generate(v_num, sizes[0], N_LABELS, seed=7)
     gen_s = time.time() - t0
 
-    cfg = InputInfo()
-    cfg.algorithm = "GCNCPU"
-    cfg.vertices = v_num
-    cfg.layer_string = LAYERS
-    cfg.epochs = args.warmup + args.epochs
-    cfg.learn_rate = 0.01
-    cfg.weight_decay = 0.0001
-    cfg.decay_epoch = -1
-    cfg.drop_rate = 0.5
-    cfg.precision = args.precision
+    # ---- sweep: find the fast config with short runs -----------------------
+    sweep_results = []
+    order, path, precision = args.order, args.path, args.precision
+    if args.sweep != "off":
+        precisions = [args.precision]
+        if args.sweep == "full":
+            precisions.append(
+                "float32" if args.precision == "bfloat16" else "bfloat16"
+            )
+        grid = [
+            (o, p, pr)
+            for pr in precisions
+            for o in ("standard", "eager")
+            for p in ("scatter", "ell")
+        ]
+        best = None
+        for o, p, pr in grid:
+            t0 = time.time()
+            try:
+                tr = _make_trainer(
+                    o, p, pr, src, dst, datum, v_num,
+                    epochs=args.sweep_epochs, warmup=1,
+                )
+                ep_s, _ = _timed_run(tr, warmup=1)
+            except Exception as e:  # a config may OOM/fail; sweep continues
+                print(f"sweep {o}/{p}/{pr} FAILED: {e}", file=sys.stderr, flush=True)
+                sweep_results.append(
+                    {"order": o, "path": p, "precision": pr, "error": str(e)[:200]}
+                )
+                continue
+            finally:
+                tr = None  # free device blocks before the next config
+            sweep_results.append(
+                {
+                    "order": o, "path": p, "precision": pr,
+                    "epoch_s": round(ep_s, 4),
+                    "wall_s": round(time.time() - t0, 1),
+                }
+            )
+            print(f"sweep {o}/{p}/{pr}: {ep_s:.4f}s/epoch", file=sys.stderr, flush=True)
+            if best is None or ep_s < best[0]:
+                best = (ep_s, o, p, pr)
+        if best is None:
+            print("FATAL: every sweep config failed", file=sys.stderr, flush=True)
+            return 1
+        _, order, path, precision = best
 
+    # ---- final measurement of the winning config ---------------------------
     t0 = time.time()
-    cls = GCNEagerTrainer if args.order == "eager" else GCNTrainer
-    trainer = cls.from_arrays(cfg, src, dst, datum)
+    trainer = _make_trainer(
+        order, path, precision, src, dst, datum, v_num,
+        epochs=args.epochs, warmup=args.warmup,
+    )
     build_s = time.time() - t0
-
-    result = trainer.run()
-    times = trainer.epoch_times[args.warmup :]
-    epoch_s = float(np.median(times))
+    epoch_s, result = _timed_run(trainer, args.warmup)
 
     n_chips = 1
     layers = len(sizes) - 1
@@ -107,14 +300,17 @@ def main(argv=None) -> int:
             "e_num": e_num,
             "layers": LAYERS,
             "scale": args.scale,
-            "precision": args.precision,
-            "order": args.order,
+            "precision": precision,
+            "order": order,
+            "path": path,
             "chips": n_chips,
             "edges_per_sec_per_chip": round(edges_per_sec_per_chip, 0),
             "final_loss": result["loss"],
             "graph_gen_s": round(gen_s, 1),
             "graph_build_s": round(build_s, 1),
             "device": str(jax.devices()[0]),
+            "backend_init_s": probe.get("init_s"),
+            "sweep": sweep_results,
             "baseline_assumption_s": BASELINE_EPOCH_S,
         },
     }

@@ -51,6 +51,21 @@ def get_algorithm(name: str) -> Type["ToolkitBase"]:
         ) from None
 
 
+@jax.jit
+def _split_counts(logits_p, label_p, mask_p, valid_p):
+    """[3] (correct, total) counts over mask splits 0/1/2, restricted to real
+    (non-padding) vertices. Inputs are padded vertex-space arrays (sharded or
+    not); the sums reduce over the sharded axis inside jit."""
+    pred = jnp.argmax(logits_p, axis=-1)
+    valid = valid_p > 0
+    ok = (pred == label_p) & valid
+    splits = jnp.arange(3, dtype=mask_p.dtype)
+    sel = (mask_p[None, :] == splits[:, None]) & valid[None, :]  # [3, P*vp]
+    correct = jnp.sum(sel & ok[None, :], axis=1)
+    total = jnp.sum(sel, axis=1)
+    return correct, total
+
+
 class ToolkitBase:
     """Shared lifecycle: graph + datum loading, accuracy reporting, timing."""
 
@@ -84,7 +99,9 @@ class ToolkitBase:
                 src, dst, cfg.vertices, weight=self.weight_mode
             )
             if not self._wants_ell():
-                self.graph = DeviceGraph.from_host(self.host_graph)
+                self.graph = DeviceGraph.from_host(
+                    self.host_graph, edge_chunk=cfg.edge_chunk or None
+                )
         log.info(
             "loaded graph |V|=%d |E|=%d avg_deg=%.1f",
             self.host_graph.v_num,
@@ -126,7 +143,9 @@ class ToolkitBase:
         t = cls(cfg, seed=seed)
         t.host_graph = build_graph(src, dst, cfg.vertices, weight=cls.weight_mode)
         if not t._wants_ell():
-            t.graph = DeviceGraph.from_host(t.host_graph)
+            t.graph = DeviceGraph.from_host(
+                t.host_graph, edge_chunk=cfg.edge_chunk or None
+            )
         t.datum = datum
         t._finalize_datum()
         return t
@@ -189,8 +208,33 @@ class ToolkitBase:
         return step
 
     def ckpt_begin(self) -> int:
-        """Resume epoch for the run loop (0 without CHECKPOINT_DIR)."""
-        return self.restore(self.cfg.checkpoint_dir) if self.cfg.checkpoint_dir else 0
+        """Resume epoch for the run loop (0 without CHECKPOINT_DIR).
+
+        Multi-host: only process 0 writes checkpoints (save()), and
+        CHECKPOINT_DIR may not be shared storage — so the restored state and
+        resume epoch are broadcast from process 0. Otherwise non-zero
+        processes would restart at epoch 0 with fresh params while process 0
+        resumes at N, desynchronizing the collective counts (the reference
+        sidesteps this because every MPI rank reads its own dump file,
+        core/graph.hpp:528-583)."""
+        if not self.cfg.checkpoint_dir:
+            return 0
+        step = self.restore(self.cfg.checkpoint_dir)
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            state = jax.tree.map(
+                np.asarray, {"params": self.params, "opt": self.opt_state}
+            )
+            step, state = multihost_utils.broadcast_one_to_all((np.int32(step), state))
+            self.params = jax.tree.map(
+                self._restore_like, self.params, state["params"]
+            )
+            self.opt_state = jax.tree.map(
+                self._restore_like, self.opt_state, state["opt"]
+            )
+            step = int(step)
+        return step
 
     def ckpt_epoch_end(self, epoch: int) -> None:
         cfg = self.cfg
@@ -213,6 +257,31 @@ class ToolkitBase:
         picked = jnp.take_along_axis(logp, label[:, None], axis=-1)[:, 0]
         denom = jnp.maximum(mask01.sum(), 1.0)
         return -(picked * mask01).sum() / denom
+
+    def dist_eval_report(self, logits_p, label_p, mask_p, valid_p):
+        """Accuracy for the sharded trainers: per-split (correct, total)
+        counters reduced INSIDE jit over the sharded vertex axis — XLA inserts
+        the cross-device (and cross-host) all-reduce, the TPU form of the
+        reference's MPI_Allreduce on accuracy counters
+        (toolkits/GCN_CPU.hpp:157-158). Never materializes global logits on
+        the host, so it is multi-process safe where a
+        ``np.asarray(global_sharded_logits)`` gather is not."""
+        correct, total = _split_counts(logits_p, label_p, mask_p, valid_p)
+        correct, total = np.asarray(correct), np.asarray(total)
+        accs = {}
+        for which, nm in enumerate(("Train", "Eval", "Test")):
+            n, c = int(total[which]), int(correct[which])
+            acc = c / n if n else 0.0
+            log.info("%s Acc: %f %d %d", nm, acc, n, c)
+            accs[nm.lower()] = acc
+        return accs
+
+    def avg_epoch_time(self) -> float:
+        """Mean epoch time, excluding the first (compile) epoch when more
+        than one was timed — a 1-epoch run reports its single epoch rather
+        than a fictitious 0.0."""
+        times = self.epoch_times[1:] if len(self.epoch_times) > 1 else self.epoch_times
+        return float(np.mean(times)) if times else 0.0
 
     def test(self, logits: np.ndarray, which: int) -> float:
         """Accuracy over mask class `which` (Test(0/1/2), GCN_CPU.hpp:142-171)."""

@@ -169,7 +169,11 @@ class FullBatchTrainer(ToolkitBase):
             if epoch % max(1, cfg.epochs // 20) == 0 or epoch == cfg.epochs - 1:
                 # per-epoch Train/Eval/Test accuracy from the training
                 # forward's logits, the reference's oracle cadence
-                # (Test(0/1/2) each epoch on X[last], GCN_CPU.hpp:241-248)
+                # (Test(0/1/2) each epoch on X[last], GCN_CPU.hpp:241-248).
+                # NOTE these cadence logits are TRAIN-mode (dropout active),
+                # so mid-training Eval/Test lines are biased low relative to
+                # the final eval-mode accuracies below — same bias as the
+                # reference's cadence, kept for log parity.
                 h = np.asarray(logits)
                 self.test(h, 0)
                 self.test(h, 1)
@@ -189,7 +193,7 @@ class FullBatchTrainer(ToolkitBase):
             "eval": self.test(logits, 1),
             "test": self.test(logits, 2),
         }
-        avg = float(np.mean(self.epoch_times[1:])) if len(self.epoch_times) > 1 else 0.0
+        avg = self.avg_epoch_time()
         log.info(
             "--avg epoch time %.4f s (first %.2f s incl. compile)",
             avg,

@@ -100,6 +100,9 @@ class DistGATTrainer(ToolkitBase):
         self.label_p = put(pad(self.datum.label.astype(np.int32)), vsh1)
         train01 = (self.datum.mask == 0).astype(np.float32)
         self.train01_p = put(pad(train01), vsh1)
+        # pad fill -1 so padding rows match no mask split in the eval counters
+        self.mask_p = put(pad(self.datum.mask, fill=-1), vsh1)
+        self.valid_p = put(self.mg.valid_mask(), vsh1)
 
         key = jax.random.PRNGKey(self.seed)
         params = init_gat_params(key, cfg.layer_sizes())
@@ -172,12 +175,13 @@ class DistGATTrainer(ToolkitBase):
 
         self.ckpt_final()
         logits_p = self._eval_logits(self.params, self.tables, self.feature_p, key)
-        logits = self.mg.unpad_vertex_array(np.asarray(logits_p))
-        accs = {
-            "train": self.test(logits, 0),
-            "eval": self.test(logits, 1),
-            "test": self.test(logits, 2),
-        }
-        avg = float(np.mean(self.epoch_times[1:])) if len(self.epoch_times) > 1 else 0.0
+        accs = self.dist_eval_report(logits_p, self.label_p, self.mask_p, self.valid_p)
+        avg = self.avg_epoch_time()
         log.info("--avg epoch time %.4f s", avg)
-        return {"loss": float(loss), "acc": accs, "avg_epoch_s": avg}
+        # loss is None when a checkpoint restore resumed at/after cfg.epochs
+        # (zero epochs ran): still report the restored model's accuracy
+        return {
+            "loss": float(loss) if loss is not None else float("nan"),
+            "acc": accs,
+            "avg_epoch_s": avg,
+        }

@@ -86,7 +86,16 @@ class DistGCNTrainer(ToolkitBase):
         cfg = self.cfg
         self.mesh = make_mesh(cfg.partitions or None)
         P = self.mesh.devices.size
-        self.dist = DistGraph.build(self.host_graph, P)
+        self.dist = DistGraph.build(
+            self.host_graph, P, edge_chunk=cfg.edge_chunk or None
+        )
+        stats = self.dist.padding_stats()
+        log.info(
+            "DistGraph [P=%d vp=%d eb=%d]: %d real edges, %.2fx block padding "
+            "(max block %d, mean %.0f)",
+            P, self.dist.vp, self.dist.eb, stats["real_edges"],
+            stats["waste_ratio"], stats["max_block"], stats["mean_block"],
+        )
         if cfg.optim_kernel:
             from neutronstarlite_tpu.parallel.dist_ell import DistEllPair
 
@@ -108,6 +117,8 @@ class DistGCNTrainer(ToolkitBase):
         self.valid_p = jax.device_put(self.dist.valid_mask(), vsh1)
         train01 = (self.datum.mask == 0).astype(np.float32)
         self.train01_p = jax.device_put(pad(train01), vsh1)
+        # pad fill -1 so padding rows match no mask split in the eval counters
+        self.mask_p = jax.device_put(pad(self.datum.mask, fill=-1), vsh1)
 
         rsh = NamedSharding(self.mesh, PS())
         key = jax.random.PRNGKey(self.seed)
@@ -182,12 +193,13 @@ class DistGCNTrainer(ToolkitBase):
 
         self.ckpt_final()
         logits_p = self._eval_logits(self.params, self.blocks, self.feature_p, self.valid_p, key)
-        logits = self.dist.unpad_vertex_array(np.asarray(logits_p))
-        accs = {
-            "train": self.test(logits, 0),
-            "eval": self.test(logits, 1),
-            "test": self.test(logits, 2),
-        }
-        avg = float(np.mean(self.epoch_times[1:])) if len(self.epoch_times) > 1 else 0.0
+        accs = self.dist_eval_report(logits_p, self.label_p, self.mask_p, self.valid_p)
+        avg = self.avg_epoch_time()
         log.info("--avg epoch time %.4f s", avg)
-        return {"loss": float(loss), "acc": accs, "avg_epoch_s": avg}
+        # loss is None when a checkpoint restore resumed at/after cfg.epochs
+        # (zero epochs ran): still report the restored model's accuracy
+        return {
+            "loss": float(loss) if loss is not None else float("nan"),
+            "acc": accs,
+            "avg_epoch_s": avg,
+        }

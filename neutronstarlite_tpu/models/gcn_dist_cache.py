@@ -154,6 +154,8 @@ class DistGCNCacheTrainer(ToolkitBase):
         self.valid_p = put(self.cmg.valid_mask(), vsh1)
         train01 = (self.datum.mask == 0).astype(np.float32)
         self.train01_p = put(pad(train01), vsh1)
+        # pad fill -1 so padding rows match no mask split in the eval counters
+        self.mask_p = put(pad(self.datum.mask, fill=-1), vsh1)
 
         # layer-0 replication: raw features of hot rows, gathered host-side
         # once — the padded vertex space indexes via pad_vertex_array ids, so
@@ -282,13 +284,8 @@ class DistGCNCacheTrainer(ToolkitBase):
             self.params, self.tables, self.cache_tables, self.feature_p,
             self.valid_p, self.cached0, key,
         )
-        logits = self.cmg.unpad_vertex_array(np.asarray(logits_p))
-        accs = {
-            "train": self.test(logits, 0),
-            "eval": self.test(logits, 1),
-            "test": self.test(logits, 2),
-        }
-        avg = float(np.mean(self.epoch_times[1:])) if len(self.epoch_times) > 1 else 0.0
+        accs = self.dist_eval_report(logits_p, self.label_p, self.mask_p, self.valid_p)
+        avg = self.avg_epoch_time()
         log.info("--avg epoch time %.4f s", avg)
         return {
             "loss": float(loss) if loss is not None else float("nan"),

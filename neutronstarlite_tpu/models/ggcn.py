@@ -15,6 +15,15 @@ tensors that remain are the f'-wide score and gate (ops/edge.edge_softmax
 handles multi-channel scores; its custom_vjp is the per-channel softmax
 Jacobian). The gated aggregation is the two-input weighted op whose autodiff
 yields both the gate and feature gradients.
+
+Intentional deviations from GGCN_CPU.hpp (noted for parity benchmarking):
+the reference applies relu to EVERY layer's output including the last and
+has no inter-layer dropout; here the final layer emits raw logits (relu
+before softmax-cross-entropy would zero half the logit space) and standard
+inter-layer dropout is added, matching the conventions of the other toolkits
+in this tree. The Ws/Wd decomposition of the edge NN is exact for the
+reference's bias-free edge weight P[2l+1]; a bias term would need one extra
+[f'] parameter added to both halves' sum.
 """
 
 from __future__ import annotations

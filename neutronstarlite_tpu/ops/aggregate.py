@@ -52,24 +52,31 @@ def _scatter_accumulate(
     if acc is None:
         acc = jnp.zeros((v_num, f), dtype=acc_dtype)
 
-    if n_chunks <= 1:
-        vals = x[src] * weight[:, None].astype(x.dtype)
-        return acc.at[dst].add(
+    def chunk_add(carry, s, d, w):
+        vals = x[s] * w[:, None].astype(x.dtype)
+        return carry.at[d].add(
             vals.astype(acc_dtype), indices_are_sorted=True, unique_indices=False
         )
+
+    if n_chunks <= 1:
+        return chunk_add(acc, src, dst, weight)
+
+    # The first chunk is applied outside the scan: under shard_map the
+    # zeros-initialized accumulator is unvarying over the mesh axis while the
+    # scan body's output (which mixes in the sharded edge data) is varying,
+    # and lax.scan requires carry-in == carry-out varying types. One
+    # data-dependent update makes the carry varying without naming the mesh
+    # axis here (this op runs both inside and outside shard_map).
+    acc = chunk_add(acc, src[:edge_chunk], dst[:edge_chunk], weight[:edge_chunk])
 
     def body(carry, chunk):
         s, d, w = chunk
-        vals = x[s] * w[:, None].astype(x.dtype)
-        carry = carry.at[d].add(
-            vals.astype(acc_dtype), indices_are_sorted=True, unique_indices=False
-        )
-        return carry, None
+        return chunk_add(carry, s, d, w), None
 
     chunks = (
-        src.reshape(n_chunks, edge_chunk),
-        dst.reshape(n_chunks, edge_chunk),
-        weight.reshape(n_chunks, edge_chunk),
+        src[edge_chunk:].reshape(n_chunks - 1, edge_chunk),
+        dst[edge_chunk:].reshape(n_chunks - 1, edge_chunk),
+        weight[edge_chunk:].reshape(n_chunks - 1, edge_chunk),
     )
     acc, _ = lax.scan(body, acc, chunks)
     return acc

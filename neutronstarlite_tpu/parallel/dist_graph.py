@@ -55,6 +55,9 @@ class DistGraph(PaddedVertexSpace):
     e_num: int
     v_num: int
     edge_chunk: int
+    # [P, P] real (unpadded) edge count per block — the authoritative
+    # realness source for derived layouts (a weight-0 edge is still an edge)
+    block_count: np.ndarray = None
 
     @property
     def eb(self) -> int:
@@ -123,7 +126,23 @@ class DistGraph(PaddedVertexSpace):
             e_num=g.e_num,
             v_num=g.v_num,
             edge_chunk=int(edge_chunk),
+            block_count=counts.reshape(P, P).astype(np.int64),
         )
+
+    def padding_stats(self) -> dict:
+        """Padded-vs-real occupancy of the [P, P, Eb] layout — the scaling
+        liability to watch on power-law graphs (every block pads to the
+        global max; the reference instead balances chunks explicitly,
+        core/graph.hpp:1186-1211). DistGCNTrainer logs this at build."""
+        real = int(self.block_count.sum())
+        padded = int(self.block_src.size)
+        return {
+            "real_edges": real,
+            "padded_edges": padded,
+            "waste_ratio": padded / max(real, 1),
+            "max_block": int(self.block_count.max()),
+            "mean_block": float(self.block_count.mean()),
+        }
 
     def shard(self, mesh) -> Tuple[jax.Array, jax.Array, jax.Array]:
         """Device-put the block arrays sharded over the dst-partition axis."""

@@ -110,6 +110,10 @@ class InputInfo:
     rep_threshold: int = 0  # out-degree >= threshold => replicate/cache row
     cache_refresh: int = 1  # epochs between deep-layer cache refreshes
     sublinear: bool = False  # activation recomputation (ntsSubLinearNNOP)
+    edge_chunk: int = 0  # scatter-path edge chunk size (0 = auto); applies
+    # to the chunked-scatter layouts (DeviceGraph, DistGraph) — the ELL and
+    # mirror-slot layouts have their own slot sizing. Tests/dryruns set it
+    # small to force the multi-chunk scan regime.
 
     @staticmethod
     def read_from_cfg_file(path: str) -> "InputInfo":
@@ -188,6 +192,8 @@ class InputInfo:
             self.cache_refresh = int(value)
         elif key == "SUBLINEAR":
             self.sublinear = bool(int(value))
+        elif key == "EDGE_CHUNK":
+            self.edge_chunk = int(value)
         # unknown keys ignored, matching the reference's else-silence
 
     def layer_sizes(self) -> List[int]:
