@@ -194,6 +194,10 @@ class ToolkitBase:
         sh = getattr(template, "sharding", None)
         return jax.device_put(a, sh) if sh is not None else a
 
+    def _apply_restored(self, state) -> None:
+        self.params = jax.tree.map(self._restore_like, self.params, state["params"])
+        self.opt_state = jax.tree.map(self._restore_like, self.opt_state, state["opt"])
+
     def restore(self, path: str) -> int:
         """Returns the epoch to resume from (0 when no checkpoint exists)."""
         from neutronstarlite_tpu.utils.checkpoint import restore_checkpoint
@@ -202,8 +206,7 @@ class ToolkitBase:
         if got is None:
             return 0
         state, step = got
-        self.params = jax.tree.map(self._restore_like, self.params, state["params"])
-        self.opt_state = jax.tree.map(self._restore_like, self.opt_state, state["opt"])
+        self._apply_restored(state)
         log.info("restored checkpoint at epoch %d from %s", step, path)
         return step
 
@@ -219,21 +222,30 @@ class ToolkitBase:
         core/graph.hpp:528-583)."""
         if not self.cfg.checkpoint_dir:
             return 0
-        step = self.restore(self.cfg.checkpoint_dir)
-        if jax.process_count() > 1:
-            from jax.experimental import multihost_utils
+        if jax.process_count() <= 1:
+            return self.restore(self.cfg.checkpoint_dir)
 
-            state = jax.tree.map(
-                np.asarray, {"params": self.params, "opt": self.opt_state}
-            )
-            step, state = multihost_utils.broadcast_one_to_all((np.int32(step), state))
-            self.params = jax.tree.map(
-                self._restore_like, self.params, state["params"]
-            )
-            self.opt_state = jax.tree.map(
-                self._restore_like, self.opt_state, state["opt"]
-            )
-            step = int(step)
+        # Multi-process: keep every step SYMMETRIC across ranks. A naive
+        # per-rank restore deadlocks — device_put onto a multi-process
+        # sharding runs an internal value-equality allgather, and a rank
+        # whose dir is empty never joins it. So: (1) host-side file read
+        # only, (2) broadcast host state from process 0, (3) identical
+        # device_puts everywhere.
+        from jax.experimental import multihost_utils
+
+        from neutronstarlite_tpu.utils.checkpoint import restore_checkpoint
+
+        got = restore_checkpoint(self.cfg.checkpoint_dir, self.checkpoint_state())
+        step = int(multihost_utils.broadcast_one_to_all(np.int32(got[1] if got else 0)))
+        if step == 0:  # no checkpoint anywhere: skip the model-sized broadcast
+            return 0
+        if got is not None:
+            host_state = jax.tree.map(np.asarray, got[0])
+        else:  # same pytree structure as a restored state, current values
+            host_state = jax.tree.map(np.asarray, self.checkpoint_state())
+        host_state = multihost_utils.broadcast_one_to_all(host_state)
+        self._apply_restored(host_state)
+        log.info("restored checkpoint at epoch %d (broadcast from process 0)", step)
         return step
 
     def ckpt_epoch_end(self, epoch: int) -> None:
