@@ -15,6 +15,10 @@ from typing import Optional
 
 import numpy as np
 
+from neutronstarlite_tpu.utils.logging import get_logger
+
+log = get_logger("dataset")
+
 MASK_TRAIN = 0
 MASK_VAL = 1
 MASK_TEST = 2
@@ -65,19 +69,31 @@ class GNNDatum:
         paired with generated features when a dataset ships without features)."""
         rng = np.random.default_rng(seed)
 
+        def fallback(kind: str, path: str):
+            # loud, because a typo'd path otherwise trains on fake data and
+            # the only symptom is a quietly wrong accuracy (the reference
+            # prints "open ... fail!", GNNDatum::readF*, ntsDataloador.hpp)
+            if path:
+                log.warning(
+                    "%s file %r missing — generating random %s", kind, path, kind
+                )
+
         if feature_file and os.path.exists(feature_file):
             feature = _read_feature_table(feature_file, v_num, feature_size)
         else:
+            fallback("feature", feature_file)
             feature = rng.standard_normal((v_num, feature_size), dtype=np.float32) * 0.1
 
         if label_file and os.path.exists(label_file):
             label = _read_id_value_table(label_file, v_num).astype(np.int32)
         else:
+            fallback("label", label_file)
             label = rng.integers(0, 2, size=v_num, dtype=np.int32)
 
         if mask_file and os.path.exists(mask_file):
             mask = _read_mask_table(mask_file, v_num)
         else:
+            fallback("mask", mask_file)
             mask = (np.arange(v_num) % 3).astype(np.int32)
 
         return GNNDatum(feature=feature, label=label, mask=mask)

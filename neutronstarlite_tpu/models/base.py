@@ -82,6 +82,10 @@ class ToolkitBase:
         self.datum: Optional[GNNDatum] = None
         self.epoch_times = []
 
+    # dist trainers build their own partitioned layout; the single-device
+    # DeviceGraph upload would be O(E) wasted HBM for them
+    needs_device_graph = True
+
     # ---- init_graph ------------------------------------------------------
     def _wants_ell(self) -> bool:
         """True when build_model will replace the DeviceGraph with ELL tables
@@ -89,6 +93,9 @@ class ToolkitBase:
         return bool(
             self.cfg.optim_kernel and getattr(type(self), "supports_optim_kernel", False)
         )
+
+    def _build_device_graph(self) -> bool:
+        return type(self).needs_device_graph and not self._wants_ell()
 
     def init_graph(self) -> None:
         cfg = self.cfg
@@ -98,7 +105,7 @@ class ToolkitBase:
             self.host_graph = build_graph(
                 src, dst, cfg.vertices, weight=self.weight_mode
             )
-            if not self._wants_ell():
+            if self._build_device_graph():
                 self.graph = DeviceGraph.from_host(
                     self.host_graph, edge_chunk=cfg.edge_chunk or None
                 )
@@ -142,7 +149,7 @@ class ToolkitBase:
         """Construct directly from in-memory edge list + datum (tests/bench)."""
         t = cls(cfg, seed=seed)
         t.host_graph = build_graph(src, dst, cfg.vertices, weight=cls.weight_mode)
-        if not t._wants_ell():
+        if t._build_device_graph():
             t.graph = DeviceGraph.from_host(
                 t.host_graph, edge_chunk=cfg.edge_chunk or None
             )

@@ -77,6 +77,7 @@ def dist_gat_forward(mesh, mg, tables, params, x, key, drop_rate: float, train: 
 class DistGATTrainer(ToolkitBase):
     """Vertex-sharded full-batch GAT (PARTITIONS cfg key picks the mesh)."""
 
+    needs_device_graph = False
     weight_mode = "ones"  # softmax supplies the edge weights
 
     def build_model(self) -> None:

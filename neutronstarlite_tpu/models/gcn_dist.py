@@ -41,7 +41,7 @@ log = get_logger("gcn_dist")
 
 def dist_gcn_forward(
     mesh,
-    dist: DistGraph,
+    dist,
     blocks,
     params,
     x,
@@ -50,8 +50,13 @@ def dist_gcn_forward(
     drop_rate: float,
     train: bool,
 ):
-    """``blocks`` is either the [P, P, Eb] ring block tuple (ppermute ring
-    path) or a DistEllPair (OPTIM_KERNEL gather-only path)."""
+    """``blocks`` selects the exchange: the [P, P, Eb] 3-tuple is the
+    ppermute ring, a DistEllPair is the OPTIM_KERNEL gather-only path, and
+    the 5-tuple of mirror tables is the compacted all_to_all exchange
+    (``dist`` is then the MirrorGraph)."""
+    from neutronstarlite_tpu.parallel.dist_edge_ops import (
+        dist_gather_dst_from_src_mirror,
+    )
     from neutronstarlite_tpu.parallel.dist_ell import (
         DistEllPair,
         dist_ell_gather_dst_from_src,
@@ -61,6 +66,8 @@ def dist_gcn_forward(
     for i, layer in enumerate(params):
         if isinstance(blocks, DistEllPair):
             h = dist_ell_gather_dst_from_src(mesh, blocks, x)
+        elif isinstance(blocks, tuple) and len(blocks) == 5:
+            h = dist_gather_dst_from_src_mirror(mesh, dist, blocks, x)
         else:
             h = dist_gather_dst_from_src(
                 mesh, dist.partitions, dist.vp, dist.edge_chunk, blocks, x
@@ -79,34 +86,79 @@ def dist_gcn_forward(
 class DistGCNTrainer(ToolkitBase):
     """Full-batch GCN sharded over all mesh devices (PARTITIONS cfg key)."""
 
+    needs_device_graph = False
     weight_mode = "gcn_norm"
     with_bn = True
+
+    @staticmethod
+    def resolve_comm_layer(cfg, host_graph, P: int) -> str:
+        """ring | ell | mirror. Explicit COMM_LAYER wins; OPTIM_KERNEL:1
+        keeps its historical meaning (ell); auto compares the per-layer WIRE
+        rows of the two dense-feature exchanges — both ship P-1 remote
+        chunks per device per layer (the local chunk never crosses the
+        interconnect), of vp shard rows (ring) vs Mb compacted mirror rows
+        — and picks the smaller: the reference's active-mirror-only message
+        optimization (comm/network.cpp:505-518) as a build-time decision.
+        Mb is priced by MirrorGraph.estimate_mb (pass 1 only), so a ring
+        verdict costs no mirror-table build."""
+        from neutronstarlite_tpu.parallel.mirror import MirrorGraph
+
+        if cfg.comm_layer in ("ring", "ell", "mirror"):
+            return cfg.comm_layer
+        if cfg.comm_layer not in ("", "auto"):
+            raise ValueError(f"unknown COMM_LAYER {cfg.comm_layer!r}")
+        if cfg.optim_kernel:
+            return "ell"
+        if P == 1:
+            return "ring"  # degenerate: no wire traffic either way
+        mb, vp = MirrorGraph.estimate_mb(host_graph, P)
+        choice = "mirror" if mb < vp else "ring"
+        log.info(
+            "COMM_LAYER auto -> %s (mirror Mb=%d vs ring vp=%d wire "
+            "rows/remote chunk/layer)",
+            choice, mb, vp,
+        )
+        return choice
 
     def build_model(self) -> None:
         cfg = self.cfg
         self.mesh = make_mesh(cfg.partitions or None)
         P = self.mesh.devices.size
-        self.dist = DistGraph.build(
-            self.host_graph, P, edge_chunk=cfg.edge_chunk or None
-        )
-        stats = self.dist.padding_stats()
-        log.info(
-            "DistGraph [P=%d vp=%d eb=%d]: %d real edges, %.2fx block padding "
-            "(max block %d, mean %.0f)",
-            P, self.dist.vp, self.dist.eb, stats["real_edges"],
-            stats["waste_ratio"], stats["max_block"], stats["mean_block"],
-        )
-        if cfg.optim_kernel:
-            from neutronstarlite_tpu.parallel.dist_ell import DistEllPair
+        layer_kind = self.resolve_comm_layer(cfg, self.host_graph, P)
+        self.comm_layer = layer_kind
 
-            self.blocks = DistEllPair.build(self.dist).shard(self.mesh)
+        if layer_kind == "mirror":
+            from neutronstarlite_tpu.parallel.mirror import MirrorGraph
+
+            self.dist = MirrorGraph.build(self.host_graph, P)
+            self.blocks = self.dist.shard(self.mesh)
             log.info(
-                "OPTIM_KERNEL: dist gather-only aggregation "
-                "(all_gather + %d-level ELL tables)",
-                len(self.blocks.fwd.nbr),
+                "COMM_LAYER mirror: compacted all_to_all exchange "
+                "(Mb=%d slots/pair, El=%d)",
+                self.dist.mb, self.dist.el,
             )
         else:
-            self.blocks = self.dist.shard(self.mesh)
+            self.dist = DistGraph.build(
+                self.host_graph, P, edge_chunk=cfg.edge_chunk or None
+            )
+            stats = self.dist.padding_stats()
+            log.info(
+                "DistGraph [P=%d vp=%d eb=%d]: %d real edges, %.2fx block "
+                "padding (max block %d, mean %.0f)",
+                P, self.dist.vp, self.dist.eb, stats["real_edges"],
+                stats["waste_ratio"], stats["max_block"], stats["mean_block"],
+            )
+            if layer_kind == "ell":
+                from neutronstarlite_tpu.parallel.dist_ell import DistEllPair
+
+                self.blocks = DistEllPair.build(self.dist).shard(self.mesh)
+                log.info(
+                    "OPTIM_KERNEL: dist gather-only aggregation "
+                    "(all_gather + %d-level ELL tables)",
+                    len(self.blocks.fwd.nbr),
+                )
+            else:
+                self.blocks = self.dist.shard(self.mesh)
 
         # padded, sharded vertex-space data
         pad = self.dist.pad_vertex_array

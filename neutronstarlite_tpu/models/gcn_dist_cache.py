@@ -117,6 +117,7 @@ def dist_gcn_cache_forward(
 class DistGCNCacheTrainer(ToolkitBase):
     """GCN over the mirror-slot exchange with hybrid dependency management."""
 
+    needs_device_graph = False
     weight_mode = "gcn_norm"
     with_bn = True
 

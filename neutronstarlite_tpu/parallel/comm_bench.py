@@ -1,0 +1,132 @@
+"""Compare the three distributed aggregation exchanges on the current mesh.
+
+``python -m neutronstarlite_tpu.parallel.comm_bench [--vertices N]
+[--avg-degree D] [--feature F] [--partitions P] [--steps K]``
+
+For each comm layer (ring = dense ppermute rotation, ell = all_gather +
+gather-only ELL tables, mirror = compacted active-mirror all_to_all) this
+builds the layout, jits one fused aggregate + backward step, and reports:
+
+- wire rows/device/layer (the analytic comm volume — what the reference
+  tunes with its active-mirror-only messages, comm/network.cpp:505-518);
+- measured step time on the current mesh (virtual CPU devices in tests,
+  real chips on a pod).
+
+The GCNDIST trainer's COMM_LAYER:auto heuristic picks mirror vs ring by the
+same wire-row comparison printed here; this tool is the measurement that
+validates (or overrides) that choice on real hardware.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def bench_layers(v_num, avg_degree, f, partitions, steps, seed=3):
+    import jax
+    import jax.numpy as jnp
+
+    from neutronstarlite_tpu.graph.storage import build_graph
+    from neutronstarlite_tpu.graph.synthetic import synthetic_power_law_graph
+    from neutronstarlite_tpu.parallel.dist_edge_ops import (
+        dist_gather_dst_from_src_mirror,
+    )
+    from neutronstarlite_tpu.parallel.dist_ell import (
+        DistEllPair,
+        dist_ell_gather_dst_from_src,
+    )
+    from neutronstarlite_tpu.parallel.dist_graph import DistGraph
+    from neutronstarlite_tpu.parallel.dist_ops import (
+        dist_gather_dst_from_src,
+        vertex_sharded,
+    )
+    from neutronstarlite_tpu.parallel.mesh import make_mesh
+    from neutronstarlite_tpu.parallel.mirror import MirrorGraph
+
+    e_num = v_num * avg_degree
+    src, dst = synthetic_power_law_graph(v_num, e_num, seed=seed)
+    g = build_graph(src, dst, v_num, weight="gcn_norm")
+    mesh = make_mesh(partitions or None)
+    P = mesh.devices.size
+
+    dist = DistGraph.build(g, P)
+    mg = MirrorGraph.build(g, P)
+    ell = DistEllPair.build(dist).shard(mesh)
+    blocks = dist.shard(mesh)
+    tables = mg.shard(mesh)
+
+    rng = np.random.default_rng(seed)
+    x = vertex_sharded(
+        mesh, dist.pad_vertex_array(rng.standard_normal((v_num, f)).astype(np.float32))
+    )
+
+    def loss_of(fn):
+        def loss(x):
+            return (fn(x) ** 2).sum()
+
+        return jax.jit(jax.value_and_grad(loss))
+
+    paths = {
+        "ring": (
+            loss_of(lambda x: dist_gather_dst_from_src(
+                mesh, dist.partitions, dist.vp, dist.edge_chunk, blocks, x)),
+            (P - 1) * dist.vp,
+        ),
+        "ell": (
+            loss_of(lambda x: dist_ell_gather_dst_from_src(mesh, ell, x)),
+            (P - 1) * dist.vp,  # all_gather ships the same shard rows
+        ),
+        "mirror": (
+            loss_of(lambda x: dist_gather_dst_from_src_mirror(mesh, mg, tables, x)),
+            (P - 1) * mg.mb,  # the p->p all_to_all chunk stays on-device
+        ),
+    }
+
+    results = {}
+    for name, (fn, wire_rows) in paths.items():
+        val, grad = fn(x)  # compile
+        jax.block_until_ready(grad)
+        t0 = time.time()
+        for _ in range(steps):
+            val, grad = fn(x)
+        jax.block_until_ready(grad)
+        dt = (time.time() - t0) / steps
+        results[name] = {
+            "step_s": round(dt, 5),
+            "wire_rows_per_dev_layer": int(wire_rows),
+            "wire_mb_per_dev_layer_f32": round(wire_rows * f * 4 / 2**20, 2),
+            "check": float(val),
+        }
+    results["meta"] = {
+        "v_num": v_num, "e_num": int(g.e_num), "feature": f, "P": P,
+        "vp": dist.vp, "mb": mg.mb, "eb": dist.eb, "el": mg.el,
+        "device": str(jax.devices()[0]),
+    }
+    return results
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--vertices", type=int, default=20000)
+    ap.add_argument("--avg-degree", type=int, default=25)
+    ap.add_argument("--feature", type=int, default=128)
+    ap.add_argument("--partitions", type=int, default=0)
+    ap.add_argument("--steps", type=int, default=5)
+    args = ap.parse_args(argv)
+
+    from neutronstarlite_tpu.utils.platform import honor_platform_env
+
+    honor_platform_env()
+    out = bench_layers(
+        args.vertices, args.avg_degree, args.feature, args.partitions, args.steps
+    )
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -86,6 +86,23 @@ class MirrorGraph(PaddedVertexSpace):
         return self.edge_dst.shape[1]
 
     @staticmethod
+    def estimate_mb(g: CSCGraph, partitions: int, lane_pad: int = 8):
+        """(mb, vp) without building the tables — pass 1 only (the
+        unique-pair count). Lets COMM_LAYER:auto price the mirror exchange
+        cheaply before committing to a layout."""
+        P = partitions
+        offsets = partition_offsets(g.v_num, g.in_degree, P)
+        vp = round_up(max(int(np.diff(offsets).max()), 1), lane_pad)
+        owner = np.searchsorted(offsets, np.arange(g.v_num), side="right") - 1
+        src = g.row_indices.astype(np.int64)
+        dst = g.dst_of_edge.astype(np.int64)
+        key_pq = owner[dst] * P + owner[src]
+        u = np.unique(key_pq * g.v_num + src)
+        pq_counts = np.bincount(u // g.v_num, minlength=P * P)
+        mb = round_up(max(int(pq_counts.max()) if pq_counts.size else 1, 1), lane_pad)
+        return mb, vp
+
+    @staticmethod
     def build(g: CSCGraph, partitions: int, lane_pad: int = 8) -> "MirrorGraph":
         P = partitions
         offsets = partition_offsets(g.v_num, g.in_degree, P)

@@ -110,6 +110,11 @@ class InputInfo:
     rep_threshold: int = 0  # out-degree >= threshold => replicate/cache row
     cache_refresh: int = 1  # epochs between deep-layer cache refreshes
     sublinear: bool = False  # activation recomputation (ntsSubLinearNNOP)
+    comm_layer: str = "auto"  # dist aggregation exchange: ring (dense
+    # ppermute rotation), ell (all_gather + gather-only ELL, the OPTIM_KERNEL
+    # path), mirror (compacted active-mirror all_to_all — the analog of the
+    # reference's active-only messages, comm/network.cpp:505-518), or auto
+    # (pick mirror vs ring by estimated wire rows; OPTIM_KERNEL:1 -> ell)
     edge_chunk: int = 0  # scatter-path edge chunk size (0 = auto); applies
     # to the chunked-scatter layouts (DeviceGraph, DistGraph) — the ELL and
     # mirror-slot layouts have their own slot sizing. Tests/dryruns set it
@@ -194,6 +199,8 @@ class InputInfo:
             self.sublinear = bool(int(value))
         elif key == "EDGE_CHUNK":
             self.edge_chunk = int(value)
+        elif key == "COMM_LAYER":
+            self.comm_layer = value.strip().lower()
         # unknown keys ignored, matching the reference's else-silence
 
     def layer_sizes(self) -> List[int]:

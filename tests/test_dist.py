@@ -143,3 +143,30 @@ def test_host_major_device_order_and_noop_distributed():
     assert keys == sorted(keys)
     mesh = make_mesh(None)
     assert mesh.devices.size == len(jax.devices())
+
+
+def test_resolve_comm_layer_rules(rng):
+    """COMM_LAYER resolution: explicit wins, OPTIM_KERNEL maps to ell, auto
+    compares mirror vs ring wire rows (the active-mirror-only message
+    optimization as a build-time decision)."""
+    from neutronstarlite_tpu.models.gcn_dist import DistGCNTrainer
+    from neutronstarlite_tpu.utils.config import InputInfo
+
+    from neutronstarlite_tpu.parallel.mirror import MirrorGraph
+
+    g, _ = tiny_graph(rng, v_num=97, e_num=800)
+    cfg = InputInfo()
+    for kind in ("ring", "ell", "mirror"):
+        cfg.comm_layer = kind
+        assert DistGCNTrainer.resolve_comm_layer(cfg, g, 4) == kind
+    cfg.comm_layer = "auto"
+    cfg.optim_kernel = True
+    assert DistGCNTrainer.resolve_comm_layer(cfg, g, 4) == "ell"
+    cfg.optim_kernel = False
+    assert DistGCNTrainer.resolve_comm_layer(cfg, g, 1) == "ring"
+    kind = DistGCNTrainer.resolve_comm_layer(cfg, g, 4)
+    mb, vp = MirrorGraph.estimate_mb(g, 4)
+    assert kind == ("mirror" if mb < vp else "ring")
+    # the estimate must agree with the full build
+    mg = MirrorGraph.build(g, 4)
+    assert (mg.mb, mg.vp) == (mb, vp)
