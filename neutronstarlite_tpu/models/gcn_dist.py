@@ -112,7 +112,10 @@ class DistGCNTrainer(ToolkitBase):
         if P == 1:
             return "ring"  # degenerate: no wire traffic either way
         mb, vp = MirrorGraph.estimate_mb(host_graph, P)
-        choice = "mirror" if mb < vp else "ring"
+        # tie goes to mirror: at equal wire volume it ships one all_to_all
+        # instead of P-1 dependent ppermute rounds (measured faster on the
+        # 8-device rig even at mb == vp; see docs/PERF.md comm-layer table)
+        choice = "mirror" if mb <= vp else "ring"
         log.info(
             "COMM_LAYER auto -> %s (mirror Mb=%d vs ring vp=%d wire "
             "rows/remote chunk/layer)",
