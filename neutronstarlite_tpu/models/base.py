@@ -80,6 +80,7 @@ class ToolkitBase:
         self.host_graph: Optional[CSCGraph] = None
         self.graph: Optional[DeviceGraph] = None
         self.datum: Optional[GNNDatum] = None
+        self.host_ell = None  # optional prebuilt ops.ell.EllPair (shared)
         self.epoch_times = []
 
     # dist trainers build their own partitioned layout; the single-device
@@ -145,10 +146,25 @@ class ToolkitBase:
         dst: np.ndarray,
         datum: GNNDatum,
         seed: int = 0,
+        host_graph=None,
+        host_ell=None,
     ) -> "ToolkitBase":
-        """Construct directly from in-memory edge list + datum (tests/bench)."""
+        """Construct directly from in-memory edge list + datum (tests/bench).
+
+        ``host_graph``: pass a prebuilt CSCGraph (matching ``weight_mode``)
+        to share one host build across many trainers — the bench sweep
+        rebuilds 9 configs over the same 114M-edge graph and the host
+        CSC/CSR build dominates its wall time otherwise.
+        ``host_ell``: likewise a prebuilt ops.ell.EllPair for OPTIM_KERNEL
+        configs (the tables are precision-independent and already device-
+        resident, so sharing also skips repeat HBM uploads)."""
         t = cls(cfg, seed=seed)
-        t.host_graph = build_graph(src, dst, cfg.vertices, weight=cls.weight_mode)
+        t.host_ell = host_ell
+        t.host_graph = (
+            host_graph
+            if host_graph is not None
+            else build_graph(src, dst, cfg.vertices, weight=cls.weight_mode)
+        )
         if t._build_device_graph():
             t.graph = DeviceGraph.from_host(
                 t.host_graph, edge_chunk=cfg.edge_chunk or None

@@ -55,7 +55,11 @@ class FullBatchTrainer(ToolkitBase):
             # structures (base.init_graph also skips the device upload when
             # it sees this path coming)
             self.graph = None
-            self.compute_graph = EllPair.from_host(self.host_graph)
+            self.compute_graph = (
+                self.host_ell
+                if self.host_ell is not None
+                else EllPair.from_host(self.host_graph)
+            )
             log.info(
                 "OPTIM_KERNEL: ELL gather-only aggregation (%d fwd buckets)",
                 len(self.compute_graph.fwd.nbr),

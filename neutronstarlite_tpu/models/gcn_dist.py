@@ -154,11 +154,15 @@ class DistGCNTrainer(ToolkitBase):
             if layer_kind == "ell":
                 from neutronstarlite_tpu.parallel.dist_ell import DistEllPair
 
-                self.blocks = DistEllPair.build(self.dist).shard(self.mesh)
+                pair = DistEllPair.build(self.dist)
+                est = pair.padding_stats(stats["real_edges"])
+                self.blocks = pair.shard(self.mesh)
                 log.info(
                     "OPTIM_KERNEL: dist gather-only aggregation "
-                    "(all_gather + %d-level ELL tables)",
+                    "(all_gather + %d-level ELL tables, %.2fx/%.2fx "
+                    "fwd/bwd slot padding)",
                     len(self.blocks.fwd.nbr),
+                    est["fwd_waste_ratio"], est["bwd_waste_ratio"],
                 )
             else:
                 self.blocks = self.dist.shard(self.mesh)

@@ -51,6 +51,44 @@ def _next_pow2(x: int) -> int:
     return 1 << max(int(x) - 1, 0).bit_length()
 
 
+def ell_tables_aggregate(x, nbrs, wgts, slot_chunk: int) -> jax.Array:
+    """Shared per-level ELL reduction: concat over levels of
+    ``sum_k wgt[r, k] * x[nbr[r, k]]`` (row chunks bound the gather
+    intermediate; callers apply their own inv_perm). Single source of the
+    numeric policy for EllBuckets.aggregate AND the distributed
+    DistEll._local_aggregate — the K-reduction accumulates in f32
+    regardless of x.dtype (the fused multiply-reduce holds its accumulator
+    in registers, so wide accumulation costs no HBM traffic): bf16 reads
+    keep the bandwidth win while degree-500 sums keep ~f32 accuracy, the
+    same policy as the reference's CUDA kernel whose shared-memory
+    accumulator is float (cuda/ntsCUDAFuseKernel.cuh:147-208)."""
+    f = x.shape[1]
+
+    def row_sum(nbr, wgt):
+        vals = x[nbr] * wgt[:, :, None].astype(x.dtype)
+        return vals.sum(axis=1, dtype=jnp.float32).astype(x.dtype)
+
+    outs = []
+    for nbr, wgt in zip(nbrs, wgts):
+        Nk, K = nbr.shape
+        rows = max(slot_chunk // K, 1)
+        if Nk <= rows:
+            outs.append(row_sum(nbr, wgt))
+            continue
+        n_ch = -(-Nk // rows)
+        pad = n_ch * rows - Nk
+        nb = jnp.pad(nbr, ((0, pad), (0, 0))).reshape(n_ch, rows, K)
+        wg = jnp.pad(wgt, ((0, pad), (0, 0))).reshape(n_ch, rows, K)
+
+        def body(_, chunk):
+            n, w = chunk
+            return 0, row_sum(n, w)
+
+        _, out = lax.scan(body, 0, (nb, wg))
+        outs.append(out.reshape(n_ch * rows, f)[:Nk])
+    return jnp.concatenate(outs, axis=0)
+
+
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class EllBuckets:
@@ -113,28 +151,9 @@ class EllBuckets:
 
     def aggregate(self, x: jax.Array) -> jax.Array:
         """out[v] = sum over v's table row of w * x[nbr]; [V, f] -> [V, f]."""
-        f = x.shape[1]
-        outs = []
-        for nbr, wgt in zip(self.nbr, self.wgt):
-            Nk, K = nbr.shape
-            rows = max(self.slot_chunk // K, 1)
-            if Nk <= rows:
-                vals = x[nbr] * wgt[:, :, None].astype(x.dtype)
-                outs.append(vals.sum(axis=1))
-                continue
-            n_ch = -(-Nk // rows)
-            pad = n_ch * rows - Nk
-            nb = jnp.pad(nbr, ((0, pad), (0, 0))).reshape(n_ch, rows, K)
-            wg = jnp.pad(wgt, ((0, pad), (0, 0))).reshape(n_ch, rows, K)
-
-            def body(_, chunk):
-                n, w = chunk
-                vals = x[n] * w[:, :, None].astype(x.dtype)
-                return 0, vals.sum(axis=1)
-
-            _, out = lax.scan(body, 0, (nb, wg))
-            outs.append(out.reshape(n_ch * rows, f)[:Nk])
-        return jnp.concatenate(outs, axis=0)[self.inv_perm]
+        return ell_tables_aggregate(x, self.nbr, self.wgt, self.slot_chunk)[
+            self.inv_perm
+        ]
 
 
 @jax.tree_util.register_dataclass

@@ -1,0 +1,185 @@
+"""On-hardware TPU coverage (round-1 verdict: "zero TPU test coverage").
+
+tests/conftest.py pins the whole pytest process to the CPU platform, so
+TPU checks run in ONE subprocess (backend init is seconds; one process
+amortizes it across all checks) whose environment selects the accelerator.
+The subprocess computes golden results with numpy on the host and runs the
+core ops on the device:
+
+- ``gather_dst_from_src`` on both backends (chunked sorted-scatter and ELL
+  gather) vs the dense [V, V] @ [V, f] golden, f32 and bf16 — the open
+  round-1 question was exactly how XLA's scatter/gather lower on real TPU;
+- the edge-op chain (scatter_src_to_edge -> edge_softmax ->
+  aggregate_edge_to_dst) vs a dense softmax golden;
+- a short GCN training run asserting the loss decreases on-device.
+
+Skips (not fails) when no accelerator is reachable — CPU-only CI keeps its
+meaning; the driver's TPU rig exercises the real paths.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_TPU_SRC = r"""
+import json, sys
+import numpy as np
+
+from neutronstarlite_tpu.utils.platform import honor_platform_env
+honor_platform_env()
+import jax
+import jax.numpy as jnp
+
+platform = jax.default_backend()
+if platform == "cpu":
+    print(json.dumps({"skip": "no accelerator (default backend is cpu)"}))
+    sys.exit(0)
+# marker: backend init succeeded — from here on, a crash is a real on-device
+# failure that the parent must report as FAIL, not skip
+print("TPU_INIT_OK", file=sys.stderr, flush=True)
+
+from neutronstarlite_tpu.graph.storage import build_graph
+from neutronstarlite_tpu.ops.device_graph import DeviceGraph
+from neutronstarlite_tpu.ops.aggregate import gather_dst_from_src, gather_src_from_dst
+from neutronstarlite_tpu.ops.ell import EllPair
+from neutronstarlite_tpu.ops.edge import (
+    scatter_src_to_edge, edge_softmax, aggregate_edge_to_dst_weighted,
+)
+
+rng = np.random.default_rng(7)
+V, E, F = 257, 2111, 64
+src = rng.integers(0, V, size=E, dtype=np.uint32)
+dst = rng.integers(0, V, size=E, dtype=np.uint32)
+loops = np.arange(V, dtype=np.uint32)
+src = np.concatenate([src, loops]); dst = np.concatenate([dst, loops])
+g = build_graph(src, dst, V, weight="gcn_norm")
+dg = DeviceGraph.from_host(g, edge_chunk=512)  # force the multi-chunk scan
+ell = EllPair.from_host(g)
+
+from neutronstarlite_tpu.graph.storage import gcn_norm_weights
+w = gcn_norm_weights(src, dst, g.out_degree, g.in_degree).astype(np.float64)
+dense = np.zeros((V, V))
+np.add.at(dense, (dst.astype(np.int64), src.astype(np.int64)), w)
+
+x = rng.standard_normal((V, F)).astype(np.float32)
+golden = dense @ x.astype(np.float64)
+
+out = {"platform": platform, "device": str(jax.devices()[0]), "checks": {}}
+
+def rel_err(a, b):
+    return float(np.abs(np.asarray(a, np.float64) - b).max()
+                 / max(np.abs(b).max(), 1e-12))
+
+for name, graph in [("scatter", dg), ("ell", ell)]:
+    for dname, xx in [("f32", x), ("bf16", x.astype(jnp.bfloat16))]:
+        fwd = jax.jit(lambda gr, v: gather_dst_from_src(gr, v))
+        r = np.asarray(fwd(graph, jnp.asarray(xx)), np.float64)
+        out["checks"][f"agg_{name}_{dname}"] = rel_err(r, golden)
+
+# backward direction (CSR) vs dense transpose
+bwd = jax.jit(lambda gr, v: gather_src_from_dst(gr, v))
+r = np.asarray(bwd(dg, jnp.asarray(x)), np.float64)
+out["checks"]["agg_csr_f32"] = rel_err(r, dense.T @ x.astype(np.float64))
+
+# gradient pairing: d/dx sum(agg(x) * c) == agg_transpose(c)
+c = rng.standard_normal((V, F)).astype(np.float32)
+gfn = jax.jit(jax.grad(lambda v: (gather_dst_from_src(dg, v) * c).sum()))
+r = np.asarray(gfn(jnp.asarray(x)), np.float64)
+out["checks"]["agg_grad_f32"] = rel_err(r, dense.T @ c.astype(np.float64))
+
+# edge-op chain: per-dst softmax of edge scores, then weighted aggregate
+score = scatter_src_to_edge(dg, jnp.asarray(x[:, :1]))
+alpha = jax.jit(lambda s: edge_softmax(dg, s))(score)
+agg = jax.jit(lambda a, v: aggregate_edge_to_dst_weighted(dg, a, v))(
+    alpha, jnp.asarray(x))
+exp = np.zeros((V, V))
+sc = x[src.astype(np.int64), 0]
+np.add.at(exp, (dst.astype(np.int64), src.astype(np.int64)), np.exp(sc))
+den = exp.sum(axis=1, keepdims=True); den[den == 0] = 1.0
+soft = exp / den
+out["checks"]["edge_softmax_agg"] = rel_err(np.asarray(agg, np.float64),
+                                            soft @ x.astype(np.float64))
+
+# short on-device training run: loss must decrease
+from neutronstarlite_tpu.models.gcn import GCNTrainer
+from neutronstarlite_tpu.graph.dataset import GNNDatum
+from neutronstarlite_tpu.utils.config import InputInfo
+cfg = InputInfo(); cfg.algorithm = "GCNCPU"; cfg.vertices = V
+cfg.layer_string = "64-32-7"; cfg.epochs = 1; cfg.learn_rate = 0.01
+cfg.weight_decay = 1e-4; cfg.decay_epoch = -1; cfg.drop_rate = 0.1
+datum = GNNDatum.random_generate(V, 64, 7, seed=3)
+tr = GCNTrainer.from_arrays(cfg, src, dst, datum)
+import logging; logging.disable(logging.CRITICAL)
+loss_first = tr.run()["loss"]          # loss after epoch 0
+tr.cfg.epochs = 10                     # stateful: continues from params
+loss_last = tr.run()["loss"]           # loss after 10 more epochs
+out["checks"]["gcn_loss_finite"] = 0.0 if np.isfinite(loss_last) else 1.0
+out["loss_first"] = loss_first
+out["loss_last"] = loss_last
+print(json.dumps(out))
+"""
+
+
+@pytest.fixture(scope="module")
+def tpu_results():
+    if os.environ.get("NTS_TPU_TESTS", "1") == "0":
+        pytest.skip("NTS_TPU_TESTS=0")
+    env = dict(os.environ)
+    # undo the conftest's CPU pin for the child; let the plugin's default
+    # (or an explicit outer JAX_PLATFORMS=tpu/axon) pick the accelerator
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in [os.path.dirname(os.path.dirname(__file__)),
+                    env.get("PYTHONPATH", "")] if p
+    )
+    try:
+        # 180 s: enough for backend init (~10 s) + compiles; a wedged
+        # accelerator tunnel hangs init forever and must only cost the
+        # suite a bounded skip
+        r = subprocess.run(
+            [sys.executable, "-c", _TPU_SRC],
+            capture_output=True, text=True, timeout=180, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        pytest.skip("TPU subprocess timed out (backend unreachable?)")
+    if r.returncode != 0 or not r.stdout.strip():
+        # skip ONLY while the backend never came up (environment problem);
+        # a crash after the init marker is an on-device failure and must FAIL
+        if "TPU_INIT_OK" in (r.stderr or ""):
+            pytest.fail(f"on-device TPU run crashed: {r.stderr[-1500:]}")
+        pytest.skip(f"TPU backend unavailable: {r.stderr[-800:]}")
+    info = json.loads(r.stdout.strip().splitlines()[-1])
+    if "skip" in info:
+        pytest.skip(info["skip"])
+    return info
+
+
+def test_tpu_aggregation_both_paths(tpu_results):
+    checks = tpu_results["checks"]
+    assert checks["agg_scatter_f32"] < 1e-5, checks
+    assert checks["agg_ell_f32"] < 1e-5, checks
+    # bf16 inputs: ~8-bit mantissa; accumulation error grows with degree
+    assert checks["agg_scatter_bf16"] < 0.05, checks
+    assert checks["agg_ell_bf16"] < 0.05, checks
+
+
+def test_tpu_csr_and_gradient_pairing(tpu_results):
+    checks = tpu_results["checks"]
+    assert checks["agg_csr_f32"] < 1e-5, checks
+    assert checks["agg_grad_f32"] < 1e-5, checks
+
+
+def test_tpu_edge_softmax_chain(tpu_results):
+    assert tpu_results["checks"]["edge_softmax_agg"] < 1e-4, tpu_results
+
+
+def test_tpu_gcn_short_training(tpu_results):
+    assert tpu_results["checks"]["gcn_loss_finite"] == 0.0, tpu_results
+    # training must make progress on-device: 10 further epochs after the
+    # first must lower the loss
+    assert tpu_results["loss_last"] < tpu_results["loss_first"], tpu_results
