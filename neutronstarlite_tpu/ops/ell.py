@@ -65,8 +65,12 @@ def ell_tables_aggregate(x, nbrs, wgts, slot_chunk: int) -> jax.Array:
     f = x.shape[1]
 
     def row_sum(nbr, wgt):
-        vals = x[nbr] * wgt[:, :, None].astype(x.dtype)
-        return vals.sum(axis=1, dtype=jnp.float32).astype(x.dtype)
+        # products AND accumulation in f32 (register-resident in the fused
+        # reduce, so no extra HBM traffic; bf16 only on the gather reads) —
+        # keep in sync with ops/pallas_kernels._ell_level_kernel, which
+        # implements the identical policy
+        vals = x[nbr].astype(jnp.float32) * wgt[:, :, None]
+        return vals.sum(axis=1).astype(x.dtype)
 
     outs = []
     for nbr, wgt in zip(nbrs, wgts):

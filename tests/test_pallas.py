@@ -1,37 +1,52 @@
-"""Pallas fused-aggregation prototype: interpret-mode correctness tests."""
+"""Interpret-mode checks of the Pallas ELL aggregation kernel.
+
+Interpret mode validates the kernel's semantics everywhere; the compiled
+VMEM path runs on the real chip via tests/test_tpu.py (which exercises
+the same EllPair tables the production path uses).
+"""
+
+from __future__ import annotations
 
 import numpy as np
-import pytest
-
 import jax.numpy as jnp
 
 from tests.conftest import tiny_graph
-from neutronstarlite_tpu.ops.device_graph import DeviceGraph
-from neutronstarlite_tpu.ops.pallas_kernels import gather_dst_from_src_pallas
+from neutronstarlite_tpu.ops.ell import EllPair
+from neutronstarlite_tpu.ops.pallas_kernels import (
+    ell_aggregate_pallas,
+    gather_dst_from_src_pallas,
+)
 
 
-def test_pallas_aggregation_matches_dense(rng):
-    g, dense = tiny_graph(rng, v_num=48, e_num=300)
-    dg = DeviceGraph.from_host(g, edge_chunk=128)
+def test_pallas_level_kernel_matches_dense(rng):
+    n_rows, K, V, f = 37, 8, 23, 16
+    nbr = rng.integers(0, V, size=(n_rows, K)).astype(np.int32)
+    wgt = rng.standard_normal((n_rows, K)).astype(np.float32)
+    wgt[:, -2:] = 0.0  # padding slots must not contribute
+    x = rng.standard_normal((V, f)).astype(np.float32)
+    out = ell_aggregate_pallas(
+        jnp.asarray(nbr), jnp.asarray(wgt), jnp.asarray(x),
+        row_tile=16, interpret=True,
+    )
+    want = (x[nbr] * wgt[:, :, None]).sum(axis=1)
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-5, atol=1e-5)
+
+
+def test_pallas_full_aggregation_matches_dense(rng):
+    g, dense = tiny_graph(rng, v_num=41, e_num=301)
+    pair = EllPair.from_host(g)
     x = rng.standard_normal((g.v_num, 8)).astype(np.float32)
-
-    out = gather_dst_from_src_pallas(
-        dg.csc_src, dg.csc_dst, dg.csc_weight, jnp.asarray(x),
-        v_num=dg.v_num, edge_chunk=128, interpret=True,
-    )
-    expected = dense @ x.astype(np.float64)
-    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-4, atol=1e-4)
+    out = gather_dst_from_src_pallas(pair, jnp.asarray(x), row_tile=8, interpret=True)
+    want = dense @ x.astype(np.float64)
+    np.testing.assert_allclose(np.asarray(out, np.float64), want, rtol=1e-4, atol=1e-4)
 
 
-def test_pallas_multi_chunk_accumulates(rng):
-    g, dense = tiny_graph(rng, v_num=32, e_num=500)
-    dg = DeviceGraph.from_host(g, edge_chunk=64)
-    assert dg.num_chunks > 1
+def test_pallas_matches_ell_xla_path(rng):
+    from neutronstarlite_tpu.ops.ell import ell_gather_dst_from_src
+
+    g, _ = tiny_graph(rng, v_num=29, e_num=190)
+    pair = EllPair.from_host(g)
     x = rng.standard_normal((g.v_num, 4)).astype(np.float32)
-    out = gather_dst_from_src_pallas(
-        dg.csc_src, dg.csc_dst, dg.csc_weight, jnp.asarray(x),
-        v_num=dg.v_num, edge_chunk=64, interpret=True,
-    )
-    np.testing.assert_allclose(
-        np.asarray(out), dense @ x.astype(np.float64), rtol=1e-4, atol=1e-4
-    )
+    a = gather_dst_from_src_pallas(pair, jnp.asarray(x), row_tile=8, interpret=True)
+    b = ell_gather_dst_from_src(pair, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
