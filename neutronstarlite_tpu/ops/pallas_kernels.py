@@ -45,6 +45,10 @@ except Exception:  # pragma: no cover
 
 DEFAULT_ROW_TILE = 512
 _K_CHUNK = 8  # static inner unroll; K beyond this iterates a fori_loop
+# bucket levels wider than this stay on the XLA path (row-vectorized kernel
+# degrades to a serial K loop on few-row hub levels; Reddit-scale power-law
+# graphs carry a K ~ 2^21 supernode bucket)
+MAX_PALLAS_K = 1024
 
 
 def _ell_level_kernel(nbr_ref, wgt_ref, x_ref, o_ref, *, k_cols: int):
@@ -121,15 +125,30 @@ def gather_dst_from_src_pallas(
     """Fused CSC aggregation out[v] = sum_{(u->v)} w_uv * x[u] over the ELL
     bucket layout (ops.ell.EllPair or EllBuckets). Forward only — pair it
     with ops.ell for training (same tables, same numeric policy)."""
-    from neutronstarlite_tpu.ops.ell import EllBuckets, EllPair
+    from neutronstarlite_tpu.ops.ell import (
+        EllBuckets,
+        EllPair,
+        ell_tables_aggregate,
+    )
 
     buckets: EllBuckets = (
         ell_pair_or_buckets.fwd
         if isinstance(ell_pair_or_buckets, EllPair)
         else ell_pair_or_buckets
     )
-    outs = [
-        ell_aggregate_pallas(nbr, wgt, x, row_tile=row_tile, interpret=interpret)
-        for nbr, wgt in zip(buckets.nbr, buckets.wgt)
-    ]
+    outs = []
+    for nbr, wgt in zip(buckets.nbr, buckets.wgt):
+        if nbr.shape[1] > MAX_PALLAS_K:
+            # hub tail: the kernel vectorizes over rows and loops K, so a
+            # [few rows, K ~ 2^21] level (a power-law supernode bucket)
+            # would serialize; its XLA gather+reduce vectorizes over K
+            outs.append(
+                ell_tables_aggregate(x, [nbr], [wgt], buckets.slot_chunk)
+            )
+        else:
+            outs.append(
+                ell_aggregate_pallas(
+                    nbr, wgt, x, row_tile=row_tile, interpret=interpret
+                )
+            )
     return jnp.concatenate(outs, axis=0)[buckets.inv_perm]

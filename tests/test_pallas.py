@@ -50,3 +50,39 @@ def test_pallas_matches_ell_xla_path(rng):
     a = gather_dst_from_src_pallas(pair, jnp.asarray(x), row_tile=8, interpret=True)
     b = ell_gather_dst_from_src(pair, jnp.asarray(x))
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+def test_pallas_hybrid_falls_back_on_wide_levels(rng):
+    """Levels wider than MAX_PALLAS_K (hub buckets) take the XLA path inside
+    gather_dst_from_src_pallas; results must match both the dense reference
+    and the pure-XLA twin (EllBuckets.aggregate over the same tables)."""
+    from neutronstarlite_tpu.ops.ell import EllBuckets
+
+    V, f = 37, 4
+    x = rng.standard_normal((V, f)).astype(np.float32)
+    # two levels: one normal, one wider than the pallas bound
+    from neutronstarlite_tpu.ops import pallas_kernels as pk
+
+    wide_k = pk.MAX_PALLAS_K * 2
+    nbr_narrow = rng.integers(0, V, size=(5, 8)).astype(np.int32)
+    wgt_narrow = rng.standard_normal((5, 8)).astype(np.float32)
+    nbr_wide = rng.integers(0, V, size=(2, wide_k)).astype(np.int32)
+    wgt_wide = rng.standard_normal((2, wide_k)).astype(np.float32)
+    buckets = EllBuckets(
+        nbr=[jnp.asarray(nbr_narrow), jnp.asarray(nbr_wide)],
+        wgt=[jnp.asarray(wgt_narrow), jnp.asarray(wgt_wide)],
+        inv_perm=jnp.asarray(np.arange(7, dtype=np.int32)),
+        v_num=7,
+        slot_chunk=1 << 21,
+    )
+    out = pk.gather_dst_from_src_pallas(buckets, jnp.asarray(x), interpret=True)
+    want = np.concatenate(
+        [
+            (x[nbr_narrow] * wgt_narrow[:, :, None]).sum(axis=1),
+            (x[nbr_wide] * wgt_wide[:, :, None]).sum(axis=1),
+        ]
+    )
+    np.testing.assert_allclose(np.asarray(out), want, rtol=2e-4, atol=2e-4)
+    # the XLA twin applies the same inv_perm, so outputs compare directly
+    twin = np.asarray(buckets.aggregate(jnp.asarray(x)))
+    np.testing.assert_allclose(np.asarray(out), twin, rtol=1e-5, atol=1e-6)
