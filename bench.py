@@ -146,7 +146,7 @@ def start_watchdog(deadline_s: float):
 
 def _make_trainer(
     order, path, precision, src, dst, datum, v_num, epochs, warmup,
-    host_graph=None, host_ell=None,
+    host_graph=None, host_ell=None, kernel_tile=0,
 ):
     from neutronstarlite_tpu.models.gcn import GCNEagerTrainer, GCNTrainer
     from neutronstarlite_tpu.utils.config import InputInfo
@@ -161,7 +161,8 @@ def _make_trainer(
     cfg.decay_epoch = -1
     cfg.drop_rate = 0.5
     cfg.precision = precision
-    cfg.optim_kernel = path == "ell"
+    cfg.optim_kernel = path in ("ell", "blocked")
+    cfg.kernel_tile = kernel_tile if path == "blocked" else 0
     cls = GCNEagerTrainer if order == "eager" else GCNTrainer
     return cls.from_arrays(
         cfg, src, dst, datum, host_graph=host_graph,
@@ -192,9 +193,15 @@ def main(argv=None) -> int:
         "TPU when d_out < d_in",
     )
     ap.add_argument(
-        "--path", default="scatter", choices=["scatter", "ell"],
-        help="aggregation backend: chunked sorted-scatter or ELL gather "
-        "(the OPTIM_KERNEL toggle)",
+        "--path", default="scatter", choices=["scatter", "ell", "blocked"],
+        help="aggregation backend: chunked sorted-scatter, ELL gather "
+        "(the OPTIM_KERNEL toggle), or source-tiled blocked ELL "
+        "(beyond-VMEM gather tables)",
+    )
+    ap.add_argument(
+        "--kernel-tile", type=int, default=8192,
+        help="blocked-path source tile width (vertices); 8192 keeps the "
+        "[vt, 602] bf16 gather table ~9.4 MB, inside the on-chip budget",
     )
     ap.add_argument(
         "--sweep", default="auto", choices=["auto", "off", "full"],
@@ -257,9 +264,10 @@ def main(argv=None) -> int:
     host_graph = build_graph(src, dst, v_num, weight="gcn_norm")
     gen_s = time.time() - t0
 
-    # one ELL table build + device upload shared by every ell config (the
-    # tables are precision- and order-independent)
+    # one table build + device upload per layout shared by every config of
+    # that path (tables are precision- and order-independent)
     _ell_cache = []
+    _blocked_cache = []
 
     def get_ell():
         if not _ell_cache:
@@ -267,6 +275,22 @@ def main(argv=None) -> int:
 
             _ell_cache.append(EllPair.from_host(host_graph))
         return _ell_cache[0]
+
+    def get_blocked():
+        if not _blocked_cache:
+            from neutronstarlite_tpu.ops.blocked_ell import BlockedEllPair
+
+            _blocked_cache.append(
+                BlockedEllPair.from_host(host_graph, vt=args.kernel_tile)
+            )
+        return _blocked_cache[0]
+
+    def get_tables(path):
+        if path == "ell":
+            return get_ell()
+        if path == "blocked":
+            return get_blocked()
+        return None
 
     # ---- sweep: find the fast config with short runs -----------------------
     sweep_results = []
@@ -277,20 +301,29 @@ def main(argv=None) -> int:
             precisions.append(
                 "float32" if args.precision == "bfloat16" else "bfloat16"
             )
+        # group configs by path so only one layout's device tables are
+        # resident at a time (each layout is GBs at full scale)
         grid = [
             (o, p, pr)
+            for p in ("scatter", "ell", "blocked")
             for pr in precisions
             for o in ("standard", "eager")
-            for p in ("scatter", "ell")
         ]
         best = None
         for o, p, pr in grid:
+            # path groups run consecutively: entering a new group frees the
+            # previous layout's device tables (the final winner re-uploads
+            # once via get_tables)
+            if p != "ell":
+                _ell_cache.clear()
+            if p != "blocked":
+                _blocked_cache.clear()
             t0 = time.time()
             try:
                 tr = _make_trainer(
                     o, p, pr, src, dst, datum, v_num,
                     epochs=args.sweep_epochs, warmup=1, host_graph=host_graph,
-                    host_ell=get_ell() if p == "ell" else None,
+                    host_ell=get_tables(p), kernel_tile=args.kernel_tile,
                 )
                 ep_s, _ = _timed_run(tr, warmup=1)
             except Exception as e:  # a config may OOM/fail; sweep continues
@@ -315,17 +348,19 @@ def main(argv=None) -> int:
             print("FATAL: every sweep config failed", file=sys.stderr, flush=True)
             return 1
         _, order, path, precision = best
+        # free losing layouts' device tables (GBs at full scale) before the
+        # final measurement
         if path != "ell":
-            # the cached ELL tables live in HBM (GBs at full scale); free
-            # them before the final scatter-path measurement
             _ell_cache.clear()
+        if path != "blocked":
+            _blocked_cache.clear()
 
     # ---- final measurement of the winning config ---------------------------
     t0 = time.time()
     trainer = _make_trainer(
         order, path, precision, src, dst, datum, v_num,
         epochs=args.epochs, warmup=args.warmup, host_graph=host_graph,
-        host_ell=get_ell() if path == "ell" else None,
+        host_ell=get_tables(path), kernel_tile=args.kernel_tile,
     )
     build_s = time.time() - t0
     epoch_s, result = _timed_run(trainer, args.warmup)

@@ -48,22 +48,35 @@ class FullBatchTrainer(ToolkitBase):
         cfg = self.cfg
         self.compute_graph = self.graph
         if self._wants_ell():
-            from neutronstarlite_tpu.ops.ell import EllPair
-
             # drop the (unused on this path) DeviceGraph edge arrays BEFORE
             # shipping the ELL tables so peak HBM never holds both O(E)
             # structures (base.init_graph also skips the device upload when
             # it sees this path coming)
             self.graph = None
-            self.compute_graph = (
-                self.host_ell
-                if self.host_ell is not None
-                else EllPair.from_host(self.host_graph)
-            )
-            log.info(
-                "OPTIM_KERNEL: ELL gather-only aggregation (%d fwd buckets)",
-                len(self.compute_graph.fwd.nbr),
-            )
+            from neutronstarlite_tpu.ops.blocked_ell import BlockedEllPair
+
+            if self.host_ell is not None:
+                self.compute_graph = self.host_ell
+            elif cfg.kernel_tile > 0:
+                self.compute_graph = BlockedEllPair.from_host(
+                    self.host_graph, vt=cfg.kernel_tile
+                )
+            else:
+                from neutronstarlite_tpu.ops.ell import EllPair
+
+                self.compute_graph = EllPair.from_host(self.host_graph)
+            if isinstance(self.compute_graph, BlockedEllPair):
+                log.info(
+                    "OPTIM_KERNEL: blocked ELL aggregation (%d src tiles of "
+                    "%d vertices)",
+                    len(self.compute_graph.fwd.tiles),
+                    self.compute_graph.fwd.vt,
+                )
+            else:
+                log.info(
+                    "OPTIM_KERNEL: ELL gather-only aggregation (%d fwd buckets)",
+                    len(self.compute_graph.fwd.nbr),
+                )
         key = jax.random.PRNGKey(self.seed)
         self.params = self.init_params(key)
         self.adam_cfg = AdamConfig(

@@ -154,6 +154,14 @@ class DistGCNTrainer(ToolkitBase):
             if layer_kind == "ell":
                 from neutronstarlite_tpu.parallel.dist_ell import DistEllPair
 
+                if cfg.kernel_tile > 0:
+                    log.warning(
+                        "KERNEL_TILE:%d ignored on the distributed path — "
+                        "blocked ELL is single-device only for now (each "
+                        "shard's gather table is already 1/P-sized)",
+                        cfg.kernel_tile,
+                    )
+
                 pair = DistEllPair.build(self.dist)
                 est = pair.padding_stats(stats["real_edges"])
                 self.blocks = pair.shard(self.mesh)

@@ -114,12 +114,20 @@ _aggregate.defvjp(_aggregate_fwd, _aggregate_bwd)
 def gather_dst_from_src(graph, x: jax.Array) -> jax.Array:
     """out[v] = sum over in-edges (u -> v) of w_uv * x[u].  [V, f] -> [V, f].
 
-    ``graph`` is a DeviceGraph (chunked sorted-scatter path) or an
+    ``graph`` is a DeviceGraph (chunked sorted-scatter path), an
     ops.ell.EllPair (gather-only ELL path, the OPTIM_KERNEL cfg flag — the
     TPU analog of the reference's optimized aggregation kernel toggle,
-    cuda/ntsCUDAFuseKernel.cuh:154)."""
+    cuda/ntsCUDAFuseKernel.cuh:154), or an ops.blocked_ell.BlockedEllPair
+    (source-tiled ELL for beyond-VMEM feature tables, OPTIM_KERNEL:1 +
+    KERNEL_TILE:vt)."""
+    from neutronstarlite_tpu.ops.blocked_ell import (
+        BlockedEllPair,
+        blocked_gather_dst_from_src,
+    )
     from neutronstarlite_tpu.ops.ell import EllPair, ell_gather_dst_from_src
 
+    if isinstance(graph, BlockedEllPair):
+        return blocked_gather_dst_from_src(graph, x)
     if isinstance(graph, EllPair):
         return ell_gather_dst_from_src(graph, x)
     return _aggregate(
@@ -138,8 +146,14 @@ def gather_dst_from_src(graph, x: jax.Array) -> jax.Array:
 def gather_src_from_dst(graph, y: jax.Array) -> jax.Array:
     """out[u] = sum over out-edges (u -> v) of w_uv * y[v] — the CSR direction
     (the reference's backward engine, exposed as a forward op)."""
+    from neutronstarlite_tpu.ops.blocked_ell import (
+        BlockedEllPair,
+        blocked_gather_src_from_dst,
+    )
     from neutronstarlite_tpu.ops.ell import EllPair, ell_gather_src_from_dst
 
+    if isinstance(graph, BlockedEllPair):
+        return blocked_gather_src_from_dst(graph, y)
     if isinstance(graph, EllPair):
         return ell_gather_src_from_dst(graph, y)
     return _aggregate(

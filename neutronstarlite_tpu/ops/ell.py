@@ -51,7 +51,7 @@ def _next_pow2(x: int) -> int:
     return 1 << max(int(x) - 1, 0).bit_length()
 
 
-def ell_tables_aggregate(x, nbrs, wgts, slot_chunk: int) -> jax.Array:
+def ell_tables_aggregate(x, nbrs, wgts, slot_chunk: int, out_dtype=None) -> jax.Array:
     """Shared per-level ELL reduction: concat over levels of
     ``sum_k wgt[r, k] * x[nbr[r, k]]`` (row chunks bound the gather
     intermediate; callers apply their own inv_perm). Single source of the
@@ -61,8 +61,14 @@ def ell_tables_aggregate(x, nbrs, wgts, slot_chunk: int) -> jax.Array:
     in registers, so wide accumulation costs no HBM traffic): bf16 reads
     keep the bandwidth win while degree-500 sums keep ~f32 accuracy, the
     same policy as the reference's CUDA kernel whose shared-memory
-    accumulator is float (cuda/ntsCUDAFuseKernel.cuh:147-208)."""
+    accumulator is float (cuda/ntsCUDAFuseKernel.cuh:147-208).
+
+    ``out_dtype``: result dtype (default x.dtype). Callers that keep
+    accumulating across calls (the blocked source-tiled layout) pass
+    float32 so the cross-call sum stays wide too. A level with K == 0
+    (the zero-degree bucket) yields zero rows without any gather."""
     f = x.shape[1]
+    out_dtype = out_dtype or x.dtype
 
     def row_sum(nbr, wgt):
         # products AND accumulation in f32 (register-resident in the fused
@@ -70,11 +76,14 @@ def ell_tables_aggregate(x, nbrs, wgts, slot_chunk: int) -> jax.Array:
         # keep in sync with ops/pallas_kernels._ell_level_kernel, which
         # implements the identical policy
         vals = x[nbr].astype(jnp.float32) * wgt[:, :, None]
-        return vals.sum(axis=1).astype(x.dtype)
+        return vals.sum(axis=1).astype(out_dtype)
 
     outs = []
     for nbr, wgt in zip(nbrs, wgts):
         Nk, K = nbr.shape
+        if K == 0:
+            outs.append(jnp.zeros((Nk, f), out_dtype))
+            continue
         rows = max(slot_chunk // K, 1)
         if Nk <= rows:
             outs.append(row_sum(nbr, wgt))
@@ -122,6 +131,16 @@ class EllBuckets:
         sdeg = deg[order]
         nbrs, wgts, perm_parts = [], [], []
         i = 0
+        # zero-degree rows get a K=0 bucket: no slots, no gather work —
+        # essential for the blocked source-tiled layout where most rows
+        # have no edge in a given tile (power-law sparsity)
+        j0 = int(np.searchsorted(sdeg, 0, side="right"))
+        if j0 > 0:
+            ids = order[:j0]
+            nbrs.append(np.zeros((j0, 0), dtype=np.int32))
+            wgts.append(np.zeros((j0, 0), dtype=np.float32))
+            perm_parts.append(ids)
+            i = j0
         while i < v_num:
             K = max(_next_pow2(max(int(sdeg[i]), 1)), _MIN_K)
             j = int(np.searchsorted(sdeg, K, side="right"))

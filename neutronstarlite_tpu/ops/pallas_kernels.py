@@ -138,7 +138,10 @@ def gather_dst_from_src_pallas(
     )
     outs = []
     for nbr, wgt in zip(buckets.nbr, buckets.wgt):
-        if nbr.shape[1] > MAX_PALLAS_K:
+        if nbr.shape[1] == 0:
+            # zero-degree bucket: zero rows, no kernel launch
+            outs.append(jnp.zeros((nbr.shape[0], x.shape[1]), x.dtype))
+        elif nbr.shape[1] > MAX_PALLAS_K:
             # hub tail: the kernel vectorizes over rows and loops K, so a
             # [few rows, K ~ 2^21] level (a power-law supernode bucket)
             # would serialize; its XLA gather+reduce vectorizes over K

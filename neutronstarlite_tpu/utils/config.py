@@ -115,6 +115,9 @@ class InputInfo:
     # path), mirror (compacted active-mirror all_to_all — the analog of the
     # reference's active-only messages, comm/network.cpp:505-518), or auto
     # (pick mirror vs ring by estimated wire rows; OPTIM_KERNEL:1 -> ell)
+    kernel_tile: int = 0  # OPTIM_KERNEL source-tile width (vertices): 0 =
+    # plain ELL; >0 = blocked ELL (ops/blocked_ell.py) whose per-tile gather
+    # table [vt, f] is sized to stay in the fast on-chip regime at any V
     edge_chunk: int = 0  # scatter-path edge chunk size (0 = auto); applies
     # to the chunked-scatter layouts (DeviceGraph, DistGraph) — the ELL and
     # mirror-slot layouts have their own slot sizing. Tests/dryruns set it
@@ -183,6 +186,8 @@ class InputInfo:
             self.lock_free = bool(int(value))
         elif key == "OPTIM_KERNEL":
             self.optim_kernel = bool(int(value))
+        elif key == "KERNEL_TILE":
+            self.kernel_tile = int(value)
         elif key == "PARTITIONS":
             self.partitions = int(value)
         elif key == "PRECISION":
