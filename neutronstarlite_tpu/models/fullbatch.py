@@ -164,6 +164,15 @@ class FullBatchTrainer(ToolkitBase):
         ]
         return "\n".join(lines)
 
+    def aot_args(self):
+        """The exact argument tuple run() passes to the jitted train step —
+        the uniform hook tools/aot_check uses to lower any registered model
+        for an accelerator topology without executing it."""
+        return (
+            self.params, self.opt_state, self.compute_graph, self.feature,
+            self.label, self._train_mask01, jax.random.PRNGKey(self.seed + 1),
+        )
+
     def run(self) -> Dict[str, Any]:
         cfg = self.cfg
         key = jax.random.PRNGKey(self.seed + 1)

@@ -229,6 +229,15 @@ class DistGCNTrainer(ToolkitBase):
         self._train_step = train_step
         self._eval_logits = eval_logits
 
+    def aot_args(self):
+        """The exact argument tuple run() passes to the jitted train step
+        (tools/aot_check parity hook)."""
+        return (
+            self.params, self.opt_state, self.blocks, self.feature_p,
+            self.label_p, self.train01_p, self.valid_p,
+            jax.random.PRNGKey(self.seed + 1),
+        )
+
     def run(self) -> Dict[str, Any]:
         cfg = self.cfg
         key = jax.random.PRNGKey(self.seed + 1)

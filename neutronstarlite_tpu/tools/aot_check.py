@@ -1,0 +1,230 @@
+"""AOT compile-check / capacity planning against a TPU topology — no chips.
+
+``python -m neutronstarlite_tpu.tools.aot_check <file.cfg>
+[--topology v5e:2x4]``
+
+Compiles the cfg's FULL jitted train step for the named accelerator
+topology via ``jax.experimental.topologies`` (PJRT topology descriptions +
+the plugin's compiler — remote or local) and prints one JSON line with the
+compile result and the compiled module's memory needs (argument/temp/output
+bytes vs HBM). Host-side graph/table construction runs on the CPU backend;
+no accelerator is claimed at any point, so this works on a dev box with
+zero TPU access — offline capacity planning the reference's
+compile-and-run-or-OOM workflow cannot do (its CUDA kernels only fail at
+launch time, toolkits/main.cpp:34-199 has no dry-run mode).
+
+Single-mesh models lower with every argument replicated on one topology
+device. ``ALGORITHM:GCNDIST`` lowers the real distributed step — the
+ppermute ring / all_gather+ELL / mirror all_to_all exchange over a mesh of
+all topology devices — by building the sharded program spec directly
+(mirroring DistGCNTrainer.build_model, which cannot be reused verbatim
+because it device_puts onto the runtime mesh).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+
+def _single_device_case(cfg, base_dir, rep):
+    """Build the trainer host-side (CPU backend) and return (jitted, args)
+    with every leaf replaced by a replicated ShapeDtypeStruct."""
+    import jax
+
+    from neutronstarlite_tpu.models import get_algorithm
+
+    cls = get_algorithm(cfg.algorithm)
+    toolkit = cls(cfg, base_dir=base_dir)
+    toolkit.init_graph()
+    toolkit.init_nn()
+    if not hasattr(toolkit, "aot_args"):
+        raise SystemExit(
+            f"ALGORITHM {cfg.algorithm}: trainer exposes no aot_args() hook"
+        )
+
+    def spec(a):
+        if hasattr(a, "shape") and hasattr(a, "dtype"):
+            return jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=rep)
+        return a
+
+    return toolkit._train_step, jax.tree.map(spec, toolkit.aot_args())
+
+
+def _dist_gcn_case(cfg, base_dir, mesh):
+    """The distributed GCN train step as ShapeDtypeStructs over ``mesh``
+    (mirrors DistGCNTrainer.build_model; kept in sync by
+    tests/test_aot_check.py's parity check)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as PS
+
+    from neutronstarlite_tpu.graph.storage import build_graph, load_edges
+    from neutronstarlite_tpu.models.gcn import init_gcn_params
+    from neutronstarlite_tpu.models.gcn_dist import (
+        DistGCNTrainer,
+        dist_gcn_forward,
+    )
+    from neutronstarlite_tpu.nn.param import AdamConfig, adam_init, adam_update
+    from neutronstarlite_tpu.parallel.mesh import PARTITION_AXIS
+
+    P = mesh.devices.size
+    edge_path = cfg.resolve_path(cfg.edge_file, base_dir)
+    src, dst = load_edges(edge_path)
+    host_graph = build_graph(src, dst, cfg.vertices, weight="gcn_norm")
+    sizes = cfg.layer_sizes()
+
+    layer_kind = DistGCNTrainer.resolve_comm_layer(cfg, host_graph, P)
+    if layer_kind == "mirror":
+        from neutronstarlite_tpu.parallel.mirror import MirrorGraph
+
+        dist = MirrorGraph.build(host_graph, P)
+        host_blocks = (
+            dist.need_ids, dist.edge_src_slot, dist.edge_dst,
+            dist.edge_weight, dist.edge_mask,
+        )
+    else:
+        from neutronstarlite_tpu.parallel.dist_graph import DistGraph
+
+        dist = DistGraph.build(host_graph, P, edge_chunk=cfg.edge_chunk or None)
+        if layer_kind == "ell":
+            from neutronstarlite_tpu.parallel.dist_ell import DistEllPair
+
+            host_blocks = DistEllPair.build(dist)
+        else:
+            host_blocks = (dist.block_src, dist.block_dst, dist.block_weight)
+
+    vsh = NamedSharding(mesh, PS(PARTITION_AXIS, None))
+    vsh1 = NamedSharding(mesh, PS(PARTITION_AXIS))
+    rsh = NamedSharding(mesh, PS())
+
+    def bspec(a):
+        # block arrays shard over their leading (dst-partition/device) axis
+        nd = len(a.shape)
+        sh = NamedSharding(mesh, PS(PARTITION_AXIS, *([None] * (nd - 1))))
+        return jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=sh)
+
+    blocks = jax.tree.map(bspec, host_blocks)
+    vp_total = dist.vp * P
+    params = init_gcn_params(
+        jax.random.PRNGKey(0), sizes, with_bn=DistGCNTrainer.with_bn
+    )
+    adam_cfg = AdamConfig(
+        alpha=cfg.learn_rate,
+        weight_decay=cfg.weight_decay,
+        decay_rate=cfg.decay_rate,
+        decay_epoch=cfg.decay_epoch,
+    )
+    masked_nll = DistGCNTrainer.masked_nll_loss
+    drop_rate = cfg.drop_rate
+
+    def train_step(params, opt_state, blocks, feature, label, train01, valid, key):
+        def loss_fn(p):
+            logits = dist_gcn_forward(
+                mesh, dist, blocks, p, feature, valid, key, drop_rate, True
+            )
+            return masked_nll(logits, label, train01), logits
+
+        (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        params, opt_state = adam_update(params, grads, opt_state, adam_cfg)
+        return params, opt_state, loss, logits
+
+    def rspec(a):
+        return jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=rsh)
+
+    args = (
+        jax.tree.map(rspec, params),
+        jax.tree.map(rspec, adam_init(params)),
+        blocks,
+        jax.ShapeDtypeStruct((vp_total, sizes[0]), jnp.float32, sharding=vsh),
+        jax.ShapeDtypeStruct((vp_total,), jnp.int32, sharding=vsh1),
+        jax.ShapeDtypeStruct((vp_total,), jnp.float32, sharding=vsh1),
+        jax.ShapeDtypeStruct((vp_total,), jnp.float32, sharding=vsh1),
+        jax.ShapeDtypeStruct((2,), jnp.uint32, sharding=rsh),
+    )
+    return jax.jit(train_step), args, layer_kind
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("cfg")
+    ap.add_argument(
+        "--topology", default="v5e:2x4",
+        help="PJRT topology name (e.g. v5e:2x4, v5e:4x4, v4:2x2x2)",
+    )
+    ap.add_argument(
+        "--platform", default="tpu",
+        help="PJRT platform for get_topology_desc",
+    )
+    args = ap.parse_args(argv)
+
+    # host work runs on the CPU backend UNCONDITIONALLY (even when the
+    # environment selects an accelerator platform): this tool's contract is
+    # that no accelerator is ever claimed — the topology compile below goes
+    # to the compiler, not to chips
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from neutronstarlite_tpu.utils.platform import honor_platform_env
+
+    honor_platform_env()
+
+    import numpy as np
+    import jax
+    from jax.experimental import topologies
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as PS
+
+    from neutronstarlite_tpu.parallel.mesh import PARTITION_AXIS
+    from neutronstarlite_tpu.utils.config import InputInfo
+
+    cfg = InputInfo.read_from_cfg_file(args.cfg)
+    base_dir = os.path.dirname(os.path.abspath(args.cfg))
+    topo = topologies.get_topology_desc(
+        platform=args.platform, topology_name=args.topology
+    )
+    devices = list(topo.devices)
+
+    out = {
+        "cfg": os.path.basename(args.cfg),
+        "algorithm": cfg.algorithm,
+        "topology": args.topology,
+        "devices": len(devices),
+    }
+    t0 = time.time()
+    try:
+        if cfg.algorithm.upper() in ("GCNDIST", "GCNTPUDIST"):
+            n = cfg.partitions or len(devices)
+            if n > len(devices):
+                # ValueError (not SystemExit) so the JSON error contract holds
+                raise ValueError(
+                    f"PARTITIONS:{n} exceeds the {len(devices)}-device "
+                    f"topology {args.topology}"
+                )
+            mesh = Mesh(np.array(devices[:n]), (PARTITION_AXIS,))
+            jitted, shapes, layer_kind = _dist_gcn_case(cfg, base_dir, mesh)
+            out["comm_layer"] = layer_kind
+            out["partitions"] = n
+        else:
+            mesh1 = Mesh(np.array(devices[:1]), ("one",))
+            rep = NamedSharding(mesh1, PS())
+            jitted, shapes = _single_device_case(cfg, base_dir, rep)
+        build_s = time.time() - t0
+        t0 = time.time()
+        compiled = jitted.lower(*shapes).compile()
+        mem = compiled.memory_analysis()
+        out.update(
+            ok=True,
+            build_s=round(build_s, 1),
+            compile_s=round(time.time() - t0, 1),
+            argument_gib=round(mem.argument_size_in_bytes / 2**30, 3),
+            temp_gib=round(mem.temp_size_in_bytes / 2**30, 3),
+            output_gib=round(mem.output_size_in_bytes / 2**30, 3),
+        )
+    except Exception as e:  # noqa: BLE001 — report, don't trace-dump
+        out.update(ok=False, error=f"{type(e).__name__}: {str(e)[:500]}")
+    print(json.dumps(out))
+    return 0 if out.get("ok") else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
