@@ -26,10 +26,11 @@ def _cora_cfg(algorithm):
     return cfg
 
 
-def test_single_device_case_compiles():
+@pytest.mark.parametrize("algorithm", ["GCNCPU", "GATCPU", "GINCPU", "GGCNCPU"])
+def test_single_device_case_compiles(algorithm):
     mesh1 = Mesh(np.array(jax.devices()[:1]), ("one",))
     rep = NamedSharding(mesh1, PS())
-    cfg = _cora_cfg("GCNCPU")
+    cfg = _cora_cfg(algorithm)
     jitted, shapes = _single_device_case(cfg, CFG_DIR, rep)
     compiled = jitted.lower(*shapes).compile()
     mem = compiled.memory_analysis()
