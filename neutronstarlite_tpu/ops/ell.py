@@ -51,17 +51,38 @@ def _next_pow2(x: int) -> int:
     return 1 << max(int(x) - 1, 0).bit_length()
 
 
+# Byte budget for one [rows, K, f] gather intermediate. At the default
+# 2^21-SLOT chunk the full-scale intermediates (512 MiB at f=128) were
+# materialized to HBM by the compiler; bounding them to ~32 MiB keeps them
+# VMEM-resident in the compiled v5e module (docs/PERF.md section 3a).
+# Width-aware (slots alone don't bound bytes when f varies 41..602).
+# Override for on-chip tuning: NTS_ELL_CHUNK_MIB.
+DEFAULT_CHUNK_MIB = 32
+
+
+def _chunk_budget_bytes() -> int:
+    import os
+
+    return int(os.environ.get("NTS_ELL_CHUNK_MIB", DEFAULT_CHUNK_MIB)) << 20
+
+
 def ell_tables_aggregate(x, nbrs, wgts, slot_chunk: int, out_dtype=None) -> jax.Array:
     """Shared per-level ELL reduction: concat over levels of
-    ``sum_k wgt[r, k] * x[nbr[r, k]]`` (row chunks bound the gather
-    intermediate; callers apply their own inv_perm). Single source of the
-    numeric policy for EllBuckets.aggregate AND the distributed
-    DistEll._local_aggregate — the K-reduction accumulates in f32
-    regardless of x.dtype (the fused multiply-reduce holds its accumulator
-    in registers, so wide accumulation costs no HBM traffic): bf16 reads
-    keep the bandwidth win while degree-500 sums keep ~f32 accuracy, the
-    same policy as the reference's CUDA kernel whose shared-memory
-    accumulator is float (cuda/ntsCUDAFuseKernel.cuh:147-208).
+    ``sum_k wgt[r, k] * x[nbr[r, k]]`` (callers apply their own inv_perm).
+    Single source of the numeric policy for EllBuckets.aggregate AND the
+    distributed DistEll._local_aggregate — the K-reduction accumulates in
+    f32 regardless of x.dtype (the fused multiply-reduce holds its
+    accumulator in registers, so wide accumulation costs no HBM traffic):
+    bf16 reads keep the bandwidth win while degree-500 sums keep ~f32
+    accuracy, the same policy as the reference's CUDA kernel whose
+    shared-memory accumulator is float (cuda/ntsCUDAFuseKernel.cuh:147-208).
+
+    The [rows, K, f] gather intermediate is bounded in BYTES (width-aware,
+    see DEFAULT_CHUNK_MIB) by chunking rows — and, for the few-row hub
+    levels whose K alone exceeds the budget (a 2^21-degree supernode at
+    f=602 is a 2.4 GiB slab), by scanning K column chunks with an f32
+    running sum. Chunk boundaries never split a row's K-reduction across
+    different precisions, so results are invariant to the chunking.
 
     ``out_dtype``: result dtype (default x.dtype). Callers that keep
     accumulating across calls (the blocked source-tiled layout) pass
@@ -69,14 +90,42 @@ def ell_tables_aggregate(x, nbrs, wgts, slot_chunk: int, out_dtype=None) -> jax.
     (the zero-degree bucket) yields zero rows without any gather."""
     f = x.shape[1]
     out_dtype = out_dtype or x.dtype
+    budget = _chunk_budget_bytes()
+    # the chunk intermediate lives in f32 whatever x.dtype is (the upcast
+    # below) — size the slot budget for the f32 slab, not the input bytes
+    slot_budget = max(budget // (f * max(x.dtype.itemsize, 4)), 1)
 
-    def row_sum(nbr, wgt):
+    def partial_f32(nbr, wgt):
         # products AND accumulation in f32 (register-resident in the fused
         # reduce, so no extra HBM traffic; bf16 only on the gather reads) —
-        # keep in sync with ops/pallas_kernels._ell_level_kernel, which
-        # implements the identical policy
+        # the ONE copy of the numeric policy; keep in sync with
+        # ops/pallas_kernels._ell_level_kernel, which mirrors it in-kernel
         vals = x[nbr].astype(jnp.float32) * wgt[:, :, None]
-        return vals.sum(axis=1).astype(out_dtype)
+        return vals.sum(axis=1)
+
+    def row_sum(nbr, wgt):
+        return partial_f32(nbr, wgt).astype(out_dtype)
+
+    def k_chunked_sum(nbr, wgt):
+        # K exceeds the per-chunk slot budget (hub levels); scan K column
+        # chunks with an f32 running sum (padding columns carry weight 0)
+        Nk, K = nbr.shape
+        kc = max(slot_budget // max(Nk, 1), 1)
+        n_ch = -(-K // kc)
+        pad = n_ch * kc - K
+        nb = jnp.pad(nbr, ((0, 0), (0, pad))).reshape(Nk, n_ch, kc)
+        wg = jnp.pad(wgt, ((0, 0), (0, pad))).reshape(Nk, n_ch, kc)
+
+        def body(acc, chunk):
+            n, w = chunk
+            return acc + partial_f32(n, w), None
+
+        acc, _ = lax.scan(
+            body,
+            jnp.zeros((Nk, f), jnp.float32),
+            (nb.transpose(1, 0, 2), wg.transpose(1, 0, 2)),
+        )
+        return acc.astype(out_dtype)
 
     outs = []
     for nbr, wgt in zip(nbrs, wgts):
@@ -84,7 +133,11 @@ def ell_tables_aggregate(x, nbrs, wgts, slot_chunk: int, out_dtype=None) -> jax.
         if K == 0:
             outs.append(jnp.zeros((Nk, f), out_dtype))
             continue
-        rows = max(slot_chunk // K, 1)
+        if K > slot_budget:
+            # rows-of-1 chunks would still breach the byte bound; chunk K
+            outs.append(k_chunked_sum(nbr, wgt))
+            continue
+        rows = max(min(slot_chunk, slot_budget) // K, 1)
         if Nk <= rows:
             outs.append(row_sum(nbr, wgt))
             continue

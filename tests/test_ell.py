@@ -94,3 +94,22 @@ def test_gcn_converges_with_optim_kernel():
     result = trainer.run()
     assert result["acc"]["test"] > 0.85
     assert result["loss"] < 0.5
+
+
+def test_k_chunked_hub_level_matches_plain(rng, monkeypatch):
+    """A hub level whose K alone exceeds the byte budget takes the K-chunked
+    scan; the f32 running sum must match the single-pass reduction."""
+    import jax.numpy as jnp
+    from neutronstarlite_tpu.ops.ell import ell_tables_aggregate
+
+    V, f, Nk, K = 64, 4, 2, 1 << 18  # K slots > 1 MiB budget at f=4
+    nbr = rng.integers(0, V, size=(Nk, K)).astype(np.int32)
+    wgt = rng.standard_normal((Nk, K)).astype(np.float32) * 0.01
+    x = rng.standard_normal((V, f)).astype(np.float32)
+    want = (x[nbr].astype(np.float64) * wgt[:, :, None]).sum(axis=1)
+
+    monkeypatch.setenv("NTS_ELL_CHUNK_MIB", "1")
+    out = ell_tables_aggregate(jnp.asarray(x), [jnp.asarray(nbr)],
+                               [jnp.asarray(wgt)], slot_chunk=1 << 21)
+    np.testing.assert_allclose(np.asarray(out, np.float64), want,
+                               rtol=1e-4, atol=1e-4)
