@@ -40,9 +40,11 @@ from jax import lax
 
 from neutronstarlite_tpu.graph.storage import CSCGraph
 
-# max elements (rows * K) gathered per scan step; bounds the [rows, K, f]
-# intermediate (e.g. 2^21 slots * 128 features * 2 B = 512 MB of HBM traffic
-# per step, chunked well below HBM capacity)
+# legacy upper cap on slots (rows * K) per scan step. Chunk sizing is now
+# governed by the BYTE budget below (min(slot_chunk, slot_budget) — at the
+# default 32 MiB budget and f >= 8 the byte bound is always the tighter
+# one); the slot cap survives only as a table-layout knob for tests that
+# force specific chunk counts.
 DEFAULT_SLOT_CHUNK = 1 << 21
 _MIN_K = 4
 
@@ -61,9 +63,18 @@ DEFAULT_CHUNK_MIB = 32
 
 
 def _chunk_budget_bytes() -> int:
+    """Read NTS_ELL_CHUNK_MIB (clamped to >= 1 MiB; non-numeric falls back
+    to the default). TRACE-TIME semantics: the value is baked into the
+    traced program, so changing the env after a jit cache is warm has no
+    effect — set it before the first compile."""
     import os
 
-    return int(os.environ.get("NTS_ELL_CHUNK_MIB", DEFAULT_CHUNK_MIB)) << 20
+    raw = os.environ.get("NTS_ELL_CHUNK_MIB", "")
+    try:
+        mib = int(raw) if raw else DEFAULT_CHUNK_MIB
+    except ValueError:
+        mib = DEFAULT_CHUNK_MIB
+    return max(mib, 1) << 20
 
 
 def ell_tables_aggregate(x, nbrs, wgts, slot_chunk: int, out_dtype=None) -> jax.Array:
@@ -115,16 +126,22 @@ def ell_tables_aggregate(x, nbrs, wgts, slot_chunk: int, out_dtype=None) -> jax.
         pad = n_ch * kc - K
         nb = jnp.pad(nbr, ((0, 0), (0, pad))).reshape(Nk, n_ch, kc)
         wg = jnp.pad(wgt, ((0, 0), (0, pad))).reshape(Nk, n_ch, kc)
+        nb_t = nb.transpose(1, 0, 2)
+        wg_t = wg.transpose(1, 0, 2)
 
-        def body(acc, chunk):
-            n, w = chunk
-            return acc + partial_f32(n, w), None
+        # first chunk outside the scan: a zeros-initialized carry is
+        # unvarying over the mesh axis under shard_map while the body's
+        # output is varying, and lax.scan requires carry-in == carry-out
+        # varying types (the round-1 ring bug class; same peel as
+        # ops/aggregate._scatter_accumulate)
+        acc = partial_f32(nb_t[0], wg_t[0])
+        if n_ch > 1:
 
-        acc, _ = lax.scan(
-            body,
-            jnp.zeros((Nk, f), jnp.float32),
-            (nb.transpose(1, 0, 2), wg.transpose(1, 0, 2)),
-        )
+            def body(acc, chunk):
+                n, w = chunk
+                return acc + partial_f32(n, w), None
+
+            acc, _ = lax.scan(body, acc, (nb_t[1:], wg_t[1:]))
         return acc.astype(out_dtype)
 
     outs = []

@@ -13,6 +13,7 @@ from neutronstarlite_tpu.ops.ell import (
     EllPair,
     ell_gather_dst_from_src,
     ell_gather_src_from_dst,
+    ell_tables_aggregate,
 )
 
 
@@ -99,9 +100,6 @@ def test_gcn_converges_with_optim_kernel():
 def test_k_chunked_hub_level_matches_plain(rng, monkeypatch):
     """A hub level whose K alone exceeds the byte budget takes the K-chunked
     scan; the f32 running sum must match the single-pass reduction."""
-    import jax.numpy as jnp
-    from neutronstarlite_tpu.ops.ell import ell_tables_aggregate
-
     V, f, Nk, K = 64, 4, 2, 1 << 18  # K slots > 1 MiB budget at f=4
     nbr = rng.integers(0, V, size=(Nk, K)).astype(np.int32)
     wgt = rng.standard_normal((Nk, K)).astype(np.float32) * 0.01
