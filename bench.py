@@ -161,12 +161,13 @@ def _make_trainer(
     cfg.decay_epoch = -1
     cfg.drop_rate = 0.5
     cfg.precision = precision
-    cfg.optim_kernel = path in ("ell", "blocked")
+    cfg.optim_kernel = path in ("ell", "blocked", "pallas")
     cfg.kernel_tile = kernel_tile if path == "blocked" else 0
+    cfg.pallas_kernel = path == "pallas"
     cls = GCNEagerTrainer if order == "eager" else GCNTrainer
     return cls.from_arrays(
         cfg, src, dst, datum, host_graph=host_graph,
-        host_ell=host_ell if path == "ell" else None,
+        host_ell=host_ell if path in ("ell", "pallas", "blocked") else None,
     )
 
 
@@ -193,10 +194,13 @@ def main(argv=None) -> int:
         "TPU when d_out < d_in",
     )
     ap.add_argument(
-        "--path", default="scatter", choices=["scatter", "ell", "blocked"],
+        "--path", default="scatter",
+        choices=["scatter", "ell", "blocked", "pallas"],
         help="aggregation backend: chunked sorted-scatter, ELL gather "
-        "(the OPTIM_KERNEL toggle), or source-tiled blocked ELL "
-        "(beyond-VMEM gather tables)",
+        "(the OPTIM_KERNEL toggle), source-tiled blocked ELL "
+        "(beyond-VMEM gather tables), or the fused Pallas ELL kernel "
+        "(VMEM-resident feature table; pair with --order eager at full "
+        "scale so aggregation runs at post-matmul widths)",
     )
     ap.add_argument(
         "--kernel-tile", type=int, default=8192,
@@ -287,7 +291,7 @@ def main(argv=None) -> int:
         return _blocked_cache[0]
 
     def get_tables(path):
-        if path == "ell":
+        if path in ("ell", "pallas"):  # pallas shares the ELL tables
             return get_ell()
         if path == "blocked":
             return get_blocked()
@@ -308,7 +312,7 @@ def main(argv=None) -> int:
         # compile measured ~25+ min on the 1-core rig, too risky for the
         # default sweep budget (measure it explicitly with --path blocked)
         paths = ("scatter", "ell") if args.sweep == "auto" else (
-            "scatter", "ell", "blocked"
+            "scatter", "ell", "pallas", "blocked"
         )
         grid = [
             (o, p, pr)
@@ -332,7 +336,7 @@ def main(argv=None) -> int:
             # path groups run consecutively: entering a new group frees the
             # previous layout's device tables (the final winner re-uploads
             # once via get_tables)
-            if p != "ell":
+            if p not in ("ell", "pallas"):
                 _ell_cache.clear()
             if p != "blocked":
                 _blocked_cache.clear()
@@ -368,7 +372,7 @@ def main(argv=None) -> int:
         _, order, path, precision = best
         # free losing layouts' device tables (GBs at full scale) before the
         # final measurement
-        if path != "ell":
+        if path not in ("ell", "pallas"):
             _ell_cache.clear()
         if path != "blocked":
             _blocked_cache.clear()

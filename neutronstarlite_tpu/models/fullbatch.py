@@ -65,12 +65,31 @@ class FullBatchTrainer(ToolkitBase):
                 from neutronstarlite_tpu.ops.ell import EllPair
 
                 self.compute_graph = EllPair.from_host(self.host_graph)
+            from neutronstarlite_tpu.ops.ell import EllPair as _EllPair
+            from neutronstarlite_tpu.ops.pallas_kernels import PallasEllPair
+
+            if cfg.pallas_kernel and isinstance(self.compute_graph, _EllPair):
+                # same tables, fused-kernel executor (PALLAS:1)
+                self.compute_graph = PallasEllPair.from_pair(self.compute_graph)
+            elif cfg.pallas_kernel:
+                log.warning(
+                    "PALLAS:1 ignored: compute graph is %s, not an EllPair "
+                    "(PALLAS conflicts with KERNEL_TILE/blocked layouts)",
+                    type(self.compute_graph).__name__,
+                )
             if isinstance(self.compute_graph, BlockedEllPair):
                 log.info(
                     "OPTIM_KERNEL: blocked ELL aggregation (%d src tiles of "
                     "%d vertices)",
                     len(self.compute_graph.fwd.tiles),
                     self.compute_graph.fwd.vt,
+                )
+            elif isinstance(self.compute_graph, PallasEllPair):
+                log.info(
+                    "OPTIM_KERNEL: Pallas fused ELL aggregation (%d fwd "
+                    "buckets, row_tile %d)",
+                    len(self.compute_graph.fwd.nbr),
+                    self.compute_graph.row_tile,
                 )
             else:
                 log.info(

@@ -119,15 +119,22 @@ def gather_dst_from_src(graph, x: jax.Array) -> jax.Array:
     TPU analog of the reference's optimized aggregation kernel toggle,
     cuda/ntsCUDAFuseKernel.cuh:154), or an ops.blocked_ell.BlockedEllPair
     (source-tiled ELL for beyond-VMEM feature tables, OPTIM_KERNEL:1 +
-    KERNEL_TILE:vt)."""
+    KERNEL_TILE:vt), or an ops.pallas_kernels.PallasEllPair (fused Pallas
+    kernel over the same ELL tables, OPTIM_KERNEL:1 + PALLAS:1)."""
     from neutronstarlite_tpu.ops.blocked_ell import (
         BlockedEllPair,
         blocked_gather_dst_from_src,
     )
     from neutronstarlite_tpu.ops.ell import EllPair, ell_gather_dst_from_src
+    from neutronstarlite_tpu.ops.pallas_kernels import (
+        PallasEllPair,
+        pallas_gather_dst_from_src,
+    )
 
     if isinstance(graph, BlockedEllPair):
         return blocked_gather_dst_from_src(graph, x)
+    if isinstance(graph, PallasEllPair):
+        return pallas_gather_dst_from_src(graph, x)
     if isinstance(graph, EllPair):
         return ell_gather_dst_from_src(graph, x)
     return _aggregate(
@@ -151,9 +158,15 @@ def gather_src_from_dst(graph, y: jax.Array) -> jax.Array:
         blocked_gather_src_from_dst,
     )
     from neutronstarlite_tpu.ops.ell import EllPair, ell_gather_src_from_dst
+    from neutronstarlite_tpu.ops.pallas_kernels import (
+        PallasEllPair,
+        pallas_gather_src_from_dst,
+    )
 
     if isinstance(graph, BlockedEllPair):
         return blocked_gather_src_from_dst(graph, y)
+    if isinstance(graph, PallasEllPair):
+        return pallas_gather_src_from_dst(graph, y)
     if isinstance(graph, EllPair):
         return ell_gather_src_from_dst(graph, y)
     return _aggregate(

@@ -30,11 +30,14 @@ off the on-chip path (VERDICT round-1 item 4).
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+
+from neutronstarlite_tpu.ops.ell import EllBuckets, EllPair, ell_tables_aggregate
 
 try:  # pallas TPU backend may be absent on pure-CPU builds
     from jax.experimental.pallas import tpu as pltpu  # noqa: F401
@@ -123,14 +126,9 @@ def gather_dst_from_src_pallas(
     interpret: bool = False,
 ) -> jax.Array:
     """Fused CSC aggregation out[v] = sum_{(u->v)} w_uv * x[u] over the ELL
-    bucket layout (ops.ell.EllPair or EllBuckets). Forward only — pair it
-    with ops.ell for training (same tables, same numeric policy)."""
-    from neutronstarlite_tpu.ops.ell import (
-        EllBuckets,
-        EllPair,
-        ell_tables_aggregate,
-    )
-
+    bucket layout (ops.ell.EllPair or EllBuckets). Forward only — for
+    training use ``pallas_gather_dst_from_src`` (PallasEllPair), whose
+    custom_vjp pairs this kernel with its transpose tables."""
     buckets: EllBuckets = (
         ell_pair_or_buckets.fwd
         if isinstance(ell_pair_or_buckets, EllPair)
@@ -155,3 +153,75 @@ def gather_dst_from_src_pallas(
                 )
             )
     return jnp.concatenate(outs, axis=0)[buckets.inv_perm]
+
+
+# ---- trainable Pallas backend (KERNEL selection: PALLAS:1) -----------------
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class PallasEllPair:
+    """EllPair twin whose aggregation runs the fused Pallas kernel.
+
+    Same tables, same numeric policy, same custom_vjp transpose pairing as
+    ops.ell.EllPair — only the per-level executor differs (VMEM-resident
+    vectorized gather kernel instead of XLA gather+reduce; hub levels wider
+    than MAX_PALLAS_K still route to XLA, see gather_dst_from_src_pallas).
+    Regime: the gathered [V, f] table must fit the VMEM budget — at Reddit
+    scale that means the EAGER propagation order, whose aggregations run at
+    the narrow post-matmul widths (GCN_CPU_EAGER.hpp:200-206 analog).
+    Off-TPU (tests, CPU CI) the kernel runs in interpret mode.
+    """
+
+    fwd: EllBuckets
+    bwd: EllBuckets
+    row_tile: int = dataclasses.field(
+        default=DEFAULT_ROW_TILE, metadata=dict(static=True)
+    )
+
+    @staticmethod
+    def from_host(g, row_tile: int = DEFAULT_ROW_TILE) -> "PallasEllPair":
+        return PallasEllPair.from_pair(EllPair.from_host(g), row_tile)
+
+    @staticmethod
+    def from_pair(pair: EllPair, row_tile: int = DEFAULT_ROW_TILE) -> "PallasEllPair":
+        return PallasEllPair(fwd=pair.fwd, bwd=pair.bwd, row_tile=int(row_tile))
+
+
+def _apply_buckets(buckets: EllBuckets, x: jax.Array, row_tile: int) -> jax.Array:
+    # interpret everywhere the default backend can't lower Mosaic — keeps
+    # the CPU suite exercising the same code path the chip runs
+    interpret = jax.default_backend() not in ("tpu",)
+    return gather_dst_from_src_pallas(
+        buckets, x, row_tile=row_tile, interpret=interpret
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _pallas_pair_aggregate(row_tile, fwd, bwd, x):
+    return _apply_buckets(fwd, x, row_tile)
+
+
+def _pallas_pair_aggregate_fwd(row_tile, fwd, bwd, x):
+    return _apply_buckets(fwd, x, row_tile), (fwd, bwd)
+
+
+def _pallas_pair_aggregate_bwd(row_tile, res, g):
+    from neutronstarlite_tpu.ops.segment import zero_cotangent
+
+    fwd, bwd = res
+    zero = jax.tree.map(zero_cotangent, (fwd, bwd))
+    return (*zero, _apply_buckets(bwd, g, row_tile))
+
+
+_pallas_pair_aggregate.defvjp(_pallas_pair_aggregate_fwd, _pallas_pair_aggregate_bwd)
+
+
+def pallas_gather_dst_from_src(pair: PallasEllPair, x: jax.Array) -> jax.Array:
+    """Fused-kernel weighted aggregation (custom_vjp pairs the transpose)."""
+    return _pallas_pair_aggregate(pair.row_tile, pair.fwd, pair.bwd, x)
+
+
+def pallas_gather_src_from_dst(pair: PallasEllPair, y: jax.Array) -> jax.Array:
+    """The CSR direction as a forward op."""
+    return _pallas_pair_aggregate(pair.row_tile, pair.bwd, pair.fwd, y)

@@ -118,6 +118,9 @@ class InputInfo:
     kernel_tile: int = 0  # OPTIM_KERNEL source-tile width (vertices): 0 =
     # plain ELL; >0 = blocked ELL (ops/blocked_ell.py) whose per-tile gather
     # table [vt, f] is sized to stay in the fast on-chip regime at any V
+    pallas_kernel: bool = False  # OPTIM_KERNEL:1 + PALLAS:1 -> run the ELL
+    # aggregation through the fused Pallas kernel (ops/pallas_kernels.py)
+    # instead of the XLA gather+reduce; same tables, same numeric policy
     edge_chunk: int = 0  # scatter-path edge chunk size (0 = auto); applies
     # to the chunked-scatter layouts (DeviceGraph, DistGraph) — the ELL and
     # mirror-slot layouts have their own slot sizing. Tests/dryruns set it
@@ -188,6 +191,8 @@ class InputInfo:
             self.optim_kernel = bool(int(value))
         elif key == "KERNEL_TILE":
             self.kernel_tile = int(value)
+        elif key == "PALLAS":
+            self.pallas_kernel = bool(int(value))
         elif key == "PARTITIONS":
             self.partitions = int(value)
         elif key == "PRECISION":
