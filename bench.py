@@ -17,13 +17,21 @@ BASELINE_EPOCH_S = 1.0 s for the 8-worker CUDA reference on this workload
 full-batch) and report vs_baseline = BASELINE_EPOCH_S / epoch_time, i.e.
 >1.0 means faster than the assumed reference.
 
-Robustness (round-1 postmortem: the TPU backend init crashed/hung deep inside
-the first device_put, producing no diagnostics): before any real work the
-backend is probed in a SUBPROCESS with a hard timeout and retried with
-backoff; on persistent failure we fail fast with the probe's stderr tail. A
-watchdog thread bounds total wall time and dumps all thread stacks before
-exiting, so a hang inside a collective or compile still yields a diagnosable
-tail instead of silence.
+Robustness (two postmortems):
+- round 1: the TPU backend init crashed/hung deep inside the first
+  device_put with no diagnostics. Fix: probe the backend in a SUBPROCESS
+  with a hard timeout before any real work; retry with backoff; fail fast
+  with the probe's stderr tail.
+- round 2: the remote compile service died MID-SWEEP; the in-process sweep
+  first lost the fastest config (its post-training eval compile hung 25
+  minutes, discarding already-measured epoch timings), then hung the whole
+  run until the watchdog killed it with no JSON. Fix: every measured config
+  now runs in its OWN worker subprocess with a per-config timeout — a hung
+  compile costs one config, not the run. The host graph (minutes to build
+  at full scale) is built once and shared via an on-disk cache; trainers
+  skip their final eval-mode compile (NTS_FINAL_EVAL=0); a worker that
+  fails after training still salvages its recorded epoch timings.
+A watchdog thread still bounds total wall time as the last resort.
 
 By default the benchmark SWEEPS the implementation space the framework
 offers — {standard, eager propagation order} x {scatter, ELL gather kernel}
@@ -124,7 +132,7 @@ def probe_backend(timeout_s: float, attempts: int, backoff_s: float):
 
 def start_watchdog(deadline_s: float):
     """Bound total wall time: on expiry, dump every thread's stack to stderr
-    and hard-exit — a hang inside a collective/compile must still leave a
+    and hard-exit — a hang inside a collective/compile must still yield a
     diagnosable tail."""
 
     def fire():
@@ -142,6 +150,73 @@ def start_watchdog(deadline_s: float):
     t.daemon = True
     t.start()
     return t
+
+
+# ---- host graph cache (built once, shared across worker subprocesses) ------
+
+_CACHE_FIELDS = (
+    "column_offset", "row_indices", "dst_of_edge", "edge_weight_forward",
+    "row_offset", "column_indices", "src_of_edge", "edge_weight_backward",
+    "out_degree", "in_degree",
+)
+
+
+def cache_dir_for(scale: float, v_num: int, e_num: int) -> str:
+    # the key encodes everything the cached bytes depend on (graph size,
+    # generator seed, weight scheme) so constant/generator changes can
+    # never silently reuse a stale graph
+    return os.path.join(
+        os.environ.get("NTS_BENCH_CACHE", "/tmp/nts_bench_cache"),
+        f"scale_{scale:g}_V{v_num}_E{e_num}_seed7_gcnnorm",
+    )
+
+
+def build_and_cache_graph(scale: float):
+    """Synthesize the edge list, build the dual CSC/CSR (native counting
+    sort — minutes at full scale), and write everything to the cache dir.
+    Pure NumPy: the supervisor never initializes the accelerator backend."""
+    from neutronstarlite_tpu.graph.storage import build_graph
+    from neutronstarlite_tpu.graph.synthetic import synthetic_power_law_graph
+
+    v_num = max(int(REDDIT_V * scale), 64)
+    e_num = max(int(REDDIT_E * scale), 512)
+    d = cache_dir_for(scale, v_num, e_num)
+    marker = os.path.join(d, "ok")
+    if os.path.exists(marker):
+        return d, v_num, e_num, 0.0
+    t0 = time.time()
+    os.makedirs(d, exist_ok=True)
+    src, dst = synthetic_power_law_graph(v_num, e_num, seed=7)
+    g = build_graph(src, dst, v_num, weight="gcn_norm")
+    np.save(os.path.join(d, "src.npy"), src)
+    np.save(os.path.join(d, "dst.npy"), dst)
+    for name in _CACHE_FIELDS:
+        np.save(os.path.join(d, name + ".npy"), getattr(g, name))
+    with open(os.path.join(d, "meta.json"), "w") as fh:
+        json.dump({"v_num": int(g.v_num), "e_num": int(g.e_num)}, fh)
+    with open(marker, "w") as fh:
+        fh.write("ok")
+    return d, v_num, e_num, time.time() - t0
+
+
+def load_cached_graph(d: str):
+    from neutronstarlite_tpu.graph.storage import CSCGraph
+
+    with open(os.path.join(d, "meta.json")) as fh:
+        meta = json.load(fh)
+    assert os.path.basename(d).endswith(
+        f"V{meta['v_num']}_E{meta['e_num']}_seed7_gcnnorm"
+    ), f"stale graph cache {d}: meta {meta}"
+    fields = {
+        name: np.load(os.path.join(d, name + ".npy")) for name in _CACHE_FIELDS
+    }
+    g = CSCGraph(v_num=meta["v_num"], e_num=meta["e_num"], **fields)
+    src = np.load(os.path.join(d, "src.npy"))
+    dst = np.load(os.path.join(d, "dst.npy"))
+    return g, src, dst
+
+
+# ---- worker: measure ONE config in this process ----------------------------
 
 
 def _make_trainer(
@@ -172,9 +247,129 @@ def _make_trainer(
 
 
 def _timed_run(trainer, warmup):
-    result = trainer.run()
+    try:
+        result = trainer.run()
+    except Exception as e:
+        # a post-training failure (e.g. the remote compile service dying
+        # during a later program's compile) must not discard epoch timings
+        # that were already measured — the metric IS the epoch time
+        times = trainer.epoch_times[warmup:]
+        if not times:
+            raise
+        print(
+            f"run failed after {len(trainer.epoch_times)} timed epochs "
+            f"({str(e)[:200]}); salvaging recorded timings",
+            file=sys.stderr, flush=True,
+        )
+        result = {"loss": None, "error": str(e)[:200]}
     times = trainer.epoch_times[warmup:]
     return float(np.median(times)), result
+
+
+def worker_main(args) -> int:
+    """Measure one (order, path, precision) config; print one JSON line.
+
+    Runs in its own process so a hung compile/backend is killable by the
+    supervisor's per-config timeout without losing the whole sweep."""
+    os.environ.setdefault("NTS_FINAL_EVAL", "0")  # no second compile per run
+    from neutronstarlite_tpu.utils.platform import honor_platform_env
+
+    honor_platform_env()
+
+    import jax
+
+    # the probe subprocess's client may not have released the accelerator
+    # lease yet (observed: probe ok, then init UNAVAILABLE ~2 s later)
+    for attempt in range(5):
+        try:
+            jax.devices()
+            break
+        except RuntimeError as e:
+            print(
+                f"worker backend init attempt {attempt + 1} failed: {e}; retrying",
+                file=sys.stderr, flush=True,
+            )
+            time.sleep(10.0 * (attempt + 1))
+    else:
+        print("FATAL: worker backend init failed", file=sys.stderr, flush=True)
+        return 1
+
+    from neutronstarlite_tpu.graph.dataset import GNNDatum
+
+    order, path, precision = args.worker_config.split("/")
+    host_graph, src, dst = load_cached_graph(args.cache_dir)
+    v_num = host_graph.v_num
+    sizes = [int(s) for s in LAYERS.split("-")]
+    datum = GNNDatum.random_generate(v_num, sizes[0], N_LABELS, seed=7)
+
+    host_ell = None
+    t0 = time.time()
+    if path in ("ell", "pallas"):
+        # rebuilt per worker: ~24 s at full scale (docs/PERF.md section 3b),
+        # cheap enough that on-disk caching of the ragged bucket arrays
+        # isn't worth its complexity (isolation is the point here)
+        from neutronstarlite_tpu.ops.ell import EllPair
+
+        host_ell = EllPair.from_host(host_graph)
+    elif path == "blocked":
+        from neutronstarlite_tpu.ops.blocked_ell import BlockedEllPair
+
+        host_ell = BlockedEllPair.from_host(host_graph, vt=args.kernel_tile)
+    tables_s = time.time() - t0
+
+    t0 = time.time()
+    trainer = _make_trainer(
+        order, path, precision, src, dst, datum, v_num,
+        epochs=args.epochs, warmup=args.warmup, host_graph=host_graph,
+        host_ell=host_ell, kernel_tile=args.kernel_tile,
+    )
+    build_s = time.time() - t0
+    epoch_s, result = _timed_run(trainer, args.warmup)
+    print(json.dumps({
+        "epoch_s": round(epoch_s, 4),
+        "loss": result.get("loss"),
+        "error": result.get("error"),
+        "epoch_times": [round(t, 4) for t in trainer.epoch_times],
+        "tables_s": round(tables_s, 1),
+        "build_s": round(build_s, 1),
+        "device": str(jax.devices()[0]),
+    }))
+    return 0
+
+
+# ---- supervisor ------------------------------------------------------------
+
+
+def run_worker_config(
+    order, path, precision, epochs, warmup, cache_dir, kernel_tile,
+    timeout_s,
+):
+    """Spawn one measurement worker; returns its parsed JSON or an error
+    record. Worker stderr passes through live (progress/log lines)."""
+    cmd = [
+        sys.executable, os.path.abspath(__file__), "--worker",
+        "--worker-config", f"{order}/{path}/{precision}",
+        "--epochs", str(epochs), "--warmup", str(warmup),
+        "--cache-dir", cache_dir, "--kernel-tile", str(kernel_tile),
+    ]
+    t0 = time.time()
+    try:
+        r = subprocess.run(
+            cmd, stdout=subprocess.PIPE, text=True, timeout=timeout_s
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": f"TIMEOUT after {timeout_s:.0f}s", "wall_s": time.time() - t0}
+    out = (r.stdout or "").strip()
+    if r.returncode != 0 or not out:
+        return {
+            "error": f"worker rc={r.returncode}", "wall_s": time.time() - t0,
+        }
+    try:
+        info = json.loads(out.splitlines()[-1])
+    except json.JSONDecodeError:
+        return {"error": "unparseable worker output", "wall_s": time.time() - t0}
+    info["wall_s"] = round(time.time() - t0, 1)
+    return info
 
 
 def main(argv=None) -> int:
@@ -210,10 +405,16 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--sweep", default="auto", choices=["auto", "off", "full"],
         help="auto: short-run sweep of order x path at --precision, then "
-        "measure the winner; full: adds the other precision; off: run "
-        "--order/--path/--precision as given",
+        "measure the winner; full: adds pallas/blocked paths and the other "
+        "precision; off: run --order/--path/--precision as given",
     )
     ap.add_argument("--sweep-epochs", type=int, default=2)
+    ap.add_argument(
+        "--config-timeout", type=float,
+        default=float(os.environ.get("NTS_CONFIG_TIMEOUT_S", 1200)),
+        help="hard per-config wall bound (worker subprocess kill); a hung "
+        "compile costs one config, not the sweep",
+    )
     ap.add_argument(
         "--probe-timeout", type=float,
         default=float(os.environ.get("NTS_PROBE_TIMEOUT_S", 300)),
@@ -221,96 +422,73 @@ def main(argv=None) -> int:
     ap.add_argument("--probe-attempts", type=int, default=3)
     ap.add_argument(
         "--deadline", type=float,
-        default=float(os.environ.get("NTS_BENCH_DEADLINE_S", 3000)),
+        default=float(os.environ.get("NTS_BENCH_DEADLINE_S", 4500)),
         help="hard wall-time bound; on expiry dump stacks and exit 3",
     )
+    # worker mode (internal)
+    ap.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--worker-config", default="", help=argparse.SUPPRESS)
+    ap.add_argument("--cache-dir", default="", help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
+
+    if args.worker:
+        return worker_main(args)
 
     main_t0 = time.time()  # the watchdog's reference clock
     start_watchdog(args.deadline)
     probe = probe_backend(args.probe_timeout, args.probe_attempts, backoff_s=15.0)
 
-    from neutronstarlite_tpu.utils.platform import honor_platform_env
+    cache_dir, v_num, e_num, gen_s = build_and_cache_graph(args.scale)
+    print(
+        f"host graph cache ready in {gen_s:.1f}s: {cache_dir} "
+        f"(V={v_num} E={e_num})",
+        file=sys.stderr, flush=True,
+    )
 
-    honor_platform_env()
+    def remaining():
+        return args.deadline - (time.time() - main_t0)
 
-    import jax
-
-    # The probe subprocess's client may not have released the accelerator
-    # lease yet when this process initializes (observed: probe ok, then main
-    # init UNAVAILABLE ~2 s later) — retry the in-process init with backoff.
-    for attempt in range(5):
-        try:
-            jax.devices()
-            break
-        except RuntimeError as e:
+    def measure(order, path, precision, epochs, warmup, budget_s):
+        # the blocked layout's full-scale host build + compile is tens of
+        # minutes (docs/PERF.md section 3c) — give it 3x the normal cap
+        cap = args.config_timeout * (3.0 if path == "blocked" else 1.0)
+        timeout_s = max(min(cap, budget_s), 60.0)
+        print(
+            f"measuring {order}/{path}/{precision} epochs={epochs} "
+            f"(timeout {timeout_s:.0f}s)",
+            file=sys.stderr, flush=True,
+        )
+        info = run_worker_config(
+            order, path, precision, epochs, warmup, cache_dir,
+            args.kernel_tile, timeout_s,
+        )
+        rec = {"order": order, "path": path, "precision": precision, **info}
+        if info.get("epoch_s") is not None:
             print(
-                f"main backend init attempt {attempt + 1} failed: {e}; retrying",
+                f"{order}/{path}/{precision}: {info['epoch_s']:.4f}s/epoch "
+                f"(wall {info.get('wall_s', 0):.0f}s)",
                 file=sys.stderr, flush=True,
             )
-            time.sleep(10.0 * (attempt + 1))
-    else:
-        print("FATAL: main-process backend init failed", file=sys.stderr, flush=True)
-        return 1
-
-    from neutronstarlite_tpu.graph.dataset import GNNDatum
-    from neutronstarlite_tpu.graph.storage import build_graph
-    from neutronstarlite_tpu.graph.synthetic import synthetic_power_law_graph
-
-    v_num = max(int(REDDIT_V * args.scale), 64)
-    e_num = max(int(REDDIT_E * args.scale), 512)
-
-    t0 = time.time()
-    src, dst = synthetic_power_law_graph(v_num, e_num, seed=7)
-    sizes = [int(s) for s in LAYERS.split("-")]
-    datum = GNNDatum.random_generate(v_num, sizes[0], N_LABELS, seed=7)
-    # one host CSC/CSR build shared by every sweep config (the build is
-    # minutes at full Reddit scale; per-config rebuild dominated the sweep)
-    host_graph = build_graph(src, dst, v_num, weight="gcn_norm")
-    gen_s = time.time() - t0
-
-    # one table build + device upload per layout shared by every config of
-    # that path (tables are precision- and order-independent)
-    _ell_cache = []
-    _blocked_cache = []
-
-    def get_ell():
-        if not _ell_cache:
-            from neutronstarlite_tpu.ops.ell import EllPair
-
-            _ell_cache.append(EllPair.from_host(host_graph))
-        return _ell_cache[0]
-
-    def get_blocked():
-        if not _blocked_cache:
-            from neutronstarlite_tpu.ops.blocked_ell import BlockedEllPair
-
-            _blocked_cache.append(
-                BlockedEllPair.from_host(host_graph, vt=args.kernel_tile)
+        else:
+            print(
+                f"{order}/{path}/{precision} FAILED: {info.get('error')}",
+                file=sys.stderr, flush=True,
             )
-        return _blocked_cache[0]
+        return rec
 
-    def get_tables(path):
-        if path in ("ell", "pallas"):  # pallas shares the ELL tables
-            return get_ell()
-        if path == "blocked":
-            return get_blocked()
-        return None
-
-    # ---- sweep: find the fast config with short runs -----------------------
+    # ---- sweep: find the fast config with short worker runs ----------------
     sweep_results = []
     order, path, precision = args.order, args.path, args.precision
+    best = None
     if args.sweep != "off":
         precisions = [args.precision]
         if args.sweep == "full":
             precisions.append(
                 "float32" if args.precision == "bfloat16" else "bfloat16"
             )
-        # group configs by path so only one layout's device tables are
-        # resident at a time (each layout is GBs at full scale). The blocked
-        # layout joins only --sweep full: its full-scale host build +
-        # compile measured ~25+ min on the 1-core rig, too risky for the
-        # default sweep budget (measure it explicitly with --path blocked)
+        # pallas/blocked join only --sweep full: pallas needs the VMEM
+        # regime (eager widths) and blocked's full-scale build+compile is
+        # tens of minutes — measure them explicitly or via full
         paths = ("scatter", "ell") if args.sweep == "auto" else (
             "scatter", "ell", "pallas", "blocked"
         )
@@ -320,93 +498,47 @@ def main(argv=None) -> int:
             for pr in precisions
             for o in ("standard", "eager")
         ]
-        best = None
-        # soft sweep budget: leave >= 40% of the deadline for the final
-        # measurement — a slow-compiling config must degrade the sweep, not
-        # let the hard watchdog kill the whole run with no output
-        sweep_budget_s = args.deadline * 0.6
+        # leave >= 35% of the deadline for the final measurement
+        sweep_budget_s = args.deadline * 0.65
         for o, p, pr in grid:
-            if time.time() - main_t0 > sweep_budget_s and best is not None:
+            budget_left = sweep_budget_s - (time.time() - main_t0)
+            if budget_left < 60.0 and best is not None:
                 print(
-                    f"sweep budget exhausted ({sweep_budget_s:.0f}s); "
-                    f"measuring best-so-far",
+                    f"sweep budget exhausted; measuring best-so-far",
                     file=sys.stderr, flush=True,
                 )
                 break
-            # path groups run consecutively: entering a new group frees the
-            # previous layout's device tables (the final winner re-uploads
-            # once via get_tables)
-            if p not in ("ell", "pallas"):
-                _ell_cache.clear()
-            if p != "blocked":
-                _blocked_cache.clear()
-            t0 = time.time()
-            try:
-                tr = _make_trainer(
-                    o, p, pr, src, dst, datum, v_num,
-                    epochs=args.sweep_epochs, warmup=1, host_graph=host_graph,
-                    host_ell=get_tables(p), kernel_tile=args.kernel_tile,
-                )
-                ep_s, _ = _timed_run(tr, warmup=1)
-            except Exception as e:  # a config may OOM/fail; sweep continues
-                print(f"sweep {o}/{p}/{pr} FAILED: {e}", file=sys.stderr, flush=True)
-                sweep_results.append(
-                    {"order": o, "path": p, "precision": pr, "error": str(e)[:200]}
-                )
-                continue
-            finally:
-                tr = None  # free device blocks before the next config
-            sweep_results.append(
-                {
-                    "order": o, "path": p, "precision": pr,
-                    "epoch_s": round(ep_s, 4),
-                    "wall_s": round(time.time() - t0, 1),
-                }
-            )
-            print(f"sweep {o}/{p}/{pr}: {ep_s:.4f}s/epoch", file=sys.stderr, flush=True)
-            if best is None or ep_s < best[0]:
-                best = (ep_s, o, p, pr)
+            rec = measure(o, p, pr, args.sweep_epochs, 1, budget_left)
+            sweep_results.append(rec)
+            ep = rec.get("epoch_s")
+            if ep is not None and (best is None or ep < best[0]):
+                best = (ep, o, p, pr, rec)
         if best is None:
             print("FATAL: every sweep config failed", file=sys.stderr, flush=True)
             return 1
-        _, order, path, precision = best
-        # free losing layouts' device tables (GBs at full scale) before the
-        # final measurement
-        if path not in ("ell", "pallas"):
-            _ell_cache.clear()
-        if path != "blocked":
-            _blocked_cache.clear()
+        _, order, path, precision, _ = best
 
     # ---- final measurement of the winning config ---------------------------
-    # a sweep config that straddled the soft budget may have eaten most of
-    # the deadline; a fresh final run recompiles, so when too little time
-    # remains, report the winner's (valid, short-run) sweep timing instead
-    # of risking a no-output watchdog kill
     measurement = "final"
-    if (
-        args.sweep != "off"
-        and best is not None
-        and time.time() - main_t0 > args.deadline * 0.75
-    ):
+    final_budget = remaining() - 90.0  # leave room to print + exit
+    rec = None
+    if final_budget > 120.0:
+        rec = measure(order, path, precision, args.epochs, args.warmup, final_budget)
+    if rec is None or rec.get("epoch_s") is None:
+        if best is None:
+            print("FATAL: final measurement failed", file=sys.stderr, flush=True)
+            return 1
         print(
-            "deadline nearly exhausted; reporting the winner's sweep timing",
+            "final measurement unavailable; reporting the winner's "
+            "(valid, short-run) sweep timing",
             file=sys.stderr, flush=True,
         )
         measurement = "sweep_short"
-        epoch_s = best[0]
-        build_s = 0.0
-        result = {"loss": None}  # None -> JSON null (NaN breaks strict parsers)
-    else:
-        t0 = time.time()
-        trainer = _make_trainer(
-            order, path, precision, src, dst, datum, v_num,
-            epochs=args.epochs, warmup=args.warmup, host_graph=host_graph,
-            host_ell=get_tables(path), kernel_tile=args.kernel_tile,
-        )
-        build_s = time.time() - t0
-        epoch_s, result = _timed_run(trainer, args.warmup)
+        rec = best[4]
+    epoch_s = rec["epoch_s"]
 
     n_chips = 1
+    sizes = [int(s) for s in LAYERS.split("-")]
     layers = len(sizes) - 1
     edges_per_sec_per_chip = e_num * layers * 2 / (epoch_s * n_chips)
 
@@ -425,10 +557,9 @@ def main(argv=None) -> int:
             "path": path,
             "chips": n_chips,
             "edges_per_sec_per_chip": round(edges_per_sec_per_chip, 0),
-            "final_loss": result["loss"],
-            "graph_gen_s": round(gen_s, 1),
-            "graph_build_s": round(build_s, 1),
-            "device": str(jax.devices()[0]),
+            "final_loss": rec.get("loss"),
+            "graph_cache_build_s": round(gen_s, 1),
+            "device": rec.get("device"),
             "backend_init_s": probe.get("init_s"),
             "sweep": sweep_results,
             "measurement": measurement,

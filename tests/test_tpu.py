@@ -120,6 +120,15 @@ if pcompiled is not None:
     r = np.asarray(pcompiled(ell, jnp.asarray(x)), np.float64)
     out["checks"]["pallas_ell_f32"] = rel_err(r, golden)
     out["pallas"] = "compiled"
+    # trainable path: compiled gradient must equal the dense transpose
+    from neutronstarlite_tpu.ops.pallas_kernels import (
+        PallasEllPair, pallas_gather_dst_from_src,
+    )
+    ppair = PallasEllPair.from_pair(ell)
+    pgrad = jax.jit(jax.grad(
+        lambda v: (pallas_gather_dst_from_src(ppair, v) * c).sum()))
+    r = np.asarray(pgrad(jnp.asarray(x)), np.float64)
+    out["checks"]["pallas_grad_f32"] = rel_err(r, dense.T @ c.astype(np.float64))
 
 # short on-device training run: loss must decrease
 from neutronstarlite_tpu.models.gcn import GCNTrainer
@@ -198,6 +207,7 @@ def test_tpu_pallas_kernel(tpu_results):
     if tpu_results.get("pallas") != "compiled":
         pytest.skip(f"pallas: {tpu_results.get('pallas')}")
     assert tpu_results["checks"]["pallas_ell_f32"] < 1e-5, tpu_results
+    assert tpu_results["checks"]["pallas_grad_f32"] < 1e-5, tpu_results
 
 
 def test_tpu_gcn_short_training(tpu_results):
