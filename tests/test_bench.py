@@ -1,0 +1,77 @@
+"""Bench harness checks (supervisor/worker split, graph cache).
+
+The bench is the round's deliverable; its host-graph cache and worker JSON
+contract get the same test discipline as the framework proper. The heavy
+TPU paths are exercised by the driver; here the CPU platform validates the
+machinery end to end at toy scale.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+import bench
+
+
+def test_graph_cache_round_trip(tmp_path, monkeypatch):
+    monkeypatch.setenv("NTS_BENCH_CACHE", str(tmp_path))
+    d, v_num, e_num, gen_s = bench.build_and_cache_graph(0.0005)
+    assert os.path.exists(os.path.join(d, "ok"))
+    g, src, dst = bench.load_cached_graph(d)
+    assert g.v_num == v_num and len(src) == len(dst)
+
+    # must equal a direct build (the cache is a pure serialization)
+    from neutronstarlite_tpu.graph.storage import build_graph
+
+    want = build_graph(src, dst, v_num, weight="gcn_norm")
+    np.testing.assert_array_equal(g.column_offset, want.column_offset)
+    np.testing.assert_array_equal(g.row_indices, want.row_indices)
+    np.testing.assert_allclose(g.edge_weight_forward, want.edge_weight_forward)
+
+    # second call is a cache hit: no rebuild
+    d2, _, _, gen_s2 = bench.build_and_cache_graph(0.0005)
+    assert d2 == d and gen_s2 == 0.0
+
+
+def test_stale_cache_detected(tmp_path, monkeypatch):
+    monkeypatch.setenv("NTS_BENCH_CACHE", str(tmp_path))
+    d, v_num, e_num, _ = bench.build_and_cache_graph(0.0005)
+    # simulate a generator/constant change leaving old bytes behind
+    meta = json.load(open(os.path.join(d, "meta.json")))
+    meta["v_num"] += 1
+    json.dump(meta, open(os.path.join(d, "meta.json"), "w"))
+    try:
+        bench.load_cached_graph(d)
+        raise AssertionError("stale cache not detected")
+    except AssertionError as e:
+        assert "stale graph cache" in str(e)
+
+
+def test_worker_subprocess_contract(tmp_path, monkeypatch):
+    """One worker run on CPU: must print a single parseable JSON line with
+    epoch timings (the supervisor's whole interface to the measurement)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["NTS_BENCH_CACHE"] = str(tmp_path)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
+    monkeypatch.setenv("NTS_BENCH_CACHE", str(tmp_path))
+    d, _, _, _ = bench.build_and_cache_graph(0.0005)
+    r = subprocess.run(
+        [
+            sys.executable, os.path.join(env["PYTHONPATH"], "bench.py"),
+            "--worker", "--worker-config", "eager/ell/float32",
+            "--epochs", "1", "--warmup", "1", "--cache-dir", d,
+            "--kernel-tile", "0",
+        ],
+        capture_output=True, text=True, timeout=300, env=env,
+    )
+    assert r.returncode == 0, r.stderr[-1500:]
+    info = json.loads(r.stdout.strip().splitlines()[-1])
+    assert info["epoch_s"] > 0
+    assert len(info["epoch_times"]) == 2  # warmup + measured
+    assert np.isfinite(info["loss"])
