@@ -278,6 +278,19 @@ def worker_main(args) -> int:
 
     import jax
 
+    # persistent compile cache: the final measurement re-runs the sweep
+    # winner's exact program (and the driver re-runs the bench every round)
+    # — serialized executables turn those multi-minute full-scale compiles
+    # into cache hits. Guarded: not every backend supports serialization.
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.environ.get("JAX_COMPILATION_CACHE_DIR", "/tmp/nts_jit_cache"),
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+    except Exception as e:  # pragma: no cover
+        print(f"compile cache unavailable: {e}", file=sys.stderr, flush=True)
+
     # the probe subprocess's client may not have released the accelerator
     # lease yet (observed: probe ok, then init UNAVAILABLE ~2 s later)
     for attempt in range(5):

@@ -80,9 +80,10 @@ class FullBatchTrainer(ToolkitBase):
             if isinstance(self.compute_graph, BlockedEllPair):
                 log.info(
                     "OPTIM_KERNEL: blocked ELL aggregation (%d src tiles of "
-                    "%d vertices)",
-                    len(self.compute_graph.fwd.tiles),
+                    "%d vertices, %d stacked levels)",
+                    self.compute_graph.fwd.n_tiles,
                     self.compute_graph.fwd.vt,
+                    len(self.compute_graph.fwd.nbr),
                 )
             elif isinstance(self.compute_graph, PallasEllPair):
                 log.info(

@@ -9,26 +9,45 @@ is an HBM transaction and the op costs O(E * f) HBM bytes per application
 
 This module tiles the SOURCE dimension instead: vertices are cut into T
 contiguous tiles of ``vt`` rows; each tile owns the sub-adjacency of edges
-whose source lies in the tile, stored as ELL bucket tables with tile-LOCAL
-source ids. Aggregation sums per-tile aggregates:
+whose source lies in the tile, with tile-LOCAL source ids, so every gather
+indexes only a [vt, f] slice sized to the on-chip budget. HBM traffic
+becomes O(E * 8 B) table reads + O(rows * f) partial-sum scatter instead of
+O(E * f) scattered row reads, and the access pattern is streaming. This is
+the TPU analog of the reference's shared-memory tiling in its optimized
+CUDA aggregation kernel (cuda/ntsCUDAFuseKernel.cuh:154-208, block-local
+accumulation) — re-derived for a memory system where the win comes from
+keeping the GATHER SOURCE on-chip rather than the accumulator.
 
-    out = sum_t  ell_aggregate(tables_t, x[t*vt : t*vt + vt])
+Layout (round-2 redesign): the first version gave each tile its own
+EllBuckets with tile-specific level structure, unrolled in Python — at
+Reddit scale the resulting program had hundreds of heterogeneous fusion
+regions and took 44 MINUTES to compile (docs/PERF.md section 3c). The
+production layout is UNIFORM across tiles: global power-of-two degree
+levels, each level one stacked [T, N_l, K_l] table padded to the max
+per-tile row count, and aggregation is ONE ``lax.scan`` over tiles — the
+compiled program is a single tile body, independent of T. Two structural
+bonuses fall out:
 
-Every gather in the per-tile term indexes only the [vt, f] slice — sized to
-the on-chip budget — so the random access stays in the fast regime at ANY
-graph size. HBM traffic becomes O(E * 8 B) table reads + O(T * V * f)
-partial-sum accumulation instead of O(E * f) scattered row reads: at Reddit
-scale with f = 602 that is ~8x less traffic, and the access pattern is
-streaming, not random. This is the TPU analog of the reference's
-shared-memory tiling in its optimized CUDA aggregation kernel
-(cuda/ntsCUDAFuseKernel.cuh:154-208, block-local accumulation) — re-derived
-for a memory system where the win comes from keeping the GATHER SOURCE
-on-chip rather than the accumulator.
+- no supernode bucket: a destination's per-tile in-degree is bounded by
+  ``vt``, so K_l <= next_pow2(vt) — the power-law hub that forces the
+  plain layout's K ~ 2^21 level (and its K-chunked scan) cannot occur;
+- rows exist only where a (tile, dst) pair has edges: the per-tile scatter
+  touches len(rows) destinations, not V, and padding rows carry
+  ``dst = v_num`` and are dropped by the scatter (mode="drop").
 
 Forward/backward pairing follows ops/ell.py exactly: the backward is the
 same blocked op over the transposed (CSR) adjacency, tiled by the original
-destination side, wrapped in one ``custom_vjp``. Numeric policy is shared
-via ops.ell.ell_tables_aggregate (f32 products + accumulation).
+destination side, wrapped in one ``custom_vjp``. Numeric policy matches
+ops.ell.ell_tables_aggregate: f32 products, f32 accumulation (both the
+per-row K-reduction and the cross-tile scatter accumulator), one cast at
+the end. Byte budget: the [rows, K, f] gather intermediate is bounded by
+the same NTS_ELL_CHUNK_MIB budget, chunking level rows with an inner scan.
+
+Single-chip only by design: the distributed layouts (parallel/dist_ell.py,
+dist_graph.py) shard vertices first; this layout is what a shard uses
+locally when its feature slab outgrows VMEM. (The zeros-initialized scan
+carry would need the varying-axes peel under shard_map — see
+ops/aggregate._scatter_accumulate — if that ever changes.)
 
 Enable per-trainer with ``OPTIM_KERNEL:1`` + ``KERNEL_TILE:<vt>`` (cfg), or
 pass a ``BlockedEllPair`` anywhere a graph/EllPair is accepted by
@@ -43,24 +62,36 @@ from typing import List
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from neutronstarlite_tpu.graph.storage import CSCGraph
-from neutronstarlite_tpu.ops.ell import (
-    DEFAULT_SLOT_CHUNK,
-    EllBuckets,
-    ell_tables_aggregate,
-)
+from neutronstarlite_tpu.ops.ell import DEFAULT_SLOT_CHUNK, _chunk_budget_bytes
+from neutronstarlite_tpu.utils.logging import get_logger
+
+log = get_logger("blocked_ell")
+
+_MIN_K = 4
 
 
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class BlockedEll:
-    """One direction's source-tiled tables. ``tiles[t]`` holds EllBuckets
-    whose neighbor ids are LOCAL to source tile t (rows are global dst)."""
+    """One direction's source-tiled stacked tables.
 
-    tiles: List[EllBuckets]
+    Per level l: ``nbr[l]`` [T, N_l, K_l] tile-local neighbor ids,
+    ``wgt[l]`` [T, N_l, K_l] weights (0 on padding slots), ``dst_row[l]``
+    [T, N_l] global destination of each row (``v_num`` on padding rows —
+    dropped by the scatter). Rows are sorted by destination within each
+    (tile, level) and unique there (a dst's whole in-tile run lives in
+    exactly one level), so the scatter carries sorted+unique flags.
+    """
+
+    nbr: List[jax.Array]
+    wgt: List[jax.Array]
+    dst_row: List[jax.Array]
     vt: int = dataclasses.field(metadata=dict(static=True))
     v_num: int = dataclasses.field(metadata=dict(static=True))
+    n_tiles: int = dataclasses.field(metadata=dict(static=True))
 
     @staticmethod
     def build(
@@ -69,54 +100,138 @@ class BlockedEll:
         adj: np.ndarray,  # [E] source ids, grouped by dst
         weights: np.ndarray,  # [E]
         vt: int,
-        slot_chunk: int = DEFAULT_SLOT_CHUNK,
+        slot_chunk: int = DEFAULT_SLOT_CHUNK,  # kept for API compat; byte
+        # budget (NTS_ELL_CHUNK_MIB) governs chunking at trace time
     ) -> "BlockedEll":
-        deg = np.diff(offsets)
+        deg = np.diff(offsets).astype(np.int64)
         dst_of_edge = np.repeat(np.arange(v_num, dtype=np.int64), deg)
         adj = np.asarray(adj, dtype=np.int64)
         weights = np.asarray(weights)
         n_tiles = -(-v_num // vt)
-        # one stable pass: order edges by source tile, keeping dst grouping
-        tile_of_edge = adj // vt
-        order = np.argsort(tile_of_edge, kind="stable")
-        counts = np.bincount(tile_of_edge, minlength=n_tiles)
-        starts = np.concatenate([[0], np.cumsum(counts)])
-        tiles = []
-        for t in range(n_tiles):
-            lo, hi = starts[t], starts[t + 1]
-            sel = order[lo:hi]
-            sub_dst = dst_of_edge[sel]
-            sub_src = adj[sel] - t * vt
-            sub_w = weights[sel]
-            sub_deg = np.bincount(sub_dst, minlength=v_num)
-            sub_off = np.concatenate([[0], np.cumsum(sub_deg)])
-            # regroup by dst (stable, so source order inside a dst persists)
-            by_dst = np.argsort(sub_dst, kind="stable")
-            tiles.append(
-                EllBuckets.build(
-                    v_num,
-                    sub_off,
-                    sub_src[by_dst].astype(np.int32),
-                    sub_w[by_dst],
-                    slot_chunk,
-                )
+
+        # sort edges by (source tile, dst): one stable pass gives every
+        # (tile, dst) row as a contiguous run
+        key = (adj // vt) * v_num + dst_of_edge
+        order = np.argsort(key, kind="stable")
+        skey = key[order]
+        # skey is sorted: extract (tile, dst) runs with one linear pass
+        # instead of np.unique's internal re-sort
+        bounds = np.nonzero(np.concatenate([[True], skey[1:] != skey[:-1]]))[0]
+        row_key = skey[bounds]
+        row_start = bounds
+        row_len = np.diff(np.concatenate([bounds, [len(skey)]]))
+        row_tile = (row_key // v_num).astype(np.int64)
+        row_dst = (row_key % v_num).astype(np.int64)
+
+        # uniform global levels: K in {4, 8, ..., next_pow2(max run)};
+        # bounded by next_pow2(vt) since an in-tile run can't exceed vt
+        row_k = np.maximum(
+            2 ** np.ceil(np.log2(np.maximum(row_len, 1))).astype(np.int64), _MIN_K
+        )
+        src_local = adj[order] - (row_tile.repeat(row_len)) * vt
+        w_sorted = weights[order]
+
+        nbrs, wgts, dsts = [], [], []
+        pad_slots = real_slots = 0
+        K = _MIN_K
+        max_k = int(row_k.max()) if len(row_k) else _MIN_K
+        while K <= max_k:
+            sel = np.nonzero(row_k == K)[0]
+            if len(sel):
+                t_sel = row_tile[sel]
+                counts = np.bincount(t_sel, minlength=n_tiles)
+                n_l = int(counts.max())
+                nbr = np.zeros((n_tiles, n_l, K), dtype=np.int32)
+                wgt = np.zeros((n_tiles, n_l, K), dtype=np.float32)
+                dstr = np.full((n_tiles, n_l), v_num, dtype=np.int32)
+                # slot of each row inside its tile = rank among the tile's
+                # rows (sel is sorted by (tile, dst), so ranks preserve the
+                # per-tile dst order -> sorted scatter indices)
+                starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+                slot = np.arange(len(sel)) - starts[t_sel]
+                lo = row_start[sel]
+                d = row_len[sel]
+                k = np.arange(K)
+                valid = k[None, :] < d[:, None]
+                flat_idx = (lo[:, None] + k[None, :])[valid]
+                ti = np.broadcast_to(t_sel[:, None], (len(sel), K))[valid]
+                si = np.broadcast_to(slot[:, None], (len(sel), K))[valid]
+                ki = np.broadcast_to(k, (len(sel), K))[valid]
+                nbr[ti, si, ki] = src_local[flat_idx]
+                wgt[ti, si, ki] = w_sorted[flat_idx]
+                dstr[t_sel, slot] = row_dst[sel]
+                nbrs.append(nbr)
+                wgts.append(wgt)
+                dsts.append(dstr)
+                pad_slots += n_tiles * n_l * K - int(d.sum())
+                real_slots += int(d.sum())
+            K *= 2
+        if real_slots:
+            log.info(
+                "blocked ELL: %d tiles of %d, %d levels, padding waste %.2fx "
+                "(%d real / %d padded slots)",
+                n_tiles, vt, len(nbrs), (real_slots + pad_slots) / real_slots,
+                real_slots, pad_slots,
             )
-        return BlockedEll(tiles=tiles, vt=int(vt), v_num=int(v_num))
+        return BlockedEll(
+            nbr=[jnp.asarray(n) for n in nbrs],
+            wgt=[jnp.asarray(w) for w in wgts],
+            dst_row=[jnp.asarray(d) for d in dsts],
+            vt=int(vt),
+            v_num=int(v_num),
+            n_tiles=int(n_tiles),
+        )
 
     def aggregate(self, x: jax.Array) -> jax.Array:
         """out[v] = sum over in-edges of w * x[src]; [V, f] -> [V, f].
 
-        Per-tile partials AND the cross-tile sum stay f32 (a vertex whose
-        in-neighbors span many tiles must not round T times in bf16); one
-        cast back to x.dtype at the end."""
-        out = None
-        for t, b in enumerate(self.tiles):
-            x_tile = x[t * self.vt : (t + 1) * self.vt]
-            part = ell_tables_aggregate(
-                x_tile, b.nbr, b.wgt, b.slot_chunk, out_dtype=jnp.float32
-            )[b.inv_perm]
-            out = part if out is None else out + part
-        return out.astype(x.dtype)
+        One lax.scan over tiles; the carry is the [V, f] f32 accumulator
+        (a vertex whose in-neighbors span many tiles must not round T
+        times in a narrow dtype). Per level the [rows, K, f] gather
+        intermediate is byte-bounded by chunking rows with an inner scan.
+        """
+        f = x.shape[1]
+        v_pad = self.n_tiles * self.vt - self.v_num
+        xt = jnp.pad(x, ((0, v_pad), (0, 0))).reshape(self.n_tiles, self.vt, f)
+        budget = _chunk_budget_bytes()
+
+        def level_add(acc, x_tile, nbr, wgt, dstr):
+            n_l, K = nbr.shape
+            rows = max(budget // (K * f * 4), 1)
+
+            def chunk_add(a, chunk):
+                nb, wg, dr = chunk
+                vals = x_tile[nb].astype(jnp.float32) * wg[:, :, None]
+                return a.at[dr].add(
+                    vals.sum(axis=1),
+                    indices_are_sorted=True,
+                    unique_indices=True,
+                    mode="drop",  # padding rows carry dst = v_num
+                ), None
+
+            if n_l <= rows:
+                acc, _ = chunk_add(acc, (nbr, wgt, dstr))
+                return acc
+            n_ch = -(-n_l // rows)
+            pad = n_ch * rows - n_l
+            nb = jnp.pad(nbr, ((0, pad), (0, 0))).reshape(n_ch, rows, K)
+            wg = jnp.pad(wgt, ((0, pad), (0, 0))).reshape(n_ch, rows, K)
+            dr = jnp.pad(
+                dstr, (0, pad), constant_values=self.v_num
+            ).reshape(n_ch, rows)
+            acc, _ = lax.scan(chunk_add, acc, (nb, wg, dr))
+            return acc
+
+        def body(acc, xs):
+            x_tile, tables = xs
+            for nbr, wgt, dstr in tables:
+                acc = level_add(acc, x_tile, nbr, wgt, dstr)
+            return acc, None
+
+        acc = jnp.zeros((self.v_num, f), jnp.float32)
+        tables = list(zip(self.nbr, self.wgt, self.dst_row))
+        acc, _ = lax.scan(body, acc, (xt, tables))
+        return acc.astype(x.dtype)
 
 
 @jax.tree_util.register_dataclass

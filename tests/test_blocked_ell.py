@@ -19,7 +19,7 @@ from neutronstarlite_tpu.ops.ell import EllPair, ell_gather_dst_from_src
 def test_blocked_forward_matches_dense(rng):
     g, dense = tiny_graph(rng, v_num=53, e_num=400)
     pair = BlockedEllPair.from_host(g, vt=16)  # forces 4 tiles, ragged last
-    assert len(pair.fwd.tiles) == 4
+    assert pair.fwd.n_tiles == 4
     x = rng.standard_normal((g.v_num, 6)).astype(np.float32)
     out = np.asarray(blocked_gather_dst_from_src(pair, jnp.asarray(x)), np.float64)
     np.testing.assert_allclose(out, dense @ x.astype(np.float64), rtol=1e-4, atol=1e-4)
