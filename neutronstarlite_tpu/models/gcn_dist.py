@@ -19,6 +19,7 @@ single-chip path (ring of length 1, no collectives).
 
 from __future__ import annotations
 
+import os
 from typing import Any, Dict
 
 import jax
@@ -268,8 +269,15 @@ class DistGCNTrainer(ToolkitBase):
                 log.info("Epoch %d loss %f", epoch, float(loss))
 
         self.ckpt_final()
-        logits_p = self._eval_logits(self.params, self.blocks, self.feature_p, self.valid_p, key)
-        accs = self.dist_eval_report(logits_p, self.label_p, self.mask_p, self.valid_p)
+        if os.environ.get("NTS_FINAL_EVAL", "1") == "0" and loss is not None:
+            # benchmark mode: skip the second full-scale program compile
+            # (same gate as FullBatchTrainer.run, see models/fullbatch.py)
+            accs = {"train": None, "eval": None, "test": None}
+        else:
+            logits_p = self._eval_logits(
+                self.params, self.blocks, self.feature_p, self.valid_p, key
+            )
+            accs = self.dist_eval_report(logits_p, self.label_p, self.mask_p, self.valid_p)
         avg = self.avg_epoch_time()
         log.info("--avg epoch time %.4f s", avg)
         # loss is None when a checkpoint restore resumed at/after cfg.epochs

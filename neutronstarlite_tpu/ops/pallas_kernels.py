@@ -52,6 +52,10 @@ _K_CHUNK = 8  # static inner unroll; K beyond this iterates a fori_loop
 # degrades to a serial K loop on few-row hub levels; Reddit-scale power-law
 # graphs carry a K ~ 2^21 supernode bucket)
 MAX_PALLAS_K = 1024
+# the kernel holds the whole [V, f] feature table in VMEM; past this budget
+# (v5e VMEM = 128 MB, minus tile double-buffers) the call degrades to the
+# XLA ELL path instead of failing Mosaic's VMEM allocation
+MAX_TABLE_BYTES = 96 << 20
 
 
 def _ell_level_kernel(nbr_ref, wgt_ref, x_ref, o_ref, *, k_cols: int):
@@ -134,6 +138,13 @@ def gather_dst_from_src_pallas(
         if isinstance(ell_pair_or_buckets, EllPair)
         else ell_pair_or_buckets
     )
+    if x.shape[0] * x.shape[1] * x.dtype.itemsize > MAX_TABLE_BYTES:
+        # beyond the VMEM-resident regime: the whole level set takes the
+        # XLA gather path (the blocked source-tiled layout is the right
+        # kernel there — ops/blocked_ell.py)
+        return ell_tables_aggregate(x, buckets.nbr, buckets.wgt, buckets.slot_chunk)[
+            buckets.inv_perm
+        ]
     outs = []
     for nbr, wgt in zip(buckets.nbr, buckets.wgt):
         if nbr.shape[1] == 0:
