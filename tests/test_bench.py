@@ -75,3 +75,15 @@ def test_worker_subprocess_contract(tmp_path, monkeypatch):
     assert info["epoch_s"] > 0
     assert len(info["epoch_times"]) == 2  # warmup + measured
     assert np.isfinite(info["loss"])
+
+
+def test_bench_matrix_measures_one_cfg():
+    """The workload-matrix tool's per-cfg measurement contract."""
+    from neutronstarlite_tpu.tools.bench_matrix import measure_cfg
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    row = measure_cfg(os.path.join(repo, "configs", "gcn_cora.cfg"),
+                      epochs=1, warmup=1)
+    assert row["algorithm"] == "GCNCPU"
+    assert row["epoch_s"] > 0
+    assert np.isfinite(row["loss"])
