@@ -462,8 +462,9 @@ def main(argv=None) -> int:
         return args.deadline - (time.time() - main_t0)
 
     def measure(order, path, precision, epochs, warmup, budget_s):
-        # the blocked layout's full-scale host build + compile is tens of
-        # minutes (docs/PERF.md section 3c) — give it 3x the normal cap
+        # the blocked layout's full-scale host table build is ~2 min per
+        # direction on the 1-core rig (docs/PERF.md section 3c; its compile
+        # is seconds since the stacked redesign) — give it 3x the normal cap
         cap = args.config_timeout * (3.0 if path == "blocked" else 1.0)
         timeout_s = max(min(cap, budget_s), 60.0)
         print(
@@ -500,8 +501,8 @@ def main(argv=None) -> int:
                 "float32" if args.precision == "bfloat16" else "bfloat16"
             )
         # pallas/blocked join only --sweep full: pallas needs the VMEM
-        # regime (eager widths) and blocked's full-scale build+compile is
-        # tens of minutes — measure them explicitly or via full
+        # regime (eager widths) and blocked pays a minutes-long host table
+        # build — measure them explicitly or via full
         paths = ("scatter", "ell") if args.sweep == "auto" else (
             "scatter", "ell", "pallas", "blocked"
         )

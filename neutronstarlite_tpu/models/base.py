@@ -318,6 +318,16 @@ class ToolkitBase:
         times = self.epoch_times[1:] if len(self.epoch_times) > 1 else self.epoch_times
         return float(np.mean(times)) if times else 0.0
 
+    @staticmethod
+    def skip_final_eval(loss) -> bool:
+        """NTS_FINAL_EVAL=0: benchmark mode — the end-of-run eval-mode
+        forward is a SECOND full-scale program compile, pure overhead for
+        an epoch-time measurement (and a failure surface: a dying compile
+        service mid-eval once sank a whole bench sweep). Only skippable
+        when training actually ran (loss is not None) so a restore-only
+        run still reports the restored model's accuracy."""
+        return os.environ.get("NTS_FINAL_EVAL", "1") == "0" and loss is not None
+
     def test(self, logits: np.ndarray, which: int) -> float:
         """Accuracy over mask class `which` (Test(0/1/2), GCN_CPU.hpp:142-171)."""
         sel = self.datum.mask == which

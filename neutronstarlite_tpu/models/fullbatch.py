@@ -54,6 +54,8 @@ class FullBatchTrainer(ToolkitBase):
             # it sees this path coming)
             self.graph = None
             from neutronstarlite_tpu.ops.blocked_ell import BlockedEllPair
+            from neutronstarlite_tpu.ops.ell import EllPair
+            from neutronstarlite_tpu.ops.pallas_kernels import PallasEllPair
 
             if self.host_ell is not None:
                 self.compute_graph = self.host_ell
@@ -62,13 +64,8 @@ class FullBatchTrainer(ToolkitBase):
                     self.host_graph, vt=cfg.kernel_tile
                 )
             else:
-                from neutronstarlite_tpu.ops.ell import EllPair
-
                 self.compute_graph = EllPair.from_host(self.host_graph)
-            from neutronstarlite_tpu.ops.ell import EllPair as _EllPair
-            from neutronstarlite_tpu.ops.pallas_kernels import PallasEllPair
-
-            if cfg.pallas_kernel and isinstance(self.compute_graph, _EllPair):
+            if cfg.pallas_kernel and isinstance(self.compute_graph, EllPair):
                 # same tables, fused-kernel executor (PALLAS:1)
                 self.compute_graph = PallasEllPair.from_pair(self.compute_graph)
             elif cfg.pallas_kernel:
@@ -231,13 +228,9 @@ class FullBatchTrainer(ToolkitBase):
         if os.environ.get("NTS_DEBUGINFO", "0") == "1":
             log.info("%s", self.debug_info(key))
 
-        # The eval-mode forward is a SECOND full-scale program compile. A
-        # benchmark run that only needs epoch timings can skip it
-        # (NTS_FINAL_EVAL=0): at Reddit scale the extra compile costs
-        # minutes and has sunk whole bench sweeps when the remote compile
-        # service failed mid-run; the cadence lines above already report
-        # train-mode accuracies.
-        if os.environ.get("NTS_FINAL_EVAL", "1") == "0" and loss is not None:
+        # benchmark mode (see ToolkitBase.skip_final_eval); the cadence
+        # lines above already report train-mode accuracies
+        if self.skip_final_eval(loss):
             accs = {"train": None, "eval": None, "test": None}
         else:
             logits = np.asarray(

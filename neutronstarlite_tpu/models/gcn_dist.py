@@ -19,7 +19,6 @@ single-chip path (ring of length 1, no collectives).
 
 from __future__ import annotations
 
-import os
 from typing import Any, Dict
 
 import jax
@@ -269,9 +268,7 @@ class DistGCNTrainer(ToolkitBase):
                 log.info("Epoch %d loss %f", epoch, float(loss))
 
         self.ckpt_final()
-        if os.environ.get("NTS_FINAL_EVAL", "1") == "0" and loss is not None:
-            # benchmark mode: skip the second full-scale program compile
-            # (same gate as FullBatchTrainer.run, see models/fullbatch.py)
+        if self.skip_final_eval(loss):  # benchmark mode, ToolkitBase docs
             accs = {"train": None, "eval": None, "test": None}
         else:
             logits_p = self._eval_logits(
