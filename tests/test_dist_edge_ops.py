@@ -24,7 +24,9 @@ from neutronstarlite_tpu.parallel.mesh import make_mesh
 from neutronstarlite_tpu.parallel.mirror import MirrorGraph
 
 multidevice = pytest.mark.skipif(
-    os.environ.get("NTS_MULTIDEVICE", "0") != "1" and (os.cpu_count() or 1) < 4,
+    os.environ.get("NTS_MULTIDEVICE", "1") == "0",  # opt-OUT: a round-1
+    # collective bug hid behind a cpu_count skip-gate; slow 1-core CI is
+    # the price of never letting that happen again (VERDICT r1 item 10)
     reason="XLA:CPU collectives starve on a single-core host; "
     "set NTS_MULTIDEVICE=1 to force",
 )
