@@ -77,6 +77,13 @@ def get_lib() -> Optional[ctypes.CDLL]:
         i64p, i32p, i64p, ctypes.c_int64, ctypes.c_int32, ctypes.c_uint64,
         i32p, i32p, i32p,
     ]
+    lib.nts_sort_by_tile.argtypes = [
+        i32p, ctypes.c_int64, ctypes.c_int32, i64p,
+    ]
+    lib.nts_fill_blocked_level.argtypes = [
+        i64p, i64p, i32p, i32p, i64p, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int32, i32p, f32p, i32p, f32p, i32p,
+    ]
     lib.nts_native_version.restype = ctypes.c_int
     _lib = lib
     log.info("native runtime loaded (v%d)", lib.nts_native_version())
@@ -120,6 +127,41 @@ def build_adjacency(
     return (
         column_offset, csc_src, csc_dst, csc_w,
         row_offset, csr_src, csr_dst, csr_w, out_degree, in_degree,
+    )
+
+
+def sort_by_tile(tile_of_edge: np.ndarray, n_tiles: int) -> np.ndarray:
+    """Stable counting-sort permutation by source tile (O(E) vs argsort's
+    O(E log E)); with dst-grouped input edges the result is (tile, dst)-
+    sorted — the blocked ELL build's edge order."""
+    lib = get_lib()
+    assert lib is not None
+    tile = np.ascontiguousarray(tile_of_edge, np.int32)
+    order = np.empty(len(tile), np.int64)
+    lib.nts_sort_by_tile(tile, len(tile), n_tiles, order)
+    return order
+
+
+def fill_blocked_level(
+    row_start: np.ndarray, row_len: np.ndarray, row_tile: np.ndarray,
+    row_dst: np.ndarray, row_slot: np.ndarray, n_l: int, K: int,
+    src_sorted: np.ndarray, w_sorted: np.ndarray,
+    nbr: np.ndarray, wgt: np.ndarray, dstr: np.ndarray,
+) -> None:
+    """Fill one stacked [T, n_l, K] blocked-ELL level in place (nbr/wgt
+    zero-initialized, dstr v_num-filled by the caller)."""
+    lib = get_lib()
+    assert lib is not None
+    lib.nts_fill_blocked_level(
+        np.ascontiguousarray(row_start, np.int64),
+        np.ascontiguousarray(row_len, np.int64),
+        np.ascontiguousarray(row_tile, np.int32),
+        np.ascontiguousarray(row_dst, np.int32),
+        np.ascontiguousarray(row_slot, np.int64),
+        len(row_start), n_l, K,
+        np.ascontiguousarray(src_sorted, np.int32),
+        np.ascontiguousarray(w_sorted, np.float32),
+        nbr, wgt, dstr,
     )
 
 

@@ -121,6 +121,43 @@ void nts_sample_hop(const int64_t* column_offset, const int32_t* row_indices,
   }
 }
 
-int nts_native_version(void) { return 1; }
+// Stable counting sort of edges by source tile. Input edges are already
+// dst-grouped (CSC order), so the output permutation is (tile, dst)-sorted —
+// the order the blocked ELL layout needs (ops/blocked_ell.py) without the
+// O(E log E) comparison sort. Single pass each for histogram and placement.
+void nts_sort_by_tile(const int32_t* tile, int64_t e_num, int32_t n_tiles,
+                      int64_t* order) {
+  int64_t* cursor = new int64_t[n_tiles + 1]();
+  for (int64_t e = 0; e < e_num; ++e) ++cursor[tile[e] + 1];
+  for (int32_t t = 0; t < n_tiles; ++t) cursor[t + 1] += cursor[t];
+  for (int64_t e = 0; e < e_num; ++e) order[cursor[tile[e]]++] = e;
+  delete[] cursor;
+}
+
+// Fill one stacked blocked-ELL level: row r's run of `row_len[r]` sorted
+// edges is copied into nbr/wgt[row_tile[r], row_slot[r], :] and its dst
+// recorded. Caller zero-inits nbr/wgt and v_num-fills dstr (padding rows).
+void nts_fill_blocked_level(const int64_t* row_start, const int64_t* row_len,
+                            const int32_t* row_tile, const int32_t* row_dst,
+                            const int64_t* row_slot, int64_t n_rows,
+                            int64_t n_l, int32_t K,
+                            const int32_t* src_sorted, const float* w_sorted,
+                            int32_t* nbr, float* wgt, int32_t* dstr) {
+#pragma omp parallel for schedule(static)
+  for (int64_t r = 0; r < n_rows; ++r) {
+    const int64_t base = (int64_t)row_tile[r] * n_l + row_slot[r];
+    int32_t* nb = nbr + base * K;
+    float* wg = wgt + base * K;
+    const int64_t lo = row_start[r];
+    const int64_t len = row_len[r];
+    for (int64_t j = 0; j < len; ++j) {
+      nb[j] = src_sorted[lo + j];
+      wg[j] = w_sorted[lo + j];
+    }
+    dstr[base] = row_dst[r];
+  }
+}
+
+int nts_native_version(void) { return 2; }
 
 }  // extern "C"

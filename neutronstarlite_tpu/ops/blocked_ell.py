@@ -103,6 +103,8 @@ class BlockedEll:
         slot_chunk: int = DEFAULT_SLOT_CHUNK,  # kept for API compat; byte
         # budget (NTS_ELL_CHUNK_MIB) governs chunking at trace time
     ) -> "BlockedEll":
+        from neutronstarlite_tpu import native as native_rt
+
         n_tiles = -(-v_num // vt)
         # int32 fast path: with T*V < 2^31 the (tile, dst) key fits int32,
         # halving the memory traffic of every pass AND letting numpy's
@@ -113,21 +115,36 @@ class BlockedEll:
         dst_of_edge = np.repeat(np.arange(v_num, dtype=idx_t), deg)
         adj = np.asarray(adj, dtype=idx_t)
         weights = np.asarray(weights)
+        if len(adj) == 0:
+            return BlockedEll(
+                nbr=[], wgt=[], dst_row=[],
+                vt=int(vt), v_num=int(v_num), n_tiles=int(n_tiles),
+            )
 
-        # sort edges by (source tile, dst): one stable pass gives every
-        # (tile, dst) row as a contiguous run
+        # sort edges by (source tile, dst): edges arrive row-grouped
+        # (offsets order), so ONE stable pass by tile yields (tile, row)
+        # order — O(E) native counting sort, or numpy's stable sort over
+        # the combined key as the fallback
         tile_of_edge = adj // np.asarray(vt, idx_t)
-        key = tile_of_edge * np.asarray(v_num, idx_t) + dst_of_edge
-        order = np.argsort(key, kind="stable")
-        skey = key[order]
-        # skey is sorted: extract (tile, dst) runs with one linear pass
-        # instead of np.unique's internal re-sort
-        bounds = np.nonzero(np.concatenate([[True], skey[1:] != skey[:-1]]))[0]
-        row_key = skey[bounds]
+        use_native = native_rt.available()
+        if use_native:
+            order = native_rt.sort_by_tile(
+                tile_of_edge.astype(np.int32, copy=False), n_tiles
+            )
+        else:
+            key = tile_of_edge * np.asarray(v_num, idx_t) + dst_of_edge
+            order = np.argsort(key, kind="stable")
+        tile_sorted = tile_of_edge[order]
+        dst_sorted = dst_of_edge[order]
+        # sorted: extract (tile, dst) runs with one linear pass
+        change = (tile_sorted[1:] != tile_sorted[:-1]) | (
+            dst_sorted[1:] != dst_sorted[:-1]
+        )
+        bounds = np.nonzero(np.concatenate([[True], change]))[0]
         row_start = bounds
-        row_len = np.diff(np.concatenate([bounds, [len(skey)]]))
-        row_tile = (row_key // v_num).astype(np.int64)
-        row_dst = (row_key % v_num).astype(np.int64)
+        row_len = np.diff(np.concatenate([bounds, [len(order)]]))
+        row_tile = tile_sorted[bounds].astype(np.int64)
+        row_dst = dst_sorted[bounds].astype(np.int64)
 
         # uniform global levels: K in {4, 8, ..., next_pow2(max run)};
         # bounded by next_pow2(vt) since an in-tile run can't exceed vt
@@ -136,6 +153,9 @@ class BlockedEll:
         )
         src_local = (adj - tile_of_edge * np.asarray(vt, idx_t))[order]
         w_sorted = weights[order]
+        if use_native:
+            src_local = src_local.astype(np.int32, copy=False)
+            w_sorted = np.ascontiguousarray(w_sorted, np.float32)
 
         nbrs, wgts, dsts = [], [], []
         pad_slots = real_slots = 0
@@ -155,17 +175,24 @@ class BlockedEll:
                 # per-tile dst order -> sorted scatter indices)
                 starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
                 slot = np.arange(len(sel)) - starts[t_sel]
-                lo = row_start[sel]
                 d = row_len[sel]
-                k = np.arange(K)
-                valid = k[None, :] < d[:, None]
-                flat_idx = (lo[:, None] + k[None, :])[valid]
-                ti = np.broadcast_to(t_sel[:, None], (len(sel), K))[valid]
-                si = np.broadcast_to(slot[:, None], (len(sel), K))[valid]
-                ki = np.broadcast_to(k, (len(sel), K))[valid]
-                nbr[ti, si, ki] = src_local[flat_idx]
-                wgt[ti, si, ki] = w_sorted[flat_idx]
-                dstr[t_sel, slot] = row_dst[sel]
+                if use_native:
+                    native_rt.fill_blocked_level(
+                        row_start[sel], d, t_sel.astype(np.int32),
+                        row_dst[sel].astype(np.int32), slot, n_l, K,
+                        src_local, w_sorted, nbr, wgt, dstr,
+                    )
+                else:
+                    lo = row_start[sel]
+                    k = np.arange(K)
+                    valid = k[None, :] < d[:, None]
+                    flat_idx = (lo[:, None] + k[None, :])[valid]
+                    ti = np.broadcast_to(t_sel[:, None], (len(sel), K))[valid]
+                    si = np.broadcast_to(slot[:, None], (len(sel), K))[valid]
+                    ki = np.broadcast_to(k, (len(sel), K))[valid]
+                    nbr[ti, si, ki] = src_local[flat_idx]
+                    wgt[ti, si, ki] = w_sorted[flat_idx]
+                    dstr[t_sel, slot] = row_dst[sel]
                 nbrs.append(nbr)
                 wgts.append(wgt)
                 dsts.append(dstr)
