@@ -211,6 +211,12 @@ class EllBuckets:
             wgts.append(np.zeros((j0, 0), dtype=np.float32))
             perm_parts.append(ids)
             i = j0
+        from neutronstarlite_tpu import native as native_rt
+
+        use_native = native_rt.available()
+        if use_native:
+            adj32 = np.ascontiguousarray(adj, np.int32)
+            w32 = np.ascontiguousarray(weights, np.float32)
         while i < v_num:
             K = max(_next_pow2(max(int(sdeg[i]), 1)), _MIN_K)
             j = int(np.searchsorted(sdeg, K, side="right"))
@@ -219,14 +225,25 @@ class EllBuckets:
             Nk = len(ids)
             nbr = np.zeros((Nk, K), dtype=np.int32)
             wgt = np.zeros((Nk, K), dtype=np.float32)
-            # vectorized fill: rows of the [Nk, K] tables from ragged runs
             lo = offsets[ids]
             d = deg[ids]
-            k = np.arange(K)
-            valid = k[None, :] < d[:, None]
-            flat_idx = (lo[:, None] + k[None, :])[valid]
-            nbr[valid] = adj[flat_idx]
-            wgt[valid] = weights[flat_idx]
+            if use_native:
+                # C fill of the ragged runs (nts_fill_blocked_level with a
+                # single "tile"; the dst/slot channel is the row index) —
+                # the same routine the blocked layout uses
+                dstr = np.empty((1, Nk), np.int32)
+                native_rt.fill_blocked_level(
+                    lo, d, np.zeros(Nk, np.int32), ids.astype(np.int32),
+                    np.arange(Nk, dtype=np.int64), Nk, K, adj32, w32,
+                    nbr.reshape(1, Nk, K), wgt.reshape(1, Nk, K), dstr,
+                )
+            else:
+                # vectorized fill: [Nk, K] table rows from ragged runs
+                k = np.arange(K)
+                valid = k[None, :] < d[:, None]
+                flat_idx = (lo[:, None] + k[None, :])[valid]
+                nbr[valid] = adj[flat_idx]
+                wgt[valid] = weights[flat_idx]
             nbrs.append(nbr)
             wgts.append(wgt)
             perm_parts.append(ids)
