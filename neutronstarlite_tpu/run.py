@@ -30,6 +30,11 @@ def main(argv=None) -> int:
         return 2
     cfg_path = argv[0]
     cfg = InputInfo.read_from_cfg_file(cfg_path)
+    # run_nts.sh parity: its <slots> argument overrides the cfg's PARTITIONS
+    # (the reference's mpiexec -np N, run_nts.sh:2)
+    slots = os.environ.get("NTS_PARTITIONS_OVERRIDE", "")
+    if slots:
+        cfg.partitions = int(slots)
     print(cfg.print())
     cls = get_algorithm(cfg.algorithm)
     toolkit = cls(cfg, base_dir=os.path.dirname(os.path.abspath(cfg_path)))

@@ -1,0 +1,23 @@
+#!/bin/bash
+# Launch parity with the reference's run_nts.sh ("mpiexec -np $1 ./build/nts $2").
+#
+# Usage: ./run_nts.sh <slots> <file.cfg>
+#
+# On TPU, "slots" means mesh partitions, not MPI ranks: one process drives
+# every local chip and the cfg's PARTITIONS key (or this argument) sizes the
+# jax.sharding.Mesh. For multi-host runs set NTS_COORDINATOR /
+# NTS_NUM_PROCESSES / NTS_PROCESS_ID per process (mpiexec-style), or
+# NTS_MULTIHOST=1 on a TPU pod — see README "Multi-chip".
+#
+# Single-host rehearsal of an N-way mesh without N chips (the analog of the
+# reference's multi-slot-on-one-host debugging rig): NTS_VIRTUAL=1 fakes N
+# CPU devices via --xla_force_host_platform_device_count.
+set -e
+slots=${1:?usage: ./run_nts.sh <slots> <file.cfg>}
+cfg=${2:?usage: ./run_nts.sh <slots> <file.cfg>}
+if [ "${NTS_VIRTUAL:-0}" = "1" ]; then
+  export JAX_PLATFORMS=cpu
+  export XLA_FLAGS="${XLA_FLAGS} --xla_force_host_platform_device_count=${slots}"
+fi
+export NTS_PARTITIONS_OVERRIDE="${slots}"
+exec python -m neutronstarlite_tpu.run "${cfg}"
