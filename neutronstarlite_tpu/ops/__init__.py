@@ -14,8 +14,17 @@ from neutronstarlite_tpu.ops.edge import (
     edge_softmax,
 )
 
+# aggregation-table layouts accepted by gather_dst_from_src (the graph
+# argument picks the backend; see ops/aggregate.py)
+from neutronstarlite_tpu.ops.blocked_ell import BlockedEllPair
+from neutronstarlite_tpu.ops.ell import EllPair
+from neutronstarlite_tpu.ops.pallas_kernels import PallasEllPair
+
 __all__ = [
     "DeviceGraph",
+    "EllPair",
+    "BlockedEllPair",
+    "PallasEllPair",
     "gather_dst_from_src",
     "gather_src_from_dst",
     "aggregate_dst_max",
