@@ -47,10 +47,12 @@ def test_cora_files_parse_to_known_stats(cora):
     assert (train, ev, test) == (1605, 566, 537)
 
 
-def test_cora_structure_only_accuracy_band(cora):
+@pytest.mark.parametrize("path", ["scatter", "ell", "blocked"])
+def test_cora_structure_only_accuracy_band(cora, path):
     """GCN on real structure/labels/split with random features must land in
     the structure-only band (the reference's accuracy-as-oracle discipline,
-    toolkits/GCN_CPU.hpp:142-171)."""
+    toolkits/GCN_CPU.hpp:142-171) — on every aggregation backend (the
+    Pallas path is bit-equal to ell by tests/test_pallas.py parity)."""
     from neutronstarlite_tpu.models.gcn import GCNTrainer
     from neutronstarlite_tpu.utils.config import InputInfo
 
@@ -61,6 +63,8 @@ def test_cora_structure_only_accuracy_band(cora):
     cfg.epochs = 60
     cfg.decay_epoch = -1
     cfg.drop_rate = 0.3
+    cfg.optim_kernel = path != "scatter"
+    cfg.kernel_tile = 512 if path == "blocked" else 0
     out = GCNTrainer.from_arrays(cfg, src, dst, datum).run()
 
     assert out["acc"]["train"] >= 0.65, out["acc"]
