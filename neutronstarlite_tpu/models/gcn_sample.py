@@ -10,6 +10,13 @@ replicated-parameter psum under pjit when a mesh is used).
 TPU shape discipline: every batch is padded to the same capacities
 (sample/sampler.py), so ``_train_batch`` compiles once and replays for every
 batch of every epoch.
+
+Sample/compute overlap: the reference pipelines host-side sampling with
+device compute via threads; here JAX's async dispatch does it structurally —
+``_train_batch`` returns before the device finishes, so the host samples
+batch i+1 (native reservoir sampler) while the chip trains on batch i. The
+per-batch device dependency is only the params chain; the single sync point
+is the epoch-end ``block_until_ready``.
 """
 
 from __future__ import annotations
