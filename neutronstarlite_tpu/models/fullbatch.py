@@ -200,7 +200,17 @@ class FullBatchTrainer(ToolkitBase):
         )
         start_epoch = self.ckpt_begin()
         loss = None
+        # NTS_PROFILE_DIR: emit a jax.profiler trace of the steady-state
+        # epochs (from the 2nd epoch on, so compile noise stays out) — the
+        # kernel-level truth behind the DEBUGINFO host timers
+        from neutronstarlite_tpu.utils.profiling import maybe_trace
+
+        trace_from = start_epoch + 1
+        trace_cm = None
         for epoch in range(start_epoch, cfg.epochs):
+            if epoch == trace_from and epoch < cfg.epochs:
+                trace_cm = maybe_trace(type(self).__name__)
+                trace_cm.__enter__()
             ekey = jax.random.fold_in(key, epoch)
             t0 = get_time()
             self.params, self.opt_state, loss, logits = self._train_step(
@@ -223,6 +233,8 @@ class FullBatchTrainer(ToolkitBase):
                 self.test(h, 2)
                 log.info("Epoch %d loss %f", epoch, float(loss))
             self.ckpt_epoch_end(epoch)
+        if trace_cm is not None:
+            trace_cm.__exit__(None, None, None)
         self.ckpt_final()
 
         if os.environ.get("NTS_DEBUGINFO", "0") == "1":
