@@ -248,7 +248,15 @@ class DistGCNTrainer(ToolkitBase):
         )
         start_epoch = self.ckpt_begin()
         loss = None
+        # steady-state trace window (see FullBatchTrainer.run)
+        from neutronstarlite_tpu.utils.profiling import maybe_trace
+
+        trace_from = start_epoch + 1
+        trace_cm = None
         for epoch in range(start_epoch, cfg.epochs):
+            if epoch == trace_from and epoch < cfg.epochs:
+                trace_cm = maybe_trace(type(self).__name__)
+                trace_cm.__enter__()
             ekey = jax.random.fold_in(key, epoch)
             t0 = get_time()
             self.params, self.opt_state, loss, _ = self._train_step(
@@ -267,6 +275,8 @@ class DistGCNTrainer(ToolkitBase):
             if epoch % max(1, cfg.epochs // 20) == 0 or epoch == cfg.epochs - 1:
                 log.info("Epoch %d loss %f", epoch, float(loss))
 
+        if trace_cm is not None:
+            trace_cm.__exit__(None, None, None)
         self.ckpt_final()
         if self.skip_final_eval(loss):  # benchmark mode, ToolkitBase docs
             accs = {"train": None, "eval": None, "test": None}
