@@ -39,6 +39,17 @@ from neutronstarlite_tpu.utils.timing import get_time
 log = get_logger("gcn_dist")
 
 
+def gcn_layer_nn(i, n_layers, layer, agg, x_in, valid_mask, key, drop_rate, train):
+    """GCN's per-layer NN over the exchanged aggregate (the reference's
+    vertexForward, GCN_CPU.hpp:215-228)."""
+    if i == n_layers - 1:
+        return agg @ layer["W"]
+    if "bn" in layer:
+        agg = batch_norm_apply(layer["bn"], agg, valid_mask=valid_mask)
+    h = jax.nn.relu(agg @ layer["W"])
+    return dropout(jax.random.fold_in(key, i), h, drop_rate, train)
+
+
 def dist_gcn_forward(
     mesh,
     dist,
@@ -49,11 +60,15 @@ def dist_gcn_forward(
     key,
     drop_rate: float,
     train: bool,
+    layer_nn=gcn_layer_nn,
 ):
     """``blocks`` selects the exchange: the [P, P, Eb] 3-tuple is the
     ppermute ring, a DistEllPair is the OPTIM_KERNEL gather-only path, and
     the 5-tuple of mirror tables is the compacted all_to_all exchange
-    (``dist`` is then the MirrorGraph)."""
+    (``dist`` is then the MirrorGraph). ``layer_nn`` is the per-layer vertex
+    NN over the exchanged aggregate — the fuse-op toolkits (GCN/GIN/CommNet)
+    share the exchange engine and differ only here, exactly the reference's
+    decoupled graph-op/NN-op split (ntsContext.hpp:86-95)."""
     from neutronstarlite_tpu.parallel.dist_edge_ops import (
         dist_gather_dst_from_src_mirror,
     )
@@ -72,13 +87,7 @@ def dist_gcn_forward(
             h = dist_gather_dst_from_src(
                 mesh, dist.partitions, dist.vp, dist.edge_chunk, blocks, x
             )
-        if i == n_layers - 1:
-            x = h @ layer["W"]
-        else:
-            if "bn" in layer:
-                h = batch_norm_apply(layer["bn"], h, valid_mask=valid_mask)
-            h = jax.nn.relu(h @ layer["W"])
-            x = dropout(jax.random.fold_in(key, i), h, drop_rate, train)
+        x = layer_nn(i, n_layers, layer, h, x, valid_mask, key, drop_rate, train)
     return x
 
 
@@ -89,6 +98,12 @@ class DistGCNTrainer(ToolkitBase):
     needs_device_graph = False
     weight_mode = "gcn_norm"
     with_bn = True
+    # per-layer NN over the exchanged aggregate; fuse-op model variants
+    # (DistGINTrainer) override this and init_model_params only
+    layer_nn = staticmethod(gcn_layer_nn)
+
+    def init_model_params(self, key):
+        return init_gcn_params(key, self.cfg.layer_sizes(), with_bn=self.with_bn)
 
     @staticmethod
     def resolve_comm_layer(cfg, host_graph, P: int) -> str:
@@ -189,7 +204,7 @@ class DistGCNTrainer(ToolkitBase):
 
         rsh = NamedSharding(self.mesh, PS())
         key = jax.random.PRNGKey(self.seed)
-        params = init_gcn_params(key, cfg.layer_sizes(), with_bn=self.with_bn)
+        params = self.init_model_params(key)
         self.params = jax.device_put(params, rsh)
         self.adam_cfg = AdamConfig(
             alpha=cfg.learn_rate,
@@ -203,6 +218,7 @@ class DistGCNTrainer(ToolkitBase):
         drop_rate = cfg.drop_rate
         masked_nll = self.masked_nll_loss
         adam_cfg = self.adam_cfg
+        layer_nn = type(self).layer_nn
 
         # ``blocks`` (the O(E) sharded edge arrays) is a jit ARGUMENT, not a
         # closure: captured arrays are inlined into the HLO as constants,
@@ -212,7 +228,8 @@ class DistGCNTrainer(ToolkitBase):
         def train_step(params, opt_state, blocks, feature, label, train01, valid, key):
             def loss_fn(p):
                 logits = dist_gcn_forward(
-                    mesh, dist, blocks, p, feature, valid, key, drop_rate, True
+                    mesh, dist, blocks, p, feature, valid, key, drop_rate,
+                    True, layer_nn,
                 )
                 return masked_nll(logits, label, train01), logits
 
@@ -223,7 +240,8 @@ class DistGCNTrainer(ToolkitBase):
         @jax.jit
         def eval_logits(params, blocks, feature, valid, key):
             return dist_gcn_forward(
-                mesh, dist, blocks, params, feature, valid, key, 0.0, False
+                mesh, dist, blocks, params, feature, valid, key, 0.0, False,
+                layer_nn,
             )
 
         self._train_step = train_step

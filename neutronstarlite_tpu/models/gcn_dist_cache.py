@@ -36,6 +36,7 @@ from jax.sharding import NamedSharding, PartitionSpec as PS
 
 from neutronstarlite_tpu.models.base import ToolkitBase, register_algorithm
 from neutronstarlite_tpu.models.gcn import init_gcn_params
+from neutronstarlite_tpu.models.gcn_dist import gcn_layer_nn
 from neutronstarlite_tpu.nn.layers import batch_norm_apply, dropout
 from neutronstarlite_tpu.nn.param import AdamConfig, adam_init, adam_update
 from neutronstarlite_tpu.parallel import dist_edge_ops as deo
@@ -103,13 +104,9 @@ def dist_gcn_cache_forward(
             h = deo.dist_aggregate_dst_fuse_weight_sim(cmg, weight, mir)
         else:
             h = deo.dist_aggregate_dst_fuse_weight(mesh, cmg, tables, weight, mir)
-        if i == n_layers - 1:
-            x = h @ layer["W"]
-        else:
-            if "bn" in layer:
-                h = batch_norm_apply(layer["bn"], h, valid_mask=valid_mask)
-            h = jax.nn.relu(h @ layer["W"])
-            x = dropout(jax.random.fold_in(key, i), h, drop_rate, train)
+        x = gcn_layer_nn(
+            i, n_layers, layer, h, x, valid_mask, key, drop_rate, train
+        )
     return x, new_caches
 
 

@@ -1,0 +1,46 @@
+"""Distributed GIN: the GIN toolkit over the sharded exchange engine.
+
+Reference: GIN_CPU.hpp / GIN_GPU.hpp run the same ForwardCPUfuseOp /
+ForwardGPUfuseOp distributed engines as GCN (their mpiexec launch IS the
+distributed mode) with GIN's vertexForward MLP (GIN_CPU.hpp:176-186):
+``y = bn(relu(W2 . relu(W1 . (agg + x))))`` (hidden; no inner relu on the
+last layer). Here the same split: DistGCNTrainer supplies the exchange
+engine (ring / all_gather+ELL / mirror all_to_all, COMM_LAYER) and this
+class overrides only the per-layer NN and parameters — the reference's
+decoupled graph-op/NN-op design (ntsContext.hpp:86-95) as a two-method
+subclass.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from neutronstarlite_tpu.models.base import register_algorithm
+from neutronstarlite_tpu.models.gcn_dist import DistGCNTrainer
+from neutronstarlite_tpu.models.gin import init_gin_params
+from neutronstarlite_tpu.nn.layers import batch_norm_apply, dropout
+
+
+def gin_layer_nn(i, n_layers, layer, agg, x_in, valid_mask, key, drop_rate, train):
+    """GIN vertexForward over the exchanged aggregate: MLP((agg + x)) with
+    bn on every layer's output, relu/dropout on hidden layers only — the
+    same structure as the single-chip twin (models/gin.py:gin_forward),
+    with the dist valid-mask excluded from the bn statistics."""
+    h = jax.nn.relu((agg + x_in) @ layer["W1"])
+    h = h @ layer["W2"]
+    if i < n_layers - 1:
+        h = jax.nn.relu(h)
+    h = batch_norm_apply(layer["bn"], h, valid_mask=valid_mask)
+    if train and i < n_layers - 1:
+        h = dropout(jax.random.fold_in(key, i), h, drop_rate, train)
+    return h
+
+
+@register_algorithm("GINDIST", "GINTPUDIST", "GINCPUDIST")
+class DistGINTrainer(DistGCNTrainer):
+    """Vertex-sharded full-batch GIN (PARTITIONS cfg key picks the mesh)."""
+
+    layer_nn = staticmethod(gin_layer_nn)
+
+    def init_model_params(self, key):
+        return init_gin_params(key, self.cfg.layer_sizes())
