@@ -8,6 +8,7 @@ import neutronstarlite_tpu.models.gin  # noqa: F401  (registers GIN variants)
 import neutronstarlite_tpu.models.gin_dist  # noqa: F401  (registers GINDIST)
 import neutronstarlite_tpu.models.ggcn  # noqa: F401  (registers GGCN)
 import neutronstarlite_tpu.models.commnet  # noqa: F401  (registers CommNet)
+import neutronstarlite_tpu.models.commnet_dist  # noqa: F401  (registers COMMNETDIST)
 import neutronstarlite_tpu.models.gcn_sample  # noqa: F401  (registers GCNSAMPLE)
 import neutronstarlite_tpu.models.test_getdep  # noqa: F401  (registers TEST_GETDEP*)
 

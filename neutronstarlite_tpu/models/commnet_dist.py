@@ -1,0 +1,36 @@
+"""Distributed CommNet over the sharded exchange engine.
+
+Reference: COMMNET_GPU.hpp runs the ForwardGPUfuseOp distributed engine
+(its mpiexec launch is the distributed mode) with the communication-step
+NN ``y = relu(C . agg + H . x)`` (:181-198). Like GINDIST, this subclass
+supplies only the per-layer NN and parameters; DistGCNTrainer's exchange
+engine (ring / all_gather+ELL / mirror, COMM_LAYER) does the rest.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from neutronstarlite_tpu.models.base import register_algorithm
+from neutronstarlite_tpu.models.commnet import init_commnet_params
+from neutronstarlite_tpu.models.gcn_dist import DistGCNTrainer
+from neutronstarlite_tpu.nn.layers import dropout
+
+
+def commnet_layer_nn(i, n_layers, layer, agg, x_in, valid_mask, key, drop_rate, train):
+    """Communication step over the exchanged aggregate — identical math to
+    the single-chip twin (models/commnet.py:commnet_forward)."""
+    h = jax.nn.relu(agg @ layer["C"] + x_in @ layer["H"])
+    if train and i < n_layers - 1:
+        h = dropout(jax.random.fold_in(key, i), h, drop_rate, train)
+    return h
+
+
+@register_algorithm("COMMNETDIST", "COMMNETTPUDIST", "COMMNETGPUDIST")
+class DistCommNetTrainer(DistGCNTrainer):
+    """Vertex-sharded full-batch CommNet (PARTITIONS cfg key)."""
+
+    layer_nn = staticmethod(commnet_layer_nn)
+
+    def init_model_params(self, key):
+        return init_commnet_params(key, self.cfg.layer_sizes())
