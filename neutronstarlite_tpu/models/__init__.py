@@ -7,6 +7,7 @@ import neutronstarlite_tpu.models.gat_dist  # noqa: F401  (registers GATDIST)
 import neutronstarlite_tpu.models.gin  # noqa: F401  (registers GIN variants)
 import neutronstarlite_tpu.models.gin_dist  # noqa: F401  (registers GINDIST)
 import neutronstarlite_tpu.models.ggcn  # noqa: F401  (registers GGCN)
+import neutronstarlite_tpu.models.ggcn_dist  # noqa: F401  (registers GGCNDIST)
 import neutronstarlite_tpu.models.commnet  # noqa: F401  (registers CommNet)
 import neutronstarlite_tpu.models.commnet_dist  # noqa: F401  (registers COMMNETDIST)
 import neutronstarlite_tpu.models.gcn_sample  # noqa: F401  (registers GCNSAMPLE)

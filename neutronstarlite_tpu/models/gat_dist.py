@@ -79,6 +79,13 @@ class DistGATTrainer(ToolkitBase):
 
     needs_device_graph = False
     weight_mode = "ones"  # softmax supplies the edge weights
+    # edge-op-chain model hook: forward(mesh, mg, tables, params, x, key,
+    # drop_rate, train) — DistGGCNTrainer overrides this and
+    # init_model_params only (decoupled graph-op/NN-op split)
+    model_forward_fn = staticmethod(dist_gat_forward)
+
+    def init_model_params(self, key):
+        return init_gat_params(key, self.cfg.layer_sizes())
 
     def build_model(self) -> None:
         cfg = self.cfg
@@ -106,7 +113,7 @@ class DistGATTrainer(ToolkitBase):
         self.valid_p = put(self.mg.valid_mask(), vsh1)
 
         key = jax.random.PRNGKey(self.seed)
-        params = init_gat_params(key, cfg.layer_sizes())
+        params = self.init_model_params(key)
         self.params = jax.tree.map(lambda a: put(a, rsh), params)
         self.adam_cfg = AdamConfig(
             alpha=cfg.learn_rate,
@@ -120,6 +127,7 @@ class DistGATTrainer(ToolkitBase):
         drop_rate = cfg.drop_rate
         masked_nll = self.masked_nll_loss
         adam_cfg = self.adam_cfg
+        forward = type(self).model_forward_fn
 
         # ``tables`` (O(E) sharded slot/dst/weight/mask arrays) rides the
         # jit boundary as an ARGUMENT — closure capture would inline it
@@ -128,7 +136,7 @@ class DistGATTrainer(ToolkitBase):
         @jax.jit
         def train_step(params, opt_state, tables, feature, label, train01, key):
             def loss_fn(p):
-                logits = dist_gat_forward(
+                logits = forward(
                     mesh, mg, tables, p, feature, key, drop_rate, True
                 )
                 return masked_nll(logits, label, train01), logits
@@ -139,7 +147,7 @@ class DistGATTrainer(ToolkitBase):
 
         @jax.jit
         def eval_logits(params, tables, feature, key):
-            return dist_gat_forward(mesh, mg, tables, params, feature, key, 0.0, False)
+            return forward(mesh, mg, tables, params, feature, key, 0.0, False)
 
         self._train_step = train_step
         self._eval_logits = eval_logits

@@ -1,0 +1,67 @@
+"""Distributed GGCN: the gated-GCN edge-op chain over mirror slots.
+
+Reference: GGCN_CPU.hpp (shipped but commented out of the dispatcher,
+main.cpp:102-108) — per layer, edge NN gate -> per-channel edge softmax ->
+gated aggregation. The distributed form follows GAT_CPU_DIST_OPTM's
+decomposition exactly (GAT_CPU_DIST.hpp:185-211 chain shape): the edge NN
+is linear before the leaky_relu, so ``W_e . [h_src||h_dst] = Ws.h_src +
+Wd.h_dst`` — both halves are vertex-level matmuls (MXU), and only the
+f'-wide score/gate live on edges. The mirror payload carries [h, Ws.h]
+(2f' columns, one dep_nbr exchange); the dst half stays local. All edge
+ops are the multi-channel dist family (parallel/dist_edge_ops.py): the
+per-channel softmax and the two-input gated aggregation are the same
+custom_vjp kernels the single-chip chain uses.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from neutronstarlite_tpu.models.base import register_algorithm
+from neutronstarlite_tpu.models.gat_dist import DistGATTrainer
+from neutronstarlite_tpu.models.ggcn import GGCN_LEAKY_SLOPE, init_ggcn_params
+from neutronstarlite_tpu.nn.layers import dropout
+from neutronstarlite_tpu.parallel import dist_edge_ops as deo
+
+
+def dist_ggcn_layer(mesh, mg, tables, layer, x, last: bool):
+    h = x @ layer["W"]  # [P*vp, f']
+    f = h.shape[1]
+    hs = h @ layer["Ws"]  # source half of the decomposed edge NN
+    hd = h @ layer["Wd"]  # dst half, stays local
+    payload = jnp.concatenate([h, hs], axis=1)
+    if mesh is None:
+        mir = deo.dist_get_dep_nbr_sim(mg, payload)  # [P, P*Mb, 2f']
+        e_hs = deo.dist_scatter_src_sim(mg, mir[:, :, f:])
+        e_hd = deo.dist_scatter_dst_sim(mg, hd)
+        score = jax.nn.leaky_relu(e_hs + e_hd, negative_slope=GGCN_LEAKY_SLOPE)
+        a = deo.dist_edge_softmax_sim(mg, score)  # per-dst, per-channel
+        out = deo.dist_aggregate_dst_fuse_weight_sim(mg, a, mir[:, :, :f])
+    else:
+        mir = deo.dist_get_dep_nbr(mesh, mg, tables, payload)
+        e_hs = deo.dist_scatter_src(mesh, mg, tables, mir[:, :, f:])
+        e_hd = deo.dist_scatter_dst(mesh, mg, tables, hd)
+        score = jax.nn.leaky_relu(e_hs + e_hd, negative_slope=GGCN_LEAKY_SLOPE)
+        a = deo.dist_edge_softmax(mesh, mg, tables, score)
+        out = deo.dist_aggregate_dst_fuse_weight(mesh, mg, tables, a, mir[:, :, :f])
+    return out if last else jax.nn.relu(out)
+
+
+def dist_ggcn_forward(mesh, mg, tables, params, x, key, drop_rate: float, train: bool):
+    n = len(params)
+    for i, layer in enumerate(params):
+        x = dist_ggcn_layer(mesh, mg, tables, layer, x, i == n - 1)
+        if train and i < n - 1:
+            x = dropout(jax.random.fold_in(key, i), x, drop_rate, train)
+    return x
+
+
+@register_algorithm("GGCNDIST", "GGCNCPUDIST", "GGNNDIST")
+class DistGGCNTrainer(DistGATTrainer):
+    """Vertex-sharded full-batch GGCN (PARTITIONS cfg key picks the mesh)."""
+
+    model_forward_fn = staticmethod(dist_ggcn_forward)
+
+    def init_model_params(self, key):
+        return init_ggcn_params(key, self.cfg.layer_sizes())
