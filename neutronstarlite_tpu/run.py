@@ -18,6 +18,16 @@ from neutronstarlite_tpu.utils.logging import get_logger
 log = get_logger("main")
 
 
+def apply_launcher_overrides(cfg: InputInfo) -> InputInfo:
+    """run_nts.sh parity: its <slots> argument (NTS_PARTITIONS_OVERRIDE)
+    overrides the cfg's PARTITIONS — the reference's mpiexec -np N
+    (run_nts.sh:2)."""
+    slots = os.environ.get("NTS_PARTITIONS_OVERRIDE", "")
+    if slots:
+        cfg.partitions = int(slots)
+    return cfg
+
+
 def main(argv=None) -> int:
     from neutronstarlite_tpu.parallel.mesh import maybe_initialize_distributed
     from neutronstarlite_tpu.utils.platform import honor_platform_env
@@ -30,11 +40,7 @@ def main(argv=None) -> int:
         return 2
     cfg_path = argv[0]
     cfg = InputInfo.read_from_cfg_file(cfg_path)
-    # run_nts.sh parity: its <slots> argument overrides the cfg's PARTITIONS
-    # (the reference's mpiexec -np N, run_nts.sh:2)
-    slots = os.environ.get("NTS_PARTITIONS_OVERRIDE", "")
-    if slots:
-        cfg.partitions = int(slots)
+    apply_launcher_overrides(cfg)
     print(cfg.print())
     cls = get_algorithm(cfg.algorithm)
     toolkit = cls(cfg, base_dir=os.path.dirname(os.path.abspath(cfg_path)))

@@ -87,3 +87,20 @@ def test_bench_matrix_measures_one_cfg():
     assert row["algorithm"] == "GCNCPU"
     assert row["epoch_s"] > 0
     assert np.isfinite(row["loss"])
+
+
+def test_run_nts_partitions_override(monkeypatch, tmp_path):
+    """run_nts.sh parity: NTS_PARTITIONS_OVERRIDE (its <slots> argument)
+    must override the cfg's PARTITIONS before dispatch."""
+    from neutronstarlite_tpu.utils.config import InputInfo
+
+    from neutronstarlite_tpu.run import apply_launcher_overrides
+
+    cfg_path = tmp_path / "t.cfg"
+    cfg_path.write_text("ALGORITHM:GCNCPU\nVERTICES:10\nPARTITIONS:2\n")
+    monkeypatch.setenv("NTS_PARTITIONS_OVERRIDE", "7")
+    cfg = apply_launcher_overrides(InputInfo.read_from_cfg_file(str(cfg_path)))
+    assert cfg.partitions == 7
+    monkeypatch.delenv("NTS_PARTITIONS_OVERRIDE")
+    cfg = apply_launcher_overrides(InputInfo.read_from_cfg_file(str(cfg_path)))
+    assert cfg.partitions == 2
