@@ -1,0 +1,314 @@
+"""On-chip measurement plan: watch for TPU-tunnel recovery, then run it all.
+
+Round-2 postmortem (docs/PERF.md §2a): the remote compile service crashed
+mid-sweep and the tunnel stayed down for hours, losing the fastest config's
+full-scale timing. The recovery watcher lived in /tmp and died with the
+session. This tool is the same plan made durable: it lives in the repo,
+probes the backend in a bounded subprocess, and the moment the tunnel is up
+runs the full measurement sequence step by step — every step resumable, so
+a mid-plan tunnel death costs only the step in flight.
+
+Plan steps (the sequence docs/PERF.md §2a promised):
+  1. on-chip test module (tests/test_tpu.py with a generous child timeout)
+  2. north-star bench: full-scale sweep + winner measurement (bench.py)
+  3. NTS_ELL_CHUNK_MIB tuning at {16, 64, 128} MiB on the ELL path
+  4. eager/pallas and eager/blocked full-scale paths
+  5. workload matrix over configs/ (tools/bench_matrix)
+  6. steady-state profile trace of the winning path (NTS_PROFILE_DIR)
+
+Artifacts land in docs/perf_runs/round2/: per-step .log (stderr tail),
+.json (the step's final JSON line, when it prints one), .ok marker
+(resumability), and a `status` append-log with timestamps. The supervisor
+itself NEVER initializes the accelerator — probes and steps are
+subprocesses with hard timeouts, so a wedged PJRT init can only cost a
+bounded wait (round-1 lesson, bench.py:20-34).
+
+A step failing while the backend still answers the probe is a real
+failure: it is retried up to --step-retries times, then recorded as
+.failed and skipped so the plan always terminates. A step failing with
+the backend down goes back to the waiting loop with the step still
+pending.
+
+Usage: python -m neutronstarlite_tpu.tools.tpu_plan [--out DIR]
+         [--poll-s 120] [--max-wall-s 32400] [--probe-timeout-s 240]
+         [--only step1,step2] [--list]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_PROBE_SRC = r"""
+import json, time
+t0 = time.time()
+from neutronstarlite_tpu.utils.platform import honor_platform_env
+honor_platform_env()
+import jax
+import numpy as np
+x = jax.device_put(np.ones((256, 256), np.float32))
+y = (x @ x).sum()
+y.block_until_ready()
+print(json.dumps({"ok": True, "platform": jax.default_backend(),
+                  "device": str(jax.devices()[0]),
+                  "init_s": round(time.time() - t0, 1)}))
+"""
+
+
+def _bench(*extra, epochs=3, warmup=1):
+    return [
+        sys.executable, os.path.join(REPO, "bench.py"), "--sweep", "off",
+        "--epochs", str(epochs), "--warmup", str(warmup), *extra,
+    ]
+
+
+def build_steps(out_dir: str):
+    """(name, cmd, timeout_s, env_overrides) in execution order."""
+    matrix_epochs = os.environ.get("NTS_PLAN_MATRIX_EPOCHS", "3")
+    return [
+        (
+            "tpu_tests",
+            [sys.executable, "-m", "pytest",
+             os.path.join(REPO, "tests", "test_tpu.py"), "-q", "-rs"],
+            2400,
+            {"NTS_TPU_TEST_TIMEOUT_S": "1800"},
+        ),
+        (
+            "bench_full",
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            5100,
+            {"NTS_BENCH_DEADLINE_S": "4800"},
+        ),
+        *[
+            (
+                f"ell_chunk_{mib}",
+                _bench("--order", "standard", "--path", "ell"),
+                1800,
+                {"NTS_ELL_CHUNK_MIB": str(mib)},
+            )
+            for mib in (16, 64, 128)
+        ],
+        (
+            "eager_pallas",
+            _bench("--order", "eager", "--path", "pallas"),
+            1800,
+            {},
+        ),
+        (
+            "eager_blocked",
+            # full-scale blocked host tables are ~2 min/direction on this
+            # 1-core rig; the stacked layout's compile is seconds
+            _bench("--order", "eager", "--path", "blocked"),
+            3600,
+            {},
+        ),
+        (
+            "bench_matrix",
+            [sys.executable, "-m", "neutronstarlite_tpu.tools.bench_matrix",
+             "--configs", os.path.join(REPO, "configs"),
+             "--epochs", matrix_epochs],
+            3600,
+            {},
+        ),
+        (
+            "profile_trace",
+            _bench("--order", "standard", "--path", "ell"),
+            1800,
+            {"NTS_PROFILE_DIR": os.path.join(out_dir, "profile")},
+        ),
+    ]
+
+
+class Plan:
+    def __init__(self, out_dir: str, probe_timeout_s: float, step_retries: int):
+        self.out = out_dir
+        self.probe_timeout_s = probe_timeout_s
+        self.step_retries = step_retries
+        os.makedirs(out_dir, exist_ok=True)
+
+    def log(self, msg: str):
+        line = f"[{time.strftime('%Y-%m-%d %H:%M:%S')}] {msg}"
+        print(line, flush=True)
+        with open(os.path.join(self.out, "status"), "a") as fh:
+            fh.write(line + "\n")
+
+    def probe(self) -> dict | None:
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)  # a CI cpu pin would make the probe
+        # trivially "succeed" on CPU and defeat backend-down detection
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", _PROBE_SRC],
+                capture_output=True, text=True, timeout=self.probe_timeout_s,
+                cwd=REPO, env=env,
+            )
+        except subprocess.TimeoutExpired:
+            return None
+        if r.returncode != 0 or not r.stdout.strip():
+            return None
+        try:
+            return json.loads(r.stdout.strip().splitlines()[-1])
+        except json.JSONDecodeError:
+            return None
+
+    def _paths(self, name):
+        return {
+            ext: os.path.join(self.out, f"{name}.{ext}")
+            for ext in ("ok", "failed", "log", "json", "tries")
+        }
+
+    def pending(self, steps):
+        out = []
+        for name, cmd, timeout_s, env_over in steps:
+            p = self._paths(name)
+            if not (os.path.exists(p["ok"]) or os.path.exists(p["failed"])):
+                out.append((name, cmd, timeout_s, env_over))
+        return out
+
+    def run_step(self, name, cmd, timeout_s, env_over) -> bool:
+        """Returns True when the step reached a terminal state (ok/failed);
+        False when the backend died under it (leave pending, re-wait)."""
+        p = self._paths(name)
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)  # accelerator, not the CI cpu pin
+        env.update(env_over)
+        self.log(f"step {name}: start (timeout {timeout_s}s) {' '.join(cmd)}")
+        t0 = time.time()
+        # child stdout/stderr go straight to files: on POSIX, TimeoutExpired
+        # carries stdout=None with capture_output, which would lose exactly
+        # the already-printed JSON line the salvage below exists to keep
+        out_path = os.path.join(self.out, f"{name}.stdout")
+        err_path = os.path.join(self.out, f"{name}.stderr")
+        with open(out_path, "w") as out_fh, open(err_path, "w") as err_fh:
+            try:
+                r = subprocess.run(
+                    cmd, stdout=out_fh, stderr=err_fh, timeout=timeout_s,
+                    env=env, cwd=REPO,
+                )
+                rc = r.returncode
+            except subprocess.TimeoutExpired:
+                rc = -1
+        wall = time.time() - t0
+        with open(out_path) as fh:
+            out_s = fh.read()
+        with open(err_path) as fh:
+            err_s = fh.read()
+        if rc == -1:
+            err_s += f"\nSTEP TIMEOUT after {timeout_s}s"
+        with open(p["log"], "w") as fh:
+            fh.write(f"# {name} rc={rc} wall={wall:.0f}s\n# cmd: {' '.join(cmd)}\n")
+            fh.write(f"# env: {json.dumps(env_over)}\n\n--- stdout ---\n")
+            fh.write(out_s[-20000:])
+            fh.write("\n--- stderr (tail) ---\n")
+            fh.write(err_s[-20000:])
+        os.unlink(out_path)
+        os.unlink(err_path)
+        # salvage the final JSON line even from a failed/timed-out step
+        # (bench prints it before a final-eval hang can kill the process)
+        for line in reversed(out_s.strip().splitlines() or [""]):
+            line = line.strip()
+            if line.startswith("{") and line.endswith("}"):
+                try:
+                    parsed = json.loads(line)
+                    with open(p["json"], "w") as fh:
+                        json.dump(parsed, fh, indent=1)
+                    break
+                except json.JSONDecodeError:
+                    continue
+        if rc == 0:
+            with open(p["ok"], "w") as fh:
+                fh.write(f"wall={wall:.0f}s\n")
+            self.log(f"step {name}: OK in {wall:.0f}s")
+            return True
+        # rc != 0 — is this the step's fault or did the tunnel die under it?
+        if self.probe() is None:
+            self.log(
+                f"step {name}: rc={rc} after {wall:.0f}s with backend DOWN — "
+                "left pending, back to waiting"
+            )
+            return False
+        tries = 1
+        if os.path.exists(p["tries"]):
+            with open(p["tries"]) as fh:
+                tries = int(fh.read().strip() or 0) + 1
+        with open(p["tries"], "w") as fh:
+            fh.write(str(tries))
+        if tries > self.step_retries:
+            with open(p["failed"], "w") as fh:
+                fh.write(f"rc={rc} wall={wall:.0f}s tries={tries}\n")
+            self.log(
+                f"step {name}: FAILED permanently (rc={rc}, try {tries}) — "
+                f"see {p['log']}"
+            )
+        else:
+            self.log(f"step {name}: rc={rc} (try {tries}, backend up) — will retry")
+        return True
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--out", default=os.path.join(REPO, "docs", "perf_runs", "round2")
+    )
+    ap.add_argument("--poll-s", type=float, default=120.0)
+    ap.add_argument("--max-wall-s", type=float, default=32400.0)
+    ap.add_argument("--probe-timeout-s", type=float, default=240.0)
+    ap.add_argument("--step-retries", type=int, default=2)
+    ap.add_argument("--only", default="", help="comma-separated step subset")
+    ap.add_argument("--list", action="store_true", help="print steps and exit")
+    args = ap.parse_args(argv)
+
+    plan = Plan(args.out, args.probe_timeout_s, args.step_retries)
+    steps = build_steps(args.out)
+    if args.only:
+        keep = set(args.only.split(","))
+        unknown = keep - {s[0] for s in steps}
+        if unknown:
+            print(f"unknown steps: {sorted(unknown)}", file=sys.stderr)
+            return 2
+        steps = [s for s in steps if s[0] in keep]
+    if args.list:
+        for name, cmd, timeout_s, env_over in steps:
+            print(f"{name:15s} timeout={timeout_s:5d}s env={env_over}")
+        return 0
+
+    t0 = time.time()
+    plan.log(f"plan start: {len(plan.pending(steps))}/{len(steps)} steps pending")
+    backend_known_up = False  # skip re-probing right after a successful step
+    while time.time() - t0 < args.max_wall_s:
+        todo = plan.pending(steps)
+        if not todo:
+            plan.log("plan COMPLETE")
+            return 0
+        if not backend_known_up:
+            info = plan.probe()
+            if info is None:
+                plan.log(
+                    f"backend down ({len(todo)} steps pending); "
+                    f"sleeping {args.poll_s:.0f}s"
+                )
+                time.sleep(args.poll_s)
+                continue
+            plan.log(
+                f"backend up: {info.get('device')} init {info.get('init_s')}s"
+            )
+        name, cmd, timeout_s, env_over = todo[0]
+        # a terminal step outcome with rc==0 proves the backend is healthy;
+        # any failure path re-probes on the next iteration
+        backend_known_up = (
+            plan.run_step(name, cmd, timeout_s, env_over)
+            and os.path.exists(os.path.join(args.out, f"{name}.ok"))
+        )
+    plan.log(f"max wall {args.max_wall_s:.0f}s reached; "
+             f"{len(plan.pending(steps))} steps still pending")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

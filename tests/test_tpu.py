@@ -163,12 +163,16 @@ def tpu_results():
                     env.get("PYTHONPATH", "")] if p
     )
     try:
-        # 180 s: enough for backend init (~10 s) + compiles; a wedged
-        # accelerator tunnel hangs init forever and must only cost the
-        # suite a bounded skip
+        # default 600 s: backend init alone has been observed to take minutes
+        # when the remote tunnel is cold/degraded, and the module runs ~10
+        # compiles through a remote compile service; a wedged tunnel hangs
+        # init forever and must only cost the suite a bounded skip.
+        # NTS_TPU_TEST_TIMEOUT_S overrides (the on-chip measurement plan
+        # raises it; quick CI rigs can lower it).
+        timeout_s = float(os.environ.get("NTS_TPU_TEST_TIMEOUT_S", 600))
         r = subprocess.run(
             [sys.executable, "-c", _TPU_SRC],
-            capture_output=True, text=True, timeout=180, env=env,
+            capture_output=True, text=True, timeout=timeout_s, env=env,
         )
     except subprocess.TimeoutExpired:
         pytest.skip("TPU subprocess timed out (backend unreachable?)")

@@ -1,0 +1,92 @@
+"""Host-side logic of the on-chip measurement-plan runner (tools/tpu_plan).
+
+The accelerator-facing parts (probe, real steps) are exercised on the TPU
+rig; what CI must pin is the supervisor logic that round 2's lost
+measurements motivated: resumable step markers, JSON salvage from a failed
+step's stdout, the backend-down vs real-failure split, and bounded retries.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+from neutronstarlite_tpu.tools.tpu_plan import Plan, build_steps
+
+
+def _mk(tmp_path):
+    return Plan(str(tmp_path), probe_timeout_s=5.0, step_retries=1)
+
+
+def test_step_ok_writes_marker_and_salvages_json(tmp_path):
+    plan = _mk(tmp_path)
+    cmd = [sys.executable, "-c", "print('noise'); print('{\"epoch_s\": 1.5}')"]
+    done = plan.run_step("s1", cmd, timeout_s=30, env_over={})
+    assert done
+    assert os.path.exists(tmp_path / "s1.ok")
+    with open(tmp_path / "s1.json") as fh:
+        assert json.load(fh) == {"epoch_s": 1.5}
+    # resumability: a completed step is no longer pending
+    steps = [("s1", cmd, 30, {}), ("s2", cmd, 30, {})]
+    assert [s[0] for s in plan.pending(steps)] == ["s2"]
+
+
+def test_step_failure_with_backend_down_stays_pending(tmp_path):
+    plan = _mk(tmp_path)
+    plan.probe = lambda: None  # tunnel died under the step
+    cmd = [sys.executable, "-c", "raise SystemExit(1)"]
+    done = plan.run_step("s1", cmd, timeout_s=30, env_over={})
+    assert not done
+    assert not os.path.exists(tmp_path / "s1.ok")
+    assert not os.path.exists(tmp_path / "s1.failed")
+    assert [s[0] for s in plan.pending([("s1", cmd, 30, {})])] == ["s1"]
+
+
+def test_step_failure_with_backend_up_retries_then_fails(tmp_path):
+    plan = _mk(tmp_path)  # step_retries=1
+    plan.probe = lambda: {"ok": True}
+    cmd = [sys.executable, "-c", "import sys; print('{\"partial\": 2}'); sys.exit(1)"]
+    assert plan.run_step("s1", cmd, timeout_s=30, env_over={})  # try 1: retryable
+    assert not os.path.exists(tmp_path / "s1.failed")
+    assert [s[0] for s in plan.pending([("s1", cmd, 30, {})])] == ["s1"]
+    assert plan.run_step("s1", cmd, timeout_s=30, env_over={})  # try 2: permanent
+    assert os.path.exists(tmp_path / "s1.failed")
+    assert plan.pending([("s1", cmd, 30, {})]) == []
+    # the failed step's JSON line was still salvaged
+    with open(tmp_path / "s1.json") as fh:
+        assert json.load(fh) == {"partial": 2}
+
+
+def test_timed_out_step_still_salvages_json(tmp_path):
+    # the motivating postmortem: bench prints its JSON line, then a later
+    # compile hangs until the step timeout — the line must survive
+    plan = _mk(tmp_path)
+    plan.probe = lambda: {"ok": True}
+    cmd = [
+        sys.executable, "-u", "-c",
+        "import time; print('{\"epoch_s\": 3.25}', flush=True); time.sleep(60)",
+    ]
+    plan.run_step("s1", cmd, timeout_s=3, env_over={})
+    with open(tmp_path / "s1.json") as fh:
+        assert json.load(fh) == {"epoch_s": 3.25}
+    assert not os.path.exists(tmp_path / "s1.ok")
+
+
+def test_env_override_reaches_step(tmp_path):
+    plan = _mk(tmp_path)
+    cmd = [
+        sys.executable, "-c",
+        "import os, json; print(json.dumps({'v': os.environ['NTS_X']}))",
+    ]
+    assert plan.run_step("s1", cmd, timeout_s=30, env_over={"NTS_X": "7"})
+    with open(tmp_path / "s1.json") as fh:
+        assert json.load(fh)["v"] == "7"
+
+
+def test_build_steps_shape():
+    steps = build_steps("/tmp/out")
+    names = [s[0] for s in steps]
+    assert names[0] == "tpu_tests" and "bench_full" in names
+    assert {"ell_chunk_16", "ell_chunk_64", "ell_chunk_128"} <= set(names)
+    assert len(names) == len(set(names))
