@@ -39,26 +39,18 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
 
-_PROBE_SRC = r"""
-import json, time
-t0 = time.time()
-from neutronstarlite_tpu.utils.platform import honor_platform_env
-honor_platform_env()
-import jax
-import numpy as np
-x = jax.device_put(np.ones((256, 256), np.float32))
-y = (x @ x).sum()
-y.block_until_ready()
-print(json.dumps({"ok": True, "platform": jax.default_backend(),
-                  "device": str(jax.devices()[0]),
-                  "init_s": round(time.time() - t0, 1)}))
-"""
+# ONE probe program for both tools: bench.py owns it (lease-release
+# retries etc. land in one place); this tool differs only in env handling
+from bench import _PROBE_SRC  # noqa: E402
 
 
 def _bench(*extra, epochs=3, warmup=1):
@@ -90,7 +82,11 @@ def build_steps(out_dir: str):
                 f"ell_chunk_{mib}",
                 _bench("--order", "standard", "--path", "ell"),
                 1800,
-                {"NTS_ELL_CHUNK_MIB": str(mib)},
+                # bench's internal watchdog must fire BEFORE the external
+                # process-group kill: it dumps stacks and salvages the
+                # final JSON line, both lost to a bare SIGKILL
+                {"NTS_ELL_CHUNK_MIB": str(mib),
+                 "NTS_BENCH_DEADLINE_S": "1500"},
             )
             for mib in (16, 64, 128)
         ],
@@ -98,7 +94,7 @@ def build_steps(out_dir: str):
             "eager_pallas",
             _bench("--order", "eager", "--path", "pallas"),
             1800,
-            {},
+            {"NTS_BENCH_DEADLINE_S": "1500"},
         ),
         (
             "eager_blocked",
@@ -106,7 +102,7 @@ def build_steps(out_dir: str):
             # 1-core rig; the stacked layout's compile is seconds
             _bench("--order", "eager", "--path", "blocked"),
             3600,
-            {},
+            {"NTS_BENCH_DEADLINE_S": "3300"},
         ),
         (
             "bench_matrix",
@@ -120,7 +116,8 @@ def build_steps(out_dir: str):
             "profile_trace",
             _bench("--order", "standard", "--path", "ell"),
             1800,
-            {"NTS_PROFILE_DIR": os.path.join(out_dir, "profile")},
+            {"NTS_PROFILE_DIR": os.path.join(out_dir, "profile"),
+             "NTS_BENCH_DEADLINE_S": "1500"},
         ),
     ]
 
@@ -185,22 +182,32 @@ class Plan:
         # the already-printed JSON line the salvage below exists to keep
         out_path = os.path.join(self.out, f"{name}.stdout")
         err_path = os.path.join(self.out, f"{name}.stderr")
+        timed_out = False
         with open(out_path, "w") as out_fh, open(err_path, "w") as err_fh:
+            # new session: on timeout, kill the WHOLE process group — a
+            # bench step's measurement workers are grandchildren, and an
+            # orphaned worker wedged in a compile would keep the single
+            # accelerator's lease and fail every later probe
+            proc = subprocess.Popen(
+                cmd, stdout=out_fh, stderr=err_fh, env=env, cwd=REPO,
+                start_new_session=True,
+            )
             try:
-                r = subprocess.run(
-                    cmd, stdout=out_fh, stderr=err_fh, timeout=timeout_s,
-                    env=env, cwd=REPO,
-                )
-                rc = r.returncode
+                rc = proc.wait(timeout=timeout_s)
             except subprocess.TimeoutExpired:
-                rc = -1
+                timed_out = True
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+                rc = proc.wait()
         wall = time.time() - t0
         with open(out_path) as fh:
             out_s = fh.read()
         with open(err_path) as fh:
             err_s = fh.read()
-        if rc == -1:
-            err_s += f"\nSTEP TIMEOUT after {timeout_s}s"
+        if timed_out:
+            err_s += f"\nSTEP TIMEOUT after {timeout_s}s (process group killed)"
         with open(p["log"], "w") as fh:
             fh.write(f"# {name} rc={rc} wall={wall:.0f}s\n# cmd: {' '.join(cmd)}\n")
             fh.write(f"# env: {json.dumps(env_over)}\n\n--- stdout ---\n")
@@ -296,7 +303,7 @@ def main(argv=None) -> int:
                 time.sleep(args.poll_s)
                 continue
             plan.log(
-                f"backend up: {info.get('device')} init {info.get('init_s')}s"
+                f"backend up: {info.get('devices')} init {info.get('init_s')}s"
             )
         name, cmd, timeout_s, env_over = todo[0]
         # a terminal step outcome with rc==0 proves the backend is healthy;

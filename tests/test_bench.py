@@ -104,3 +104,43 @@ def test_run_nts_partitions_override(monkeypatch, tmp_path):
     monkeypatch.delenv("NTS_PARTITIONS_OVERRIDE")
     cfg = apply_launcher_overrides(InputInfo.read_from_cfg_file(str(cfg_path)))
     assert cfg.partitions == 2
+
+
+def test_last_good_salvage_round_trip(tmp_path, monkeypatch):
+    """Backend-down salvage: a persisted same-scale measurement is re-emitted
+    marked stale (rc 0); wrong scale or no file yields the null record (rc 1)."""
+    monkeypatch.setattr(bench, "LAST_GOOD_PATH", str(tmp_path / "last.json"))
+    out = {
+        "metric": "gcn_reddit_full_batch_epoch_time", "value": 4.2,
+        "unit": "s", "vs_baseline": 0.238,
+        "extra": {"scale": 1.0, "path": "ell"},
+    }
+    bench.save_last_good(out)
+    rec = bench.load_last_good(1.0)
+    assert rec["value"] == 4.2 and rec["measured_at"]
+    assert bench.load_last_good(0.05) is None  # scale mismatch
+
+    rc = bench.emit_stale_or_fail(1.0, "backend unavailable", diag="x" * 900)
+    assert rc == 0
+    rc = bench.emit_stale_or_fail(0.05, "backend unavailable")
+    assert rc == 1
+    # live-backend failure (likely regression): salvage but NOT success
+    rc = bench.emit_stale_or_fail(1.0, "every sweep config failed",
+                                  rc_on_salvage=4)
+    assert rc == 4
+
+
+def test_stale_emission_content(tmp_path, monkeypatch, capsys):
+    monkeypatch.setattr(bench, "LAST_GOOD_PATH", str(tmp_path / "last.json"))
+    bench.save_last_good({
+        "metric": "gcn_reddit_full_batch_epoch_time", "value": 7.0,
+        "unit": "s", "vs_baseline": 0.143, "extra": {"scale": 1.0},
+    })
+    assert bench.emit_stale_or_fail(1.0, "every sweep config failed") == 0
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    rec = json.loads(line)
+    assert rec["value"] == 7.0
+    assert rec["extra"]["stale"] is True
+    assert "every sweep config failed" in rec["extra"]["stale_reason"]
+    assert rec["extra"]["measured_at"]
+    assert "measured_at" not in rec  # moved into extra, schema unchanged
