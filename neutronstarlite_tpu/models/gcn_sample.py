@@ -57,6 +57,10 @@ def _batch_arrays(b: SampledBatch):
 @register_algorithm("GCNSAMPLESINGLE", "GCNSAMPLE", "GCNCPUSAMPLE")
 class GCNSampleTrainer(ToolkitBase):
     weight_mode = "gcn_norm"
+    # sampling reads the HOST CSC (the FullyRepGraph analog); the device only
+    # ever sees padded batch subgraphs — uploading the full edge set to HBM
+    # would waste gigabytes at Reddit scale for arrays never touched
+    needs_device_graph = False
 
     def build_model(self) -> None:
         cfg = self.cfg
@@ -96,18 +100,26 @@ class GCNSampleTrainer(ToolkitBase):
         drop_rate = cfg.drop_rate
         adam_cfg = self.adam_cfg
         caps = self.samplers[0].node_caps
+        # PRECISION:bfloat16 — same policy as the full-batch models
+        # (models/gcn.py): feature gather + matmuls in bf16, parameters and
+        # returned logits stay float32 (edge weights stay f32, so the
+        # per-batch segment sum accumulates wide)
+        compute_dtype = jnp.bfloat16 if cfg.precision == "bfloat16" else None
+
+        def cast(a):
+            return a.astype(compute_dtype) if compute_dtype is not None else a
 
         def batch_forward(params, feature, nodes, hops, key, train):
-            x = get_feature(feature, nodes[0])
+            x = cast(get_feature(feature, nodes[0]))
             for i, (p, (src_l, dst_l, w)) in enumerate(zip(params, hops)):
                 agg = minibatch_gather(src_l, dst_l, w, x, caps[i + 1])
-                h = agg @ p["W"]
+                h = cast(agg) @ cast(p["W"])
                 if i < len(params) - 1:
                     h = jax.nn.relu(h)
                     if train:
                         h = dropout(jax.random.fold_in(key, i), h, drop_rate, train)
                 x = h
-            return x  # [B, n_classes]
+            return x.astype(jnp.float32)  # [B, n_classes]
 
         def batch_loss(params, feature, label, nodes, hops, seed_mask, seeds, key):
             logits = batch_forward(params, feature, nodes, hops, key, True)

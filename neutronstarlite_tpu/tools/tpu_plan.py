@@ -113,6 +113,14 @@ def build_steps(out_dir: str):
             {},
         ),
         (
+            "sampled_bench",
+            # the OTHER headline training mode: fan-out-sampled mini-batch
+            # at Reddit scale (shares bench.py's on-disk graph cache)
+            [sys.executable, "-m", "neutronstarlite_tpu.tools.bench_sample"],
+            1800,
+            {},
+        ),
+        (
             "profile_trace",
             _bench("--order", "standard", "--path", "ell"),
             1800,

@@ -144,3 +144,23 @@ def test_stale_emission_content(tmp_path, monkeypatch, capsys):
     assert "every sweep config failed" in rec["extra"]["stale_reason"]
     assert rec["extra"]["measured_at"]
     assert "measured_at" not in rec  # moved into extra, schema unchanged
+
+
+def test_bench_sample_contract(tmp_path, monkeypatch, capsys):
+    """Sampled-bench JSON contract at toy scale on CPU: one parseable line
+    with a positive batch time and the workload descriptors."""
+    monkeypatch.setenv("NTS_BENCH_CACHE", str(tmp_path))
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    from neutronstarlite_tpu.tools.bench_sample import main as sample_main
+
+    rc = sample_main([
+        "--scale", "0.001", "--batch-size", "32", "--fanout", "4-4",
+        "--batches", "4", "--warmup", "1",
+    ])
+    assert rc == 0
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    rec = json.loads(line)
+    assert rec["metric"] == "gcn_reddit_sampled_batch_time"
+    assert rec["value"] > 0
+    assert rec["extra"]["batches_per_epoch"] >= 1
+    assert np.isfinite(rec["extra"]["final_loss"])
