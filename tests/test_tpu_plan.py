@@ -87,6 +87,8 @@ def test_env_override_reaches_step(tmp_path):
 def test_build_steps_shape():
     steps = build_steps("/tmp/out")
     names = [s[0] for s in steps]
-    assert names[0] == "tpu_tests" and "bench_full" in names
+    # the north-star measurement leads: a late tunnel recovery must reach
+    # bench_full before anything else can eat the remaining wall clock
+    assert names[0] == "bench_full" and "tpu_tests" in names
     assert {"ell_chunk_16", "ell_chunk_64", "ell_chunk_128"} <= set(names)
     assert len(names) == len(set(names))
