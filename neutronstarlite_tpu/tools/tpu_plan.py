@@ -82,7 +82,10 @@ def build_steps(out_dir: str):
         *[
             (
                 f"ell_chunk_{mib}",
-                _bench("--order", "standard", "--path", "ell"),
+                # eager order: at full scale aggregation runs at post-matmul
+                # widths (128/41 not 602) — 4.7x less gather traffic on
+                # layer 1, the expected production order for the chunk tune
+                _bench("--order", "eager", "--path", "ell"),
                 1800,
                 # bench's internal watchdog must fire BEFORE the external
                 # process-group kill: it dumps stacks and salvages the
