@@ -164,3 +164,30 @@ def test_bench_sample_contract(tmp_path, monkeypatch, capsys):
     assert rec["value"] > 0
     assert rec["extra"]["batches_per_epoch"] >= 1
     assert np.isfinite(rec["extra"]["final_loss"])
+
+
+def test_worker_paths_agree(tmp_path, monkeypatch):
+    """The pallas/blocked worker configs must run end-to-end and agree with
+    the ELL path's loss bit-for-bit (same math, different layouts) — a
+    plumbing bug here would otherwise burn an on-chip measurement slot."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["NTS_BENCH_CACHE"] = str(tmp_path)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
+    monkeypatch.setenv("NTS_BENCH_CACHE", str(tmp_path))
+    d, _, _, _ = bench.build_and_cache_graph(0.0005)
+    losses = {}
+    for path, tile in (("ell", 0), ("pallas", 0), ("blocked", 64)):
+        r = subprocess.run(
+            [
+                sys.executable, os.path.join(env["PYTHONPATH"], "bench.py"),
+                "--worker", "--worker-config", f"eager/{path}/float32",
+                "--epochs", "1", "--warmup", "1", "--cache-dir", d,
+                "--kernel-tile", str(tile),
+            ],
+            capture_output=True, text=True, timeout=300, env=env,
+        )
+        assert r.returncode == 0, (path, r.stderr[-1500:])
+        losses[path] = json.loads(r.stdout.strip().splitlines()[-1])["loss"]
+    assert losses["pallas"] == losses["ell"], losses
+    assert losses["blocked"] == losses["ell"], losses
