@@ -173,13 +173,32 @@ def tpu_results():
         p for p in [os.path.dirname(os.path.dirname(__file__)),
                     env.get("PYTHONPATH", "")] if p
     )
+    # stage 1 — cheap probe: a DOWN tunnel must cost the suite ~2 min, not
+    # the full module timeout below (bench.py owns the probe program)
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import bench
+
+    probe_timeout = float(os.environ.get("NTS_TPU_PROBE_TIMEOUT_S", 150))
     try:
-        # default 600 s: backend init alone has been observed to take minutes
-        # when the remote tunnel is cold/degraded, and the module runs ~10
-        # compiles through a remote compile service; a wedged tunnel hangs
-        # init forever and must only cost the suite a bounded skip.
-        # NTS_TPU_TEST_TIMEOUT_S overrides (the on-chip measurement plan
-        # raises it; quick CI rigs can lower it).
+        pr = subprocess.run(
+            [sys.executable, "-c", bench._PROBE_SRC],
+            capture_output=True, text=True, timeout=probe_timeout, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        pytest.skip(f"TPU probe timed out after {probe_timeout:.0f}s "
+                    "(backend unreachable)")
+    if pr.returncode != 0 or not pr.stdout.strip():
+        pytest.skip(f"TPU probe failed: {pr.stderr[-500:]}")
+    if '"platform": "cpu"' in pr.stdout:
+        pytest.skip("no accelerator (probe resolved to cpu)")
+
+    try:
+        # stage 2 — default 600 s: backend init alone has been observed to
+        # take minutes when the remote tunnel is cold/degraded, and the
+        # module runs ~10 compiles through a remote compile service; a
+        # wedged tunnel hangs init forever and must only cost the suite a
+        # bounded skip. NTS_TPU_TEST_TIMEOUT_S overrides (the on-chip
+        # measurement plan raises it; quick CI rigs can lower it).
         timeout_s = float(os.environ.get("NTS_TPU_TEST_TIMEOUT_S", 600))
         r = subprocess.run(
             [sys.executable, "-c", _TPU_SRC],
