@@ -124,11 +124,25 @@ class DistGCNCacheTrainer(ToolkitBase):
 
         # PROC_REP off => threshold above any degree => no hot slots, pure
         # communication; the build degenerates to the plain MirrorGraph.
-        threshold = (
-            cfg.rep_threshold
-            if cfg.process_rep
-            else int(self.host_graph.out_degree.max()) + 1
-        )
+        # REP_THRESHOLD:auto (-1) => the hybrid decision is made for the
+        # user: smallest threshold whose replicated layer-0 rows fit the
+        # CACHE_BUDGET_MIB budget (most caching, least wire traffic).
+        if not cfg.process_rep:
+            threshold = int(self.host_graph.out_degree.max()) + 1
+        elif cfg.rep_threshold < 0:
+            # the budget must cover EVERYTHING allocated per hot slot: the
+            # replicated layer-0 rows [P*mc, f0] plus one historical cache
+            # [P*mc, hidden_i] per deep layer (dist_gcn_cache_forward emits
+            # caches for layers 1..n-1) — so price the sum of those widths,
+            # not just f0
+            widths = cfg.layer_sizes()[:-1]
+            threshold = CachedMirrorGraph.choose_replication_threshold(
+                self.host_graph, P,
+                feature_size=sum(widths),
+                budget_bytes=cfg.cache_budget_mib << 20,
+            )
+        else:
+            threshold = cfg.rep_threshold
         self.cmg = CachedMirrorGraph.build(self.host_graph, P, threshold)
         self.cache_refresh = max(int(cfg.cache_refresh), 1)
         if self.mesh is not None:

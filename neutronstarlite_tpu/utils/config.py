@@ -107,7 +107,9 @@ class InputInfo:
     # DepCache hybrid dependency management (parallel/feature_cache.py;
     # reference replication_threshold graph.hpp:179, FeatureCache
     # NtsScheduler.hpp:556). Active when PROC_REP:1.
-    rep_threshold: int = 0  # out-degree >= threshold => replicate/cache row
+    rep_threshold: int = 0  # out-degree >= threshold => replicate/cache row;
+    # -1 (REP_THRESHOLD:auto) = choose under the CACHE_BUDGET_MIB budget
+    cache_budget_mib: int = 256  # HBM budget/device for the replicated rows
     cache_refresh: int = 1  # epochs between deep-layer cache refreshes
     sublinear: bool = False  # activation recomputation (ntsSubLinearNNOP)
     comm_layer: str = "auto"  # dist aggregation exchange: ring (dense
@@ -202,7 +204,13 @@ class InputInfo:
         elif key == "CHECKPOINT_EVERY":
             self.checkpoint_every = int(value)
         elif key == "REP_THRESHOLD":
-            self.rep_threshold = int(value)
+            # "auto" -> -1: the cache build chooses the smallest threshold
+            # whose replicated rows fit CACHE_BUDGET_MIB (the automatic
+            # hybrid dependency decision; see CachedMirrorGraph.
+            # choose_replication_threshold)
+            self.rep_threshold = -1 if value.lower() == "auto" else int(value)
+        elif key == "CACHE_BUDGET_MIB":
+            self.cache_budget_mib = int(value)
         elif key == "CACHE_REFRESH":
             self.cache_refresh = int(value)
         elif key == "SUBLINEAR":

@@ -180,3 +180,52 @@ def test_dist_gcn_cache_trainer_pure_comm_matches_plain_gcn(rng):
     assert t.cmg.mc == 0
     result = t.run()
     assert result["acc"]["train"] > 0.8, result
+
+
+def test_auto_threshold_respects_budget_and_is_minimal(rng):
+    """choose_replication_threshold must return the SMALLEST degree cutoff
+    whose per-device cached bytes fit the budget (most caching under the
+    constraint), and an impossible budget must disable caching entirely."""
+    g, _ = tiny_graph(rng, v_num=96, e_num=900)
+    P, f = 4, 8
+
+    def bytes_at(t):
+        cmg = CachedMirrorGraph.build(g, P, t)
+        return P * cmg.mc * f * 4
+
+    # generous budget: everything cached -> threshold at/below min degree
+    t_all = CachedMirrorGraph.choose_replication_threshold(
+        g, P, feature_size=f, budget_bytes=1 << 30
+    )
+    assert bytes_at(t_all) <= 1 << 30
+    assert t_all <= int(g.out_degree.min())
+
+    # tight budget: the returned t fits, and the next-lower candidate breaks
+    budget = bytes_at(int(np.median(g.out_degree)))
+    t = CachedMirrorGraph.choose_replication_threshold(
+        g, P, feature_size=f, budget_bytes=budget
+    )
+    assert bytes_at(t) <= budget
+    lower = g.out_degree[g.out_degree < t]
+    if len(lower):
+        assert bytes_at(int(lower.max())) > budget
+
+    # impossible budget: no caching at all
+    t_none = CachedMirrorGraph.choose_replication_threshold(
+        g, P, feature_size=f, budget_bytes=0
+    )
+    cmg = CachedMirrorGraph.build(g, P, t_none)
+    assert cmg.mc == 0
+
+
+def test_rep_threshold_auto_cfg(tmp_path):
+    from neutronstarlite_tpu.utils.config import InputInfo
+
+    p = tmp_path / "c.cfg"
+    p.write_text("ALGORITHM:GCNDISTCACHE\nVERTICES:10\n"
+                 "REP_THRESHOLD:auto\nCACHE_BUDGET_MIB:64\n")
+    cfg = InputInfo.read_from_cfg_file(str(p))
+    assert cfg.rep_threshold == -1
+    assert cfg.cache_budget_mib == 64
+    p.write_text("ALGORITHM:GCNDISTCACHE\nVERTICES:10\nREP_THRESHOLD:12\n")
+    assert InputInfo.read_from_cfg_file(str(p)).rep_threshold == 12
