@@ -115,9 +115,11 @@ def test_slot_capacity_saving(rng):
     assert half.mc + half.mf >= plain.mb  # groups padded separately
 
 
-def test_dist_gcn_cache_trainer_converges(rng):
+@pytest.mark.parametrize("threshold_mode", ["manual", "auto"])
+def test_dist_gcn_cache_trainer_converges(rng, threshold_mode):
     """End-to-end DistGCNCacheTrainer (simulate mode): replication +
-    historical caching (refresh every 3 epochs) still converges."""
+    historical caching (refresh every 3 epochs) still converges — with a
+    manual threshold and with the REP_THRESHOLD:auto budget decision."""
     from neutronstarlite_tpu.graph.dataset import GNNDatum
     from neutronstarlite_tpu.graph.synthetic import planted_partition_graph
     from neutronstarlite_tpu.models.gcn_dist_cache import DistGCNCacheTrainer
@@ -138,7 +140,11 @@ def test_dist_gcn_cache_trainer_converges(rng):
     cfg.decay_epoch = -1
     cfg.partitions = 4
     cfg.process_rep = True
-    cfg.rep_threshold = 8
+    if threshold_mode == "manual":
+        cfg.rep_threshold = 8
+    else:
+        cfg.rep_threshold = -1  # REP_THRESHOLD:auto
+        cfg.cache_budget_mib = 1
     cfg.cache_refresh = 3
 
     class SimTrainer(DistGCNCacheTrainer):
