@@ -249,3 +249,42 @@ def test_dist_commnet_trainer_matches_single_chip(rng):
     np.testing.assert_allclose(
         dist_out["loss"], single_out["loss"], rtol=0.15, atol=0.05
     )
+
+
+@multidevice
+def test_dist_eager_gcn_matches_single_chip(rng):
+    """GCNEAGERDIST (the reference's GCN_EAGER dist toolkit): NN-then-
+    exchange order on a real 4-device mesh must track the single-chip eager
+    trainer's loss — with dropout off and identical seeds the math is the
+    same, only the exchange runs at post-matmul widths."""
+    from neutronstarlite_tpu.graph.dataset import GNNDatum
+    from neutronstarlite_tpu.graph.synthetic import planted_partition_graph
+    from neutronstarlite_tpu.models.gcn import GCNEagerTrainer
+    from neutronstarlite_tpu.models.gcn_dist import DistGCNEagerTrainer
+    from neutronstarlite_tpu.utils.config import InputInfo
+
+    v_num, classes, f = 96, 3, 8
+    src, dst, feature, label = planted_partition_graph(
+        v_num, classes, avg_degree=10, feature_size=f, seed=5
+    )
+    mask = (np.arange(v_num) % 3).astype(np.int32)
+    datum = GNNDatum(feature=feature, label=label.astype(np.int32), mask=mask)
+
+    def cfg_for(partitions):
+        cfg = InputInfo()
+        cfg.vertices = v_num
+        cfg.layer_string = f"{f}-12-{classes}"
+        cfg.epochs = 12
+        cfg.learn_rate = 0.02
+        cfg.drop_rate = 0.0
+        cfg.decay_epoch = -1
+        cfg.partitions = partitions
+        return cfg
+
+    dist_out = DistGCNEagerTrainer.from_arrays(cfg_for(4), src, dst, datum).run()
+    single_out = GCNEagerTrainer.from_arrays(cfg_for(0), src, dst, datum).run()
+    assert np.isfinite(dist_out["loss"]), dist_out
+    assert dist_out["acc"]["train"] >= 0.9, dist_out
+    np.testing.assert_allclose(
+        dist_out["loss"], single_out["loss"], rtol=0.15, atol=0.05
+    )
