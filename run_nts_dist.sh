@@ -1,0 +1,67 @@
+#!/bin/bash
+# Launch parity with the reference's run_nts_dist.sh:
+#   scp cfg to every host in ./hostfile, then "mpiexec -hostfile hostfile -np N".
+#
+# Usage: ./run_nts_dist.sh <procs> <file.cfg> [hostfile]
+#
+# With a hostfile (one host per line): copies the cfg to each host's matching
+# directory and launches one process per line over ssh, wiring the
+# jax.distributed world exactly the way mpiexec wires MPI_COMM_WORLD —
+# process 0's host is the coordinator, NTS_NUM_PROCESSES/NTS_PROCESS_ID are
+# the rank variables (parallel/mesh.maybe_initialize_distributed).
+#
+# Without a hostfile: all <procs> processes spawn on localhost — the
+# reference's multi-slot-on-one-host debugging rig ("strongly recommand use
+# one slot, except for debugging", reference README), and the rig
+# tests/test_multihost.py exercises in CI.
+set -e
+procs=${1:?usage: ./run_nts_dist.sh <procs> <file.cfg> [hostfile]}
+cfg=${2:?usage: ./run_nts_dist.sh <procs> <file.cfg> [hostfile]}
+hostfile=${3:-}
+port=${NTS_PORT:-$((12000 + RANDOM % 20000))}
+cur_dir=$(cd "$(dirname "$0")" && pwd)
+
+if [ -n "${hostfile}" ]; then
+  # blank/whitespace lines would miscount the world and ssh to "user@"
+  mapfile -t hosts < <(sed 's/[[:space:]]*$//' "${hostfile}" | grep -v '^$')
+  if [ "${#hosts[@]}" -lt "${procs}" ]; then
+    echo "run_nts_dist.sh: ${procs} processes requested but hostfile has" \
+      "only ${#hosts[@]} usable hosts — every rank would block forever in" \
+      "jax.distributed.initialize waiting for the missing ones" >&2
+    exit 2
+  fi
+  coord="${hosts[0]}:${port}"
+  pids=()
+  for ((i = 0; i < procs; i++)); do
+    host="${hosts[$i]}"
+    # a rank whose cfg never arrived must fail HERE, loudly — not crash the
+    # whole world later on a missing file or train on a stale copy
+    scp -q "${cfg}" "${USER}@${host}:${cur_dir}/" || {
+      echo "run_nts_dist.sh: scp of ${cfg} to ${host} failed" >&2
+      exit 3
+    }
+    ssh "${USER}@${host}" \
+      "cd ${cur_dir} && NTS_COORDINATOR=${coord} NTS_NUM_PROCESSES=${procs} \
+       NTS_PROCESS_ID=${i} NTS_PARTITIONS_OVERRIDE=${procs} \
+       python -m neutronstarlite_tpu.run $(basename "${cfg}")" &
+    pids+=($!)
+  done
+else
+  # localhost: N processes, one JAX world over the loopback coordinator.
+  # Forcing the CPU platform: N processes cannot share the one local
+  # accelerator, and this mode exists for debugging the distributed wiring.
+  coord="127.0.0.1:${port}"
+  pids=()
+  for ((i = 0; i < procs; i++)); do
+    JAX_PLATFORMS=cpu NTS_COORDINATOR="${coord}" NTS_NUM_PROCESSES="${procs}" \
+      NTS_PROCESS_ID="${i}" NTS_PARTITIONS_OVERRIDE="${procs}" \
+      python -m neutronstarlite_tpu.run "${cfg}" &
+    pids+=($!)
+  done
+fi
+
+rc=0
+for pid in "${pids[@]}"; do
+  wait "${pid}" || rc=$?
+done
+exit "${rc}"

@@ -139,3 +139,47 @@ def test_two_process_resume_with_nonshared_ckpt_dir(tmp_path):
     assert second[1]["epochs_run"] == 2
     assert second[0]["loss"] == pytest.approx(second[1]["loss"], rel=1e-6)
     assert second[0]["acc"] == second[1]["acc"]
+
+
+@multihost
+def test_run_nts_dist_launcher(tmp_path):
+    """run_nts_dist.sh (the reference's hostfile/mpiexec dist driver) in
+    localhost mode: N real processes form one jax.distributed world through
+    the CLI and finish the algorithm."""
+    rng = np.random.default_rng(4)
+    V = 60
+    src = rng.integers(0, V, 400)
+    dst = rng.integers(0, V, 400)
+    loops = np.arange(V)
+    edge_path = tmp_path / "tiny.edge.txt"
+    with open(edge_path, "w") as fh:
+        for s, d in zip(np.concatenate([src, loops]), np.concatenate([dst, loops])):
+            fh.write(f"{s} {d}\n")
+    cfg_path = tmp_path / "dist2.cfg"
+    cfg_path.write_text(
+        "ALGORITHM:GCNDIST\nVERTICES:60\nLAYERS:8-16-3\nEPOCHS:3\n"
+        f"EDGE_FILE:{edge_path}\nFEATURE_FILE:{tmp_path}/absent.feat\n"
+        f"LABEL_FILE:{tmp_path}/absent.label\nMASK_FILE:{tmp_path}/absent.mask\n"
+        "LEARN_RATE:0.02\nDECAY_EPOCH:-1\nDROP_RATE:0.0\n"
+    )
+    env = dict(os.environ)
+    env.pop("NTS_DIST_SIMULATE", None)
+    env["NTS_PORT"] = str(_free_port())  # a random-port collision is a flake
+    # new session + killpg: a deadlocked collective must fail the test at
+    # the timeout, not hang pytest on orphaned ranks holding the pipes
+    # (the same reason _run_world kill()s its ranks)
+    proc = subprocess.Popen(
+        [os.path.join(_REPO, "run_nts_dist.sh"), "2", str(cfg_path)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env, cwd=_REPO, start_new_session=True,
+    )
+    try:
+        out, err = proc.communicate(timeout=280)
+    except subprocess.TimeoutExpired:
+        import signal
+
+        os.killpg(proc.pid, signal.SIGKILL)
+        proc.wait()
+        pytest.fail("run_nts_dist.sh world deadlocked (timeout)")
+    assert proc.returncode == 0, (out[-1500:], err[-800:])
+    assert "finish algorithm" in out
