@@ -26,9 +26,11 @@ log = get_logger("fullbatch")
 class FullBatchTrainer(ToolkitBase):
     """Template for single-mesh full-batch models (GCN/GAT/GIN/CommNet...)."""
 
-    # models whose only graph op is the fused weighted aggregation can run
-    # it over the gather-only ELL layout (OPTIM_KERNEL:1, ops/ell.py); edge-
-    # op chains (GAT/GGCN) need the CSC edge arrays and keep DeviceGraph
+    # models whose only graph op is the fused weighted aggregation run it
+    # over the gather-only ELL layout (OPTIM_KERNEL:1, ops/ell.py); GAT
+    # rides the same layout through the fused attention path (ops/ell_gat,
+    # via adapt_ell_graph); GGCN's multi-channel edge chain still needs the
+    # CSC edge arrays and keeps DeviceGraph
     supports_optim_kernel = False
 
     def init_params(self, key):
@@ -43,6 +45,11 @@ class FullBatchTrainer(ToolkitBase):
         a gigabyte-sized program (remote-compile paths reject it outright).
         """
         raise NotImplementedError
+
+    def adapt_ell_graph(self, compute_graph):
+        """Hook: wrap/replace the OPTIM_KERNEL compute graph with
+        trainer-specific tables (GAT adds attention slot maps)."""
+        return compute_graph
 
     def build_model(self) -> None:
         cfg = self.cfg
@@ -94,6 +101,9 @@ class FullBatchTrainer(ToolkitBase):
                     "OPTIM_KERNEL: ELL gather-only aggregation (%d fwd buckets)",
                     len(self.compute_graph.fwd.nbr),
                 )
+            # trainer-specific table adaptation (e.g. GAT wraps the plain
+            # EllPair with the attention slot maps); default is identity
+            self.compute_graph = self.adapt_ell_graph(self.compute_graph)
         key = jax.random.PRNGKey(self.seed)
         self.params = self.init_params(key)
         self.adam_cfg = AdamConfig(

@@ -65,10 +65,26 @@ def gat_layer(graph: DeviceGraph, W, a, x, last: bool):
     return out if last else jax.nn.relu(out)
 
 
+def gat_layer_ell(gep, W, a, x, last: bool):
+    """The same layer over the fused ELL attention path (ops/ell_gat.py):
+    dense [rows, K] score/softmax/aggregate, no [E] tensors, no scatter."""
+    from neutronstarlite_tpu.ops.ell_gat import gat_ell_attention_aggregate
+
+    h = x @ W
+    f = h.shape[1]
+    al = (h @ a[:f])[:, 0]
+    ar = (h @ a[f:])[:, 0]
+    out = gat_ell_attention_aggregate(gep, h, al, ar, LEAKY_SLOPE)
+    return out if last else jax.nn.relu(out)
+
+
 def gat_forward(graph, params, x, key, drop_rate: float, train: bool):
+    from neutronstarlite_tpu.ops.ell_gat import GatEllPair
+
+    layer_fn = gat_layer_ell if isinstance(graph, GatEllPair) else gat_layer
     n = len(params)
     for i, layer in enumerate(params):
-        x = gat_layer(graph, layer["W"], layer["a"], x, i == n - 1)
+        x = layer_fn(graph, layer["W"], layer["a"], x, i == n - 1)
         if train and i < n - 1:
             x = dropout(jax.random.fold_in(key, i), x, drop_rate, train)
     return x
@@ -78,9 +94,23 @@ def gat_forward(graph, params, x, key, drop_rate: float, train: bool):
 class GATTrainer(FullBatchTrainer):
     # the softmax supplies edge weights; the underlying scatter is unweighted
     weight_mode = "ones"
+    # OPTIM_KERNEL:1 -> the fused ELL attention path (scatter-free)
+    supports_optim_kernel = True
 
     def init_params(self, key):
         return init_gat_params(key, self.cfg.layer_sizes())
+
+    def adapt_ell_graph(self, compute_graph):
+        from neutronstarlite_tpu.ops.ell import EllPair
+        from neutronstarlite_tpu.ops.ell_gat import GatEllPair
+
+        if not isinstance(compute_graph, EllPair):
+            raise ValueError(
+                "OPTIM_KERNEL GAT uses the plain ELL tables; KERNEL_TILE/"
+                f"PALLAS layouts ({type(compute_graph).__name__}) are not "
+                "supported with ALGORITHM:GATCPU"
+            )
+        return GatEllPair.from_pair(compute_graph, self.host_graph)
 
     def model_forward(self, params, graph, x, key, train):
         return gat_forward(

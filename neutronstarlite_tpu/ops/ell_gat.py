@@ -1,0 +1,327 @@
+"""Fused ELL-table GAT attention: scatter-free edge softmax + aggregation.
+
+The edge-op GAT chain (models/gat.py; reference GAT_CPU.hpp:195-222)
+materializes [E]-aligned score/alpha arrays and runs segment softmax +
+segment sums over them. On TPU every one of those segment ops either
+serializes (scatter) or pays sort machinery. This module re-expresses the
+whole per-layer attention over the OPTIM_KERNEL degree-bucketed ELL tables
+(ops/ell.py): a destination's in-edges occupy exactly ONE padded row
+[K], so
+
+- edge scores   e[r, k] = leaky_relu(al[nbr[r, k]] + ar[row_vertex[r]])
+- edge softmax  alpha[r, k] = masked softmax over the row's K slots
+- aggregation   out[r] = sum_k alpha[r, k] * h[nbr[r, k]]
+
+are all DENSE [rows, K(, f)] operations — gathers and row reductions, no
+scatter, no [E] tensors. This is the TPU analog of fusing SDDMM + softmax +
+SpMM (FusedMM's unification) on top of the reference's own decomposed
+attention (GAT_CPU_DIST_OPTM: a.[h_src||h_dst] = a_src.h_src + a_dst.h_dst).
+
+The aggregation's h-gradient needs the transposed aggregation with the SAME
+runtime alphas: ``GatEllPair`` precomputes, for every backward (CSR) table
+slot, the flat index of its edge's forward slot (``bwd_alpha_idx``), so the
+backward pass gathers alpha straight out of the forward tables — the
+runtime-weight generalization of ops/ell.py's paired custom_vjp (reference
+CSC-forward/CSR-backward pairing, cuda/ntsCUDAFuseKernel.cuh:147/:327).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax import lax
+
+from neutronstarlite_tpu.graph.storage import CSCGraph
+from neutronstarlite_tpu.ops.ell import (
+    DEFAULT_SLOT_CHUNK,
+    EllBuckets,
+    EllPair,
+    _chunk_budget_bytes,
+    ell_tables_aggregate,
+)
+
+NEG_INF = -1e30  # masked-slot score (bf16-safe sentinel, not actual inf)
+
+
+def _flat_slot_layout(buckets: EllBuckets):
+    """Host-side row layout of the concatenated per-level tables:
+    (level_base, level_rows, level_K, row_vertex) where row_vertex[r] is the
+    vertex whose in-edges occupy concatenated row r."""
+    level_rows = [n.shape[0] for n in buckets.nbr]
+    level_K = [n.shape[1] for n in buckets.nbr]
+    bases, base = [], 0
+    for rows, k in zip(level_rows, level_K):
+        bases.append(base)
+        base += rows * k
+    inv = np.asarray(buckets.inv_perm)
+    row_vertex = np.empty(buckets.v_num, dtype=np.int64)
+    row_vertex[inv] = np.arange(buckets.v_num)
+    return bases, level_rows, level_K, row_vertex
+
+
+def _edge_flat_slots(offsets, adj_dst, buckets: EllBuckets):
+    """For every edge of the direction's adjacency (CSC edge order for the
+    forward tables): the flat index of its slot in the concatenated
+    [rows, K] level tables. Relies on the build filling each row's slots in
+    adjacency order (both the native and the NumPy fill copy runs
+    adj[lo:lo+deg] left-to-right)."""
+    bases, level_rows, level_K, _ = _flat_slot_layout(buckets)
+    # concat-row index of each vertex + its level and intra-level row
+    inv = np.asarray(buckets.inv_perm).astype(np.int64)
+    row_starts = np.cumsum([0] + level_rows)
+    level_of_row = np.searchsorted(row_starts, np.arange(row_starts[-1]), side="right") - 1
+    e_num = len(adj_dst)
+    k_within = np.arange(e_num) - offsets[adj_dst]
+    rows = inv[adj_dst]
+    lv = level_of_row[rows]
+    local_row = rows - row_starts[lv]
+    return (
+        np.asarray(bases)[lv]
+        + local_row * np.asarray(level_K)[lv]
+        + k_within
+    ).astype(np.int64)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class GatEllPair:
+    """ELL tables + the extra maps GAT's data-dependent weights need.
+
+    ``fwd_row_vertex[r]``: destination vertex of concatenated fwd row r
+    (for gathering the per-dst attention half into row order).
+    ``bwd_alpha_idx[l]`` [Nk_b, K_b]: flat fwd-slot index of each backward
+    slot's edge (padding slots point at 0 and are masked by the bwd table's
+    zero weight).
+    """
+
+    pair: EllPair
+    fwd_row_vertex: jax.Array  # [V]
+    bwd_alpha_idx: List[jax.Array]
+
+    @staticmethod
+    def from_host(g: CSCGraph, slot_chunk: int = DEFAULT_SLOT_CHUNK) -> "GatEllPair":
+        return GatEllPair.from_pair(EllPair.from_host(g, slot_chunk), g)
+
+    @staticmethod
+    def from_pair(pair: EllPair, g: CSCGraph) -> "GatEllPair":
+        """Add the attention slot maps to an already-built EllPair (the
+        generic OPTIM_KERNEL build constructs the pair; this wraps it)."""
+        _, _, _, fwd_row_vertex = _flat_slot_layout(pair.fwd)
+
+        # fwd slot of every CSC edge
+        fwd_slot_of_csc = _edge_flat_slots(
+            g.column_offset, g.dst_of_edge.astype(np.int64), pair.fwd
+        )
+        # CSR edge -> CSC edge correspondence (multigraph-safe: stable sort
+        # by (src, dst) pairs both edge orders the same way)
+        csc_src = g.row_indices.astype(np.int64)
+        csc_dst = g.dst_of_edge.astype(np.int64)
+        csr_src = g.src_of_edge.astype(np.int64)
+        csr_dst = g.column_indices.astype(np.int64)
+        a = np.lexsort((csc_dst, csc_src))  # CSC edge ids in (src, dst) order
+        b = np.lexsort((csr_dst, csr_src))  # CSR edge ids in (src, dst) order
+        csc_of_csr = np.empty(g.e_num, dtype=np.int64)
+        csc_of_csr[b] = a
+
+        # bwd slot of every CSR edge, then invert into per-level tables
+        bwd_slot_of_csr = _edge_flat_slots(
+            g.row_offset, g.src_of_edge.astype(np.int64), pair.bwd
+        )
+        bases_b, level_rows_b, level_K_b, _ = _flat_slot_layout(pair.bwd)
+        total_b = sum(r * k for r, k in zip(level_rows_b, level_K_b))
+        flat_idx = np.zeros(total_b, dtype=np.int64)  # padding -> fwd slot 0
+        flat_idx[bwd_slot_of_csr] = fwd_slot_of_csc[csc_of_csr]
+        bwd_alpha_idx = []
+        for base, rows, k in zip(bases_b, level_rows_b, level_K_b):
+            bwd_alpha_idx.append(
+                jnp.asarray(
+                    flat_idx[base: base + rows * k].reshape(rows, k),
+                    dtype=jnp.int32,
+                )
+            )
+        return GatEllPair(
+            pair=pair,
+            fwd_row_vertex=jnp.asarray(fwd_row_vertex, dtype=jnp.int32),
+            bwd_alpha_idx=bwd_alpha_idx,
+        )
+
+
+@jax.custom_vjp
+def _gather_al_levels(gep: GatEllPair, al: jax.Array):
+    """Per-level ``al[nbr]`` with a scatter-free transpose: the cotangent of
+    slot (r, k) belongs to vertex nbr[r, k], and summing a per-slot array
+    into [V] is exactly a row reduction over the BACKWARD tables — each bwd
+    row collects all of one vertex's forward slots via ``bwd_alpha_idx``.
+    Autodiff of the plain gather would instead emit an E-sized scatter-add,
+    the serialized lowering this module exists to avoid."""
+    return [al[nbr] for nbr in gep.pair.fwd.nbr]
+
+
+def _gal_fwd(gep, al):
+    return _gather_al_levels(gep, al), gep
+
+
+def _gal_bwd(gep, g_levels):
+    from neutronstarlite_tpu.ops.segment import zero_cotangent
+
+    dtype = g_levels[0].dtype if g_levels else jnp.float32
+    bwd = gep.pair.bwd
+    g_flat = jnp.concatenate([g.reshape(-1) for g in g_levels])
+    parts = []
+    for w, idx in zip(bwd.wgt, gep.bwd_alpha_idx):
+        if idx.shape[1] == 0:
+            parts.append(jnp.zeros((idx.shape[0],), dtype))
+            continue
+        parts.append(
+            jnp.where(w != 0.0, g_flat[idx], 0.0).sum(axis=1).astype(dtype)
+        )
+    grad_al = jnp.concatenate(parts)[bwd.inv_perm]
+    return (jax.tree.map(zero_cotangent, gep), grad_al)
+
+
+_gather_al_levels.defvjp(_gal_fwd, _gal_bwd)
+
+
+def gat_ell_alpha(gep: GatEllPair, al: jax.Array, ar: jax.Array, slope: float):
+    """Per-level attention weights: masked softmax of
+    leaky_relu(al[src] + ar[dst]) over each destination row's K slots.
+    Dense differentiable ops; the src-half gather pairs a scatter-free
+    transpose (``_gather_al_levels``), the dst-half gather's transpose is a
+    V-sized width-1 permutation scatter (cheap) left to autodiff."""
+    fwd = gep.pair.fwd
+    row_starts = np.cumsum([0] + [n.shape[0] for n in fwd.nbr])
+    al_levels = _gather_al_levels(gep, al)
+    alphas = []
+    for i, (nbr, wgt) in enumerate(zip(fwd.nbr, fwd.wgt)):
+        if nbr.shape[1] == 0:
+            alphas.append(jnp.zeros(nbr.shape, al.dtype))
+            continue
+        dst_v = jax.lax.dynamic_slice_in_dim(
+            gep.fwd_row_vertex, int(row_starts[i]), nbr.shape[0]
+        )
+        e = jax.nn.leaky_relu(
+            al_levels[i] + ar[dst_v][:, None], negative_slope=slope
+        )
+        real = wgt != 0.0
+        e = jnp.where(real, e, NEG_INF)
+        e = e - jax.lax.stop_gradient(e.max(axis=1, keepdims=True))
+        ex = jnp.where(real, jnp.exp(e), 0.0)
+        alphas.append(ex / jnp.maximum(ex.sum(axis=1, keepdims=True), 1e-20))
+    return alphas
+
+
+@jax.custom_vjp
+def _runtime_weighted_aggregate(gep: GatEllPair, alphas, h):
+    fwd = gep.pair.fwd
+    return ell_tables_aggregate(h, fwd.nbr, alphas, fwd.slot_chunk)[
+        fwd.inv_perm
+    ]
+
+
+def _rwa_fwd(gep, alphas, h):
+    return _runtime_weighted_aggregate(gep, alphas, h), (gep, alphas, h)
+
+
+def _rwa_bwd(res, g):
+    from neutronstarlite_tpu.ops.segment import zero_cotangent
+
+    gep, alphas, h = res
+    fwd, bwd = gep.pair.fwd, gep.pair.bwd
+
+    # grad_h: transposed aggregation with the SAME runtime alphas, gathered
+    # into the backward tables by the precomputed slot map (padding slots
+    # keep weight 0 via the bwd table's own zero weights)
+    alpha_flat = jnp.concatenate([a.reshape(-1) for a in alphas])
+    bwd_weights = [
+        jnp.where(w != 0.0, alpha_flat[idx], 0.0)
+        for w, idx in zip(bwd.wgt, gep.bwd_alpha_idx)
+    ]
+    grad_h = ell_tables_aggregate(g, bwd.nbr, bwd_weights, bwd.slot_chunk)[
+        bwd.inv_perm
+    ]
+
+    # grad_alpha[r, k] = g[row_vertex[r]] . h[nbr[r, k]] — the [rows, K, f]
+    # gather intermediate is bounded in bytes exactly like the forward
+    # (ell_tables_aggregate's chunking; DEFAULT_CHUNK_MIB rationale)
+    g_rows = g[gep.fwd_row_vertex]
+    row_starts = np.cumsum([0] + [n.shape[0] for n in fwd.nbr])
+    grad_alphas = []
+    for i, (nbr, wgt) in enumerate(zip(fwd.nbr, fwd.wgt)):
+        if nbr.shape[1] == 0:
+            grad_alphas.append(jnp.zeros(nbr.shape, g.dtype))
+            continue
+        g_lv = jax.lax.dynamic_slice_in_dim(
+            g_rows, int(row_starts[i]), nbr.shape[0]
+        )
+        grad_alphas.append(
+            _grad_alpha_level(g_lv, h, nbr, wgt, fwd.slot_chunk)
+        )
+
+    return (jax.tree.map(zero_cotangent, gep), grad_alphas, grad_h)
+
+
+def _grad_alpha_level(g_lv, h, nbr, wgt, slot_chunk: int):
+    """[Nk, K] slot gradients with the gather slab byte-bounded by row (or,
+    for hub levels whose K alone exceeds the budget, column) chunking —
+    mirrors ell_tables_aggregate's chunk policy, f32 products."""
+    f = h.shape[1]
+    slot_budget = max(_chunk_budget_bytes() // (f * 4), 1)
+    Nk, K = nbr.shape
+
+    def dense(nb, wg, gl):
+        ga = jnp.einsum(
+            "rf,rkf->rk", gl.astype(jnp.float32), h[nb].astype(jnp.float32)
+        )
+        return jnp.where(wg != 0.0, ga, 0.0).astype(gl.dtype)
+
+    if K > slot_budget:
+        # hub level: chunk the K columns
+        kc = max(slot_budget // max(Nk, 1), 1)
+        n_ch = -(-K // kc)
+        pad = n_ch * kc - K
+        nb = jnp.pad(nbr, ((0, 0), (0, pad))).reshape(Nk, n_ch, kc)
+        wg = jnp.pad(wgt, ((0, 0), (0, pad))).reshape(Nk, n_ch, kc)
+
+        def kbody(_, chunk):
+            n, w = chunk
+            return 0, dense(n, w, g_lv)
+
+        _, out = lax.scan(kbody, 0, (nb.transpose(1, 0, 2), wg.transpose(1, 0, 2)))
+        return out.transpose(1, 0, 2).reshape(Nk, n_ch * kc)[:, :K]
+
+    rows = max(min(slot_chunk, slot_budget) // K, 1)
+    if Nk <= rows:
+        return dense(nbr, wgt, g_lv)
+    n_ch = -(-Nk // rows)
+    pad = n_ch * rows - Nk
+    nb = jnp.pad(nbr, ((0, pad), (0, 0))).reshape(n_ch, rows, K)
+    wg = jnp.pad(wgt, ((0, pad), (0, 0))).reshape(n_ch, rows, K)
+    gl = jnp.pad(g_lv, ((0, pad), (0, 0))).reshape(n_ch, rows, f)
+
+    def body(_, chunk):
+        n, w, g_c = chunk
+        return 0, dense(n, w, g_c)
+
+    _, out = lax.scan(body, 0, (nb, wg, gl))
+    return out.reshape(n_ch * rows, K)[:Nk]
+
+
+_runtime_weighted_aggregate.defvjp(_rwa_fwd, _rwa_bwd)
+
+
+def gat_ell_attention_aggregate(
+    gep: GatEllPair,
+    h: jax.Array,
+    al: jax.Array,
+    ar: jax.Array,
+    slope: float,
+) -> jax.Array:
+    """The whole GAT graph-op chain over ELL tables:
+    scores -> per-dst softmax -> weighted aggregate, [V, f] -> [V, f]."""
+    alphas = gat_ell_alpha(gep, al, ar, slope)
+    return _runtime_weighted_aggregate(gep, alphas, h)
