@@ -130,6 +130,28 @@ if pcompiled is not None:
     r = np.asarray(pgrad(jnp.asarray(x)), np.float64)
     out["checks"]["pallas_grad_f32"] = rel_err(r, dense.T @ c.astype(np.float64))
 
+# fused ELL-GAT attention on hardware: the scatter-free score/softmax/
+# aggregate chain must match the edge-op chain's layer output and gradient
+from neutronstarlite_tpu.models.gat import gat_layer, gat_layer_ell, init_gat_params
+from neutronstarlite_tpu.ops.ell_gat import GatEllPair
+g_ones = build_graph(src, dst, V, weight="ones")
+dg_ones = DeviceGraph.from_host(g_ones, edge_chunk=512)
+gep = GatEllPair.from_host(g_ones)
+gat_params = init_gat_params(jax.random.PRNGKey(5), [F, 32])
+W_g, a_g = gat_params[0]["W"], gat_params[0]["a"]
+want_gat = np.asarray(
+    jax.jit(lambda W, a, v: gat_layer(dg_ones, W, a, v, True))(W_g, a_g, jnp.asarray(x)),
+    np.float64,
+)
+got_gat = np.asarray(
+    jax.jit(lambda W, a, v: gat_layer_ell(gep, W, a, v, True))(W_g, a_g, jnp.asarray(x)),
+    np.float64,
+)
+out["checks"]["gat_fused_fwd"] = rel_err(got_gat, want_gat)
+gw = jax.jit(jax.grad(lambda v: (gat_layer(dg_ones, W_g, a_g, v, True) * c[:, :32]).sum()))(jnp.asarray(x))
+fw = jax.jit(jax.grad(lambda v: (gat_layer_ell(gep, W_g, a_g, v, True) * c[:, :32]).sum()))(jnp.asarray(x))
+out["checks"]["gat_fused_grad"] = rel_err(np.asarray(fw, np.float64), np.asarray(gw, np.float64))
+
 # blocked (source-tiled) ELL layout on hardware: the beyond-VMEM production
 # candidate must agree with the dense golden, forward and gradient
 from neutronstarlite_tpu.ops.blocked_ell import BlockedEllPair
@@ -241,6 +263,12 @@ def test_tpu_blocked_ell(tpu_results):
     checks = tpu_results["checks"]
     assert checks["agg_blocked_f32"] < 1e-5, checks
     assert checks["blocked_grad_f32"] < 1e-5, checks
+
+
+def test_tpu_fused_gat(tpu_results):
+    checks = tpu_results["checks"]
+    assert checks["gat_fused_fwd"] < 1e-4, checks
+    assert checks["gat_fused_grad"] < 1e-4, checks
 
 
 def test_tpu_pallas_kernel(tpu_results):
