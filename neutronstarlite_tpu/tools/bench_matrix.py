@@ -79,6 +79,18 @@ def main(argv=None) -> int:
     honor_platform_env()
     import jax
 
+    # persistent compile cache (same as bench.py's workers): the driver
+    # re-runs the matrix every round and the remote compile service is the
+    # flakiest link — serialized executables turn repeats into cache hits
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.environ.get("JAX_COMPILATION_CACHE_DIR", "/tmp/nts_jit_cache"),
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+    except Exception as e:  # pragma: no cover
+        print(f"compile cache unavailable: {e}", file=sys.stderr, flush=True)
+
     skips = [s for s in args.skip.split(",") if s]
     rows = []
     for cfg_path in sorted(glob.glob(os.path.join(args.configs, "*.cfg"))):
