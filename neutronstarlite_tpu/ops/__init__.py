@@ -19,12 +19,15 @@ from neutronstarlite_tpu.ops.edge import (
 from neutronstarlite_tpu.ops.blocked_ell import BlockedEllPair
 from neutronstarlite_tpu.ops.ell import EllPair
 from neutronstarlite_tpu.ops.pallas_kernels import PallasEllPair
+from neutronstarlite_tpu.ops.ell_gat import GatEllPair, gat_ell_attention_aggregate
 
 __all__ = [
     "DeviceGraph",
     "EllPair",
     "BlockedEllPair",
     "PallasEllPair",
+    "GatEllPair",
+    "gat_ell_attention_aggregate",
     "gather_dst_from_src",
     "gather_src_from_dst",
     "aggregate_dst_max",
