@@ -107,6 +107,22 @@ void nts_sample_hop(const int64_t* column_offset, const int32_t* row_indices,
     int64_t k = 0;
     if (deg <= fanout) {
       for (int64_t j = lo; j < hi; ++j) dst_out[k++] = row_indices[j];
+    } else if (deg > (int64_t)fanout * 32 && fanout <= 256) {
+      // Floyd's distinct sampling: O(fanout) uniform positions. The
+      // reservoir below is O(deg) per destination — on a power-law graph
+      // a 2^21-degree hub drawn as a dst costs a 2M-edge scan every batch
+      // (measured 70 of 94 ms/batch at full Reddit scale); Floyd never
+      // touches the adjacency beyond the sampled slots.
+      int64_t pos[256];
+      for (int64_t j = deg - fanout; j < deg; ++j) {
+        int64_t t = (int64_t)(xorshift64((uint64_t*)&rs) % (uint64_t)(j + 1));
+        int found = 0;
+        for (int64_t m = 0; m < k; ++m)
+          if (pos[m] == t) { found = 1; break; }
+        pos[k++] = found ? j : t;
+      }
+      for (int64_t m = 0; m < k; ++m)
+        dst_out[m] = row_indices[lo + pos[m]];
     } else {
       // reservoir: fill first `fanout`, then replace with prob fanout/j
       for (int64_t j = 0; j < fanout; ++j) dst_out[j] = row_indices[lo + j];
@@ -158,6 +174,6 @@ void nts_fill_blocked_level(const int64_t* row_start, const int64_t* row_len,
   }
 }
 
-int nts_native_version(void) { return 2; }
+int nts_native_version(void) { return 3; }
 
 }  // extern "C"

@@ -77,3 +77,33 @@ def test_gcn_sample_converges_on_planted_partition():
     result = trainer.run()
     assert result["acc"]["test"] > 0.75, result
     assert get_algorithm("GCNSAMPLESINGLE") is GCNSampleTrainer
+
+
+def test_native_hub_sampling_distinct_and_uniform():
+    """The O(fanout) Floyd branch (deg > 32*fanout) must return DISTINCT
+    valid in-neighbors with per-neighbor inclusion roughly uniform — the
+    same distribution as the reservoir it replaces for hub destinations."""
+    from neutronstarlite_tpu import native
+
+    if not native.available():
+        pytest.skip("native runtime unavailable")
+    deg, fanout, trials = 10_000, 8, 400
+    # star graph: vertex 0 has in-edges from 1..deg
+    column_offset = np.zeros(deg + 2, dtype=np.int64)
+    column_offset[1:] = deg  # only vertex 0 has in-edges
+    row_indices = np.arange(1, deg + 1, dtype=np.int32)
+    counts = np.zeros(deg, dtype=np.int64)
+    for t in range(trials):
+        src, dst_idx = native.sample_hop(
+            column_offset, row_indices, np.zeros(1, dtype=np.int64),
+            fanout, seed=1000 + t,
+        )
+        assert len(src) == fanout
+        assert len(np.unique(src)) == fanout  # distinct
+        assert src.min() >= 1 and src.max() <= deg  # valid neighbors
+        counts[src - 1] += 1
+    # inclusion probability fanout/deg; over `trials` draws the count of any
+    # single neighbor is Binomial(trials, 8e-4) — just assert the spread is
+    # sane (no neighbor hugely over-represented, total conserved)
+    assert counts.sum() == trials * fanout
+    assert counts.max() <= 8, counts.max()  # P(X >= 9) astronomically small
