@@ -84,6 +84,10 @@ def get_lib() -> Optional[ctypes.CDLL]:
         i64p, i64p, i32p, i32p, i64p, ctypes.c_int64, ctypes.c_int64,
         ctypes.c_int32, i32p, f32p, i32p, f32p, i32p,
     ]
+    lib.nts_dedup_remap.argtypes = [
+        i64p, ctypes.c_int64, i64p, i32p,
+    ]
+    lib.nts_dedup_remap.restype = ctypes.c_int64
     lib.nts_native_version.restype = ctypes.c_int
     _lib = lib
     log.info("native runtime loaded (v%d)", lib.nts_native_version())
@@ -188,3 +192,23 @@ def sample_hop(
     # compact: keep the first counts[i] entries of each dst's slot
     keep = (np.arange(n * fanout) % fanout) < np.repeat(out_counts, fanout)
     return out_src[keep].astype(np.int64), out_dst_idx[keep].astype(np.int64)
+
+
+def dedup_remap(ids: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Sorted unique ids + each input's index into them — semantically
+    ``uniq = np.unique(ids); local = np.searchsorted(uniq, ids)`` via two
+    O(n) hash passes around an m-element sort (sampCSC::postprocessing's
+    dedup, coocsc.hpp:62-89). Ids must be NONNEGATIVE (vertex ids): the C
+    hash table uses -1 as its empty-slot sentinel."""
+    lib = get_lib()
+    assert lib is not None
+    ids = np.ascontiguousarray(ids, np.int64)
+    if len(ids) and ids.min() < 0:
+        raise ValueError("dedup_remap requires nonnegative ids (vertex ids)")
+    n = len(ids)
+    uniq = np.empty(n, np.int64)
+    local = np.empty(n, np.int32)
+    m = lib.nts_dedup_remap(
+        np.ascontiguousarray(ids, np.int64), n, uniq, local
+    )
+    return uniq[:m], local.astype(np.int64)

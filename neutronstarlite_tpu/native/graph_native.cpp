@@ -13,6 +13,7 @@
 // instead of messaging). C ABI for ctypes; the Python side owns all memory
 // (NumPy buffers), so there is no allocator coupling.
 
+#include <algorithm>
 #include <atomic>
 #include <cmath>
 #include <cstdint>
@@ -137,6 +138,57 @@ void nts_sample_hop(const int64_t* column_offset, const int32_t* row_indices,
   }
 }
 
+// Sorted dedup + remap of a batch's sampled source ids (the hot part of
+// sampCSC::postprocessing, coocsc.hpp:62-89 — std::map there). Two hash
+// passes around one m-element sort beat numpy's full n log n sort+search:
+// (1) open-addressing insert of all n ids -> unique set, (2) sort the m
+// uniques (sorted ids keep the device feature-gather local), (3) re-insert
+// sorted ids, (4) look up each id's local index. Returns m. uniq must have
+// capacity >= n; local capacity n.
+static inline int64_t nts_hash_slot(int64_t key, int64_t mask) {
+  uint64_t h = (uint64_t)key * 0x9E3779B97F4A7C15ULL;
+  return (int64_t)((h ^ (h >> 29)) & (uint64_t)mask);
+}
+
+int64_t nts_dedup_remap(const int64_t* ids, int64_t n, int64_t* uniq,
+                        int32_t* local) {
+  if (n == 0) return 0;
+  int64_t cap = 1;
+  while (cap < n * 2) cap <<= 1;
+  const int64_t mask = cap - 1;
+  int64_t* keys = new int64_t[cap];
+  int32_t* vals = new int32_t[cap];
+  for (int64_t i = 0; i < cap; ++i) keys[i] = -1;
+  int64_t m = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t k = ids[i];
+    int64_t s = nts_hash_slot(k, mask);
+    while (keys[s] != -1 && keys[s] != k) s = (s + 1) & mask;
+    if (keys[s] == -1) {
+      keys[s] = k;
+      uniq[m++] = k;
+    }
+  }
+  // insertion sort is fine for tiny m; std::sort otherwise
+  std::sort(uniq, uniq + m);
+  for (int64_t i = 0; i < cap; ++i) keys[i] = -1;
+  for (int64_t j = 0; j < m; ++j) {
+    int64_t s = nts_hash_slot(uniq[j], mask);
+    while (keys[s] != -1) s = (s + 1) & mask;
+    keys[s] = uniq[j];
+    vals[s] = (int32_t)j;
+  }
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t k = ids[i];
+    int64_t s = nts_hash_slot(k, mask);
+    while (keys[s] != k) s = (s + 1) & mask;
+    local[i] = vals[s];
+  }
+  delete[] keys;
+  delete[] vals;
+  return m;
+}
+
 // Stable counting sort of edges by source tile. Input edges are already
 // dst-grouped (CSC order), so the output permutation is (tile, dst)-sorted —
 // the order the blocked ELL layout needs (ops/blocked_ell.py) without the
@@ -174,6 +226,6 @@ void nts_fill_blocked_level(const int64_t* row_start, const int64_t* row_len,
   }
 }
 
-int nts_native_version(void) { return 3; }
+int nts_native_version(void) { return 4; }
 
 }  // extern "C"

@@ -133,10 +133,16 @@ class Sampler:
         for h in range(len(self.fanouts) - 1, -1, -1):
             fanout = self.fanouts[h]
             src, dst_idx = self._sample_neighbors(cur_nodes, fanout)
-            # dedup + batch-local remap (sampCSC::postprocessing's role,
-            # std::map replaced by np.unique + searchsorted)
-            uniq = np.unique(src)
-            src_local = np.searchsorted(uniq, src)
+            # dedup + batch-local remap (sampCSC::postprocessing's role;
+            # native hash passes, or np.unique + searchsorted fallback —
+            # identical sorted-unique semantics either way)
+            if self.use_native:
+                from neutronstarlite_tpu import native
+
+                uniq, src_local = native.dedup_remap(src)
+            else:
+                uniq = np.unique(src)
+                src_local = np.searchsorted(uniq, src)
             # per-edge weight: full-graph GCN norm (nts_norm_degree over the
             # original degrees, ntsBaseOp.hpp:194)
             d_out = np.maximum(g.out_degree[src], 1).astype(np.float64)

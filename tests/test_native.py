@@ -125,3 +125,26 @@ def test_blocked_build_native_matches_numpy(rng):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     for a, b in zip(nat.dst_row, ref.dst_row):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_dedup_remap_matches_numpy():
+    from neutronstarlite_tpu import native
+
+    if not native.available():
+        pytest.skip("native runtime unavailable")
+    rng = np.random.default_rng(8)
+    for n in (0, 1, 7, 1000, 50_000):
+        ids = rng.integers(0, max(n // 3, 1) + 1, n).astype(np.int64)
+        uniq, local = native.dedup_remap(ids)
+        want_uniq = np.unique(ids)
+        np.testing.assert_array_equal(uniq, want_uniq)
+        np.testing.assert_array_equal(local, np.searchsorted(want_uniq, ids))
+
+
+def test_dedup_remap_rejects_negative_ids():
+    from neutronstarlite_tpu import native
+
+    if not native.available():
+        pytest.skip("native runtime unavailable")
+    with pytest.raises(ValueError, match="nonnegative"):
+        native.dedup_remap(np.array([-1, 5], dtype=np.int64))
