@@ -252,11 +252,13 @@ def test_dist_commnet_trainer_matches_single_chip(rng):
 
 
 @multidevice
-def test_dist_eager_gcn_matches_single_chip(rng):
+@pytest.mark.parametrize("comm_layer", ["ring", "ell", "mirror"])
+def test_dist_eager_gcn_matches_single_chip(rng, comm_layer):
     """GCNEAGERDIST (the reference's GCN_EAGER dist toolkit): NN-then-
     exchange order on a real 4-device mesh must track the single-chip eager
     trainer's loss — with dropout off and identical seeds the math is the
-    same, only the exchange runs at post-matmul widths."""
+    same, only the exchange runs at post-matmul widths. All three exchange
+    layers carry the swapped order."""
     from neutronstarlite_tpu.graph.dataset import GNNDatum
     from neutronstarlite_tpu.graph.synthetic import planted_partition_graph
     from neutronstarlite_tpu.models.gcn import GCNEagerTrainer
@@ -279,6 +281,8 @@ def test_dist_eager_gcn_matches_single_chip(rng):
         cfg.drop_rate = 0.0
         cfg.decay_epoch = -1
         cfg.partitions = partitions
+        if partitions:
+            cfg.comm_layer = comm_layer
         return cfg
 
     dist_out = DistGCNEagerTrainer.from_arrays(cfg_for(4), src, dst, datum).run()
