@@ -108,7 +108,7 @@ void nts_sample_hop(const int64_t* column_offset, const int32_t* row_indices,
     int64_t k = 0;
     if (deg <= fanout) {
       for (int64_t j = lo; j < hi; ++j) dst_out[k++] = row_indices[j];
-    } else if (deg > (int64_t)fanout * 32 && fanout <= 256) {
+    } else if (deg > (int64_t)fanout * 8 && fanout <= 256) {
       // Floyd's distinct sampling: O(fanout) uniform positions. The
       // reservoir below is O(deg) per destination — on a power-law graph
       // a 2^21-degree hub drawn as a dst costs a 2M-edge scan every batch
@@ -226,6 +226,6 @@ void nts_fill_blocked_level(const int64_t* row_start, const int64_t* row_len,
   }
 }
 
-int nts_native_version(void) { return 4; }
+int nts_native_version(void) { return 5; }
 
 }  // extern "C"
