@@ -176,7 +176,7 @@ def sample_hop(
     fanout: int,
     seed: int,
 ) -> Tuple[np.ndarray, np.ndarray]:
-    """Reservoir fan-out sampling; returns (src, dst_idx) compacted arrays."""
+    """Fan-out sampling (reservoir or Floyd per degree); returns (src, dst_idx)."""
     lib = get_lib()
     assert lib is not None
     n = len(dsts)
