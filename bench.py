@@ -112,6 +112,12 @@ def emit_stale_or_fail(scale: float, reason: str, diag: str = "",
         stale["extra"]["stale_reason"] = (
             f"{reason}; value is the last persisted on-chip measurement"
         )
+        # schema-level provenance: a consumer that parses only the JSON line
+        # (ignoring extra.* and the exit code) must still be unable to
+        # mistake this for a fresh measurement — the metric name itself says
+        # stale and vs_baseline is nulled (advisor round-2 finding)
+        stale["metric"] = str(stale.get("metric", "")) + "_stale"
+        stale["vs_baseline"] = None
         if diag:
             stale["extra"]["last_probe"] = diag[-500:]
         stale["extra"]["measured_at"] = stale.pop("measured_at", None)

@@ -111,7 +111,10 @@ class GatEllPair:
     def from_pair(pair: EllPair, g: CSCGraph) -> "GatEllPair":
         """Add the attention slot maps to an already-built EllPair (the
         generic OPTIM_KERNEL build constructs the pair; this wraps it)."""
-        _, _, _, fwd_row_vertex = _flat_slot_layout(pair.fwd)
+        _, level_rows_f, level_K_f, fwd_row_vertex = _flat_slot_layout(
+            pair.fwd
+        )
+        total_f = sum(r * k for r, k in zip(level_rows_f, level_K_f))
 
         # fwd slot of every CSC edge
         fwd_slot_of_csc = _edge_flat_slots(
@@ -134,6 +137,16 @@ class GatEllPair:
         )
         bases_b, level_rows_b, level_K_b, _ = _flat_slot_layout(pair.bwd)
         total_b = sum(r * k for r, k in zip(level_rows_b, level_K_b))
+        # the on-device slot maps below are int32 (half the index bandwidth
+        # of int64 on the gather-bound path); a padded slot space past 2^31
+        # (graphs ~10x Reddit scale) would overflow them silently — refuse
+        # loudly at build time instead
+        if max(total_f, total_b) >= 2**31:
+            raise ValueError(
+                f"GatEllPair slot space exceeds int32: fwd {total_f} / "
+                f"bwd {total_b} padded slots >= 2^31; shard the graph "
+                "(PARTITIONS) so each shard's ELL table fits int32 indexing"
+            )
         flat_idx = np.zeros(total_b, dtype=np.int64)  # padding -> fwd slot 0
         flat_idx[bwd_slot_of_csr] = fwd_slot_of_csc[csc_of_csr]
         bwd_alpha_idx = []

@@ -147,6 +147,13 @@ class CachedMirrorGraph(MirrorGraph):
             return P * mc * feature_size * itemsize
 
         cands = np.unique(u_deg)
+        if len(cands) == 0:
+            # no mirrors at all (edgeless graph or a partition whose every
+            # edge is local): nothing to replicate, any threshold caches
+            # nothing — pick one that provably does.
+            t = int(g.out_degree.max(initial=0)) + 1
+            log.info("auto replication threshold: no mirrors, t=%d", t)
+            return t
         # find the smallest threshold that fits: cached_bytes is
         # non-increasing in t, so binary search the candidate list
         lo, hi = 0, len(cands)  # invariant: cands[hi:] fit

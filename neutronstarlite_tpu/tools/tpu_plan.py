@@ -8,13 +8,14 @@ probes the backend in a bounded subprocess, and the moment the tunnel is up
 runs the full measurement sequence step by step — every step resumable, so
 a mid-plan tunnel death costs only the step in flight.
 
-Plan steps (the sequence docs/PERF.md §2a promised):
-  1. on-chip test module (tests/test_tpu.py with a generous child timeout)
-  2. north-star bench: full-scale sweep + winner measurement (bench.py)
-  3. NTS_ELL_CHUNK_MIB tuning at {16, 64, 128} MiB on the ELL path
-  4. eager/pallas and eager/blocked full-scale paths
-  5. workload matrix over configs/ (tools/bench_matrix)
-  6. steady-state profile trace of the winning path (NTS_PROFILE_DIR)
+Plan steps — ``--list`` is authoritative; in execution order:
+  1. bench_full: north-star full-scale sweep + winner measurement (bench.py)
+  2. tpu_tests: on-chip test module (tests/test_tpu.py, generous timeout)
+  3. ell_chunk_{16,64,128}: NTS_ELL_CHUNK_MIB tuning on the eager/ELL path
+  4. eager_pallas / eager_blocked: the other full-scale kernel paths
+  5. bench_matrix: workload matrix over configs/ (tools/bench_matrix)
+  6. sampled_bench: fan-out-sampled mini-batch at Reddit scale
+  7. profile_trace: steady-state trace of standard/ELL (NTS_PROFILE_DIR)
 
 Artifacts land in docs/perf_runs/round2/: per-step .log (stderr tail),
 .json (the step's final JSON line, when it prints one), .ok marker

@@ -224,6 +224,22 @@ def test_auto_threshold_respects_budget_and_is_minimal(rng):
     assert cmg.mc == 0
 
 
+def test_auto_threshold_no_mirrors(rng):
+    """An edgeless graph has an empty mirror set; the auto threshold must
+    return a cache-nothing cutoff instead of indexing into an empty
+    candidate list (advisor round-2 finding)."""
+    from neutronstarlite_tpu.graph.storage import build_graph
+
+    v = 16
+    empty = np.zeros((0,), dtype=np.uint32)
+    g = build_graph(empty, empty, v, weight="ones")
+    t = CachedMirrorGraph.choose_replication_threshold(
+        g, partitions=4, feature_size=8, budget_bytes=1 << 20
+    )
+    cmg = CachedMirrorGraph.build(g, 4, t)
+    assert cmg.mc == 0
+
+
 def test_rep_threshold_auto_cfg(tmp_path):
     from neutronstarlite_tpu.utils.config import InputInfo
 

@@ -80,7 +80,7 @@ def test_gcn_sample_converges_on_planted_partition():
 
 
 def test_native_hub_sampling_distinct_and_uniform():
-    """The O(fanout) Floyd branch (deg > 32*fanout) must return DISTINCT
+    """The O(fanout) Floyd branch (deg > 8*fanout) must return DISTINCT
     valid in-neighbors with per-neighbor inclusion roughly uniform — the
     same distribution as the reservoir it replaces for hub destinations."""
     from neutronstarlite_tpu import native
