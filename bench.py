@@ -317,9 +317,9 @@ def _make_trainer(
     cfg.decay_epoch = -1
     cfg.drop_rate = 0.5
     cfg.precision = precision
-    cfg.optim_kernel = path in ("ell", "blocked", "pallas")
-    cfg.kernel_tile = kernel_tile if path == "blocked" else 0
-    cfg.pallas_kernel = path == "pallas"
+    cfg.optim_kernel = path in ("ell", "blocked", "pallas", "bsp")
+    cfg.kernel_tile = kernel_tile if path in ("blocked", "bsp") else 0
+    cfg.pallas_kernel = path in ("pallas", "bsp")
     cls = GCNEagerTrainer if order == "eager" else GCNTrainer
     return cls.from_arrays(
         cfg, src, dst, datum, host_graph=host_graph,
@@ -484,12 +484,13 @@ def main(argv=None) -> int:
     )
     ap.add_argument(
         "--path", default="scatter",
-        choices=["scatter", "ell", "blocked", "pallas"],
+        choices=["scatter", "ell", "blocked", "pallas", "bsp"],
         help="aggregation backend: chunked sorted-scatter, ELL gather "
         "(the OPTIM_KERNEL toggle), source-tiled blocked ELL "
-        "(beyond-VMEM gather tables), or the fused Pallas ELL kernel "
-        "(VMEM-resident feature table; pair with --order eager at full "
-        "scale so aggregation runs at post-matmul widths)",
+        "(beyond-VMEM gather tables), the fused Pallas ELL kernel "
+        "(gathered table VMEM-resident, feature-column-chunked past the "
+        "budget — any width), or the streamed block-sparse Pallas kernel "
+        "(V-beyond-VMEM regime, ops/bsp_ell.py)",
     )
     ap.add_argument(
         "--kernel-tile", type=int, default=8192,

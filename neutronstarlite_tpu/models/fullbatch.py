@@ -61,11 +61,18 @@ class FullBatchTrainer(ToolkitBase):
             # it sees this path coming)
             self.graph = None
             from neutronstarlite_tpu.ops.blocked_ell import BlockedEllPair
+            from neutronstarlite_tpu.ops.bsp_ell import BspEllPair
             from neutronstarlite_tpu.ops.ell import EllPair
             from neutronstarlite_tpu.ops.pallas_kernels import PallasEllPair
 
             if self.host_ell is not None:
                 self.compute_graph = self.host_ell
+            elif cfg.kernel_tile > 0 and cfg.pallas_kernel:
+                # PALLAS:1 + KERNEL_TILE:vt -> the streamed block-sparse
+                # kernel (ops/bsp_ell.py), the V-beyond-VMEM Pallas regime
+                self.compute_graph = BspEllPair.from_host(
+                    self.host_graph, vt=cfg.kernel_tile
+                )
             elif cfg.kernel_tile > 0:
                 self.compute_graph = BlockedEllPair.from_host(
                     self.host_graph, vt=cfg.kernel_tile
@@ -75,12 +82,6 @@ class FullBatchTrainer(ToolkitBase):
             if cfg.pallas_kernel and isinstance(self.compute_graph, EllPair):
                 # same tables, fused-kernel executor (PALLAS:1)
                 self.compute_graph = PallasEllPair.from_pair(self.compute_graph)
-            elif cfg.pallas_kernel:
-                log.warning(
-                    "PALLAS:1 ignored: compute graph is %s, not an EllPair "
-                    "(PALLAS conflicts with KERNEL_TILE/blocked layouts)",
-                    type(self.compute_graph).__name__,
-                )
             if isinstance(self.compute_graph, BlockedEllPair):
                 log.info(
                     "OPTIM_KERNEL: blocked ELL aggregation (%d src tiles of "
@@ -95,6 +96,14 @@ class FullBatchTrainer(ToolkitBase):
                     "buckets, row_tile %d)",
                     len(self.compute_graph.fwd.nbr),
                     self.compute_graph.row_tile,
+                )
+            elif isinstance(self.compute_graph, BspEllPair):
+                log.info(
+                    "OPTIM_KERNEL: streamed block-sparse Pallas aggregation "
+                    "(%d fwd blocks, dt=%d vt=%d)",
+                    self.compute_graph.fwd.nbr.shape[0],
+                    self.compute_graph.fwd.dt,
+                    self.compute_graph.fwd.vt,
                 )
             else:
                 log.info(

@@ -119,18 +119,23 @@ def gather_dst_from_src(graph, x: jax.Array) -> jax.Array:
     TPU analog of the reference's optimized aggregation kernel toggle,
     cuda/ntsCUDAFuseKernel.cuh:154), or an ops.blocked_ell.BlockedEllPair
     (source-tiled ELL for beyond-VMEM feature tables, OPTIM_KERNEL:1 +
-    KERNEL_TILE:vt), or an ops.pallas_kernels.PallasEllPair (fused Pallas
-    kernel over the same ELL tables, OPTIM_KERNEL:1 + PALLAS:1)."""
+    KERNEL_TILE:vt), an ops.pallas_kernels.PallasEllPair (fused Pallas
+    kernel over the same ELL tables, OPTIM_KERNEL:1 + PALLAS:1), or an
+    ops.bsp_ell.BspEllPair (streamed block-sparse Pallas kernel for
+    V-beyond-VMEM graphs, OPTIM_KERNEL:1 + PALLAS:1 + KERNEL_TILE:vt)."""
     from neutronstarlite_tpu.ops.blocked_ell import (
         BlockedEllPair,
         blocked_gather_dst_from_src,
     )
+    from neutronstarlite_tpu.ops.bsp_ell import BspEllPair, bsp_gather_dst_from_src
     from neutronstarlite_tpu.ops.ell import EllPair, ell_gather_dst_from_src
     from neutronstarlite_tpu.ops.pallas_kernels import (
         PallasEllPair,
         pallas_gather_dst_from_src,
     )
 
+    if isinstance(graph, BspEllPair):
+        return bsp_gather_dst_from_src(graph, x)
     if isinstance(graph, BlockedEllPair):
         return blocked_gather_dst_from_src(graph, x)
     if isinstance(graph, PallasEllPair):
@@ -157,12 +162,15 @@ def gather_src_from_dst(graph, y: jax.Array) -> jax.Array:
         BlockedEllPair,
         blocked_gather_src_from_dst,
     )
+    from neutronstarlite_tpu.ops.bsp_ell import BspEllPair, bsp_gather_src_from_dst
     from neutronstarlite_tpu.ops.ell import EllPair, ell_gather_src_from_dst
     from neutronstarlite_tpu.ops.pallas_kernels import (
         PallasEllPair,
         pallas_gather_src_from_dst,
     )
 
+    if isinstance(graph, BspEllPair):
+        return bsp_gather_src_from_dst(graph, y)
     if isinstance(graph, BlockedEllPair):
         return blocked_gather_src_from_dst(graph, y)
     if isinstance(graph, PallasEllPair):

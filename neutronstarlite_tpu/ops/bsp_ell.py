@@ -1,0 +1,336 @@
+"""Block-sparse (dst-tile, src-tile) streamed Pallas aggregation.
+
+The regime ladder for the fused neighbor aggregation on one chip:
+
+1. [V, f] fits VMEM            -> ops/pallas_kernels.py (table resident)
+2. [V, 128] fits VMEM          -> same kernel, feature-column chunked
+3. V itself is beyond VMEM     -> THIS module (V ~ 10x Reddit and up)
+
+Here neither the feature table nor a 128-wide column of it fits on-chip,
+so the kernel streams BOTH sides: vertices are cut into destination tiles
+of ``dt`` rows and source tiles of ``vt`` rows; edges are packed into
+fixed-shape blocks, each block belonging to one (dst tile, src tile)
+pair. The pallas grid walks blocks sorted by destination tile with the
+[dt, f] output tile living in VMEM across every consecutive block of its
+tile (zeroed on first visit, spilled to HBM when the tile changes — the
+revisiting-output accumulation pattern), while the [vt, f] source slab is
+DMA-streamed per block via a scalar-prefetched block->tile map
+(``pltpu.PrefetchScalarGridSpec``). HBM traffic per application:
+O(E * 8 B) table reads + O(sum over dst tiles of present src tiles *
+vt * f) slab streams + O(V * f) output writes — versus O(E * f) random
+HBM gathers for the plain layout past VMEM.
+
+Block layout: each block is ``R`` rows of ``K`` slots. A row is (a piece
+of) one destination's in-edge run within one source tile: runs longer
+than K split into several rows (legal because every row's partial sum is
+accumulated). Rows store tile-LOCAL neighbor ids ``nbr`` [B, K, R] and
+weights ``wgt`` [B, K, R] (the K-major layout keeps R on the 128-lane
+axis), plus the row's tile-local destination ``ldst`` [B, R]. Padding
+rows/slots carry weight 0 and index 0, contributing nothing.
+
+The per-block combine is scatter-free BY CONSTRUCTION: row partial sums
+``acc`` [R, f] land in the output tile through a one-hot MXU matmul —
+``onehot(ldst) [dt, R] @ acc [R, f]`` — the TPU-idiomatic scatter (the
+MXU is the only unit that reorders data at full bandwidth; per-row
+dynamic stores would serialize). This is the cost that makes regime 2
+preferable whenever the row count allows: the matmul spends
+``dt * f * 2`` FLOPs per packed ROW (independent of K), so at Reddit
+scale (~7M rows, dt=512, f=602) it would burn ~4.2 TFLOP per application
+— slower than keeping a 128-wide column slab resident and gathering
+from VMEM. Past ~375k-row slabs there is no resident option; the matmul
+price buys streaming locality the plain layout cannot offer, and the
+multi-chip path (parallel/dist_ell.py) re-enters regime 1/2 per shard by
+cutting V by P. Reference analog: the shared-memory tiled CUDA
+aggregation (cuda/ntsCUDAFuseKernel.cuh:154-208) — re-derived for a
+memory system where the accumulator tile, not the source tile, is the
+scarce on-chip resource.
+
+Forward/backward pairing follows ops/ell.py: the backward is the same
+kernel over the transposed (CSR) layout, one ``custom_vjp``. Numeric
+policy: f32 row products, f32 accumulation (in-block and across blocks),
+one cast at the end. Off-TPU the kernel runs in interpret mode (tests).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+
+from neutronstarlite_tpu.graph.storage import CSCGraph
+from neutronstarlite_tpu.utils.logging import get_logger
+
+try:  # pallas TPU backend may be absent on pure-CPU builds
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PLTPU = True
+except Exception:  # pragma: no cover
+    _HAS_PLTPU = False
+
+log = get_logger("bsp_ell")
+
+DEFAULT_DT = 512  # dst tile rows (the VMEM-resident accumulator height)
+DEFAULT_VT = 4096  # src tile rows (the streamed slab height)
+DEFAULT_K = 8  # slots per packed row
+DEFAULT_R = 128  # rows per block (the 128-lane axis of the tables)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class BspEll:
+    """One direction's packed block tables (see module docstring)."""
+
+    nbr: jax.Array  # [B, K, R] int32 tile-local neighbor ids
+    wgt: jax.Array  # [B, K, R] f32 (0 on padding)
+    ldst: jax.Array  # [B, R] int32 tile-local destination row
+    blk_dst: jax.Array  # [B] int32 destination tile of each block
+    blk_src: jax.Array  # [B] int32 source tile of each block
+    v_num: int = dataclasses.field(metadata=dict(static=True))
+    dt: int = dataclasses.field(metadata=dict(static=True))
+    vt: int = dataclasses.field(metadata=dict(static=True))
+
+    @staticmethod
+    def build(
+        v_num: int,
+        offsets: np.ndarray,  # [V+1] per-dst adjacency offsets
+        adj: np.ndarray,  # [E] source ids, grouped by dst
+        weights: np.ndarray,  # [E]
+        dt: int = DEFAULT_DT,
+        vt: int = DEFAULT_VT,
+        k_slots: int = DEFAULT_K,
+        r_rows: int = DEFAULT_R,
+    ) -> "BspEll":
+        K, R = int(k_slots), int(r_rows)
+        t_dst = -(-v_num // dt)
+        t_src = -(-v_num // vt)
+        e_num = len(adj)
+        deg = np.diff(offsets).astype(np.int64)
+        dst_of_edge = np.repeat(np.arange(v_num, dtype=np.int64), deg)
+        adj = np.asarray(adj, dtype=np.int64)
+        weights = np.asarray(weights, dtype=np.float32)
+
+        if e_num:
+            # group edges by (dst tile, src tile); edges arrive dst-grouped,
+            # so a stable sort by the pair key keeps dst ascending per group
+            key = (dst_of_edge // dt) * t_src + adj // vt
+            order = np.argsort(key, kind="stable")
+            ks, ds = key[order], dst_of_edge[order]
+            ss, ws = adj[order], weights[order]
+
+            # (group, dst) runs -> packed rows of <= K slots each
+            change = (ks[1:] != ks[:-1]) | (ds[1:] != ds[:-1])
+            run_start = np.nonzero(np.concatenate([[True], change]))[0]
+            run_len = np.diff(np.concatenate([run_start, [e_num]]))
+            run_key, run_dst = ks[run_start], ds[run_start]
+            rows_of_run = -(-run_len // K)
+            n_rows = int(rows_of_run.sum())
+            row_of_first = np.concatenate([[0], np.cumsum(rows_of_run)[:-1]])
+            row_run = np.repeat(np.arange(len(run_start)), rows_of_run)
+            row_key = run_key[row_run]
+            row_dst = run_dst[row_run]
+
+            # rows are key-sorted; rank within key -> (block, slot)
+            key_change = np.nonzero(
+                np.concatenate([[True], row_key[1:] != row_key[:-1]])
+            )[0]
+            first_row_of_key = np.repeat(
+                key_change,
+                np.diff(np.concatenate([key_change, [n_rows]])),
+            )
+            rank = np.arange(n_rows) - first_row_of_key
+            # cumulative block count at each key group's start
+            grp_rows = np.diff(np.concatenate([key_change, [n_rows]]))
+            grp_blocks = -(-grp_rows // R)
+            grp_block_start = np.concatenate([[0], np.cumsum(grp_blocks)[:-1]])
+            blocks_before = np.repeat(grp_block_start, grp_rows)
+            row_block = blocks_before + rank // R
+            row_slot = rank % R
+            n_data_blocks = int(grp_blocks.sum())
+        else:
+            n_rows = n_data_blocks = 0
+            row_block = row_slot = row_dst = row_key = np.zeros(0, np.int64)
+
+        # every dst tile needs >= 1 block so its output tile gets zeroed
+        # (an unvisited pallas output block would be uninitialized memory)
+        present = np.zeros(t_dst, dtype=bool)
+        if n_data_blocks:
+            blk_first = np.nonzero(
+                np.concatenate([[True], row_block[1:] != row_block[:-1]])
+            )[0]
+            data_bd = (row_key[blk_first] // t_src).astype(np.int32)
+            data_bs = (row_key[blk_first] % t_src).astype(np.int32)
+            present[data_bd] = True
+        else:
+            data_bd = data_bs = np.zeros(0, np.int32)
+        filler = np.nonzero(~present)[0].astype(np.int32)
+        B = n_data_blocks + len(filler)
+
+        nbr = np.zeros((B, K, R), dtype=np.int32)
+        wgt = np.zeros((B, K, R), dtype=np.float32)
+        ldst = np.zeros((B, R), dtype=np.int32)
+        bd = np.concatenate([data_bd, filler])
+        bs = np.concatenate([data_bs, np.zeros(len(filler), np.int32)])
+
+        if e_num:
+            # per-edge placement: row-relative slot position
+            run_of_edge = np.repeat(np.arange(len(run_start)), run_len)
+            off = np.arange(e_num) - run_start[run_of_edge]
+            e_row = row_of_first[run_of_edge] + off // K
+            p = off % K
+            b_e = row_block[e_row]
+            s_e = row_slot[e_row]
+            nbr[b_e, p, s_e] = (ss - (ss // vt) * vt).astype(np.int32)
+            wgt[b_e, p, s_e] = ws
+            ldst[row_block, row_slot] = (row_dst - (row_dst // dt) * dt).astype(
+                np.int32
+            )
+            waste = B * K * R / max(e_num, 1)
+            log.info(
+                "bsp ELL: %d blocks [%d slots x %d rows], %d dst x %d src "
+                "tiles, %d packed rows, slot waste %.2fx",
+                B, K, R, t_dst, t_src, n_rows, waste,
+            )
+
+        # blocks sorted by dst tile (stable: data blocks keep their src-tile
+        # grouping) so output-tile revisits are consecutive
+        order_b = np.argsort(bd, kind="stable")
+        return BspEll(
+            nbr=jnp.asarray(nbr[order_b]),
+            wgt=jnp.asarray(wgt[order_b]),
+            ldst=jnp.asarray(ldst[order_b]),
+            blk_dst=jnp.asarray(bd[order_b]),
+            blk_src=jnp.asarray(bs[order_b]),
+            v_num=int(v_num),
+            dt=int(dt),
+            vt=int(vt),
+        )
+
+    def aggregate(self, x: jax.Array, interpret: bool = None) -> jax.Array:
+        """out[v] = sum over in-edges of w * x[src]; [V, f] -> [V, f]."""
+        if interpret is None:
+            interpret = jax.default_backend() not in ("tpu",)
+        f = x.shape[1]
+        t_dst = -(-self.v_num // self.dt)
+        t_src = -(-self.v_num // self.vt)
+        B = self.nbr.shape[0]
+        if B == 0 or f == 0:
+            return jnp.zeros((self.v_num, f), x.dtype)
+        xp = jnp.pad(x, ((0, t_src * self.vt - self.v_num), (0, 0)))
+        out = _bsp_call(
+            self.blk_dst, self.blk_src, self.nbr, self.wgt, self.ldst, xp,
+            dt=self.dt, vt=self.vt, t_dst=t_dst, interpret=interpret,
+        )
+        return out[: self.v_num].astype(x.dtype)
+
+
+def _bsp_kernel(bd_ref, bs_ref, nbr_ref, wgt_ref, ldst_ref, x_ref, o_ref, *, dt):
+    """One block: gather rows from the source slab, one-hot-matmul them
+    into the destination tile (zeroed on the tile's first visit)."""
+    b = pl.program_id(0)
+    prev = bd_ref[jnp.maximum(b - 1, 0)]
+
+    @pl.when(jnp.logical_or(b == 0, bd_ref[b] != prev))
+    def _init():
+        o_ref[:] = jnp.zeros_like(o_ref)
+
+    x = x_ref[:]  # [vt, f]
+    K, R = nbr_ref.shape[1], nbr_ref.shape[2]
+    f = x.shape[1]
+    acc = jnp.zeros((R, f), jnp.float32)
+    for k in range(K):  # K is a small static constant: full unroll
+        nb = nbr_ref[0, k, :]
+        wb = wgt_ref[0, k, :]
+        acc = acc + x[nb].astype(jnp.float32) * wb[:, None]
+    onehot = (
+        lax.broadcasted_iota(jnp.int32, (dt, R), 0) == ldst_ref[0, :][None, :]
+    ).astype(jnp.float32)
+    o_ref[:] += jnp.dot(onehot, acc, preferred_element_type=jnp.float32)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("dt", "vt", "t_dst", "interpret")
+)
+def _bsp_call(blk_dst, blk_src, nbr, wgt, ldst, xp, *, dt, vt, t_dst, interpret):
+    B, K, R = nbr.shape
+    f = xp.shape[1]
+    if not _HAS_PLTPU:  # pragma: no cover - exercised only on minimal builds
+        raise RuntimeError("pallas TPU backend unavailable for bsp_ell")
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # blk_dst, blk_src drive the index maps
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, K, R), lambda b, bd, bs: (b, 0, 0)),
+            pl.BlockSpec((1, K, R), lambda b, bd, bs: (b, 0, 0)),
+            pl.BlockSpec((1, R), lambda b, bd, bs: (b, 0)),
+            pl.BlockSpec((vt, f), lambda b, bd, bs: (bs[b], 0)),
+        ],
+        out_specs=pl.BlockSpec((dt, f), lambda b, bd, bs: (bd[b], 0)),
+    )
+    return pl.pallas_call(
+        functools.partial(_bsp_kernel, dt=dt),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((t_dst * dt, f), jnp.float32),
+        interpret=interpret,
+    )(blk_dst, blk_src, nbr, wgt, ldst, xp)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class BspEllPair:
+    """Forward (CSC) + backward (CSR) block tables, custom_vjp-paired."""
+
+    fwd: BspEll
+    bwd: BspEll
+
+    @staticmethod
+    def from_host(
+        g: CSCGraph,
+        dt: int = DEFAULT_DT,
+        vt: int = DEFAULT_VT,
+        k_slots: int = DEFAULT_K,
+        r_rows: int = DEFAULT_R,
+    ) -> "BspEllPair":
+        fwd = BspEll.build(
+            g.v_num, g.column_offset, g.row_indices, g.edge_weight_forward,
+            dt, vt, k_slots, r_rows,
+        )
+        bwd = BspEll.build(
+            g.v_num, g.row_offset, g.column_indices, g.edge_weight_backward,
+            dt, vt, k_slots, r_rows,
+        )
+        return BspEllPair(fwd=fwd, bwd=bwd)
+
+
+@jax.custom_vjp
+def _bsp_aggregate(fwd: BspEll, bwd: BspEll, x: jax.Array):
+    return fwd.aggregate(x)
+
+
+def _bsp_aggregate_fwd(fwd, bwd, x):
+    return fwd.aggregate(x), (fwd, bwd)
+
+
+def _bsp_aggregate_bwd(res, g):
+    from neutronstarlite_tpu.ops.segment import zero_cotangent
+
+    fwd, bwd = res
+    zero = jax.tree.map(zero_cotangent, (fwd, bwd))
+    return (*zero, bwd.aggregate(g))
+
+
+_bsp_aggregate.defvjp(_bsp_aggregate_fwd, _bsp_aggregate_bwd)
+
+
+def bsp_gather_dst_from_src(pair: BspEllPair, x: jax.Array) -> jax.Array:
+    """Streamed block-sparse weighted aggregation (custom_vjp-paired)."""
+    return _bsp_aggregate(pair.fwd, pair.bwd, x)
+
+
+def bsp_gather_src_from_dst(pair: BspEllPair, y: jax.Array) -> jax.Array:
+    """The CSR direction as a forward op."""
+    return _bsp_aggregate(pair.bwd, pair.fwd, y)

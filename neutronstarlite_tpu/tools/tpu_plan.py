@@ -12,7 +12,9 @@ Plan steps — ``--list`` is authoritative; in execution order:
   1. bench_full: north-star full-scale sweep + winner measurement (bench.py)
   2. tpu_tests: on-chip test module (tests/test_tpu.py, generous timeout)
   3. ell_chunk_{16,64,128}: NTS_ELL_CHUNK_MIB tuning on the eager/ELL path
-  4. eager_pallas / eager_blocked: the other full-scale kernel paths
+  4. eager_pallas / standard_pallas / eager_bsp / eager_blocked: the
+     other full-scale kernel paths (standard_pallas and eager_bsp are
+     round-3 kernels: f-chunked fused ELL and streamed block-sparse)
   5. bench_matrix: workload matrix over configs/ (tools/bench_matrix)
   6. sampled_bench: fan-out-sampled mini-batch at Reddit scale
   7. profile_trace: steady-state trace of standard/ELL (NTS_PROFILE_DIR)
@@ -101,6 +103,24 @@ def build_steps(out_dir: str):
             _bench("--order", "eager", "--path", "pallas"),
             1800,
             {"NTS_BENCH_DEADLINE_S": "1500"},
+        ),
+        (
+            # round 3: feature-column chunking made the fused Pallas kernel
+            # legal at the 602-wide STANDARD order (pallas_kernels.py) —
+            # the heaviest gather in the workload, previously XLA-fallback
+            "standard_pallas",
+            _bench("--order", "standard", "--path", "pallas"),
+            1800,
+            {"NTS_BENCH_DEADLINE_S": "1500"},
+        ),
+        (
+            # round 3: streamed block-sparse kernel (ops/bsp_ell.py) — the
+            # V-beyond-VMEM regime; timed at Reddit scale for the record
+            # even though the resident/f-chunked paths should win here
+            "eager_bsp",
+            _bench("--order", "eager", "--path", "bsp"),
+            2400,
+            {"NTS_BENCH_DEADLINE_S": "2100"},
         ),
         (
             "eager_blocked",
@@ -275,7 +295,7 @@ class Plan:
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument(
-        "--out", default=os.path.join(REPO, "docs", "perf_runs", "round2")
+        "--out", default=os.path.join(REPO, "docs", "perf_runs", "round3")
     )
     ap.add_argument("--poll-s", type=float, default=120.0)
     ap.add_argument("--max-wall-s", type=float, default=32400.0)
