@@ -1,0 +1,52 @@
+"""Dist DEBUGINFO: exchange-vs-compute attribution for the dist trainers.
+
+The reference's dist toolkits decompose the epoch into nn/graph/sync/copy
+buckets with host timers around every engine call
+(toolkits/GCN.hpp:308-353 DEBUGINFO). Under jit one fused program runs the
+whole step, so the split is recovered the way the single-chip trainer does
+it (FullBatchTrainer.debug_info): separately jitted programs, each a
+prefix of the real step —
+
+  nn_time        = forward with the graph exchange DISABLED (identity /
+                   zero exchange at the true layer widths: same matmuls,
+                   no collectives, no aggregation)
+  graph_time     = full forward - nn_time (mirror fetch / ring / edge ops)
+  backward_time  = value_and_grad - forward
+  update_time    = full train step - value_and_grad
+
+All programs run warm (compiled before timing) and report medians.
+Enabled by NTS_DEBUGINFO=1 on any dist trainer's run().
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from neutronstarlite_tpu.utils.timing import get_time
+
+
+def time_median(fn, args, n: int = 3) -> float:
+    """Median wall time of a jitted fn over n warm runs."""
+    jax.block_until_ready(fn(*args))
+    ts = []
+    for _ in range(n):
+        t0 = get_time()
+        jax.block_until_ready(fn(*args))
+        ts.append(get_time() - t0)
+    return float(np.median(ts))
+
+
+def format_dist_report(t_nn: float, t_fwd: float, t_grad: float,
+                       t_step: float) -> str:
+    """Reference-shaped report lines (GCN.hpp:310-333's #key=value(s)
+    format, the buckets that exist under XLA)."""
+    return "\n".join([
+        "DEBUGINFO:",
+        f"#nn_time={t_nn * 1000:.3f}(ms)",
+        f"#graph_time={max(t_fwd - t_nn, 0.0) * 1000:.3f}(ms)",
+        f"#forward_time={t_fwd * 1000:.3f}(ms)",
+        f"#backward_time={max(t_grad - t_fwd, 0.0) * 1000:.3f}(ms)",
+        f"#update_time={max(t_step - t_grad, 0.0) * 1000:.3f}(ms)",
+        f"#all_train_step_time={t_step * 1000:.3f}(ms)",
+    ])

@@ -39,13 +39,21 @@ from neutronstarlite_tpu.utils.timing import get_time
 log = get_logger("gat_dist")
 
 
-def dist_gat_layer(mesh, mg: MirrorGraph, tables, W, a, x, last: bool):
+def dist_gat_layer(mesh, mg: MirrorGraph, tables, W, a, x, last: bool,
+                   nn_only: bool = False):
     """One GAT layer in the distributed edge-op chain. ``mesh=None`` selects
-    the simulated (collective-free) ops."""
+    the simulated (collective-free) ops. ``nn_only`` replaces the whole
+    graph-op chain (mirror fetch + edge ops) with a zero aggregate at the
+    same shape — DEBUGINFO's nn_time program (models/debuginfo.py)."""
     h = x @ W  # [P*vp, f'] — local matmul, params replicated
     f = h.shape[1]
     al = h @ a[:f]  # [P*vp, 1] source half of the decomposed attention
     ar = h @ a[f:]  # [P*vp, 1] dst half
+    if nn_only:
+        # the [f', 1] attention matvecs al/ar may be DCE'd here; they are
+        # negligible next to the W matmul, so nn_time stays honest
+        out = jnp.zeros_like(h)
+        return out if last else jax.nn.relu(out)
     payload = jnp.concatenate([h, al], axis=1)
     if mesh is None:
         mir = deo.dist_get_dep_nbr_sim(mg, payload)  # [P, P*Mb, f'+1]
@@ -64,10 +72,14 @@ def dist_gat_layer(mesh, mg: MirrorGraph, tables, W, a, x, last: bool):
     return out if last else jax.nn.relu(out)
 
 
-def dist_gat_forward(mesh, mg, tables, params, x, key, drop_rate: float, train: bool):
+def dist_gat_forward(mesh, mg, tables, params, x, key, drop_rate: float,
+                     train: bool, nn_only: bool = False):
     n = len(params)
     for i, layer in enumerate(params):
-        x = dist_gat_layer(mesh, mg, tables, layer["W"], layer["a"], x, i == n - 1)
+        x = dist_gat_layer(
+            mesh, mg, tables, layer["W"], layer["a"], x, i == n - 1,
+            nn_only=nn_only,
+        )
         if train and i < n - 1:
             x = dropout(jax.random.fold_in(key, i), x, drop_rate, train)
     return x
@@ -152,6 +164,56 @@ class DistGATTrainer(ToolkitBase):
         self._train_step = train_step
         self._eval_logits = eval_logits
 
+        # DEBUGINFO programs (models/debuginfo.py)
+        def _loss(params, tables, feature, label, train01, key,
+                  nn_only=False):
+            logits = forward(mesh, mg, tables, params, feature, key,
+                             drop_rate, True, nn_only=nn_only)
+            return masked_nll(logits, label, train01)
+
+        @jax.jit
+        def fwd_loss(params, tables, feature, label, train01, key):
+            return _loss(params, tables, feature, label, train01, key)
+
+        @jax.jit
+        def fwd_nn_only(params, tables, feature, label, train01, key):
+            return _loss(params, tables, feature, label, train01, key,
+                         nn_only=True)
+
+        @jax.jit
+        def fwd_grad(params, tables, feature, label, train01, key):
+            return jax.value_and_grad(
+                lambda p: _loss(p, tables, feature, label, train01, key)
+            )(params)
+
+        self._dbg_fwd = fwd_loss
+        self._dbg_nn = fwd_nn_only
+        self._dbg_grad = fwd_grad
+
+    def debug_info(self, key, n: int = 3) -> str:
+        """Exchange-vs-compute attribution for the dist GAT step (the
+        reference dist toolkits' DEBUGINFO, GCN.hpp:308-353 /
+        GAT_CPU_DIST.hpp engine timers)."""
+        from neutronstarlite_tpu.models.debuginfo import (
+            format_dist_report,
+            time_median,
+        )
+
+        args = (
+            self.params, self.tables, self.feature_p, self.label_p,
+            self.train01_p, key,
+        )
+        t_nn = time_median(self._dbg_nn, args, n)
+        t_fwd = time_median(self._dbg_fwd, args, n)
+        t_grad = time_median(self._dbg_grad, args, n)
+        t_step = time_median(
+            self._train_step,
+            (self.params, self.opt_state, self.tables, self.feature_p,
+             self.label_p, self.train01_p, key),
+            n,
+        )
+        return format_dist_report(t_nn, t_fwd, t_grad, t_step)
+
     def run(self) -> Dict[str, Any]:
         cfg = self.cfg
         key = jax.random.PRNGKey(self.seed + 1)
@@ -187,6 +249,10 @@ class DistGATTrainer(ToolkitBase):
         accs = self.dist_eval_report(logits_p, self.label_p, self.mask_p, self.valid_p)
         avg = self.avg_epoch_time()
         log.info("--avg epoch time %.4f s", avg)
+        import os as _os
+
+        if _os.environ.get("NTS_DEBUGINFO", "0") == "1":
+            log.info("%s", self.debug_info(key))
         # loss is None when a checkpoint restore resumed at/after cfg.epochs
         # (zero epochs ran): still report the restored model's accuracy
         return {

@@ -62,6 +62,7 @@ def dist_gcn_forward(
     train: bool,
     layer_nn=gcn_layer_nn,
     eager: bool = False,
+    no_exchange: bool = False,
 ):
     """``blocks`` selects the exchange: the [P, P, Eb] 3-tuple is the
     ppermute ring, a DistEllPair is the OPTIM_KERNEL gather-only path, and
@@ -76,6 +77,10 @@ def dist_gcn_forward(
     traffic AND aggregation — then runs at the post-matmul width, 602->128
     on the Reddit layer stack, the bandwidth-right order for a TPU mesh when
     d_out < d_in."""
+    from neutronstarlite_tpu.parallel.dist_blocked import (
+        DistBlockedEllPair,
+        dist_blocked_gather_dst_from_src,
+    )
     from neutronstarlite_tpu.parallel.dist_edge_ops import (
         dist_gather_dst_from_src_mirror,
     )
@@ -85,6 +90,13 @@ def dist_gcn_forward(
     )
 
     def exchange(v):
+        if no_exchange:
+            # DEBUGINFO's nn-only program: identical layer widths and
+            # matmuls, the graph exchange replaced by identity — the
+            # nn_time/graph_time split (models/debuginfo.py)
+            return v
+        if isinstance(blocks, DistBlockedEllPair):
+            return dist_blocked_gather_dst_from_src(mesh, blocks, v)
         if isinstance(blocks, DistEllPair):
             return dist_ell_gather_dst_from_src(mesh, blocks, v)
         if isinstance(blocks, tuple) and len(blocks) == 5:
@@ -179,33 +191,51 @@ class DistGCNTrainer(ToolkitBase):
                 self.host_graph, P, edge_chunk=cfg.edge_chunk or None
             )
             stats = self.dist.padding_stats()
+            step_stats = self.dist.step_padding_stats()
             log.info(
-                "DistGraph [P=%d vp=%d eb=%d]: %d real edges, %.2fx block "
-                "padding (max block %d, mean %.0f)",
+                "DistGraph [P=%d vp=%d eb=%d]: %d real edges, %.2fx "
+                "step-major ring padding (uniform layout would be %.2fx; "
+                "max block %d, mean %.0f)",
                 P, self.dist.vp, self.dist.eb, stats["real_edges"],
-                stats["waste_ratio"], stats["max_block"], stats["mean_block"],
+                step_stats["waste_ratio"], stats["waste_ratio"],
+                stats["max_block"], stats["mean_block"],
             )
             if layer_kind == "ell":
-                from neutronstarlite_tpu.parallel.dist_ell import DistEllPair
-
                 if cfg.kernel_tile > 0:
-                    log.warning(
-                        "KERNEL_TILE:%d ignored on the distributed path — "
-                        "blocked ELL is single-device only for now (each "
-                        "shard's gather table is already 1/P-sized)",
-                        cfg.kernel_tile,
+                    # the gathered [P*vp, f] slab outgrows the fast gather
+                    # regime: source-tiled blocked tables per device
+                    # (parallel/dist_blocked.py, round-3 KERNEL_TILE-on-dist)
+                    from neutronstarlite_tpu.parallel.dist_blocked import (
+                        DistBlockedEllPair,
                     )
 
-                pair = DistEllPair.build(self.dist)
-                est = pair.padding_stats(stats["real_edges"])
-                self.blocks = pair.shard(self.mesh)
-                log.info(
-                    "OPTIM_KERNEL: dist gather-only aggregation "
-                    "(all_gather + %d-level ELL tables, %.2fx/%.2fx "
-                    "fwd/bwd slot padding)",
-                    len(self.blocks.fwd.nbr),
-                    est["fwd_waste_ratio"], est["bwd_waste_ratio"],
-                )
+                    pair = DistBlockedEllPair.build(
+                        self.dist, vt=cfg.kernel_tile
+                    )
+                    est = pair.padding_stats(stats["real_edges"])
+                    self.blocks = pair.shard(self.mesh)
+                    log.info(
+                        "OPTIM_KERNEL: dist blocked aggregation "
+                        "(all_gather + [P, %d-tile] stacked tables, "
+                        "%.2fx/%.2fx fwd/bwd slot padding)",
+                        self.blocks.fwd.n_tiles,
+                        est["fwd_waste_ratio"], est["bwd_waste_ratio"],
+                    )
+                else:
+                    from neutronstarlite_tpu.parallel.dist_ell import (
+                        DistEllPair,
+                    )
+
+                    pair = DistEllPair.build(self.dist)
+                    est = pair.padding_stats(stats["real_edges"])
+                    self.blocks = pair.shard(self.mesh)
+                    log.info(
+                        "OPTIM_KERNEL: dist gather-only aggregation "
+                        "(all_gather + %d-level ELL tables, %.2fx/%.2fx "
+                        "fwd/bwd slot padding)",
+                        len(self.blocks.fwd.nbr),
+                        est["fwd_waste_ratio"], est["bwd_waste_ratio"],
+                    )
             else:
                 self.blocks = self.dist.shard(self.mesh)
 
@@ -267,6 +297,58 @@ class DistGCNTrainer(ToolkitBase):
         self._train_step = train_step
         self._eval_logits = eval_logits
 
+        # DEBUGINFO programs (models/debuginfo.py): forward loss, the same
+        # forward with the exchange disabled (nn-only), and forward+grad
+        def _loss(params, blocks, feature, label, train01, valid, key,
+                  no_exchange=False):
+            logits = dist_gcn_forward(
+                mesh, dist, blocks, params, feature, valid, key, drop_rate,
+                True, layer_nn, eager, no_exchange=no_exchange,
+            )
+            return masked_nll(logits, label, train01)
+
+        @jax.jit
+        def fwd_loss(params, blocks, feature, label, train01, valid, key):
+            return _loss(params, blocks, feature, label, train01, valid, key)
+
+        @jax.jit
+        def fwd_nn_only(params, blocks, feature, label, train01, valid, key):
+            return _loss(params, blocks, feature, label, train01, valid, key,
+                         no_exchange=True)
+
+        @jax.jit
+        def fwd_grad(params, blocks, feature, label, train01, valid, key):
+            return jax.value_and_grad(
+                lambda p: _loss(p, blocks, feature, label, train01, valid, key)
+            )(params)
+
+        self._dbg_fwd = fwd_loss
+        self._dbg_nn = fwd_nn_only
+        self._dbg_grad = fwd_grad
+
+    def debug_info(self, key, n: int = 3) -> str:
+        """Exchange-vs-compute attribution for the dist step — the
+        reference dist toolkits' DEBUGINFO report (GCN.hpp:308-353)."""
+        from neutronstarlite_tpu.models.debuginfo import (
+            format_dist_report,
+            time_median,
+        )
+
+        args = (
+            self.params, self.blocks, self.feature_p, self.label_p,
+            self.train01_p, self.valid_p, key,
+        )
+        t_nn = time_median(self._dbg_nn, args, n)
+        t_fwd = time_median(self._dbg_fwd, args, n)
+        t_grad = time_median(self._dbg_grad, args, n)
+        t_step = time_median(
+            self._train_step,
+            (self.params, self.opt_state, self.blocks, self.feature_p,
+             self.label_p, self.train01_p, self.valid_p, key),
+            n,
+        )
+        return format_dist_report(t_nn, t_fwd, t_grad, t_step)
+
     def aot_args(self):
         """The exact argument tuple run() passes to the jitted train step
         (tools/aot_check parity hook)."""
@@ -325,6 +407,10 @@ class DistGCNTrainer(ToolkitBase):
             accs = self.dist_eval_report(logits_p, self.label_p, self.mask_p, self.valid_p)
         avg = self.avg_epoch_time()
         log.info("--avg epoch time %.4f s", avg)
+        import os as _os
+
+        if _os.environ.get("NTS_DEBUGINFO", "0") == "1":
+            log.info("%s", self.debug_info(key))
         # loss is None when a checkpoint restore resumed at/after cfg.epochs
         # (zero epochs ran): still report the restored model's accuracy
         return {

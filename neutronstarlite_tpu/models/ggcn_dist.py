@@ -25,11 +25,17 @@ from neutronstarlite_tpu.nn.layers import dropout
 from neutronstarlite_tpu.parallel import dist_edge_ops as deo
 
 
-def dist_ggcn_layer(mesh, mg, tables, layer, x, last: bool):
+def dist_ggcn_layer(mesh, mg, tables, layer, x, last: bool,
+                    nn_only: bool = False):
     h = x @ layer["W"]  # [P*vp, f']
     f = h.shape[1]
     hs = h @ layer["Ws"]  # source half of the decomposed edge NN
     hd = h @ layer["Wd"]  # dst half, stays local
+    if nn_only:
+        # DEBUGINFO nn-only program: graph-op chain replaced by a zero
+        # aggregate at the same shape (models/debuginfo.py)
+        out = jnp.zeros_like(h)
+        return out if last else jax.nn.relu(out)
     payload = jnp.concatenate([h, hs], axis=1)
     if mesh is None:
         mir = deo.dist_get_dep_nbr_sim(mg, payload)  # [P, P*Mb, 2f']
@@ -48,10 +54,12 @@ def dist_ggcn_layer(mesh, mg, tables, layer, x, last: bool):
     return out if last else jax.nn.relu(out)
 
 
-def dist_ggcn_forward(mesh, mg, tables, params, x, key, drop_rate: float, train: bool):
+def dist_ggcn_forward(mesh, mg, tables, params, x, key, drop_rate: float,
+                      train: bool, nn_only: bool = False):
     n = len(params)
     for i, layer in enumerate(params):
-        x = dist_ggcn_layer(mesh, mg, tables, layer, x, i == n - 1)
+        x = dist_ggcn_layer(mesh, mg, tables, layer, x, i == n - 1,
+                            nn_only=nn_only)
         if train and i < n - 1:
             x = dropout(jax.random.fold_in(key, i), x, drop_rate, train)
     return x

@@ -43,11 +43,12 @@ per-row K-reduction and the cross-tile scatter accumulator), one cast at
 the end. Byte budget: the [rows, K, f] gather intermediate is bounded by
 the same NTS_ELL_CHUNK_MIB budget, chunking level rows with an inner scan.
 
-Single-chip only by design: the distributed layouts (parallel/dist_ell.py,
-dist_graph.py) shard vertices first; this layout is what a shard uses
-locally when its feature slab outgrows VMEM. (The zeros-initialized scan
-carry would need the varying-axes peel under shard_map — see
-ops/aggregate._scatter_accumulate — if that ever changes.)
+Distributed use (round 3): the layout is rectangular — ``src_num`` may
+exceed ``v_num`` — so a device can aggregate its vp destination rows
+from the [P*vp] all_gathered source space (parallel/dist_blocked.py
+stacks per-device tables; KERNEL_TILE:vt on the dist trainers). Both
+scans peel their first iteration so the accumulator carry is varying
+under shard_map (the ops/aggregate._scatter_accumulate move).
 
 Enable per-trainer with ``OPTIM_KERNEL:1`` + ``KERNEL_TILE:<vt>`` (cfg), or
 pass a ``BlockedEllPair`` anywhere a graph/EllPair is accepted by
@@ -92,6 +93,10 @@ class BlockedEll:
     vt: int = dataclasses.field(metadata=dict(static=True))
     v_num: int = dataclasses.field(metadata=dict(static=True))
     n_tiles: int = dataclasses.field(metadata=dict(static=True))
+    # source-space row count when it differs from the destination space —
+    # the distributed path aggregates a device's vp destination rows from
+    # the [P*vp] all_gathered source space (parallel/dist_blocked.py)
+    src_num: int = dataclasses.field(default=0, metadata=dict(static=True))
 
     @staticmethod
     def build(
@@ -102,15 +107,21 @@ class BlockedEll:
         vt: int,
         slot_chunk: int = DEFAULT_SLOT_CHUNK,  # kept for API compat; byte
         # budget (NTS_ELL_CHUNK_MIB) governs chunking at trace time
+        src_num: int | None = None,  # source rows (default: square, = v_num)
     ) -> "BlockedEll":
         from neutronstarlite_tpu import native as native_rt
 
-        n_tiles = -(-v_num // vt)
+        src_num = v_num if src_num is None else int(src_num)
+        n_tiles = -(-src_num // vt)
         # int32 fast path: with T*V < 2^31 the (tile, dst) key fits int32,
         # halving the memory traffic of every pass AND letting numpy's
         # stable sort use its integer radix path — measured ~2x on the
         # full-scale 114.6M-edge build (1-core rig)
-        idx_t = np.int32 if n_tiles * v_num < 2**31 else np.int64
+        idx_t = (
+            np.int32
+            if max(n_tiles * v_num, src_num) < 2**31
+            else np.int64
+        )
         deg = np.diff(offsets).astype(np.int64)
         dst_of_edge = np.repeat(np.arange(v_num, dtype=idx_t), deg)
         adj = np.asarray(adj, dtype=idx_t)
@@ -119,6 +130,7 @@ class BlockedEll:
             return BlockedEll(
                 nbr=[], wgt=[], dst_row=[],
                 vt=int(vt), v_num=int(v_num), n_tiles=int(n_tiles),
+                src_num=src_num,
             )
 
         # sort edges by (source tile, dst): edges arrive row-grouped
@@ -213,18 +225,30 @@ class BlockedEll:
             vt=int(vt),
             v_num=int(v_num),
             n_tiles=int(n_tiles),
+            src_num=src_num,
         )
 
     def aggregate(self, x: jax.Array) -> jax.Array:
-        """out[v] = sum over in-edges of w * x[src]; [V, f] -> [V, f].
+        """out[v] = sum over in-edges of w * x[src]; [S, f] -> [V, f]
+        (S = src_num; square S == V on the single-chip path).
 
         One lax.scan over tiles; the carry is the [V, f] f32 accumulator
         (a vertex whose in-neighbors span many tiles must not round T
         times in a narrow dtype). Per level the [rows, K, f] gather
         intermediate is byte-bounded by chunking rows with an inner scan.
-        """
+
+        shard_map compatibility (the round-2 "varying-carry peel" note):
+        both scans peel their FIRST iteration outside the loop — under
+        shard_map a zeros-initialized carry is unvarying over the mesh
+        axis while the body's output (which mixes in sharded tables) is
+        varying, and lax.scan requires carry-in == carry-out varying
+        types. One data-dependent update before each scan makes the carry
+        varying without naming the mesh axis here (the same move as
+        ops/aggregate._scatter_accumulate, so this op runs identically
+        inside and outside shard_map)."""
         f = x.shape[1]
-        v_pad = self.n_tiles * self.vt - self.v_num
+        src_num = self.src_num or self.v_num
+        v_pad = self.n_tiles * self.vt - src_num
         xt = jnp.pad(x, ((0, v_pad), (0, 0))).reshape(self.n_tiles, self.vt, f)
         budget = _chunk_budget_bytes()
 
@@ -252,7 +276,10 @@ class BlockedEll:
             dr = jnp.pad(
                 dstr, (0, pad), constant_values=self.v_num
             ).reshape(n_ch, rows)
-            acc, _ = lax.scan(chunk_add, acc, (nb, wg, dr))
+            # first chunk outside the scan (varying-carry peel, see above)
+            acc, _ = chunk_add(acc, (nb[0], wg[0], dr[0]))
+            if n_ch > 1:
+                acc, _ = lax.scan(chunk_add, acc, (nb[1:], wg[1:], dr[1:]))
             return acc
 
         def body(acc, xs):
@@ -263,7 +290,11 @@ class BlockedEll:
 
         acc = jnp.zeros((self.v_num, f), jnp.float32)
         tables = list(zip(self.nbr, self.wgt, self.dst_row))
-        acc, _ = lax.scan(body, acc, (xt, tables))
+        # first tile outside the scan (varying-carry peel, see above)
+        acc, _ = body(acc, (xt[0], [(n[0], w[0], d[0]) for n, w, d in tables]))
+        if self.n_tiles > 1:
+            rest = [(n[1:], w[1:], d[1:]) for n, w, d in tables]
+            acc, _ = lax.scan(body, acc, (xt[1:], rest))
         return acc.astype(x.dtype)
 
 

@@ -39,6 +39,17 @@ from neutronstarlite_tpu.parallel.vertex_space import PaddedVertexSpace, round_u
 _round_up = round_up  # layout helper shared with MirrorGraph
 
 
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class RingBlocks:
+    """Step-major ring edge blocks: per ring step s, [P, Eb_s] arrays whose
+    row p is edge block (p, (p+s) % P) — see DistGraph.step_blocks."""
+
+    src: list
+    dst: list
+    wgt: list
+
+
 @dataclasses.dataclass
 class DistGraph(PaddedVertexSpace):
     """Host-side container; ``device_blocks()`` ships the block arrays."""
@@ -144,8 +155,81 @@ class DistGraph(PaddedVertexSpace):
             "mean_block": float(self.block_count.mean()),
         }
 
-    def shard(self, mesh) -> Tuple[jax.Array, jax.Array, jax.Array]:
-        """Device-put the block arrays sharded over the dst-partition axis."""
+    def step_blocks(self) -> "RingBlocks":
+        """Re-pack the [P, P, Eb] blocks into the ring's STEP-MAJOR device
+        layout: per ring step s, a [P, Eb_s] triple whose row p is block
+        (p, (p+s) % P), padded only to that step's cross-device max (and
+        the edge_chunk multiple the chunked scatter needs).
+
+        This is the round-3 padding bound (VERDICT round-2 item 6): the
+        uniform layout pads every block to the GLOBAL max — on a power-law
+        graph the dominant diagonal (local) blocks set Eb and every remote
+        block pays it. Per-step padding is the TPU-legal version of the
+        reference's per-chunk exact sizes (core/graph.hpp:1186-1211):
+        shapes stay static and identical across devices (SPMD), but each
+        step only pays its own diagonal's max. Bonus: the per-device body
+        indexes its row directly — no dynamic_index_in_dim over q."""
+        P = self.partitions
+        src_l, dst_l, w_l = [], [], []
+        for s, eb_s in enumerate(self._step_sizes()):
+            bs = np.zeros((P, eb_s), dtype=np.int32)
+            bd = np.zeros((P, eb_s), dtype=np.int32)
+            bw = np.zeros((P, eb_s), dtype=np.float32)
+            for p in range(P):
+                q = (p + s) % P
+                n = int(self.block_count[p, q])
+                bs[p, :n] = self.block_src[p, q, :n]
+                bd[p, :n] = self.block_dst[p, q, :n]
+                bw[p, :n] = self.block_weight[p, q, :n]
+            # host numpy: the single device transfer happens in shard()
+            # with the right layout (a jnp.asarray here would land every
+            # step's bytes on device 0 first, then copy again)
+            src_l.append(bs)
+            dst_l.append(bd)
+            w_l.append(bw)
+        return RingBlocks(src=src_l, dst=dst_l, wgt=w_l)
+
+    def _step_sizes(self) -> list:
+        """Per-ring-step padded block length Eb_s — the ONE source of the
+        step-major sizing rule (step_blocks and step_padding_stats share it
+        so the stats can never diverge from what the ring ships)."""
+        P = self.partitions
+        return [
+            _round_up(
+                max(
+                    max(int(self.block_count[p, (p + s) % P]) for p in range(P)),
+                    1,
+                ),
+                self.edge_chunk,
+            )
+            for s in range(P)
+        ]
+
+    def step_padding_stats(self) -> dict:
+        """Occupancy of the step-major layout (what the ring actually
+        ships to HBM), next to the uniform [P, P, Eb] layout's."""
+        padded = self.partitions * sum(self._step_sizes())
+        real = int(self.block_count.sum())
+        return {
+            "real_edges": real,
+            "padded_edges": padded,
+            "waste_ratio": padded / max(real, 1),
+        }
+
+    def shard(self, mesh) -> "RingBlocks":
+        """Device-put the step-major ring blocks sharded over devices."""
+        from jax.sharding import NamedSharding, PartitionSpec as PS
+
+        sh = NamedSharding(mesh, PS("p", None))
+        rb = self.step_blocks()
+        return RingBlocks(
+            src=[jax.device_put(a, sh) for a in rb.src],
+            dst=[jax.device_put(a, sh) for a in rb.dst],
+            wgt=[jax.device_put(a, sh) for a in rb.wgt],
+        )
+
+    def shard_dense(self, mesh) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        """The uniform [P, P, Eb] device layout (legacy/diagnostic path)."""
         from jax.sharding import NamedSharding, PartitionSpec as PS
 
         sh = NamedSharding(mesh, PS("p", None, None))

@@ -58,6 +58,24 @@ def _ring_aggregate_local(block_src, block_dst, block_weight, x_local, *,
     return acc
 
 
+def _ring_aggregate_local_steps(step_blocks, x_local, *,
+                                partitions: int, vp: int, edge_chunk: int):
+    """Step-major per-device body: step_blocks[s] = ([Eb_s] src, dst, w) —
+    already this device's block for ring step s (row p of the stacked
+    [P, Eb_s] arrays), so there is no dynamic block indexing and each step
+    pays only its own diagonal's padding (DistGraph.step_blocks)."""
+    acc = jnp.zeros((vp, x_local.shape[1]), dtype=x_local.dtype)
+    cur = x_local
+    fwd_perm = [(i, (i - 1) % partitions) for i in range(partitions)]
+    for s, (src, dst, w) in enumerate(step_blocks):
+        acc = _scatter_accumulate(
+            src, dst, w, cur, vp, edge_chunk, acc.dtype, acc=acc
+        )
+        if s != partitions - 1:
+            cur = lax.ppermute(cur, PARTITION_AXIS, fwd_perm)
+    return acc
+
+
 def dist_gather_dst_from_src(
     mesh: Mesh,
     partitions: int,
@@ -71,7 +89,37 @@ def dist_gather_dst_from_src(
     ``x`` is the padded [P*vp, f] feature array (sharded or shardable over
     axis 0); returns the aggregated array with the same layout. Differentiable
     (the backward is the reverse ring).
+
+    ``blocks`` is either a RingBlocks (step-major per-step [P, Eb_s]
+    triples, the production layout — DistGraph.shard) or the legacy
+    uniform ([P, P, Eb] src, dst, weight) triple.
     """
+    from neutronstarlite_tpu.parallel.dist_graph import RingBlocks
+
+    if isinstance(blocks, RingBlocks):
+        n_steps = len(blocks.src)
+
+        def local_steps(*args):
+            xs = args[-1]
+            # shard_map passes [1, Eb_s] rows; squeeze the device axis
+            steps = [
+                (args[s][0], args[n_steps + s][0], args[2 * n_steps + s][0])
+                for s in range(n_steps)
+            ]
+            return _ring_aggregate_local_steps(
+                steps, xs, partitions=partitions, vp=vp,
+                edge_chunk=edge_chunk,
+            )
+
+        fn = jax.shard_map(
+            local_steps,
+            mesh=mesh,
+            in_specs=tuple(PS(PARTITION_AXIS, None) for _ in range(3 * n_steps))
+            + (PS(PARTITION_AXIS, None),),
+            out_specs=PS(PARTITION_AXIS, None),
+        )
+        return fn(*blocks.src, *blocks.dst, *blocks.wgt, x)
+
     block_src, block_dst, block_weight = blocks
 
     body = partial(

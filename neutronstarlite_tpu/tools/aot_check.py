@@ -89,12 +89,20 @@ def _dist_gcn_case(cfg, base_dir, mesh):
         from neutronstarlite_tpu.parallel.dist_graph import DistGraph
 
         dist = DistGraph.build(host_graph, P, edge_chunk=cfg.edge_chunk or None)
-        if layer_kind == "ell":
+        if layer_kind == "ell" and cfg.kernel_tile > 0:
+            from neutronstarlite_tpu.parallel.dist_blocked import (
+                DistBlockedEllPair,
+            )
+
+            host_blocks = DistBlockedEllPair.build(dist, vt=cfg.kernel_tile)
+        elif layer_kind == "ell":
             from neutronstarlite_tpu.parallel.dist_ell import DistEllPair
 
             host_blocks = DistEllPair.build(dist)
         else:
-            host_blocks = (dist.block_src, dist.block_dst, dist.block_weight)
+            # step-major ring layout (DistGraph.step_blocks) — what the
+            # trainer ships since round 3
+            host_blocks = dist.step_blocks()
 
     vsh = NamedSharding(mesh, PS(PARTITION_AXIS, None))
     vsh1 = NamedSharding(mesh, PS(PARTITION_AXIS))

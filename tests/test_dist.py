@@ -292,3 +292,40 @@ def test_dist_eager_gcn_matches_single_chip(rng, comm_layer):
     np.testing.assert_allclose(
         dist_out["loss"], single_out["loss"], rtol=0.15, atol=0.05
     )
+
+
+@multidevice
+def test_dist_debuginfo_report(rng):
+    """Dist DEBUGINFO (models/debuginfo.py): the exchange-vs-compute split
+    must produce the reference-shaped report (#nn_time/#graph_time/...,
+    GCN.hpp:308-353) with finite, internally consistent numbers."""
+    from neutronstarlite_tpu.graph.dataset import GNNDatum
+    from neutronstarlite_tpu.graph.synthetic import planted_partition_graph
+    from neutronstarlite_tpu.models.gcn_dist import DistGCNTrainer
+    from neutronstarlite_tpu.utils.config import InputInfo
+
+    v_num, classes, f = 64, 3, 8
+    src, dst, feature, label = planted_partition_graph(
+        v_num, classes, avg_degree=8, feature_size=f, seed=2
+    )
+    mask = (np.arange(v_num) % 3).astype(np.int32)
+    datum = GNNDatum(feature=feature, label=label.astype(np.int32), mask=mask)
+    cfg = InputInfo()
+    cfg.vertices = v_num
+    cfg.layer_string = f"{f}-8-{classes}"
+    cfg.epochs = 2
+    cfg.decay_epoch = -1
+    cfg.drop_rate = 0.0
+    cfg.partitions = 2
+    tr = DistGCNTrainer.from_arrays(cfg, src, dst, datum)
+    tr.run()
+    report = tr.debug_info(jax.random.PRNGKey(0), n=1)
+    for line in ("#nn_time=", "#graph_time=", "#forward_time=",
+                 "#backward_time=", "#update_time=", "#all_train_step_time="):
+        assert line in report, report
+    vals = {
+        ln.split("=")[0]: float(ln.split("=")[1].split("(")[0])
+        for ln in report.splitlines() if ln.startswith("#")
+    }
+    assert all(np.isfinite(v) and v >= 0 for v in vals.values()), vals
+    assert vals["#all_train_step_time"] >= vals["#forward_time"] * 0.5
