@@ -98,6 +98,80 @@ class GNNDatum:
 
         return GNNDatum(feature=feature, label=label, mask=mask)
 
+    @staticmethod
+    def read_feature_label_mask_ogb(
+        feature_file: str,
+        label_file: str,
+        mask_dir: str,
+        v_num: int,
+        feature_size: int,
+        seed: int = 0,
+    ) -> "GNNDatum":
+        """OGB-converted layout (readFeature_Label_Mask_OGB,
+        core/ntsDataloador.hpp:223-303): the feature file is one
+        comma-separated line of ``feature_size`` floats per vertex (row i =
+        vertex i, no ID column), the label file one bare integer per
+        vertex, and ``mask_dir`` a DIRECTORY holding train.csv / valid.csv
+        / test.csv, each listing member vertex ids. Vertices in none of the
+        three lists get mask 3 (excluded from every split — the
+        reference's "unknown" value). Missing files degrade per-field with
+        the same loud fallback as the standard reader."""
+        rng = np.random.default_rng(seed)
+
+        if feature_file and os.path.exists(feature_file):
+            feature = np.loadtxt(
+                feature_file, dtype=np.float32, delimiter=",", ndmin=2
+            )
+            if feature.shape != (v_num, feature_size):
+                raise ValueError(
+                    f"{feature_file}: expected {(v_num, feature_size)}, "
+                    f"got {feature.shape}"
+                )
+        else:
+            if feature_file:
+                log.warning(
+                    "feature file %r missing — generating random features",
+                    feature_file,
+                )
+            feature = (
+                rng.standard_normal((v_num, feature_size), dtype=np.float32) * 0.1
+            )
+
+        if label_file and os.path.exists(label_file):
+            label = np.loadtxt(label_file, dtype=np.int64).reshape(-1)
+            if label.shape[0] != v_num:
+                raise ValueError(
+                    f"{label_file}: expected {v_num} labels, got {label.shape[0]}"
+                )
+            label = label.astype(np.int32)
+        else:
+            if label_file:
+                log.warning(
+                    "label file %r missing — generating random labels", label_file
+                )
+            label = rng.integers(0, 2, size=v_num, dtype=np.int32)
+
+        mask = np.full(v_num, 3, dtype=np.int32)  # 3 = in no split
+        names = (("train.csv", MASK_TRAIN), ("valid.csv", MASK_VAL),
+                 ("test.csv", MASK_TEST))
+        if mask_dir and os.path.isdir(mask_dir):
+            for name, val in names:
+                p = os.path.join(mask_dir, name)
+                if not os.path.exists(p):
+                    log.warning("mask split %r missing — split left empty", p)
+                    continue
+                ids = np.loadtxt(p, dtype=np.int64, delimiter=",", ndmin=1)
+                mask[ids.reshape(-1)] = val
+        else:
+            if mask_dir:
+                log.warning(
+                    "mask dir %r missing — falling back to mask = id %% 3",
+                    mask_dir,
+                )
+            mask = (np.arange(v_num) % 3).astype(np.int32)
+
+        return GNNDatum(feature=feature, label=label, mask=mask)
+
     def label_num(self) -> int:
         return int(self.label.max()) + 1
 

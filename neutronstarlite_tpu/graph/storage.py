@@ -99,6 +99,25 @@ def load_edges(path: str) -> Tuple[np.ndarray, np.ndarray]:
     return load_edges_binary(path)
 
 
+def load_undirected_from_directed(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    """Load a directed edge list and symmetrize it: every stored edge
+    (u, v) yields both (u, v) and (v, u).
+
+    Reference: Graph::load_undirected_from_directed (core/graph.hpp:640),
+    which bumps BOTH endpoint degrees per stored edge — so a stored self
+    loop contributes twice there, and matching that exactly would double
+    its weight. We keep one copy of each self loop (the aggregation
+    semantics users actually want from "make it undirected") and document
+    the deviation here. Format sniffing is shared with ``load_edges``.
+    """
+    src, dst = load_edges(path)
+    rev = src != dst
+    return (
+        np.concatenate([src, dst[rev]]),
+        np.concatenate([dst, src[rev]]),
+    )
+
+
 def gcn_norm_weights(
     src: np.ndarray, dst: np.ndarray, out_degree: np.ndarray, in_degree: np.ndarray
 ) -> np.ndarray:

@@ -102,7 +102,16 @@ class ToolkitBase:
         cfg = self.cfg
         edge_path = cfg.resolve_path(cfg.edge_file, self.base_dir)
         with self.timers.phase("graph_load"):
-            src, dst = load_edges(edge_path)
+            if getattr(cfg, "undirected", False):
+                # UNDIRECTED:1 — symmetrize at load
+                # (load_undirected_from_directed, core/graph.hpp:640)
+                from neutronstarlite_tpu.graph.storage import (
+                    load_undirected_from_directed,
+                )
+
+                src, dst = load_undirected_from_directed(edge_path)
+            else:
+                src, dst = load_edges(edge_path)
             self.host_graph = build_graph(
                 src, dst, cfg.vertices, weight=self.weight_mode
             )
@@ -122,10 +131,20 @@ class ToolkitBase:
         cfg = self.cfg
         sizes = cfg.layer_sizes()
         with self.timers.phase("datum_load"):
-            self.datum = GNNDatum.read_feature_label_mask(
+            mask_path = cfg.resolve_path(cfg.mask_file, self.base_dir)
+            fmt = getattr(cfg, "data_format", "auto")
+            use_ogb = fmt == "ogb" or (
+                fmt == "auto" and bool(mask_path) and os.path.isdir(mask_path)
+            )
+            reader = (
+                GNNDatum.read_feature_label_mask_ogb
+                if use_ogb
+                else GNNDatum.read_feature_label_mask
+            )
+            self.datum = reader(
                 cfg.resolve_path(cfg.feature_file, self.base_dir),
                 cfg.resolve_path(cfg.label_file, self.base_dir),
-                cfg.resolve_path(cfg.mask_file, self.base_dir),
+                mask_path,
                 cfg.vertices,
                 sizes[0],
                 seed=self.seed,

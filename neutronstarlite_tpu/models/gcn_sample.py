@@ -62,7 +62,12 @@ class GCNSampleTrainer(ToolkitBase):
     # would waste gigabytes at Reddit scale for arrays never touched
     needs_device_graph = False
 
-    def build_model(self) -> None:
+    def _finalize_datum(self) -> None:
+        # the training batch stream (sample/parallel.py) forks its
+        # persistent worker pool — that must happen BEFORE the first JAX
+        # backend touch (the jnp.asarray datum upload in the base method):
+        # forking after PJRT's runtime threads exist risks a deadlocked
+        # child (module docstring's fork-safety note)
         cfg = self.cfg
         sizes = cfg.layer_sizes()
         fanouts = cfg.fanouts()
@@ -72,6 +77,25 @@ class GCNSampleTrainer(ToolkitBase):
         # ships FANOUT:5-10-10 with LAYERS:1433-256-7); use the last n_layers
         n_layers = len(sizes) - 1
         self.fanouts = fanouts[-n_layers:]
+        from neutronstarlite_tpu.sample.parallel import ParallelEpochSampler
+
+        # one object for every worker count (workers=0 runs inline): the
+        # per-(epoch, index) seeding makes the batch sequence bit-identical
+        # regardless, so worker count is a pure throughput knob
+        self.par_sampler = ParallelEpochSampler(
+            self.host_graph,
+            np.where(self.datum.mask == 0)[0],
+            cfg.batch_size,
+            self.fanouts,
+            seed=self.seed,
+        )
+        self.sample_workers = self.par_sampler.workers
+        super()._finalize_datum()
+
+    def build_model(self) -> None:
+        cfg = self.cfg
+        sizes = cfg.layer_sizes()
+        n_layers = len(sizes) - 1  # self.fanouts set in _finalize_datum
         key = jax.random.PRNGKey(self.seed)
         params = []
         for i in range(n_layers):
@@ -86,7 +110,9 @@ class GCNSampleTrainer(ToolkitBase):
         )
         self.opt_state = adam_init(self.params)
 
-        # train/val/test samplers from mask nids (GCN_CPU_SAMPLE.hpp:251-265)
+        # train/val/test samplers from mask nids (GCN_CPU_SAMPLE.hpp:251-265);
+        # eval streams are sequential (shuffle=False), training batches come
+        # from self.par_sampler above
         self.samplers = {
             which: Sampler(
                 self.host_graph,
@@ -165,14 +191,15 @@ class GCNSampleTrainer(ToolkitBase):
         cfg = self.cfg
         key = jax.random.PRNGKey(self.seed + 1)
         log.info(
-            "GNNmini::Engine[TPU.GCNSampleimpl] B=%d fanout=%s [%d] Epochs",
-            cfg.batch_size, self.fanouts, cfg.epochs,
+            "GNNmini::Engine[TPU.GCNSampleimpl] B=%d fanout=%s [%d] Epochs "
+            "(%d sample workers)",
+            cfg.batch_size, self.fanouts, cfg.epochs, self.sample_workers,
         )
         loss = None
         for epoch in range(cfg.epochs):
             t0 = get_time()
             losses = []
-            for bi, b in enumerate(self.samplers[0].sample_epoch()):
+            for bi, b in enumerate(self.par_sampler.sample_epoch(epoch)):
                 nodes, hops, seed_mask, seeds = _batch_arrays(b)
                 bkey = jax.random.fold_in(key, epoch * 100003 + bi)
                 self.params, self.opt_state, loss = self._train_batch(
@@ -187,6 +214,10 @@ class GCNSampleTrainer(ToolkitBase):
                     "Epoch %d loss %f (%d batches)",
                     epoch, float(np.mean([float(l) for l in losses])), len(losses),
                 )
+        # training is done: release the sampling worker pool (a sweep that
+        # builds many trainers must not accumulate forked children; a
+        # second run() on the same trainer samples inline, same batches)
+        self.par_sampler.close()
         accs = {
             "train": self._evaluate(0, key),
             "eval": self._evaluate(1, key),

@@ -112,6 +112,13 @@ class InputInfo:
     cache_budget_mib: int = 256  # HBM budget/device for the replicated rows
     cache_refresh: int = 1  # epochs between deep-layer cache refreshes
     sublinear: bool = False  # activation recomputation (ntsSubLinearNNOP)
+    undirected: bool = False  # UNDIRECTED:1 -> symmetrize the edge list at
+    # load (both directions of every stored edge), the reference's
+    # load_undirected_from_directed (core/graph.hpp:640)
+    data_format: str = "auto"  # DATA_FORMAT: nts (ID-prefixed text tables,
+    # readFeature_Label_Mask) | ogb (CSV features, bare labels, mask DIR of
+    # train/valid/test.csv — readFeature_Label_Mask_OGB,
+    # core/ntsDataloador.hpp:223) | auto (ogb iff MASK_FILE is a directory)
     comm_layer: str = "auto"  # dist aggregation exchange: ring (dense
     # ppermute rotation), ell (all_gather + gather-only ELL, the OPTIM_KERNEL
     # path), mirror (compacted active-mirror all_to_all — the analog of the
@@ -122,7 +129,9 @@ class InputInfo:
     # table [vt, f] is sized to stay in the fast on-chip regime at any V
     pallas_kernel: bool = False  # OPTIM_KERNEL:1 + PALLAS:1 -> run the ELL
     # aggregation through the fused Pallas kernel (ops/pallas_kernels.py)
-    # instead of the XLA gather+reduce; same tables, same numeric policy
+    # instead of the XLA gather+reduce; same tables, same numeric policy.
+    # PALLAS:1 + KERNEL_TILE:vt -> the streamed block-sparse Pallas kernel
+    # (ops/bsp_ell.py), the single-chip V-beyond-VMEM regime
     edge_chunk: int = 0  # scatter-path edge chunk size (0 = auto); applies
     # to the chunked-scatter layouts (DeviceGraph, DistGraph) — the ELL and
     # mirror-slot layouts have their own slot sizing. Tests/dryruns set it
@@ -219,6 +228,10 @@ class InputInfo:
             self.edge_chunk = int(value)
         elif key == "COMM_LAYER":
             self.comm_layer = value.strip().lower()
+        elif key == "UNDIRECTED":
+            self.undirected = bool(int(value))
+        elif key == "DATA_FORMAT":
+            self.data_format = value.strip().lower()
         # unknown keys ignored, matching the reference's else-silence
 
     def layer_sizes(self) -> List[int]:

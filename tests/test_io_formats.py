@@ -1,0 +1,102 @@
+"""Dataset-IO variants: the OGB-converted reader and the undirected
+loader (VERDICT round-2 missing item 4).
+
+References: readFeature_Label_Mask_OGB (core/ntsDataloador.hpp:223-303)
+and Graph::load_undirected_from_directed (core/graph.hpp:640).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from neutronstarlite_tpu.graph.dataset import GNNDatum
+from neutronstarlite_tpu.graph.storage import (
+    build_graph,
+    load_undirected_from_directed,
+)
+
+
+def _write_ogb_fixture(tmp_path, v_num=6, f=3):
+    feat = np.arange(v_num * f, dtype=np.float32).reshape(v_num, f) / 10
+    with open(tmp_path / "feat.csv", "w") as fh:
+        for row in feat:
+            fh.write(",".join(f"{x:.4f}" for x in row) + "\n")
+    label = np.array([0, 1, 2, 0, 1, 2], dtype=np.int64)
+    np.savetxt(tmp_path / "labels.txt", label, fmt="%d")
+    mask_dir = tmp_path / "split"
+    os.makedirs(mask_dir)
+    np.savetxt(mask_dir / "train.csv", [0, 1], fmt="%d")
+    np.savetxt(mask_dir / "valid.csv", [2], fmt="%d")
+    np.savetxt(mask_dir / "test.csv", [3, 4], fmt="%d")
+    return feat, label, mask_dir
+
+
+def test_ogb_reader_roundtrip(tmp_path):
+    feat, label, mask_dir = _write_ogb_fixture(tmp_path)
+    d = GNNDatum.read_feature_label_mask_ogb(
+        str(tmp_path / "feat.csv"), str(tmp_path / "labels.txt"),
+        str(mask_dir), 6, 3,
+    )
+    np.testing.assert_allclose(d.feature, feat, atol=1e-4)
+    np.testing.assert_array_equal(d.label, label)
+    # vertex 5 is in no split -> mask 3 (excluded everywhere)
+    np.testing.assert_array_equal(d.mask, [0, 0, 1, 2, 2, 3])
+    assert d.mask_tensor(0).sum() == 2 and d.mask_tensor(2).sum() == 2
+
+
+def test_ogb_reader_selected_by_mask_dir(tmp_path):
+    """base.init_nn auto-detects OGB when MASK_FILE is a directory."""
+    from neutronstarlite_tpu.models.gcn import GCNTrainer
+    from neutronstarlite_tpu.utils.config import InputInfo
+
+    feat, label, mask_dir = _write_ogb_fixture(tmp_path)
+    src = np.array([0, 1, 2, 3, 4, 5], np.uint32)
+    dst = np.array([1, 2, 3, 4, 5, 0], np.uint32)
+    with open(tmp_path / "g.edge", "w") as fh:
+        for s, t in zip(src, dst):
+            fh.write(f"{s} {t}\n")
+    cfg = InputInfo()
+    cfg.algorithm = "GCNCPU"
+    cfg.vertices = 6
+    cfg.layer_string = "3-4-3"
+    cfg.epochs = 2
+    cfg.decay_epoch = -1
+    cfg.drop_rate = 0.0
+    cfg.edge_file = str(tmp_path / "g.edge")
+    cfg.feature_file = str(tmp_path / "feat.csv")
+    cfg.label_file = str(tmp_path / "labels.txt")
+    cfg.mask_file = str(mask_dir)
+    tr = GCNTrainer(cfg)
+    tr.init_graph()
+    tr.init_nn()
+    np.testing.assert_allclose(tr.datum.feature, feat, atol=1e-4)
+    np.testing.assert_array_equal(tr.datum.mask, [0, 0, 1, 2, 2, 3])
+
+
+def test_data_format_cfg_key(tmp_path):
+    from neutronstarlite_tpu.utils.config import InputInfo
+
+    p = tmp_path / "c.cfg"
+    p.write_text("ALGORITHM:GCNCPU\nDATA_FORMAT:ogb\nUNDIRECTED:1\n")
+    cfg = InputInfo.read_from_cfg_file(str(p))
+    assert cfg.data_format == "ogb"
+    assert cfg.undirected is True
+
+
+def test_undirected_loader_symmetrizes(tmp_path):
+    p = tmp_path / "d.edge"
+    # includes a self loop (kept single) and a duplicate-direction pair
+    p.write_text("0 1\n2 2\n1 0\n3 4\n")
+    src, dst = load_undirected_from_directed(str(p))
+    g = build_graph(src, dst, 5, weight="ones")
+    dense = np.zeros((5, 5))
+    np.add.at(dense, (dst.astype(int), src.astype(int)), 1.0)
+    # symmetric adjacency
+    np.testing.assert_array_equal(dense, dense.T)
+    # 0<->1 stored both ways -> weight 2 each direction; self loop single
+    assert dense[1, 0] == 2 and dense[0, 1] == 2
+    assert dense[2, 2] == 1
+    assert dense[4, 3] == 1 and dense[3, 4] == 1
+    assert g.e_num == 7  # 4 stored + 3 reverses (self loop not doubled)

@@ -107,3 +107,125 @@ def test_native_hub_sampling_distinct_and_uniform():
     # sane (no neighbor hugely over-represented, total conserved)
     assert counts.sum() == trials * fanout
     assert counts.max() <= 8, counts.max()  # P(X >= 9) astronomically small
+
+
+def _batches_equal(a, b):
+    assert len(a) == len(b)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x.seeds, y.seeds)
+        np.testing.assert_array_equal(x.seed_mask, y.seed_mask)
+        for nx, ny in zip(x.nodes, y.nodes):
+            np.testing.assert_array_equal(nx, ny)
+        for hx, hy in zip(x.hops, y.hops):
+            np.testing.assert_array_equal(hx.src_local, hy.src_local)
+            np.testing.assert_array_equal(hx.dst_local, hy.dst_local)
+            np.testing.assert_allclose(hx.weight, hy.weight)
+            assert hx.n_dst == hy.n_dst
+
+
+def test_parallel_sampler_worker_count_is_pure_throughput(rng):
+    """sample/parallel.py contract: batches are seeded per (epoch, index),
+    so 0, 1 and 3 workers must produce BIT-IDENTICAL epochs in order."""
+    from neutronstarlite_tpu.graph.storage import build_graph
+    from neutronstarlite_tpu.sample.parallel import ParallelEpochSampler
+
+    V, E = 300, 2400
+    src = rng.integers(0, V, size=E, dtype=np.uint32)
+    dst = rng.integers(0, V, size=E, dtype=np.uint32)
+    g = build_graph(src, dst, V, weight="gcn_norm")
+    seeds = np.arange(0, V, 2)
+
+    def epoch(workers, e=1):
+        # force_workers: jax is already live in the pytest process; the
+        # CPU rig tolerates the fork and this is exactly the mp-path test
+        s = ParallelEpochSampler(
+            g, seeds, 32, [4, 3], seed=9, workers=workers, force_workers=True
+        )
+        try:
+            return list(s.sample_epoch(e))
+        finally:
+            s.close()
+
+    inline = epoch(0)
+    assert len(inline) == -(-len(seeds) // 32)
+    _batches_equal(inline, epoch(1))
+    _batches_equal(inline, epoch(3))
+    # different epoch -> different shuffle/samples
+    other = epoch(0, e=2)
+    assert any(
+        not np.array_equal(a.seeds, b.seeds) for a, b in zip(inline, other)
+    )
+
+
+def test_parallel_sampler_trains():
+    """GCNSampleTrainer with multi-worker sampling, in a PRISTINE process
+    (the production shape: the pool forks before the first JAX backend
+    touch, so the fork-safety gate stays open) — must converge and report
+    the worker count it was given."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    prog = r"""
+import json
+import numpy as np
+from neutronstarlite_tpu.utils.platform import honor_platform_env
+honor_platform_env()
+from neutronstarlite_tpu.graph.dataset import GNNDatum
+from neutronstarlite_tpu.graph.synthetic import planted_partition_graph
+from neutronstarlite_tpu.models.gcn_sample import GCNSampleTrainer
+from neutronstarlite_tpu.utils.config import InputInfo
+
+v_num, classes, f = 240, 3, 12
+src, dst, feature, label = planted_partition_graph(
+    v_num, classes, avg_degree=10, feature_size=f, seed=4
+)
+mask = (np.arange(v_num) % 3).astype(np.int32)
+datum = GNNDatum(feature=feature, label=label.astype(np.int32), mask=mask)
+cfg = InputInfo()
+cfg.algorithm = "GCNSAMPLESINGLE"
+cfg.vertices = v_num
+cfg.layer_string = f"{f}-16-{classes}"
+cfg.fanout_string = "4-4"
+cfg.batch_size = 32
+cfg.epochs = 10
+cfg.learn_rate = 0.02
+cfg.drop_rate = 0.0
+cfg.decay_epoch = -1
+tr = GCNSampleTrainer.from_arrays(cfg, src, dst, datum)
+result = tr.run()
+print(json.dumps({
+    "workers": tr.sample_workers,
+    "train_acc": result["acc"]["train"],
+}))
+"""
+    env = dict(os.environ)
+    env["NTS_SAMPLE_WORKERS"] = "2"
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, "-c", prog], capture_output=True, text=True,
+        timeout=300, env=env,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["workers"] == 2, out
+    assert out["train_acc"] > 0.8, out
+
+
+def test_parallel_sampler_degrades_inline_with_live_jax(rng):
+    """With a live JAX backend in-process (this pytest process) the pool
+    must refuse to fork by default and degrade to inline sampling."""
+    import jax
+
+    jax.random.PRNGKey(0)  # ensure the backend is initialized
+    from neutronstarlite_tpu.graph.storage import build_graph
+    from neutronstarlite_tpu.sample.parallel import ParallelEpochSampler
+
+    V = 64
+    src = rng.integers(0, V, size=300, dtype=np.uint32)
+    dst = rng.integers(0, V, size=300, dtype=np.uint32)
+    g = build_graph(src, dst, V, weight="gcn_norm")
+    s = ParallelEpochSampler(g, np.arange(V), 16, [3], seed=1, workers=4)
+    assert s.workers == 0 and s._in_q is None
+    assert len(list(s.sample_epoch(0))) == 4
