@@ -111,6 +111,23 @@ def _aggregate_bwd(v_num, edge_chunk, res, g):
 _aggregate.defvjp(_aggregate_fwd, _aggregate_bwd)
 
 
+def _lane_pad_width(f: int) -> int:
+    """The eager/scatter full-scale cliff fence (docs/PERF.md section 2a:
+    eager/scatter measured 15x slower than standard/scatter at full Reddit
+    scale ONLY — the 41-wide scatter-add over 114.6M updates appears to
+    fall out of XLA's vectorized sorted-update regime below the 128-lane
+    width). Hypothesis-fix: pad narrow features to the lane width before
+    the scatter and slice after — 3x slot traffic at f=41 in exchange for
+    the vectorized regime. Gated by NTS_SCATTER_LANE_PAD=1 until the
+    on-chip A/B (tpu_plan step eager_scatter_fence) decides the default;
+    returns the padded width, or f when the fence is off / not applicable."""
+    import os
+
+    if f >= 128 or os.environ.get("NTS_SCATTER_LANE_PAD", "0") != "1":
+        return f
+    return 128
+
+
 def gather_dst_from_src(graph, x: jax.Array) -> jax.Array:
     """out[v] = sum over in-edges (u -> v) of w_uv * x[u].  [V, f] -> [V, f].
 
@@ -142,7 +159,11 @@ def gather_dst_from_src(graph, x: jax.Array) -> jax.Array:
         return pallas_gather_dst_from_src(graph, x)
     if isinstance(graph, EllPair):
         return ell_gather_dst_from_src(graph, x)
-    return _aggregate(
+    f = x.shape[1]
+    fp = _lane_pad_width(f)
+    if fp != f:
+        x = jnp.pad(x, ((0, 0), (0, fp - f)))
+    out = _aggregate(
         graph.v_num,
         graph.edge_chunk,
         graph.csc_src,
@@ -153,6 +174,7 @@ def gather_dst_from_src(graph, x: jax.Array) -> jax.Array:
         graph.csr_weight,
         x,
     )
+    return out[:, :f] if fp != f else out
 
 
 def gather_src_from_dst(graph, y: jax.Array) -> jax.Array:

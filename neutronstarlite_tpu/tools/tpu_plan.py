@@ -131,6 +131,16 @@ def build_steps(out_dir: str):
             {"NTS_BENCH_DEADLINE_S": "3300"},
         ),
         (
+            # round 3: A/B for the eager/scatter full-scale cliff fence
+            # (docs/PERF.md 2a anomaly; ops/aggregate._lane_pad_width).
+            # bench_full's sweep times plain eager/scatter; this step times
+            # the lane-padded variant — together they decide the default
+            "eager_scatter_fence",
+            _bench("--order", "eager", "--path", "scatter", epochs=2),
+            1800,
+            {"NTS_SCATTER_LANE_PAD": "1", "NTS_BENCH_DEADLINE_S": "1500"},
+        ),
+        (
             "bench_matrix",
             [sys.executable, "-m", "neutronstarlite_tpu.tools.bench_matrix",
              "--configs", os.path.join(REPO, "configs"),

@@ -121,3 +121,28 @@ def test_padding_edges_contribute_nothing(rng):
     np.testing.assert_allclose(
         np.asarray(out), dense @ x.astype(np.float64), rtol=1e-4, atol=1e-4
     )
+
+
+def test_scatter_lane_pad_fence_parity(rng, monkeypatch):
+    """NTS_SCATTER_LANE_PAD=1 (the eager/scatter cliff fence, PERF.md 2a)
+    pads narrow features to the lane width around the scatter — values and
+    gradients must be unchanged."""
+    import jax
+
+    from neutronstarlite_tpu.ops.aggregate import gather_dst_from_src
+    from neutronstarlite_tpu.ops.device_graph import DeviceGraph
+    from tests.conftest import tiny_graph
+
+    g, dense = tiny_graph(rng, v_num=37, e_num=260)
+    dg = DeviceGraph.from_host(g)
+    x = jnp.asarray(rng.standard_normal((g.v_num, 41)).astype(np.float32))
+    c = jnp.asarray(rng.standard_normal((g.v_num, 41)).astype(np.float32))
+
+    plain = gather_dst_from_src(dg, x)
+    g_plain = jax.grad(lambda v: (gather_dst_from_src(dg, v) * c).sum())(x)
+    monkeypatch.setenv("NTS_SCATTER_LANE_PAD", "1")
+    fenced = gather_dst_from_src(dg, x)
+    g_fenced = jax.grad(lambda v: (gather_dst_from_src(dg, v) * c).sum())(x)
+    assert fenced.shape == (g.v_num, 41)
+    np.testing.assert_allclose(np.asarray(fenced), np.asarray(plain), rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(g_fenced), np.asarray(g_plain), rtol=1e-6, atol=1e-6)
