@@ -1,0 +1,152 @@
+"""HBM-bandwidth roofline model for the full-batch GCN epoch.
+
+VERDICT round-2 item 2: state the bandwidth bound for the full-scale ELL
+epoch, measure the gap, close or explain it. This tool owns the BOUND
+side: a per-path byte model of one training epoch (forward + backward +
+Adam) over the Reddit-scale workload, evaluated against the v5e's ~819
+GB/s HBM, and — when `docs/perf_runs/round3/*.json` holds measured epoch
+times — the achieved fraction per measured config.
+
+Byte model (per layer application; b = itemsize of the compute dtype):
+
+- ELL / Pallas-resident regime: the gathered table sits in VMEM (or is
+  column-chunked to fit), so HBM pays the TABLE STREAM, not the gathers:
+  nbr+wgt slots (pad-inflated) * (4+4) B per direction, the input rows
+  once (V*f*b), the output rows once (V*f*b). The Pallas f-chunked
+  variant re-reads the tables once per 128-wide column chunk.
+- scatter path: the sorted-scatter update stream is HBM-visible:
+  E*(4+4+4) B of edge arrays + E*f*b gathered rows + E*f*4 scatter
+  updates per direction (the model that explains why ELL wins).
+- matmuls: V*(f_in + f_out)*b activations + weights (negligible) each
+  way; Adam: 4 reads + 2 writes of every parameter (f32).
+
+The numbers are a BOUND, not a prediction: XLA fusion can beat the
+scatter model's middle terms and padding waste can exceed the slot
+inflation measured host-side. Usage:
+
+    python -m neutronstarlite_tpu.tools.roofline [--scale 1.0]
+        [--runs-dir docs/perf_runs/round3] [--markdown]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+
+REDDIT_V, REDDIT_E = 232965, 114615892
+LAYERS = (602, 128, 41)
+HBM_GBS = 819.0  # v5e
+ELL_PAD = 1.33  # measured fwd slot inflation at full scale (PERF.md 3b)
+
+
+def epoch_bytes(order: str, path: str, v: int, e: int, b: int = 2) -> float:
+    """Approximate HBM bytes of one epoch (fwd+bwd, all layers, + Adam)."""
+    widths = list(LAYERS)
+    total = 0.0
+    slots = e * ELL_PAD
+    for i in range(len(widths) - 1):
+        f_in, f_out = widths[i], widths[i + 1]
+        f_agg = f_in if order == "standard" else f_out
+        # aggregation, forward + backward (transpose tables, same volume)
+        vmem_budget = 96 << 20
+        if path == "pallas":
+            # f-chunked fused kernel: tables re-read per 128-lane column
+            # chunk, every gather on-chip regardless of width
+            n_chunks = (
+                -(-f_agg // 128) if v * f_agg * b > vmem_budget else 1
+            )
+            agg = 2 * (slots * 8.0 * n_chunks + 2 * v * f_agg * b)
+        elif path in ("ell", "blocked", "bsp"):
+            agg = 2 * (slots * 8.0 + 2 * v * f_agg * b)
+            if path == "ell" and v * f_agg * b > vmem_budget:
+                # XLA gather table beyond VMEM: every gathered row is an
+                # HBM transaction (the regime the pallas f-chunking and
+                # the blocked layouts exist to avoid)
+                agg += 2 * slots * f_agg * b
+        else:  # scatter
+            agg = 2 * (e * 12.0 + e * f_agg * b + e * f_agg * 4.0)
+        # the layer matmul fwd+bwd activation traffic
+        mm = 2 * v * (f_in + f_out) * b
+        total += agg + mm
+    params = sum(
+        widths[i] * widths[i + 1] for i in range(len(widths) - 1)
+    )
+    total += 6 * 4 * params  # Adam reads/writes, f32
+    return total
+
+
+def bound_s(order: str, path: str, v: int, e: int) -> float:
+    return epoch_bytes(order, path, v, e) / (HBM_GBS * 1e9)
+
+
+def collect_measured(runs_dir: str):
+    """(name, epoch_s, order, path) from the plan's salvaged step JSONs."""
+    out = []
+    for p in sorted(glob.glob(os.path.join(runs_dir, "*.json"))):
+        try:
+            with open(p) as fh:
+                rec = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            continue
+        extra = rec.get("extra") or {}
+        if rec.get("value") is None or extra.get("stale"):
+            continue
+        if "order" in extra and "path" in extra:
+            out.append((
+                os.path.basename(p)[:-5], float(rec["value"]),
+                extra["order"], extra["path"],
+            ))
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=float, default=1.0)
+    ap.add_argument(
+        "--runs-dir",
+        default=os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))),
+            "docs", "perf_runs", "round3",
+        ),
+    )
+    ap.add_argument("--markdown", action="store_true")
+    args = ap.parse_args(argv)
+
+    v = max(int(REDDIT_V * args.scale), 64)
+    e = max(int(REDDIT_E * args.scale), 512)
+
+    rows = []
+    for order in ("standard", "eager"):
+        for path in ("scatter", "ell", "pallas"):
+            rows.append((order, path, bound_s(order, path, v, e)))
+
+    measured = collect_measured(args.runs_dir)
+    meas_by = {(o, p): (n, t) for n, t, o, p in measured}
+
+    if args.markdown:
+        print(f"| order | path | HBM bound (s) | measured (s) | % of roofline |")
+        print("|---|---|---|---|---|")
+    else:
+        print(f"roofline @ scale {args.scale:g} (V={v} E={e}, {HBM_GBS:.0f} GB/s)")
+    for order, path, t_bound in rows:
+        m = meas_by.get((order, path))
+        if args.markdown:
+            if m:
+                print(f"| {order} | {path} | {t_bound:.3f} | {m[1]:.3f} "
+                      f"| {100 * t_bound / m[1]:.0f}% ({m[0]}) |")
+            else:
+                print(f"| {order} | {path} | {t_bound:.3f} | — | — |")
+        else:
+            tail = (
+                f"  measured {m[1]:.3f}s = {100 * t_bound / m[1]:.0f}% of bound"
+                f" ({m[0]})" if m else ""
+            )
+            print(f"{order:9s} {path:8s} bound {t_bound:.3f}s{tail}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

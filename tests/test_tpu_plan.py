@@ -92,3 +92,20 @@ def test_build_steps_shape():
     assert names[0] == "bench_full" and "tpu_tests" in names
     assert {"ell_chunk_16", "ell_chunk_64", "ell_chunk_128"} <= set(names)
     assert len(names) == len(set(names))
+
+
+def test_roofline_model_sanity(capsys):
+    """Roofline bounds: positive, ELL strictly under scatter (that is the
+    design bet), pallas never above the beyond-VMEM ELL regime, markdown
+    renders one row per (order, path)."""
+    from neutronstarlite_tpu.tools import roofline as rf
+
+    v, e = 232965, 114615892
+    for order in ("standard", "eager"):
+        assert 0 < rf.bound_s(order, "ell", v, e) < rf.bound_s(order, "scatter", v, e)
+    # standard order: f=602 table is beyond VMEM; the f-chunked pallas
+    # bound must beat the HBM-gather ELL bound
+    assert rf.bound_s("standard", "pallas", v, e) < rf.bound_s("standard", "ell", v, e)
+    rf.main(["--markdown", "--runs-dir", "/nonexistent"])
+    out = capsys.readouterr().out
+    assert out.count("| standard |") == 3 and out.count("| eager |") == 3
