@@ -585,11 +585,13 @@ def main(argv=None) -> int:
             precisions.append(
                 "float32" if args.precision == "bfloat16" else "bfloat16"
             )
-        # pallas/blocked join only --sweep full: pallas needs the VMEM
-        # regime (eager widths) and blocked pays a minutes-long host table
-        # build — measure them explicitly or via full
-        paths = ("scatter", "ell") if args.sweep == "auto" else (
-            "scatter", "ell", "pallas", "blocked"
+        # pallas joined the auto sweep in round 3: feature-column chunking
+        # (ops/pallas_kernels.py) made the fused kernel legal at any width,
+        # and its roofline bound is ~20x under the beyond-VMEM ELL regime
+        # at the standard order. blocked/bsp stay behind --sweep full
+        # (minutes-long host table builds).
+        paths = ("scatter", "ell", "pallas") if args.sweep == "auto" else (
+            "scatter", "ell", "pallas", "blocked", "bsp"
         )
         grid = [
             (o, p, pr)
