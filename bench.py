@@ -68,12 +68,33 @@ LAST_GOOD_PATH = os.path.join(
 )
 
 
+def _last_good_path(scale: float) -> str:
+    # per-scale files: a small-scale smoke run must never overwrite the
+    # full-scale salvage record (round-3 near-miss: a scale=0.002 CPU
+    # smoke clobbered the only persisted v5e measurement). scale 1.0
+    # keeps the legacy filename the driver/judge already know.
+    if scale == 1.0:
+        return LAST_GOOD_PATH
+    base, ext = os.path.splitext(LAST_GOOD_PATH)
+    return f"{base}_scale_{scale:g}{ext}"
+
+
 def save_last_good(out: dict) -> None:
+    device = str(out.get("extra", {}).get("device", ""))
+    if "CPU" in device.upper():
+        # a CPU run (local smoke/test) is not an on-chip measurement;
+        # persisting it would let emit_stale_or_fail report it as one
+        print(
+            f"not persisting CPU-device measurement ({device})",
+            file=sys.stderr, flush=True,
+        )
+        return
     try:
-        os.makedirs(os.path.dirname(LAST_GOOD_PATH), exist_ok=True)
+        path = _last_good_path(float(out.get("extra", {}).get("scale", 1.0)))
+        os.makedirs(os.path.dirname(path), exist_ok=True)
         rec = dict(out)
         rec["measured_at"] = time.strftime("%Y-%m-%d %H:%M:%S")
-        with open(LAST_GOOD_PATH, "w") as fh:
+        with open(path, "w") as fh:
             json.dump(rec, fh, indent=1)
     except OSError as e:  # pragma: no cover - persistence is best-effort
         print(f"could not persist measurement: {e}", file=sys.stderr, flush=True)
@@ -81,7 +102,7 @@ def save_last_good(out: dict) -> None:
 
 def load_last_good(scale: float):
     try:
-        with open(LAST_GOOD_PATH) as fh:
+        with open(_last_good_path(scale)) as fh:
             rec = json.load(fh)
     except (OSError, json.JSONDecodeError):
         return None
@@ -590,8 +611,12 @@ def main(argv=None) -> int:
         # and its roofline bound is ~20x under the beyond-VMEM ELL regime
         # at the standard order. blocked/bsp stay behind --sweep full
         # (minutes-long host table builds).
-        paths = ("scatter", "ell", "pallas") if args.sweep == "auto" else (
-            "scatter", "ell", "pallas", "blocked", "bsp"
+        # pallas FIRST: on a tight deadline the budget-exhaustion break
+        # must drop the already-known round-2 paths, never the expected
+        # winner the sweep exists to measure (scatter last: its full-scale
+        # number is the round-2 record)
+        paths = ("pallas", "ell", "scatter") if args.sweep == "auto" else (
+            "pallas", "ell", "scatter", "blocked", "bsp"
         )
         grid = [
             (o, p, pr)

@@ -163,6 +163,57 @@ bgrad = jax.jit(jax.grad(
 r = np.asarray(bgrad(jnp.asarray(x)), np.float64)
 out["checks"]["blocked_grad_f32"] = rel_err(r, dense.T @ c.astype(np.float64))
 
+# round 3 — feature-column-chunked Pallas: chunk widths are multiples of
+# 128 lanes, so forcing chunking needs a >= 256-wide input and a budget
+# admitting exactly [V, 128] — then the call recurses into 128-wide
+# chunked kernel launches on real hardware (NOT the XLA fallback; a
+# budget below one 128-lane chunk would exercise nothing)
+if pcompiled is not None:
+    import neutronstarlite_tpu.ops.pallas_kernels as pk
+    F2 = 256
+    x_wide = rng.standard_normal((V, F2)).astype(np.float32)
+    golden_wide = dense @ x_wide.astype(np.float64)
+    _saved_budget = pk.MAX_TABLE_BYTES
+    pk.MAX_TABLE_BYTES = V * 128 * 4  # one 128-lane f32 chunk fits
+    try:
+        r = np.asarray(
+            gather_dst_from_src_pallas(ell, jnp.asarray(x_wide)), np.float64
+        )
+        out["checks"]["pallas_fchunk_f32"] = rel_err(r, golden_wide)
+    finally:
+        pk.MAX_TABLE_BYTES = _saved_budget
+
+# round 3 — streamed block-sparse kernel (ops/bsp_ell.py): first Mosaic
+# compile of the scalar-prefetch grid + one-hot MXU combine. Same policy
+# as the resident Pallas kernel: a lowering failure is recorded, a
+# post-compile crash propagates as FAIL.
+from neutronstarlite_tpu.ops.bsp_ell import BspEllPair, bsp_gather_dst_from_src
+bsp_pair = BspEllPair.from_host(g, dt=64, vt=128, k_slots=8, r_rows=128)
+bfn = jax.jit(bsp_gather_dst_from_src)
+try:
+    bcompiled = bfn.lower(bsp_pair, jnp.asarray(x)).compile()
+except Exception as e:  # noqa: BLE001 — unsupported lowering, not a bug
+    bcompiled = None
+    out["bsp"] = f"lowering failed: {type(e).__name__}: {str(e)[:300]}"
+if bcompiled is not None:
+    r = np.asarray(bcompiled(bsp_pair, jnp.asarray(x)), np.float64)
+    out["checks"]["bsp_f32"] = rel_err(r, golden)
+    out["bsp"] = "compiled"
+    bspg = jax.jit(jax.grad(
+        lambda v: (bsp_gather_dst_from_src(bsp_pair, v) * c).sum()))
+    r = np.asarray(bspg(jnp.asarray(x)), np.float64)
+    out["checks"]["bsp_grad_f32"] = rel_err(r, dense.T @ c.astype(np.float64))
+
+# round 3 — eager/scatter cliff fence: lane-padded scatter parity on chip
+import os as _os
+_os.environ["NTS_SCATTER_LANE_PAD"] = "1"
+xn = x[:, :41]  # the anomaly's narrow width
+r = np.asarray(
+    jax.jit(gather_dst_from_src)(dg, jnp.asarray(xn)), np.float64
+)
+out["checks"]["scatter_lane_pad_f32"] = rel_err(r, dense @ xn.astype(np.float64))
+_os.environ.pop("NTS_SCATTER_LANE_PAD", None)
+
 # short on-device training run: loss must decrease
 from neutronstarlite_tpu.models.gcn import GCNTrainer
 from neutronstarlite_tpu.graph.dataset import GNNDatum
@@ -276,6 +327,27 @@ def test_tpu_pallas_kernel(tpu_results):
         pytest.skip(f"pallas: {tpu_results.get('pallas')}")
     assert tpu_results["checks"]["pallas_ell_f32"] < 1e-5, tpu_results
     assert tpu_results["checks"]["pallas_grad_f32"] < 1e-5, tpu_results
+
+
+def test_tpu_pallas_feature_chunking(tpu_results):
+    """Round 3: the forced-budget column-chunked fused kernel on chip."""
+    if tpu_results.get("pallas") != "compiled":
+        pytest.skip(f"pallas: {tpu_results.get('pallas')}")
+    assert tpu_results["checks"]["pallas_fchunk_f32"] < 1e-5, tpu_results
+
+
+def test_tpu_bsp_kernel(tpu_results):
+    """Round 3: first Mosaic compile of the streamed block-sparse kernel
+    (scalar-prefetch grid + one-hot MXU combine + output revisiting)."""
+    if tpu_results.get("bsp") != "compiled":
+        pytest.skip(f"bsp: {tpu_results.get('bsp')}")
+    assert tpu_results["checks"]["bsp_f32"] < 1e-5, tpu_results
+    assert tpu_results["checks"]["bsp_grad_f32"] < 1e-5, tpu_results
+
+
+def test_tpu_scatter_lane_pad_fence(tpu_results):
+    """Round 3: the eager/scatter cliff fence is value-exact on chip."""
+    assert tpu_results["checks"]["scatter_lane_pad_f32"] < 1e-5, tpu_results
 
 
 def test_tpu_gcn_short_training(tpu_results):
