@@ -53,7 +53,25 @@ def _single_device_case(cfg, base_dir, rep):
     return toolkit._train_step, jax.tree.map(spec, toolkit.aot_args())
 
 
-def _dist_gcn_case(cfg, base_dir, mesh):
+def _synthetic_edges(cfg, scale: float):
+    """Reddit-scale synthetic edge list via bench.py's on-disk graph cache
+    (numpy only — the cache is shared with the benchmark, so a prior bench
+    run makes this instant). Overrides the cfg's EDGE_FILE/VERTICES."""
+    import sys as _sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    if repo not in _sys.path:
+        _sys.path.insert(0, repo)
+    from bench import build_and_cache_graph, load_cached_graph
+
+    d, v_num, _, _ = build_and_cache_graph(scale)
+    _, src, dst = load_cached_graph(d)
+    cfg.vertices = v_num
+    return src, dst
+
+
+def _dist_gcn_case(cfg, base_dir, mesh, edges=None):
     """The distributed GCN train step as ShapeDtypeStructs over ``mesh``
     (mirrors DistGCNTrainer.build_model; kept in sync by
     tests/test_aot_check.py's parity check)."""
@@ -71,8 +89,11 @@ def _dist_gcn_case(cfg, base_dir, mesh):
     from neutronstarlite_tpu.parallel.mesh import PARTITION_AXIS
 
     P = mesh.devices.size
-    edge_path = cfg.resolve_path(cfg.edge_file, base_dir)
-    src, dst = load_edges(edge_path)
+    if edges is None:
+        edge_path = cfg.resolve_path(cfg.edge_file, base_dir)
+        src, dst = load_edges(edge_path)
+    else:
+        src, dst = edges
     host_graph = build_graph(src, dst, cfg.vertices, weight="gcn_norm")
     sizes = cfg.layer_sizes()
 
@@ -166,6 +187,12 @@ def main(argv=None) -> int:
         "--platform", default="tpu",
         help="PJRT platform for get_topology_desc",
     )
+    ap.add_argument(
+        "--synthetic-scale", type=float, default=None,
+        help="ignore EDGE_FILE and use bench.py's cached Reddit-scale "
+        "synthetic graph at this scale (1.0 = full) — full-scale capacity "
+        "checks without the dataset on disk (dist algorithms only)",
+    )
     args = ap.parse_args(argv)
 
     # host work runs on the CPU backend UNCONDITIONALLY (even when the
@@ -209,10 +236,22 @@ def main(argv=None) -> int:
                     f"topology {args.topology}"
                 )
             mesh = Mesh(np.array(devices[:n]), (PARTITION_AXIS,))
-            jitted, shapes, layer_kind = _dist_gcn_case(cfg, base_dir, mesh)
+            edges = (
+                _synthetic_edges(cfg, args.synthetic_scale)
+                if args.synthetic_scale is not None
+                else None
+            )
+            out["vertices"] = cfg.vertices
+            jitted, shapes, layer_kind = _dist_gcn_case(
+                cfg, base_dir, mesh, edges=edges
+            )
             out["comm_layer"] = layer_kind
             out["partitions"] = n
         else:
+            if args.synthetic_scale is not None:
+                raise ValueError(
+                    "--synthetic-scale supports dist algorithms only"
+                )
             mesh1 = Mesh(np.array(devices[:1]), ("one",))
             rep = NamedSharding(mesh1, PS())
             jitted, shapes = _single_device_case(cfg, base_dir, rep)

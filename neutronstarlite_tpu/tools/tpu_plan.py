@@ -141,6 +141,17 @@ def build_steps(out_dir: str):
             {"NTS_SCATTER_LANE_PAD": "1", "NTS_BENCH_DEADLINE_S": "1500"},
         ),
         (
+            # round 3: full-scale 8-way AOT capacity check of the
+            # KERNEL_TILE dist path (VERDICT item 5's "full-scale
+            # aot_check compile"); needs the remote TPU compiler, no chips
+            "aot_dist_blocked",
+            [sys.executable, "-m", "neutronstarlite_tpu.tools.aot_check",
+             os.path.join(REPO, "configs", "gcn_reddit_full_dist_blocked.cfg"),
+             "--topology", "v5e:2x4", "--synthetic-scale", "1.0"],
+            3600,
+            {},
+        ),
+        (
             "bench_matrix",
             [sys.executable, "-m", "neutronstarlite_tpu.tools.bench_matrix",
              "--configs", os.path.join(REPO, "configs"),

@@ -37,8 +37,11 @@ def test_single_device_case_compiles(algorithm):
     assert mem.argument_size_in_bytes > 0
 
 
-@pytest.mark.parametrize("comm_layer", ["ring", "ell", "mirror"])
-def test_dist_gcn_case_compiles(comm_layer):
+@pytest.mark.parametrize(
+    "comm_layer,kernel_tile",
+    [("ring", 0), ("ell", 0), ("mirror", 0), ("ell", 512)],
+)
+def test_dist_gcn_case_compiles(comm_layer, kernel_tile):
     from neutronstarlite_tpu.parallel.mesh import PARTITION_AXIS
 
     devs = jax.devices()
@@ -48,6 +51,8 @@ def test_dist_gcn_case_compiles(comm_layer):
     cfg = _cora_cfg("GCNDIST")
     cfg.comm_layer = comm_layer
     cfg.partitions = 4
+    cfg.kernel_tile = kernel_tile  # 512 -> the dist blocked (KERNEL_TILE)
+    # spec path, the aot_dist_blocked plan step's shape
     jitted, shapes, kind = _dist_gcn_case(cfg, CFG_DIR, mesh)
     assert kind == comm_layer
     compiled = jitted.lower(*shapes).compile()
