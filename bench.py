@@ -568,10 +568,10 @@ def main(argv=None) -> int:
         return args.deadline - (time.time() - main_t0)
 
     def measure(order, path, precision, epochs, warmup, budget_s):
-        # the blocked layout's full-scale host table build is ~2 min per
-        # direction on the 1-core rig (docs/PERF.md section 3c; its compile
-        # is seconds since the stacked redesign) — give it 3x the normal cap
-        cap = args.config_timeout * (3.0 if path == "blocked" else 1.0)
+        # blocked/bsp pay a minutes-long full-scale host table build on the
+        # 1-core rig (docs/PERF.md section 3c; compiles are seconds since
+        # the stacked redesign) — give them 3x the normal cap
+        cap = args.config_timeout * (3.0 if path in ("blocked", "bsp") else 1.0)
         timeout_s = max(min(cap, budget_s), 60.0)
         print(
             f"measuring {order}/{path}/{precision} epochs={epochs} "
