@@ -26,12 +26,17 @@ import time
 import numpy as np
 
 
-def bench_layers(v_num, avg_degree, f, partitions, steps, seed=3):
+def bench_layers(v_num, avg_degree, f, partitions, steps, seed=3,
+                 kernel_tile=0):
     import jax
     import jax.numpy as jnp
 
     from neutronstarlite_tpu.graph.storage import build_graph
     from neutronstarlite_tpu.graph.synthetic import synthetic_power_law_graph
+    from neutronstarlite_tpu.parallel.dist_blocked import (
+        DistBlockedEllPair,
+        dist_blocked_gather_dst_from_src,
+    )
     from neutronstarlite_tpu.parallel.dist_edge_ops import (
         dist_gather_dst_from_src_mirror,
     )
@@ -85,6 +90,12 @@ def bench_layers(v_num, avg_degree, f, partitions, steps, seed=3):
             (P - 1) * mg.mb,  # the p->p all_to_all chunk stays on-device
         ),
     }
+    if kernel_tile:
+        blk = DistBlockedEllPair.build(dist, vt=kernel_tile).shard(mesh)
+        paths["blocked"] = (
+            loss_of(lambda x: dist_blocked_gather_dst_from_src(mesh, blk, x)),
+            (P - 1) * dist.vp,  # same all_gather wire volume as ell
+        )
 
     results = {}
     for name, (fn, wire_rows) in paths.items():
@@ -116,13 +127,18 @@ def main(argv=None) -> int:
     ap.add_argument("--feature", type=int, default=128)
     ap.add_argument("--partitions", type=int, default=0)
     ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument(
+        "--kernel-tile", type=int, default=0,
+        help="also bench the dist blocked layer (KERNEL_TILE:vt path)",
+    )
     args = ap.parse_args(argv)
 
     from neutronstarlite_tpu.utils.platform import honor_platform_env
 
     honor_platform_env()
     out = bench_layers(
-        args.vertices, args.avg_degree, args.feature, args.partitions, args.steps
+        args.vertices, args.avg_degree, args.feature, args.partitions,
+        args.steps, kernel_tile=args.kernel_tile,
     )
     print(json.dumps(out, indent=2))
     return 0
