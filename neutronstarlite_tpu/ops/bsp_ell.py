@@ -116,9 +116,24 @@ class BspEll:
 
         if e_num:
             # group edges by (dst tile, src tile); edges arrive dst-grouped,
-            # so a stable sort by the pair key keeps dst ascending per group
+            # so a stable sort by the pair key keeps dst ascending per group.
+            # The key space is tiny (t_dst * t_src ~ 13k at full Reddit
+            # scale), so the native O(E) counting sort applies directly —
+            # measured neutral on wall time at full scale (the per-edge
+            # fancy-index fills dominate the build, 274 s vs 276 s) but it
+            # avoids argsort's O(E) int64 temp at peak
+            from neutronstarlite_tpu import native as native_rt
+
             key = (dst_of_edge // dt) * t_src + adj // vt
-            order = np.argsort(key, kind="stable")
+            # key-space bound: the counting sort allocates an int64
+            # histogram of t_dst * t_src entries — past ~16M keys (128 MB)
+            # argsort is the better trade, long before the int32 limit
+            if native_rt.available() and t_dst * t_src < 2**24:
+                order = native_rt.sort_by_tile(
+                    key.astype(np.int32, copy=False), t_dst * t_src
+                )
+            else:
+                order = np.argsort(key, kind="stable")
             ks, ds = key[order], dst_of_edge[order]
             ss, ws = adj[order], weights[order]
 
