@@ -1,0 +1,136 @@
+"""Single-chip kernel micro-benchmarks (docs/PERF.md §1, reproducible).
+
+Round 2's §1 table came from ad-hoc scripts; this tool makes the method
+durable and extends it to the round-3 kernels. Shapes follow §1: the
+5%-Reddit edge set (E=5.73M) over the half-Reddit vertex table
+([116k, f]), bf16 compute. Timing defeats the remote execution path's
+identical-dispatch caching by feeding a fresh scalar into every
+iteration (naive repeat-timing reports impossible numbers — §1's note);
+reported time is the median of ``--iters`` post-compile runs.
+
+Ops: dense matmul / HBM stream (method validation against hardware
+peaks), random row gather, XLA ELL aggregate, sorted scatter-add, fused
+Pallas ELL (VMEM-resident), fused Pallas ELL at 602 wide (the round-3
+feature-column-chunked regime), and the streamed block-sparse kernel
+(ops/bsp_ell.py). Failures (e.g. a Mosaic lowering gap) are recorded
+per-op, never fatal.
+
+Usage: python -m neutronstarlite_tpu.tools.micro_bench [--iters 10]
+Prints ONE JSON line; the recovery plan step ``micro_kernels`` archives
+it under docs/perf_runs/round3/.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+V = 116482  # half Reddit (the §1 table shapes)
+E = 5730794  # 5% Reddit edges
+F = 128
+F_WIDE = 602
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument(
+        "--scale", type=float, default=1.0,
+        help="shrink V/E (CPU smoke tests; 1.0 = the §1 table shapes)",
+    )
+    args = ap.parse_args(argv)
+    global V, E
+    V = max(int(V * args.scale), 64)
+    E = max(int(E * args.scale), 512)
+
+    from neutronstarlite_tpu.utils.platform import honor_platform_env
+
+    honor_platform_env()
+    import jax
+    import jax.numpy as jnp
+
+    from neutronstarlite_tpu.graph.storage import build_graph
+    from neutronstarlite_tpu.graph.synthetic import synthetic_power_law_graph
+    from neutronstarlite_tpu.ops.bsp_ell import BspEllPair, bsp_gather_dst_from_src
+    from neutronstarlite_tpu.ops.device_graph import DeviceGraph
+    from neutronstarlite_tpu.ops.aggregate import gather_dst_from_src
+    from neutronstarlite_tpu.ops.ell import EllPair, ell_gather_dst_from_src
+    from neutronstarlite_tpu.ops.pallas_kernels import gather_dst_from_src_pallas
+
+    rng = np.random.default_rng(args.seed)
+    out = {"platform": jax.default_backend(), "device": str(jax.devices()[0]),
+           "V": V, "E": E, "ops": {}}
+
+    print("building graph + tables (host)...", file=sys.stderr, flush=True)
+    src, dst = synthetic_power_law_graph(V, E, seed=args.seed)
+    g = build_graph(src, dst, V, weight="gcn_norm")
+    dg = DeviceGraph.from_host(g)
+    ell = EllPair.from_host(g)
+    bsp = BspEllPair.from_host(g, dt=512, vt=8192)
+
+    x = jnp.asarray(rng.standard_normal((V, F)).astype(np.float32), jnp.bfloat16)
+    xw = jnp.asarray(
+        rng.standard_normal((V, F_WIDE)).astype(np.float32), jnp.bfloat16
+    )
+    w_mm = jnp.asarray(
+        rng.standard_normal((F_WIDE, F)).astype(np.float32), jnp.bfloat16
+    )
+    idx = jnp.asarray(rng.integers(0, V, size=E), jnp.int32)
+    big = jnp.asarray(rng.standard_normal(8 << 20).astype(np.float32))  # 32 MB
+
+    def timed(name, fn, traffic_bytes=None, flops=None):
+        """fn(scalar) -> array; records median ms (+ derived rate)."""
+        try:
+            jfn = jax.jit(fn)
+            jax.block_until_ready(jfn(jnp.float32(1.0)))  # compile
+            ts = []
+            for i in range(args.iters):
+                s = jnp.float32(1.0 + 1e-6 * (i + 1))  # fresh dispatch
+                t0 = time.perf_counter()
+                jax.block_until_ready(jfn(s))
+                ts.append(time.perf_counter() - t0)
+            med = float(np.median(ts))
+            rec = {"ms": round(med * 1e3, 4)}
+            if traffic_bytes:
+                rec["apparent_gbs"] = round(traffic_bytes / med / 1e9, 1)
+            if flops:
+                rec["tflops"] = round(flops / med / 1e12, 1)
+            out["ops"][name] = rec
+            print(f"{name}: {rec}", file=sys.stderr, flush=True)
+        except Exception as e:  # noqa: BLE001 — record, keep going
+            out["ops"][name] = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
+            print(f"{name} FAILED: {out['ops'][name]}", file=sys.stderr, flush=True)
+
+    timed("matmul_bf16_602x128", lambda s: (xw * s) @ w_mm,
+          flops=2.0 * V * F_WIDE * F)
+    timed("hbm_stream_f32_64MB", lambda s: big * s,
+          traffic_bytes=2 * big.size * 4)
+    timed("row_gather_bf16", lambda s: (x * s)[idx],
+          traffic_bytes=E * F * 2)
+    timed("ell_aggregate_xla_bf16",
+          lambda s: ell_gather_dst_from_src(ell, x * s),
+          traffic_bytes=E * F * 2)
+    timed("sorted_scatter_bf16",
+          lambda s: gather_dst_from_src(dg, x * s),
+          traffic_bytes=E * F * 2)
+    timed("pallas_ell_resident_bf16",
+          lambda s: gather_dst_from_src_pallas(ell, x * s),
+          traffic_bytes=E * F * 2)
+    timed("pallas_ell_fchunked_602_bf16",
+          lambda s: gather_dst_from_src_pallas(ell, xw * s),
+          traffic_bytes=E * F_WIDE * 2)
+    timed("bsp_streamed_bf16",
+          lambda s: bsp_gather_dst_from_src(bsp, x * s),
+          traffic_bytes=E * F * 2)
+
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

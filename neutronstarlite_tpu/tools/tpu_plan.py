@@ -76,6 +76,13 @@ def build_steps(out_dir: str):
             {"NTS_BENCH_DEADLINE_S": "4800"},
         ),
         (
+            # reproducible §1 micro table incl. the round-3 kernels
+            "micro_kernels",
+            [sys.executable, "-m", "neutronstarlite_tpu.tools.micro_bench"],
+            1800,
+            {},
+        ),
+        (
             "tpu_tests",
             [sys.executable, "-m", "pytest",
              os.path.join(REPO, "tests", "test_tpu.py"), "-q", "-rs"],
