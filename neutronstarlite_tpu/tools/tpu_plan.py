@@ -10,14 +10,17 @@ a mid-plan tunnel death costs only the step in flight.
 
 Plan steps — ``--list`` is authoritative; in execution order:
   1. bench_full: north-star full-scale sweep + winner measurement (bench.py)
-  2. tpu_tests: on-chip test module (tests/test_tpu.py, generous timeout)
-  3. ell_chunk_{16,64,128}: NTS_ELL_CHUNK_MIB tuning on the eager/ELL path
-  4. eager_pallas / standard_pallas / eager_bsp / eager_blocked: the
+  2. micro_kernels: reproducible PERF §1 micro table (tools/micro_bench)
+  3. tpu_tests: on-chip test module (tests/test_tpu.py, generous timeout)
+  4. ell_chunk_{16,64,128}: NTS_ELL_CHUNK_MIB tuning on the eager/ELL path
+  5. eager_pallas / standard_pallas / eager_bsp / eager_blocked: the
      other full-scale kernel paths (standard_pallas and eager_bsp are
      round-3 kernels: f-chunked fused ELL and streamed block-sparse)
-  5. bench_matrix: workload matrix over configs/ (tools/bench_matrix)
-  6. sampled_bench: fan-out-sampled mini-batch at Reddit scale
-  7. profile_trace: steady-state trace of standard/ELL (NTS_PROFILE_DIR)
+  6. eager_scatter_fence: lane-pad A/B for the PERF §2a scatter cliff
+  7. aot_dist_blocked: full-scale 8-way KERNEL_TILE-dist capacity compile
+  8. bench_matrix: workload matrix over configs/ (tools/bench_matrix)
+  9. sampled_bench: fan-out-sampled mini-batch at Reddit scale
+  10. profile_trace: steady-state trace of standard/ELL (NTS_PROFILE_DIR)
 
 Artifacts land in docs/perf_runs/round2/: per-step .log (stderr tail),
 .json (the step's final JSON line, when it prints one), .ok marker

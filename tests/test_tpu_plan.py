@@ -109,3 +109,22 @@ def test_roofline_model_sanity(capsys):
     rf.main(["--markdown", "--runs-dir", "/nonexistent"])
     out = capsys.readouterr().out
     assert out.count("| standard |") == 3 and out.count("| eager |") == 3
+
+
+def test_roofline_collect_measured(tmp_path):
+    """collect_measured reads the plan's salvaged step JSONs, skipping
+    stale and value-null records."""
+    import json
+
+    from neutronstarlite_tpu.tools import roofline as rf
+
+    good = {"metric": "m", "value": 1.5, "unit": "s",
+            "extra": {"order": "eager", "path": "ell"}}
+    stale = {"metric": "m_stale", "value": 7.0, "unit": "s",
+             "extra": {"order": "standard", "path": "scatter", "stale": True}}
+    null = {"metric": "m", "value": None, "extra": {"order": "x", "path": "y"}}
+    for name, rec in [("a", good), ("b", stale), ("c", null)]:
+        (tmp_path / f"{name}.json").write_text(json.dumps(rec))
+    (tmp_path / "broken.json").write_text("{not json")
+    got = rf.collect_measured(str(tmp_path))
+    assert got == [("a", 1.5, "eager", "ell")], got
