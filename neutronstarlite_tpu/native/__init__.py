@@ -84,6 +84,10 @@ def get_lib() -> Optional[ctypes.CDLL]:
         i64p, i64p, i32p, i32p, i64p, ctypes.c_int64, ctypes.c_int64,
         ctypes.c_int32, i32p, f32p, i32p, f32p, i32p,
     ]
+    lib.nts_fill_bsp.argtypes = [
+        i64p, i64p, i64p, i32p, ctypes.c_int64, i64p, i64p, i32p, f32p,
+        ctypes.c_int32, ctypes.c_int32, i32p, f32p, i32p,
+    ]
     lib.nts_dedup_remap.argtypes = [
         i64p, ctypes.c_int64, i64p, i32p,
     ]
@@ -166,6 +170,30 @@ def fill_blocked_level(
         np.ascontiguousarray(src_sorted, np.int32),
         np.ascontiguousarray(w_sorted, np.float32),
         nbr, wgt, dstr,
+    )
+
+
+def fill_bsp(
+    run_start: np.ndarray, run_len: np.ndarray, row_of_first: np.ndarray,
+    run_ldst: np.ndarray, row_block: np.ndarray, row_slot: np.ndarray,
+    src_local: np.ndarray, w_sorted: np.ndarray, K: int, R: int,
+    nbr: np.ndarray, wgt: np.ndarray, ldst: np.ndarray,
+) -> None:
+    """Fill the [B, K, R] block-sparse tables in place (ops/bsp_ell.py);
+    nbr/wgt/ldst zero-initialized by the caller."""
+    lib = get_lib()
+    assert lib is not None
+    lib.nts_fill_bsp(
+        np.ascontiguousarray(run_start, np.int64),
+        np.ascontiguousarray(run_len, np.int64),
+        np.ascontiguousarray(row_of_first, np.int64),
+        np.ascontiguousarray(run_ldst, np.int32),
+        len(run_start),
+        np.ascontiguousarray(row_block, np.int64),
+        np.ascontiguousarray(row_slot, np.int64),
+        np.ascontiguousarray(src_local, np.int32),
+        np.ascontiguousarray(w_sorted, np.float32),
+        K, R, nbr, wgt, ldst,
     )
 
 

@@ -192,18 +192,28 @@ class BspEll:
         bs = np.concatenate([data_bs, np.zeros(len(filler), np.int32)])
 
         if e_num:
-            # per-edge placement: row-relative slot position
-            run_of_edge = np.repeat(np.arange(len(run_start)), run_len)
-            off = np.arange(e_num) - run_start[run_of_edge]
-            e_row = row_of_first[run_of_edge] + off // K
-            p = off % K
-            b_e = row_block[e_row]
-            s_e = row_slot[e_row]
-            nbr[b_e, p, s_e] = (ss - (ss // vt) * vt).astype(np.int32)
-            wgt[b_e, p, s_e] = ws
-            ldst[row_block, row_slot] = (row_dst - (row_dst // dt) * dt).astype(
-                np.int32
-            )
+            src_local = (ss - (ss // vt) * vt).astype(np.int32)
+            run_ldst = (run_dst - (run_dst // dt) * dt).astype(np.int32)
+            if native_rt.available():
+                # one OpenMP pass over runs (the three O(E) fancy-index
+                # scatters below were the measured build bottleneck)
+                native_rt.fill_bsp(
+                    run_start, run_len, row_of_first, run_ldst,
+                    row_block, row_slot, src_local,
+                    np.ascontiguousarray(ws, np.float32), K, R,
+                    nbr, wgt, ldst,
+                )
+            else:
+                # per-edge placement: row-relative slot position
+                run_of_edge = np.repeat(np.arange(len(run_start)), run_len)
+                off = np.arange(e_num) - run_start[run_of_edge]
+                e_row = row_of_first[run_of_edge] + off // K
+                p = off % K
+                b_e = row_block[e_row]
+                s_e = row_slot[e_row]
+                nbr[b_e, p, s_e] = src_local
+                wgt[b_e, p, s_e] = ws
+                ldst[row_block, row_slot] = run_ldst[row_run]
             waste = B * K * R / max(e_num, 1)
             log.info(
                 "bsp ELL: %d blocks [%d slots x %d rows], %d dst x %d src "

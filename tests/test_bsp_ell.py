@@ -155,3 +155,28 @@ def test_bsp_trainer_matches_ell_trainer(rng):
         return tr.run()["loss"]
 
     np.testing.assert_allclose(run(True), run(False), rtol=1e-4, atol=1e-5)
+
+
+def test_bsp_native_fill_matches_numpy(rng, monkeypatch):
+    """The native one-pass fill (nts_fill_bsp) must produce byte-identical
+    tables to the NumPy fancy-index build."""
+    from neutronstarlite_tpu import native
+
+    if not native.available():
+        pytest.skip("native runtime unavailable")
+    g, _ = tiny_graph(rng, v_num=73, e_num=640)
+
+    nat = BspEllPair.from_host(g, dt=8, vt=16, k_slots=4, r_rows=8)
+    monkeypatch.setenv("NTS_NO_NATIVE", "1")
+    import neutronstarlite_tpu.native as native_mod
+
+    monkeypatch.setattr(native_mod, "_lib", None)
+    monkeypatch.setattr(native_mod, "_tried", False)
+    ref = BspEllPair.from_host(g, dt=8, vt=16, k_slots=4, r_rows=8)
+    for side in ("fwd", "bwd"):
+        a, b = getattr(nat, side), getattr(ref, side)
+        np.testing.assert_array_equal(np.asarray(a.nbr), np.asarray(b.nbr))
+        np.testing.assert_array_equal(np.asarray(a.wgt), np.asarray(b.wgt))
+        np.testing.assert_array_equal(np.asarray(a.ldst), np.asarray(b.ldst))
+        np.testing.assert_array_equal(np.asarray(a.blk_dst), np.asarray(b.blk_dst))
+        np.testing.assert_array_equal(np.asarray(a.blk_src), np.asarray(b.blk_src))
