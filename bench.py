@@ -582,7 +582,8 @@ def main(argv=None) -> int:
             order, path, precision, epochs, warmup, cache_dir,
             args.kernel_tile, timeout_s,
         )
-        rec = {"order": order, "path": path, "precision": precision, **info}
+        rec = {"order": order, "path": path, "precision": precision,
+               "timeout_s": round(timeout_s), **info}
         if info.get("epoch_s") is not None:
             print(
                 f"{order}/{path}/{precision}: {info['epoch_s']:.4f}s/epoch "
@@ -626,6 +627,19 @@ def main(argv=None) -> int:
         ]
         # leave >= 35% of the deadline for the final measurement
         sweep_budget_s = args.deadline * 0.65
+        # round-3 postmortem: two hung pallas compiles each ate a full
+        # config_timeout (1200 s) and starved every later leg down to 60 s
+        # scraps — the sweep found NO config and the run failed with the
+        # production path unmeasured. Two fences: (a) a per-leg cap
+        # (multiplier-aware for blocked/bsp table builds, and never more
+        # than 35% of the sweep budget) so one path cannot consume the
+        # whole sweep; (b) a leg that times out after receiving its FULL
+        # allotment (a hung compile, not a budget-starved leg) forfeits
+        # the path's remaining legs — the other order hangs the same way.
+        leg_cap_s = float(
+            os.environ.get("NTS_SWEEP_LEG_CAP_S", args.deadline * 0.15)
+        )
+        timed_out_paths = set()
         for o, p, pr in grid:
             budget_left = sweep_budget_s - (time.time() - main_t0)
             if budget_left < 60.0 and best is not None:
@@ -634,11 +648,30 @@ def main(argv=None) -> int:
                     file=sys.stderr, flush=True,
                 )
                 break
-            rec = measure(o, p, pr, args.sweep_epochs, 1, budget_left)
+            if p in timed_out_paths:
+                print(
+                    f"skipping {o}/{p}/{pr}: path timed out earlier in sweep",
+                    file=sys.stderr, flush=True,
+                )
+                sweep_results.append(
+                    {"order": o, "path": p, "precision": pr,
+                     "error": "skipped: path timed out earlier in sweep"}
+                )
+                continue
+            mult = 3.0 if p in ("blocked", "bsp") else 1.0
+            leg_full_s = min(
+                args.config_timeout * mult, leg_cap_s * mult,
+                sweep_budget_s * 0.35,
+            )
+            rec = measure(o, p, pr, args.sweep_epochs, 1,
+                          min(budget_left, leg_full_s))
             sweep_results.append(rec)
             ep = rec.get("epoch_s")
             if ep is not None and (best is None or ep < best[0]):
                 best = (ep, o, p, pr, rec)
+            elif ("TIMEOUT" in str(rec.get("error", ""))
+                  and rec.get("timeout_s", 0) >= leg_full_s - 1.0):
+                timed_out_paths.add(p)
         if best is None:
             print("FATAL: every sweep config failed", file=sys.stderr, flush=True)
             return emit_stale_or_fail(

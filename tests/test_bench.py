@@ -191,3 +191,47 @@ def test_worker_paths_agree(tmp_path, monkeypatch):
         losses[path] = json.loads(r.stdout.strip().splitlines()[-1])["loss"]
     assert losses["pallas"] == losses["ell"], losses
     assert losses["blocked"] == losses["ell"], losses
+
+
+def test_sweep_hang_fences(tmp_path, monkeypatch, capsys):
+    """Round-3 postmortem regression: a path whose compile hangs (leg ends
+    in TIMEOUT) must (a) be capped at the per-leg budget, not the whole
+    sweep budget, and (b) forfeit its remaining sweep legs — so the later
+    paths still get measured and the sweep still finds a winner."""
+    calls = []
+
+    def fake_worker(order, path, precision, epochs, warmup, cache_dir,
+                    kernel_tile, timeout_s):
+        calls.append((order, path, round(timeout_s)))
+        if path == "pallas":
+            return {"error": f"TIMEOUT after {timeout_s:.0f}s", "wall_s": 1.0}
+        ep = {"ell": 2.0, "scatter": 5.0}[path]
+        return {"epoch_s": ep, "loss": 0.5, "device": "fake", "wall_s": 1.0}
+
+    monkeypatch.delenv("NTS_SWEEP_LEG_CAP_S", raising=False)
+    monkeypatch.setattr(bench, "start_watchdog", lambda *a: None)
+    monkeypatch.setattr(bench, "run_worker_config", fake_worker)
+    monkeypatch.setattr(
+        bench, "probe_backend", lambda *a, **k: {"init_s": 0.1}
+    )
+    monkeypatch.setattr(
+        bench, "build_and_cache_graph",
+        lambda scale: (str(tmp_path), 1000, 5000, 0.1),
+    )
+    monkeypatch.setattr(bench, "LAST_GOOD_PATH", str(tmp_path / "last.json"))
+    rc = bench.main(["--deadline", "1000", "--epochs", "1", "--warmup", "0"])
+    assert rc == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    # the winner is the fastest NON-hung path, measured at the leg cap
+    assert rec["extra"]["path"] == "ell"
+    # standard/pallas ran once, capped at deadline*0.15 (not 0.65)
+    first = calls[0]
+    assert first[:2] == ("standard", "pallas") and first[2] <= 150
+    # eager/pallas never spawned a worker: the path was fenced after the
+    # first TIMEOUT
+    assert ("eager", "pallas") not in {c[:2] for c in calls}
+    skipped = [
+        r for r in rec["extra"]["sweep"]
+        if r["path"] == "pallas" and "skipped" in str(r.get("error", ""))
+    ]
+    assert skipped, rec["extra"]["sweep"]
