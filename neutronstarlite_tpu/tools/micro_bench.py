@@ -43,7 +43,15 @@ def main(argv=None) -> int:
         "--scale", type=float, default=1.0,
         help="shrink V/E (CPU smoke tests; 1.0 = the §1 table shapes)",
     )
+    ap.add_argument(
+        "--ops", default="",
+        help="comma-separated op-name substrings to run (default: all). "
+        "A hung Mosaic compile stalls this process in C++ where no Python "
+        "timeout can interrupt it — run suspect ops as separate invocations "
+        "(the recovery plan's per-step subprocess timeout is the kill)",
+    )
     args = ap.parse_args(argv)
+    op_filter = [s for s in args.ops.split(",") if s]
     global V, E
     V = max(int(V * args.scale), 64)
     E = max(int(E * args.scale), 512)
@@ -66,26 +74,49 @@ def main(argv=None) -> int:
     out = {"platform": jax.default_backend(), "device": str(jax.devices()[0]),
            "V": V, "E": E, "ops": {}}
 
-    print("building graph + tables (host)...", file=sys.stderr, flush=True)
-    src, dst = synthetic_power_law_graph(V, E, seed=args.seed)
-    g = build_graph(src, dst, V, weight="gcn_norm")
-    dg = DeviceGraph.from_host(g)
-    ell = EllPair.from_host(g)
-    bsp = BspEllPair.from_host(g, dt=512, vt=8192)
+    def selected(name: str) -> bool:
+        return not op_filter or any(s in name for s in op_filter)
 
-    x = jnp.asarray(rng.standard_normal((V, F)).astype(np.float32), jnp.bfloat16)
-    xw = jnp.asarray(
-        rng.standard_normal((V, F_WIDE)).astype(np.float32), jnp.bfloat16
-    )
-    w_mm = jnp.asarray(
-        rng.standard_normal((F_WIDE, F)).astype(np.float32), jnp.bfloat16
-    )
-    idx = jnp.asarray(rng.integers(0, V, size=E), jnp.int32)
-    big = jnp.asarray(rng.standard_normal(8 << 20).astype(np.float32))  # 32 MB
+    # Every input — graph tables AND dense arrays — is built lazily through
+    # this cache, so a filtered triage run pays only for what its ops touch
+    # (the bsp packing and the 233k x 602 wide table are minutes/hundreds
+    # of MB at --scale 2.0 on the 1-core rig). Ops declare their resources
+    # by key in OPS below; there is exactly one place op names live.
+    built = {}
 
-    def timed(name, fn, traffic_bytes=None, flops=None):
-        """fn(scalar) -> array; records median ms (+ derived rate)."""
+    def need(key):
+        if key not in built:
+            print(f"building {key} (host)...", file=sys.stderr, flush=True)
+            built[key] = builders[key]()
+        return built[key]
+
+    builders = {
+        "g": lambda: build_graph(
+            *synthetic_power_law_graph(V, E, seed=args.seed), V,
+            weight="gcn_norm",
+        ),
+        "dg": lambda: DeviceGraph.from_host(need("g")),
+        "ell": lambda: EllPair.from_host(need("g")),
+        "bsp": lambda: BspEllPair.from_host(need("g"), dt=512, vt=8192),
+        "x": lambda: jnp.asarray(
+            rng.standard_normal((V, F)).astype(np.float32), jnp.bfloat16
+        ),
+        "xw": lambda: jnp.asarray(
+            rng.standard_normal((V, F_WIDE)).astype(np.float32), jnp.bfloat16
+        ),
+        "w_mm": lambda: jnp.asarray(
+            rng.standard_normal((F_WIDE, F)).astype(np.float32), jnp.bfloat16
+        ),
+        "idx": lambda: jnp.asarray(rng.integers(0, V, size=E), jnp.int32),
+        "big": lambda: jnp.asarray(
+            rng.standard_normal(8 << 20).astype(np.float32)  # 32 MB
+        ),
+    }
+
+    def timed(name, make_fn, traffic_bytes=None, flops=None):
+        """make_fn() -> fn(scalar) -> array; records median ms (+ rate)."""
         try:
+            fn = make_fn()
             jfn = jax.jit(fn)
             jax.block_until_ready(jfn(jnp.float32(1.0)))  # compile
             ts = []
@@ -106,27 +137,52 @@ def main(argv=None) -> int:
             out["ops"][name] = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
             print(f"{name} FAILED: {out['ops'][name]}", file=sys.stderr, flush=True)
 
-    timed("matmul_bf16_602x128", lambda s: (xw * s) @ w_mm,
-          flops=2.0 * V * F_WIDE * F)
-    timed("hbm_stream_f32_64MB", lambda s: big * s,
-          traffic_bytes=2 * big.size * 4)
-    timed("row_gather_bf16", lambda s: (x * s)[idx],
-          traffic_bytes=E * F * 2)
-    timed("ell_aggregate_xla_bf16",
-          lambda s: ell_gather_dst_from_src(ell, x * s),
-          traffic_bytes=E * F * 2)
-    timed("sorted_scatter_bf16",
-          lambda s: gather_dst_from_src(dg, x * s),
-          traffic_bytes=E * F * 2)
-    timed("pallas_ell_resident_bf16",
-          lambda s: gather_dst_from_src_pallas(ell, x * s),
-          traffic_bytes=E * F * 2)
-    timed("pallas_ell_fchunked_602_bf16",
-          lambda s: gather_dst_from_src_pallas(ell, xw * s),
-          traffic_bytes=E * F_WIDE * 2)
-    timed("bsp_streamed_bf16",
-          lambda s: bsp_gather_dst_from_src(bsp, x * s),
-          traffic_bytes=E * F * 2)
+    # the single source of op names: (name, needs, fn_factory, kwargs).
+    # Resources are resolved EAGERLY (outside any jit trace — building a
+    # table mid-trace caches leaked tracers) and only for selected ops, so
+    # the filter decides what gets built and a rename cannot drift out of
+    # sync with a gate
+    OPS = [
+        ("matmul_bf16_602x128", ("xw", "w_mm"),
+         lambda xw, w_mm: lambda s: (xw * s) @ w_mm,
+         dict(flops=2.0 * V * F_WIDE * F)),
+        ("hbm_stream_f32_64MB", ("big",),
+         lambda big: lambda s: big * s,
+         dict(traffic_bytes=2 * (8 << 20) * 4)),
+        ("row_gather_bf16", ("x", "idx"),
+         lambda x, idx: lambda s: (x * s)[idx],
+         dict(traffic_bytes=E * F * 2)),
+        ("ell_aggregate_xla_bf16", ("ell", "x"),
+         lambda ell, x: lambda s: ell_gather_dst_from_src(ell, x * s),
+         dict(traffic_bytes=E * F * 2)),
+        ("sorted_scatter_bf16", ("dg", "x"),
+         lambda dg, x: lambda s: gather_dst_from_src(dg, x * s),
+         dict(traffic_bytes=E * F * 2)),
+        ("pallas_ell_resident_bf16", ("ell", "x"),
+         lambda ell, x: lambda s: gather_dst_from_src_pallas(ell, x * s),
+         dict(traffic_bytes=E * F * 2)),
+        ("pallas_ell_fchunked_602_bf16", ("ell", "xw"),
+         lambda ell, xw: lambda s: gather_dst_from_src_pallas(ell, xw * s),
+         dict(traffic_bytes=E * F_WIDE * 2)),
+        ("bsp_streamed_bf16", ("bsp", "x"),
+         lambda bsp, x: lambda s: bsp_gather_dst_from_src(bsp, x * s),
+         dict(traffic_bytes=E * F * 2)),
+    ]
+
+    run = [op for op in OPS if selected(op[0])]
+    if not run:
+        # a filter matching nothing must fail LOUDLY: a vacuous {} with
+        # rc 0 would let the supervisor mark a triage step collected
+        print(
+            f"FATAL: --ops {args.ops!r} matches none of "
+            f"{[op[0] for op in OPS]}",
+            file=sys.stderr, flush=True,
+        )
+        return 2
+    for name, needs, fn_factory, kwargs in run:
+        timed(name,
+              lambda ff=fn_factory, nd=needs: ff(*[need(k) for k in nd]),
+              **kwargs)
 
     print(json.dumps(out))
     return 0

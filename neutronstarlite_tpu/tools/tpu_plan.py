@@ -11,6 +11,8 @@ a mid-plan tunnel death costs only the step in flight.
 Plan steps — ``--list`` is authoritative; in execution order:
   1. bench_full: north-star full-scale sweep + winner measurement (bench.py)
   2. micro_kernels: reproducible PERF §1 micro table (tools/micro_bench)
+  2a. fullv_{pallas_resident,pallas_fchunked,bsp}: hang-triage per-op
+      timings at the full 233k-row table, one isolated step each
   3. tpu_tests: on-chip test module (tests/test_tpu.py, generous timeout)
   4. ell_chunk_{16,64,128}: NTS_ELL_CHUNK_MIB tuning on the eager/ELL path
   5. eager_pallas / standard_pallas / eager_bsp / eager_blocked: the
@@ -85,6 +87,28 @@ def build_steps(out_dir: str):
             1800,
             {},
         ),
+        # round-3 hang triage: both full-scale pallas sweep legs timed out
+        # (2026-07-31); per-op timing at the FULL 233k-row table (--scale
+        # 2.0 doubles the §1 V) separates a Mosaic compile blowup from a
+        # slow-gather runtime. One op per step: a hung compile stalls the
+        # process inside C++ where no in-process timeout can reach it, so
+        # the isolation (and the kill) is this supervisor's per-step
+        # subprocess timeout, and a hang costs only its own step
+        *[
+            (
+                f"fullv_{tag}",
+                [sys.executable, "-m",
+                 "neutronstarlite_tpu.tools.micro_bench",
+                 "--scale", "2.0", "--ops", op],
+                1200,
+                {},
+            )
+            for tag, op in (
+                ("pallas_resident", "pallas_ell_resident"),
+                ("pallas_fchunked", "pallas_ell_fchunked"),
+                ("bsp", "bsp_streamed"),
+            )
+        ],
         (
             "tpu_tests",
             [sys.executable, "-m", "pytest",
