@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -62,6 +63,18 @@ MAX_PALLAS_K = 1024
 # buffers); only when the ROW count alone exceeds the budget does the call
 # degrade to the XLA ELL path instead of failing Mosaic's VMEM allocation
 MAX_TABLE_BYTES = 96 << 20
+# levels with K below this are merged into one K=PALLAS_MIN_K level at
+# PallasEllPair build time (round-3 hang postmortem): every (rows, K, f)
+# triple is a distinct Mosaic compile, and at full Reddit scale ~22 bucket
+# levels x f-chunks x fwd/bwd directions stacked ~50 kernel compiles into
+# ONE jitted epoch program — aggregate compile time through the remote
+# compile service blew the 1200 s measurement window with nothing
+# persisted (the executable cache is whole-program). Low-K levels hold few
+# SLOTS on power-law graphs (rows with deg <= 64 contribute << E slots),
+# so padding them up is a few percent of slot traffic in exchange for
+# ~halving the distinct-kernel count. 0 disables. Numerically exact:
+# padding slots carry weight 0 into an f32 accumulation.
+PALLAS_MIN_K = int(os.environ.get("NTS_PALLAS_MIN_K", "64"))
 
 
 def _ell_level_kernel(nbr_ref, wgt_ref, x_ref, o_ref, *, k_cols: int):
@@ -129,6 +142,43 @@ def ell_aggregate_pallas(
     return out[:n_rows]
 
 
+def merge_low_k_levels(buckets: EllBuckets, min_k: int) -> EllBuckets:
+    """Merge every bucket level with 0 < K <= min_k into ONE level padded
+    to K=min_k. Consecutive levels concatenate in their original order, so
+    the concatenated output rows — and therefore ``inv_perm`` — are
+    untouched; padding slots carry neighbor 0 with weight 0 and contribute
+    nothing (the module-constant rationale explains why fewer levels
+    matter: one Mosaic compile per (rows, K, f) triple). The K=0
+    zero-degree level stays separate: merging it would buy slots for rows
+    with no edges at all."""
+    if min_k <= 0:
+        return buckets
+    merged_nbr, merged_wgt = [], []
+    group_n, group_w = [], []
+    for nbr, wgt in zip(buckets.nbr, buckets.wgt):
+        k = nbr.shape[1]
+        if 0 < k <= min_k:
+            pad = min_k - k
+            group_n.append(jnp.pad(nbr, ((0, 0), (0, pad))))
+            group_w.append(jnp.pad(wgt, ((0, 0), (0, pad))))
+            continue
+        # levels arrive in increasing K, so the low-K group is a prefix
+        # (after the optional K=0 level) — flush before any wider level
+        if group_n:
+            merged_nbr.append(jnp.concatenate(group_n, axis=0))
+            merged_wgt.append(jnp.concatenate(group_w, axis=0))
+            group_n, group_w = [], []
+        merged_nbr.append(nbr)
+        merged_wgt.append(wgt)
+    if group_n:
+        merged_nbr.append(jnp.concatenate(group_n, axis=0))
+        merged_wgt.append(jnp.concatenate(group_w, axis=0))
+    return EllBuckets(
+        nbr=merged_nbr, wgt=merged_wgt, inv_perm=buckets.inv_perm,
+        v_num=buckets.v_num, slot_chunk=buckets.slot_chunk,
+    )
+
+
 def gather_dst_from_src_pallas(
     ell_pair_or_buckets,
     x: jax.Array,
@@ -157,15 +207,21 @@ def gather_dst_from_src_pallas(
             return ell_tables_aggregate(
                 x, buckets.nbr, buckets.wgt, buckets.slot_chunk
             )[buckets.inv_perm]
+        # pad f up to a chunk multiple first so EVERY chunk call shares one
+        # [V, fc] shape — a ragged tail chunk (602 = 4*128 + 90) would be
+        # its own Mosaic compile for every level (round-3 hang postmortem)
+        fpad = (-f) % fc
+        if fpad:
+            x = jnp.pad(x, ((0, 0), (0, fpad)))
         return jnp.concatenate(
             [
                 gather_dst_from_src_pallas(
                     buckets, x[:, lo: lo + fc], row_tile, interpret
                 )
-                for lo in range(0, f, fc)
+                for lo in range(0, f + fpad, fc)
             ],
             axis=1,
-        )
+        )[:, :f]
     outs = []
     for nbr, wgt in zip(buckets.nbr, buckets.wgt):
         if nbr.shape[1] == 0:
@@ -195,10 +251,13 @@ def gather_dst_from_src_pallas(
 class PallasEllPair:
     """EllPair twin whose aggregation runs the fused Pallas kernel.
 
-    Same tables, same numeric policy, same custom_vjp transpose pairing as
-    ops.ell.EllPair — only the per-level executor differs (VMEM-resident
-    vectorized gather kernel instead of XLA gather+reduce; hub levels wider
-    than MAX_PALLAS_K still route to XLA, see gather_dst_from_src_pallas).
+    Same numeric policy and custom_vjp transpose pairing as ops.ell.EllPair;
+    the tables differ in one build-time transform: levels with K <=
+    PALLAS_MIN_K are merged into a single K=PALLAS_MIN_K level (fewer
+    Mosaic compiles — see merge_low_k_levels; numerically exact). The
+    per-level executor is the VMEM-resident vectorized gather kernel
+    instead of XLA gather+reduce; hub levels wider than MAX_PALLAS_K still
+    route to XLA, see gather_dst_from_src_pallas.
     Regime: the gathered [V, fc] table must fit the VMEM budget per
     feature-column chunk — any width works (wide layers are column-chunked,
     re-reading the tables per chunk), so both the EAGER order
@@ -220,7 +279,11 @@ class PallasEllPair:
 
     @staticmethod
     def from_pair(pair: EllPair, row_tile: int = DEFAULT_ROW_TILE) -> "PallasEllPair":
-        return PallasEllPair(fwd=pair.fwd, bwd=pair.bwd, row_tile=int(row_tile))
+        return PallasEllPair(
+            fwd=merge_low_k_levels(pair.fwd, PALLAS_MIN_K),
+            bwd=merge_low_k_levels(pair.bwd, PALLAS_MIN_K),
+            row_tile=int(row_tile),
+        )
 
 
 def _apply_buckets(buckets: EllBuckets, x: jax.Array, row_tile: int) -> jax.Array:

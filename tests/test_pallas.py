@@ -180,3 +180,57 @@ def test_pallas_feature_chunking_matches_dense(rng, monkeypatch):
     monkeypatch.setattr(pk, "MAX_TABLE_BYTES", 8)
     fb = gather_dst_from_src_pallas(pair, jnp.asarray(x), row_tile=8, interpret=True)
     np.testing.assert_allclose(np.asarray(fb, np.float64), want, rtol=1e-4, atol=1e-4)
+
+
+def test_merge_low_k_levels_exact_and_fewer(rng):
+    """Round-3 compile-count fix: merging every 0<K<=min_k level into one
+    K=min_k level must leave the aggregation bit-identical (padding slots
+    carry weight 0 into the same f32 accumulation, row order and inv_perm
+    untouched) while strictly reducing the level count."""
+    from neutronstarlite_tpu.ops.ell import ell_tables_aggregate
+    from neutronstarlite_tpu.ops.pallas_kernels import merge_low_k_levels
+
+    g, dense = tiny_graph(rng, v_num=97, e_num=900)
+    pair = EllPair.from_host(g)
+    for buckets in (pair.fwd, pair.bwd):
+        merged = merge_low_k_levels(buckets, 16)
+        assert len(merged.nbr) < len(buckets.nbr)
+        assert all(n.shape[1] == 0 or n.shape[1] >= 16 for n in merged.nbr)
+        x = rng.standard_normal((g.v_num, 8)).astype(np.float32)
+        a = ell_tables_aggregate(
+            jnp.asarray(x), buckets.nbr, buckets.wgt, buckets.slot_chunk
+        )[buckets.inv_perm]
+        b = ell_tables_aggregate(
+            jnp.asarray(x), merged.nbr, merged.wgt, merged.slot_chunk
+        )[merged.inv_perm]
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # min_k=0 disables: same object structure back
+    assert merge_low_k_levels(pair.fwd, 0) is pair.fwd
+
+
+def test_pallas_pair_merged_gradient_matches_ell(rng):
+    """PallasEllPair.from_pair now merges levels; the custom_vjp pairing
+    over the merged tables must still match the XLA ELL gradient."""
+    import jax
+
+    from neutronstarlite_tpu.ops.ell import ell_gather_dst_from_src
+    from neutronstarlite_tpu.ops.pallas_kernels import (
+        PallasEllPair,
+        pallas_gather_dst_from_src,
+    )
+
+    g, _ = tiny_graph(rng, v_num=53, e_num=420)
+    pair = EllPair.from_host(g)
+    ppair = PallasEllPair.from_pair(pair, row_tile=8)
+    x = jnp.asarray(rng.standard_normal((g.v_num, 4)).astype(np.float32))
+
+    def loss_p(v):
+        return (pallas_gather_dst_from_src(ppair, v) ** 2).sum()
+
+    def loss_e(v):
+        return (ell_gather_dst_from_src(pair, v) ** 2).sum()
+
+    np.testing.assert_allclose(
+        np.asarray(jax.grad(loss_p)(x)), np.asarray(jax.grad(loss_e)(x)),
+        rtol=1e-4, atol=1e-4,
+    )
