@@ -202,6 +202,17 @@ class DistGCNTrainer(ToolkitBase):
             )
             if layer_kind == "ell":
                 if cfg.kernel_tile > 0:
+                    if getattr(cfg, "pallas_kernel", False):
+                        # the single-chip PALLAS+KERNEL_TILE combo routes
+                        # to the bsp kernel (fullbatch.py); there is no
+                        # dist bsp yet — say so instead of silently
+                        # running the XLA blocked executor
+                        log.warning(
+                            "PALLAS:1 has no dist KERNEL_TILE kernel; "
+                            "running the XLA blocked executor "
+                            "(drop KERNEL_TILE to get the fused "
+                            "per-shard pallas kernel)"
+                        )
                     # the gathered [P*vp, f] slab outgrows the fast gather
                     # regime: source-tiled blocked tables per device
                     # (parallel/dist_blocked.py, round-3 KERNEL_TILE-on-dist)
@@ -226,14 +237,18 @@ class DistGCNTrainer(ToolkitBase):
                         DistEllPair,
                     )
 
-                    pair = DistEllPair.build(self.dist)
+                    # PALLAS:1 now reaches the dist path too: the per-shard
+                    # local aggregation runs the fused VMEM kernel over the
+                    # same stacked tables (low-K levels merged at build)
+                    kern = "pallas" if cfg.pallas_kernel else "xla"
+                    pair = DistEllPair.build(self.dist, kernel=kern)
                     est = pair.padding_stats(stats["real_edges"])
                     self.blocks = pair.shard(self.mesh)
                     log.info(
                         "OPTIM_KERNEL: dist gather-only aggregation "
-                        "(all_gather + %d-level ELL tables, %.2fx/%.2fx "
-                        "fwd/bwd slot padding)",
-                        len(self.blocks.fwd.nbr),
+                        "(all_gather + %d-level ELL tables, %s per-shard "
+                        "kernel, %.2fx/%.2fx fwd/bwd slot padding)",
+                        len(self.blocks.fwd.nbr), kern,
                         est["fwd_waste_ratio"], est["bwd_waste_ratio"],
                     )
             else:

@@ -43,7 +43,12 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from neutronstarlite_tpu.ops.ell import EllBuckets, EllPair, ell_tables_aggregate
+from neutronstarlite_tpu.ops.ell import (
+    EllBuckets,
+    EllPair,
+    _next_pow2,
+    ell_tables_aggregate,
+)
 
 try:  # pallas TPU backend may be absent on pure-CPU builds
     from jax.experimental.pallas import tpu as pltpu  # noqa: F401
@@ -142,37 +147,65 @@ def ell_aggregate_pallas(
     return out[:n_rows]
 
 
-def merge_low_k_levels(buckets: EllBuckets, min_k: int) -> EllBuckets:
-    """Merge every bucket level with 0 < K <= min_k into ONE level padded
-    to K=min_k. Consecutive levels concatenate in their original order, so
-    the concatenated output rows — and therefore ``inv_perm`` — are
-    untouched; padding slots carry neighbor 0 with weight 0 and contribute
-    nothing (the module-constant rationale explains why fewer levels
-    matter: one Mosaic compile per (rows, K, f) triple). The K=0
+def merge_level_tables(nbrs, wgts, min_k: int, row_axis: int = 0):
+    """Merge every level with 0 < K <= min_k into ONE level padded to
+    K=min_k: pad the (last) K axis, concatenate rows along ``row_axis``.
+    Consecutive levels concatenate in their original order, so the
+    concatenated output rows — and therefore any inv row map over them —
+    are untouched; padding slots carry neighbor 0 with weight 0 and
+    contribute nothing (the module-constant rationale explains why fewer
+    levels matter: one Mosaic compile per (rows, K, f) triple). The K=0
     zero-degree level stays separate: merging it would buy slots for rows
-    with no edges at all."""
+    with no edges at all. Serves both the 2D EllBuckets tables
+    (row_axis=0) and the stacked [P, Nk, K] dist tables (row_axis=1)."""
     if min_k <= 0:
-        return buckets
+        return list(nbrs), list(wgts)
     merged_nbr, merged_wgt = [], []
     group_n, group_w = [], []
-    for nbr, wgt in zip(buckets.nbr, buckets.wgt):
-        k = nbr.shape[1]
+    for nbr, wgt in zip(nbrs, wgts):
+        k = nbr.shape[-1]
         if 0 < k <= min_k:
-            pad = min_k - k
-            group_n.append(jnp.pad(nbr, ((0, 0), (0, pad))))
-            group_w.append(jnp.pad(wgt, ((0, 0), (0, pad))))
+            pad = [(0, 0)] * nbr.ndim
+            pad[-1] = (0, min_k - k)
+            group_n.append(jnp.pad(nbr, pad))
+            group_w.append(jnp.pad(wgt, pad))
             continue
         # levels arrive in increasing K, so the low-K group is a prefix
         # (after the optional K=0 level) — flush before any wider level
         if group_n:
-            merged_nbr.append(jnp.concatenate(group_n, axis=0))
-            merged_wgt.append(jnp.concatenate(group_w, axis=0))
+            merged_nbr.append(jnp.concatenate(group_n, axis=row_axis))
+            merged_wgt.append(jnp.concatenate(group_w, axis=row_axis))
             group_n, group_w = [], []
         merged_nbr.append(nbr)
         merged_wgt.append(wgt)
     if group_n:
-        merged_nbr.append(jnp.concatenate(group_n, axis=0))
-        merged_wgt.append(jnp.concatenate(group_w, axis=0))
+        merged_nbr.append(jnp.concatenate(group_n, axis=row_axis))
+        merged_wgt.append(jnp.concatenate(group_w, axis=row_axis))
+    return merged_nbr, merged_wgt
+
+
+def effective_min_k(total_slots: int, n_rows: int, min_k: int) -> int:
+    """Cap the merge threshold at the graph's own degree scale: merging to
+    K=64 on a mean-degree-5 graph (Cora) pads ~15x, while on mean-degree-
+    492 Reddit the same merge costs a few percent. next-pow2 of the mean
+    slot count per row keeps the compile-count win where slots are dense
+    and bounds the padding where they are not (mean is computed over the
+    already-padded tables, so it upper-bounds the real mean degree)."""
+    if min_k <= 0 or n_rows <= 0:
+        return min_k
+    return min(min_k, _next_pow2(max(total_slots // n_rows, 1)))
+
+
+def merge_low_k_levels(buckets: EllBuckets, min_k: int) -> EllBuckets:
+    """EllBuckets wrapper of ``merge_level_tables`` (row_axis=0). ``min_k``
+    is applied literally — degree-adaptive capping is the POLICY sites'
+    job (PallasEllPair.from_pair, parallel/dist_ell.DistEllPair.build via
+    ``effective_min_k``), not this mechanism's."""
+    if min_k <= 0:
+        return buckets
+    merged_nbr, merged_wgt = merge_level_tables(
+        buckets.nbr, buckets.wgt, min_k, row_axis=0
+    )
     return EllBuckets(
         nbr=merged_nbr, wgt=merged_wgt, inv_perm=buckets.inv_perm,
         v_num=buckets.v_num, slot_chunk=buckets.slot_chunk,
@@ -194,6 +227,24 @@ def gather_dst_from_src_pallas(
         if isinstance(ell_pair_or_buckets, EllPair)
         else ell_pair_or_buckets
     )
+    return pallas_tables_aggregate(
+        x, buckets.nbr, buckets.wgt, buckets.slot_chunk,
+        row_tile=row_tile, interpret=interpret,
+    )[buckets.inv_perm]
+
+
+def pallas_tables_aggregate(
+    x: jax.Array,
+    nbrs,
+    wgts,
+    slot_chunk: int,
+    row_tile: int = DEFAULT_ROW_TILE,
+    interpret: bool = False,
+) -> jax.Array:
+    """Level-table twin of ``ops.ell.ell_tables_aggregate`` running the
+    fused kernel per level (callers apply their own inv_perm) — the shared
+    executor for the single-chip path above and the distributed per-shard
+    path (parallel/dist_ell.py with kernel='pallas')."""
     v_num, f = x.shape
     if v_num * f * x.dtype.itemsize > MAX_TABLE_BYTES:
         # wider than the VMEM budget: chunk the FEATURE dim so each chunk's
@@ -204,9 +255,7 @@ def gather_dst_from_src_pallas(
             # the ROW count alone exceeds the budget (V > ~375k rows in
             # bf16): single-chip beyond-VMEM graphs route to the XLA path
             # here; ops/bsp_ell.py is the Pallas kernel for that regime
-            return ell_tables_aggregate(
-                x, buckets.nbr, buckets.wgt, buckets.slot_chunk
-            )[buckets.inv_perm]
+            return ell_tables_aggregate(x, nbrs, wgts, slot_chunk)
         # pad f up to a chunk multiple first so EVERY chunk call shares one
         # [V, fc] shape — a ragged tail chunk (602 = 4*128 + 90) would be
         # its own Mosaic compile for every level (round-3 hang postmortem)
@@ -215,15 +264,16 @@ def gather_dst_from_src_pallas(
             x = jnp.pad(x, ((0, 0), (0, fpad)))
         return jnp.concatenate(
             [
-                gather_dst_from_src_pallas(
-                    buckets, x[:, lo: lo + fc], row_tile, interpret
+                pallas_tables_aggregate(
+                    x[:, lo: lo + fc], nbrs, wgts, slot_chunk,
+                    row_tile=row_tile, interpret=interpret,
                 )
                 for lo in range(0, f + fpad, fc)
             ],
             axis=1,
         )[:, :f]
     outs = []
-    for nbr, wgt in zip(buckets.nbr, buckets.wgt):
+    for nbr, wgt in zip(nbrs, wgts):
         if nbr.shape[1] == 0:
             # zero-degree bucket: zero rows, no kernel launch
             outs.append(jnp.zeros((nbr.shape[0], x.shape[1]), x.dtype))
@@ -231,16 +281,14 @@ def gather_dst_from_src_pallas(
             # hub tail: the kernel vectorizes over rows and loops K, so a
             # [few rows, K ~ 2^21] level (a power-law supernode bucket)
             # would serialize; its XLA gather+reduce vectorizes over K
-            outs.append(
-                ell_tables_aggregate(x, [nbr], [wgt], buckets.slot_chunk)
-            )
+            outs.append(ell_tables_aggregate(x, [nbr], [wgt], slot_chunk))
         else:
             outs.append(
                 ell_aggregate_pallas(
                     nbr, wgt, x, row_tile=row_tile, interpret=interpret
                 )
             )
-    return jnp.concatenate(outs, axis=0)[buckets.inv_perm]
+    return jnp.concatenate(outs, axis=0)
 
 
 # ---- trainable Pallas backend (KERNEL selection: PALLAS:1) -----------------
@@ -279,9 +327,16 @@ class PallasEllPair:
 
     @staticmethod
     def from_pair(pair: EllPair, row_tile: int = DEFAULT_ROW_TILE) -> "PallasEllPair":
+        def adaptive(buckets: EllBuckets) -> EllBuckets:
+            slots = sum(int(n.shape[0] * n.shape[1]) for n in buckets.nbr)
+            rows = sum(int(n.shape[0]) for n in buckets.nbr)
+            return merge_low_k_levels(
+                buckets, effective_min_k(slots, rows, PALLAS_MIN_K)
+            )
+
         return PallasEllPair(
-            fwd=merge_low_k_levels(pair.fwd, PALLAS_MIN_K),
-            bwd=merge_low_k_levels(pair.bwd, PALLAS_MIN_K),
+            fwd=adaptive(pair.fwd),
+            bwd=adaptive(pair.bwd),
             row_tile=int(row_tile),
         )
 
