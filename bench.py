@@ -321,6 +321,33 @@ def load_cached_graph(d: str):
 # ---- worker: measure ONE config in this process ----------------------------
 
 
+def build_host_tables(path, host_graph, kernel_tile):
+    """Path -> prebuilt host aggregation tables. The ONE place the
+    path-to-table mapping lives: worker_main and tools/aot_bench_path both
+    call this, so the AOT tool always compiles the exact program the
+    worker runs."""
+    if path == "ell":
+        # rebuilt per worker: ~24 s at full scale (docs/PERF.md section 3b),
+        # cheap enough that on-disk caching of the ragged bucket arrays
+        # isn't worth its complexity (isolation is the point here)
+        from neutronstarlite_tpu.ops.ell import EllPair
+
+        return EllPair.from_host(host_graph)
+    if path == "pallas":
+        # PALLAS:1 = the streamed block-sparse kernel at the DEFAULT src
+        # tile (the resident-gather design cannot lower to Mosaic —
+        # ops/pallas_kernels.py docstring); path "bsp" A/Bs an explicit
+        # KERNEL_TILE src-tile height against this default
+        from neutronstarlite_tpu.ops.bsp_ell import BspEllPair
+
+        return BspEllPair.from_host(host_graph)
+    if path == "blocked":
+        from neutronstarlite_tpu.ops.blocked_ell import BlockedEllPair
+
+        return BlockedEllPair.from_host(host_graph, vt=kernel_tile)
+    return None
+
+
 def _make_trainer(
     order, path, precision, src, dst, datum, v_num, epochs, warmup,
     host_graph=None, host_ell=None, kernel_tile=0,
@@ -417,19 +444,8 @@ def worker_main(args) -> int:
     sizes = [int(s) for s in LAYERS.split("-")]
     datum = GNNDatum.random_generate(v_num, sizes[0], N_LABELS, seed=7)
 
-    host_ell = None
     t0 = time.time()
-    if path in ("ell", "pallas"):
-        # rebuilt per worker: ~24 s at full scale (docs/PERF.md section 3b),
-        # cheap enough that on-disk caching of the ragged bucket arrays
-        # isn't worth its complexity (isolation is the point here)
-        from neutronstarlite_tpu.ops.ell import EllPair
-
-        host_ell = EllPair.from_host(host_graph)
-    elif path == "blocked":
-        from neutronstarlite_tpu.ops.blocked_ell import BlockedEllPair
-
-        host_ell = BlockedEllPair.from_host(host_graph, vt=args.kernel_tile)
+    host_ell = build_host_tables(path, host_graph, args.kernel_tile)
     tables_s = time.time() - t0
 
     t0 = time.time()
@@ -508,10 +524,10 @@ def main(argv=None) -> int:
         choices=["scatter", "ell", "blocked", "pallas", "bsp"],
         help="aggregation backend: chunked sorted-scatter, ELL gather "
         "(the OPTIM_KERNEL toggle), source-tiled blocked ELL "
-        "(beyond-VMEM gather tables), the fused Pallas ELL kernel "
-        "(gathered table VMEM-resident, feature-column-chunked past the "
-        "budget — any width), or the streamed block-sparse Pallas kernel "
-        "(V-beyond-VMEM regime, ops/bsp_ell.py)",
+        "(beyond-VMEM gather tables), or the streamed block-sparse "
+        "Pallas kernel (ops/bsp_ell.py — the one fused design Mosaic "
+        "can compile); pallas = bsp at the default src tile, bsp = "
+        "bsp at --kernel-tile",
     )
     ap.add_argument(
         "--kernel-tile", type=int, default=8192,
@@ -571,7 +587,9 @@ def main(argv=None) -> int:
         # blocked/bsp pay a minutes-long full-scale host table build on the
         # 1-core rig (docs/PERF.md section 3c; compiles are seconds since
         # the stacked redesign) — give them 3x the normal cap
-        cap = args.config_timeout * (3.0 if path in ("blocked", "bsp") else 1.0)
+        cap = args.config_timeout * (
+            3.0 if path in ("blocked", "bsp", "pallas") else 1.0
+        )
         timeout_s = max(min(cap, budget_s), 60.0)
         print(
             f"measuring {order}/{path}/{precision} epochs={epochs} "
@@ -607,11 +625,11 @@ def main(argv=None) -> int:
             precisions.append(
                 "float32" if args.precision == "bfloat16" else "bfloat16"
             )
-        # pallas joined the auto sweep in round 3: feature-column chunking
-        # (ops/pallas_kernels.py) made the fused kernel legal at any width,
-        # and its roofline bound is ~20x under the beyond-VMEM ELL regime
-        # at the standard order. blocked/bsp stay behind --sweep full
-        # (minutes-long host table builds).
+        # pallas = the streamed block-sparse kernel at its default src
+        # tile (the resident-gather design cannot lower to Mosaic,
+        # ops/pallas_kernels.py docstring); its one-hot-MXU cost model
+        # bounds the epoch ~10-100x under the XLA gather path's observed
+        # time. blocked/bsp (explicit-tile A/B) stay behind --sweep full.
         # pallas FIRST: on a tight deadline the budget-exhaustion break
         # must drop the already-known round-2 paths, never the expected
         # winner the sweep exists to measure (scatter last: its full-scale
@@ -658,7 +676,7 @@ def main(argv=None) -> int:
                      "error": "skipped: path timed out earlier in sweep"}
                 )
                 continue
-            mult = 3.0 if p in ("blocked", "bsp") else 1.0
+            mult = 3.0 if p in ("blocked", "bsp", "pallas") else 1.0
             leg_full_s = min(
                 args.config_timeout * mult, leg_cap_s * mult,
                 sweep_budget_s * 0.35,

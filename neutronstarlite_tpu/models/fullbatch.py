@@ -67,11 +67,21 @@ class FullBatchTrainer(ToolkitBase):
 
             if self.host_ell is not None:
                 self.compute_graph = self.host_ell
-            elif cfg.kernel_tile > 0 and cfg.pallas_kernel:
-                # PALLAS:1 + KERNEL_TILE:vt -> the streamed block-sparse
-                # kernel (ops/bsp_ell.py), the V-beyond-VMEM Pallas regime
+            elif cfg.pallas_kernel and os.environ.get(
+                "NTS_PALLAS_RESIDENT", "0"
+            ) == "1":
+                # the resident-table kernel cannot lower to Mosaic (TPU
+                # gather restriction, ops/pallas_kernels.py docstring) —
+                # interpret-mode experiments only
+                self.compute_graph = PallasEllPair.from_host(self.host_graph)
+            elif cfg.pallas_kernel:
+                # PALLAS:1 -> the streamed block-sparse kernel at ANY
+                # scale: the one fused aggregation design Mosaic can
+                # compile (one-hot MXU combine, no gather). KERNEL_TILE:vt
+                # sets the src-tile height explicitly.
                 self.compute_graph = BspEllPair.from_host(
-                    self.host_graph, vt=cfg.kernel_tile
+                    self.host_graph,
+                    **({"vt": cfg.kernel_tile} if cfg.kernel_tile > 0 else {}),
                 )
             elif cfg.kernel_tile > 0:
                 self.compute_graph = BlockedEllPair.from_host(
@@ -79,9 +89,6 @@ class FullBatchTrainer(ToolkitBase):
                 )
             else:
                 self.compute_graph = EllPair.from_host(self.host_graph)
-            if cfg.pallas_kernel and isinstance(self.compute_graph, EllPair):
-                # same tables, fused-kernel executor (PALLAS:1)
-                self.compute_graph = PallasEllPair.from_pair(self.compute_graph)
             if isinstance(self.compute_graph, BlockedEllPair):
                 log.info(
                     "OPTIM_KERNEL: blocked ELL aggregation (%d src tiles of "

@@ -237,10 +237,19 @@ class DistGCNTrainer(ToolkitBase):
                         DistEllPair,
                     )
 
-                    # PALLAS:1 now reaches the dist path too: the per-shard
-                    # local aggregation runs the fused VMEM kernel over the
-                    # same stacked tables (low-K levels merged at build)
+                    # PALLAS:1 reaches the dist path as the INTERPRET-mode
+                    # per-shard executor (CPU-mesh parity rigs). On a real
+                    # TPU the resident-gather kernel cannot lower to
+                    # Mosaic (ops/pallas_kernels.py docstring), so the XLA
+                    # executor serves until a dist-bsp kernel lands.
                     kern = "pallas" if cfg.pallas_kernel else "xla"
+                    if kern == "pallas" and jax.default_backend() == "tpu":
+                        log.warning(
+                            "PALLAS:1 dist executor is interpret-only "
+                            "(Mosaic gather restriction); running the "
+                            "XLA per-shard executor on TPU"
+                        )
+                        kern = "xla"
                     pair = DistEllPair.build(self.dist, kernel=kern)
                     est = pair.padding_stats(stats["real_edges"])
                     self.blocks = pair.shard(self.mesh)

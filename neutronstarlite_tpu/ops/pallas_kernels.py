@@ -17,20 +17,26 @@ section 2) — and fuses gather + scale + K-reduction in VMEM:
   assembles with the inverse permutation — a drop-in twin of
   ``ops.ell.ell_gather_dst_from_src``'s forward.
 
-Regime (measured reasoning, docs/PERF.md section 1): the kernel requires
-the gathered table VMEM-resident. Round 3 removes the WIDTH limit via
-feature-column chunking: when [V, f] exceeds the budget the call splits f
-into column chunks of the widest multiple-of-128 width that fits, runs
-the kernel per chunk ([V, fc] resident), and concatenates — the ELL
-tables are re-read once per chunk (O(E * 8 B * n_chunks), ~5x at Reddit's
-602-wide layer 1) in exchange for keeping every gather on-chip instead of
-O(E * f) HBM transactions. This covers the full-scale STANDARD order,
-whose first-layer [233k, 602] table was the original fallback trigger.
-The remaining VMEM bound is the ROW count: V <= budget / (128 * itemsize)
-(~375k rows in bf16). Past that — graphs ~10x Reddit on one chip — use
-ops/bsp_ell.py, the (dst-tile, src-tile) streamed block-sparse kernel
-(VERDICT round-2 item 3); its docstring carries the FLOP/bandwidth math
-for why f-chunking is preferred whenever the row count allows.
+**STATUS (round 3, discovered via topology AOT compiles 2026-07-31):
+interpret-mode / design-study only — this kernel cannot lower to Mosaic.**
+The TPU's only vectorized gather (``tpu.dynamic_gather``, exposed through
+``jnp.take_along_axis``) is an ELEMENTWISE shuffle whose input, index and
+output shapes must all match (jax/_src/pallas/mosaic/lowering.py's
+lax.gather rule); a row gather ``x[idx]`` from a resident [V, f] table —
+the core of this kernel — has out rows != V and is rejected for every
+(rows, K, V, f) shape tested. There is no VMEM-resident random-row-gather
+primitive to build on, so the whole "table resident, gather on-chip"
+regime is unimplementable in compiled Pallas on this stack; the full-scale
+bench legs that tried compiled ~50-kernel epochs of this design never
+returned (the remote compile service hangs rather than surfacing the
+ValueError). The PRODUCTION fused aggregation is ops/bsp_ell.py — the
+(dst-tile, src-tile) streamed block-sparse kernel whose per-block combine
+is a one-hot MXU matmul, i.e. the one fused design that needs NO gather
+at all; ``PALLAS:1`` routes there (models/fullbatch.py). This module
+remains as the interpret-mode twin (semantics tests, CPU CI) and the
+written record of the regime analysis: feature-column chunking, level
+merging and the VMEM budget math below are correct FOR THE DESIGN and
+would apply directly should Mosaic grow a row-gather primitive.
 """
 
 from __future__ import annotations
@@ -341,12 +347,20 @@ class PallasEllPair:
         )
 
 
+def pallas_interpret_default() -> bool:
+    """interpret everywhere the default backend can't lower Mosaic — keeps
+    the CPU suite exercising the same code path the chip runs.
+    NTS_PALLAS_FORCE_COMPILED=1 overrides for AOT lowering against a TPU
+    TOPOLOGY from a CPU host (tools/aot_bench_path): tracing never executes
+    the kernel, and the topology compiler consumes the Mosaic call."""
+    if os.environ.get("NTS_PALLAS_FORCE_COMPILED", "0") == "1":
+        return False
+    return jax.default_backend() not in ("tpu",)
+
+
 def _apply_buckets(buckets: EllBuckets, x: jax.Array, row_tile: int) -> jax.Array:
-    # interpret everywhere the default backend can't lower Mosaic — keeps
-    # the CPU suite exercising the same code path the chip runs
-    interpret = jax.default_backend() not in ("tpu",)
     return gather_dst_from_src_pallas(
-        buckets, x, row_tile=row_tile, interpret=interpret
+        buckets, x, row_tile=row_tile, interpret=pallas_interpret_default()
     )
 
 

@@ -127,11 +127,15 @@ class InputInfo:
     kernel_tile: int = 0  # OPTIM_KERNEL source-tile width (vertices): 0 =
     # plain ELL; >0 = blocked ELL (ops/blocked_ell.py) whose per-tile gather
     # table [vt, f] is sized to stay in the fast on-chip regime at any V
-    pallas_kernel: bool = False  # OPTIM_KERNEL:1 + PALLAS:1 -> run the ELL
-    # aggregation through the fused Pallas kernel (ops/pallas_kernels.py)
-    # instead of the XLA gather+reduce; same tables, same numeric policy.
-    # PALLAS:1 + KERNEL_TILE:vt -> the streamed block-sparse Pallas kernel
-    # (ops/bsp_ell.py), the single-chip V-beyond-VMEM regime
+    pallas_kernel: bool = False  # OPTIM_KERNEL:1 + PALLAS:1 -> run the
+    # aggregation through the fused streamed block-sparse Pallas kernel
+    # (ops/bsp_ell.py — the one fused design Mosaic can compile: one-hot
+    # MXU gather + scatter, no unsupported row gathers) at any scale;
+    # KERNEL_TILE:vt sets its src-tile height (default DEFAULT_VT). The
+    # resident-gather kernel (ops/pallas_kernels.py) is interpret-only,
+    # reachable via NTS_PALLAS_RESIDENT=1 (its docstring has the analysis).
+    # On the dist path PALLAS:1 is the interpret-mode per-shard executor
+    # (CPU-mesh rigs); on TPU it downgrades to XLA with a warning.
     edge_chunk: int = 0  # scatter-path edge chunk size (0 = auto); applies
     # to the chunked-scatter layouts (DeviceGraph, DistGraph) — the ELL and
     # mirror-slot layouts have their own slot sizing. Tests/dryruns set it

@@ -178,5 +178,6 @@ def test_bsp_native_fill_matches_numpy(rng, monkeypatch):
         np.testing.assert_array_equal(np.asarray(a.nbr), np.asarray(b.nbr))
         np.testing.assert_array_equal(np.asarray(a.wgt), np.asarray(b.wgt))
         np.testing.assert_array_equal(np.asarray(a.ldst), np.asarray(b.ldst))
-        np.testing.assert_array_equal(np.asarray(a.blk_dst), np.asarray(b.blk_dst))
-        np.testing.assert_array_equal(np.asarray(a.blk_src), np.asarray(b.blk_src))
+        np.testing.assert_array_equal(
+            np.asarray(a.blk_key), np.asarray(b.blk_key)
+        )
