@@ -139,18 +139,16 @@ def build_steps(out_dir: str):
             {"NTS_BENCH_DEADLINE_S": "1500"},
         ),
         (
-            # round 3: feature-column chunking made the fused Pallas kernel
-            # legal at the 602-wide STANDARD order (pallas_kernels.py) —
-            # the heaviest gather in the workload, previously XLA-fallback
+            # PALLAS:1 = the Mosaic bsp kernel at the default src tile;
+            # the standard order prices its one-hot matmuls at f=602
             "standard_pallas",
             _bench("--order", "standard", "--path", "pallas"),
             1800,
             {"NTS_BENCH_DEADLINE_S": "1500"},
         ),
         (
-            # round 3: streamed block-sparse kernel (ops/bsp_ell.py) — the
-            # V-beyond-VMEM regime; timed at Reddit scale for the record
-            # even though the resident/f-chunked paths should win here
+            # the Mosaic bsp kernel at an explicit large src tile (8192)
+            # vs the default-vt pallas legs and the small-vt sweep below
             "eager_bsp",
             _bench("--order", "eager", "--path", "bsp"),
             # measured: the full-scale packed-block host build is ~276 s
@@ -158,6 +156,22 @@ def build_steps(out_dir: str):
             3600,
             {"NTS_BENCH_DEADLINE_S": "3300"},
         ),
+        *[
+            (
+                # src-tile sensitivity of the Mosaic bsp kernel: the
+                # in-kernel W build costs O(R * vt * K) VPU compares per
+                # block while the block count grows sublinearly as vt
+                # shrinks — the optimum is expected BELOW the streaming
+                # defaults (8192/4096); eager_bsp + the pallas leg anchor
+                # the high end
+                f"bsp_vt_{vt}",
+                _bench("--order", "eager", "--path", "bsp",
+                       "--kernel-tile", str(vt)),
+                3600,
+                {"NTS_BENCH_DEADLINE_S": "3300"},
+            )
+            for vt in (2048, 1024)
+        ],
         (
             "eager_blocked",
             # full-scale blocked host tables are ~2 min/direction on this
