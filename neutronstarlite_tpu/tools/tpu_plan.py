@@ -15,9 +15,10 @@ Plan steps — ``--list`` is authoritative; in execution order:
       timings at the full 233k-row table, one isolated step each
   3. tpu_tests: on-chip test module (tests/test_tpu.py, generous timeout)
   4. ell_chunk_{16,64,128}: NTS_ELL_CHUNK_MIB tuning on the eager/ELL path
-  5. eager_pallas / standard_pallas / eager_bsp / eager_blocked: the
-     other full-scale kernel paths (standard_pallas and eager_bsp are
-     round-3 kernels: f-chunked fused ELL and streamed block-sparse)
+  5. eager_pallas / standard_pallas / eager_bsp / bsp_vt_{2048,1024} /
+     eager_blocked: the other full-scale kernel paths — pallas = the
+     Mosaic bsp kernel at the default src tile, eager_bsp/bsp_vt_* sweep
+     the src-tile height (W-build cost vs block count)
   6. eager_scatter_fence: lane-pad A/B for the PERF §2a scatter cliff
   7. aot_dist_blocked: full-scale 8-way KERNEL_TILE-dist capacity compile
   8. bench_matrix: workload matrix over configs/ (tools/bench_matrix)
