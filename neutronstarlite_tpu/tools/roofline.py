@@ -38,7 +38,12 @@ import os
 REDDIT_V, REDDIT_E = 232965, 114615892
 LAYERS = (602, 128, 41)
 HBM_GBS = 819.0  # v5e
+MXU_TFLOPS_BF16 = 197.0  # v5e peak
 ELL_PAD = 1.33  # measured fwd slot inflation at full scale (PERF.md 3b)
+# Mosaic bsp kernel (the PALLAS:1 path): measured full-scale block counts
+# per direction (nts.bsp_ell build logs, docs/perf_runs/round3/)
+BSP_BLOCKS = {8192: 140896, 4096: 174445}
+BSP_R = 128  # rows per block (one-hot matmul height)
 
 
 def epoch_bytes(order: str, path: str, v: int, e: int, b: int = 2) -> float:
@@ -51,14 +56,18 @@ def epoch_bytes(order: str, path: str, v: int, e: int, b: int = 2) -> float:
         f_agg = f_in if order == "standard" else f_out
         # aggregation, forward + backward (transpose tables, same volume)
         vmem_budget = 96 << 20
-        if path == "pallas":
-            # f-chunked fused kernel: tables re-read per 128-lane column
-            # chunk, every gather on-chip regardless of width
-            n_chunks = (
-                -(-f_agg // 128) if v * f_agg * b > vmem_budget else 1
-            )
-            agg = 2 * (slots * 8.0 * n_chunks + 2 * v * f_agg * b)
-        elif path in ("ell", "blocked", "bsp"):
+        if path in ("pallas", "bsp"):
+            # Mosaic bsp kernel (PALLAS:1): the bound is MXU time, not
+            # HBM — each block pays one [R, vt] @ [vt, f_agg] bf16 dot
+            # (the weights-folded one-hot gather); slab streams and table
+            # reads are an order smaller. Convert the FLOP bound into
+            # equivalent "bytes" at the HBM rate so one epoch model serves
+            # (bound_s divides by HBM_GBS).
+            vt = 8192 if path == "bsp" else 4096
+            blocks = BSP_BLOCKS.get(vt, BSP_BLOCKS[4096]) * (v / REDDIT_V)
+            mxu_flops = 2.0 * blocks * BSP_R * vt * f_agg
+            agg = 2 * mxu_flops / (MXU_TFLOPS_BF16 * 1e12) * (HBM_GBS * 1e9)
+        elif path in ("ell", "blocked"):
             agg = 2 * (slots * 8.0 + 2 * v * f_agg * b)
             if path == "ell" and v * f_agg * b > vmem_budget:
                 # XLA gather table beyond VMEM: every gathered row is an
