@@ -19,6 +19,7 @@ single-chip path (ring of length 1, no collectives).
 
 from __future__ import annotations
 
+import os
 from typing import Any, Dict
 
 import jax
@@ -81,6 +82,10 @@ def dist_gcn_forward(
         DistBlockedEllPair,
         dist_blocked_gather_dst_from_src,
     )
+    from neutronstarlite_tpu.parallel.dist_bsp import (
+        DistBspPair,
+        dist_bsp_gather_dst_from_src,
+    )
     from neutronstarlite_tpu.parallel.dist_edge_ops import (
         dist_gather_dst_from_src_mirror,
     )
@@ -95,6 +100,8 @@ def dist_gcn_forward(
             # matmuls, the graph exchange replaced by identity — the
             # nn_time/graph_time split (models/debuginfo.py)
             return v
+        if isinstance(blocks, DistBspPair):
+            return dist_bsp_gather_dst_from_src(mesh, blocks, v)
         if isinstance(blocks, DistBlockedEllPair):
             return dist_blocked_gather_dst_from_src(mesh, blocks, v)
         if isinstance(blocks, DistEllPair):
@@ -201,17 +208,40 @@ class DistGCNTrainer(ToolkitBase):
                 stats["max_block"], stats["mean_block"],
             )
             if layer_kind == "ell":
-                if cfg.kernel_tile > 0:
+                if getattr(cfg, "pallas_kernel", False) and os.environ.get(
+                    "NTS_PALLAS_RESIDENT", "0"
+                ) != "1":
+                    # PALLAS:1 -> the rectangular Mosaic bsp kernel per
+                    # shard over the all_gathered slab (parallel/dist_bsp)
+                    # — the same fused kernel the single chip runs;
+                    # KERNEL_TILE sets its src-tile height
+                    from neutronstarlite_tpu.ops.bsp_ell import DEFAULT_VT
+                    from neutronstarlite_tpu.parallel.dist_bsp import (
+                        DistBspPair,
+                    )
+
+                    pair = DistBspPair.build(
+                        self.dist, vt=cfg.kernel_tile or DEFAULT_VT
+                    )
+                    est = pair.padding_stats(stats["real_edges"])
+                    self.blocks = pair.shard(self.mesh)
+                    log.info(
+                        "OPTIM_KERNEL: dist bsp aggregation (all_gather + "
+                        "[P, %d, %d, %d] stacked blocks, vt=%d, "
+                        "%.2fx/%.2fx fwd/bwd slot padding)",
+                        *self.blocks.fwd.nbr.shape[1:],
+                        self.blocks.fwd.vt,
+                        est["fwd_waste_ratio"], est["bwd_waste_ratio"],
+                    )
+                elif cfg.kernel_tile > 0:
                     if getattr(cfg, "pallas_kernel", False):
-                        # the single-chip PALLAS+KERNEL_TILE combo routes
-                        # to the bsp kernel (fullbatch.py); there is no
-                        # dist bsp yet — say so instead of silently
-                        # running the XLA blocked executor
+                        # only reachable with NTS_PALLAS_RESIDENT=1: the
+                        # resident executor has no KERNEL_TILE form, so
+                        # the pallas request is dropped — say so
                         log.warning(
-                            "PALLAS:1 has no dist KERNEL_TILE kernel; "
-                            "running the XLA blocked executor "
-                            "(drop KERNEL_TILE to get the fused "
-                            "per-shard pallas kernel)"
+                            "PALLAS:1 ignored: NTS_PALLAS_RESIDENT=1 has "
+                            "no KERNEL_TILE executor; running the XLA "
+                            "blocked layout"
                         )
                     # the gathered [P*vp, f] slab outgrows the fast gather
                     # regime: source-tiled blocked tables per device
@@ -237,17 +267,16 @@ class DistGCNTrainer(ToolkitBase):
                         DistEllPair,
                     )
 
-                    # PALLAS:1 reaches the dist path as the INTERPRET-mode
-                    # per-shard executor (CPU-mesh parity rigs). On a real
-                    # TPU the resident-gather kernel cannot lower to
-                    # Mosaic (ops/pallas_kernels.py docstring), so the XLA
-                    # executor serves until a dist-bsp kernel lands.
+                    # NTS_PALLAS_RESIDENT=1 + PALLAS:1 keeps the interpret
+                    # -only per-shard resident executor for CPU-mesh
+                    # experiments (it cannot lower to Mosaic; on TPU it
+                    # downgrades to XLA with a warning)
                     kern = "pallas" if cfg.pallas_kernel else "xla"
                     if kern == "pallas" and jax.default_backend() == "tpu":
                         log.warning(
-                            "PALLAS:1 dist executor is interpret-only "
-                            "(Mosaic gather restriction); running the "
-                            "XLA per-shard executor on TPU"
+                            "NTS_PALLAS_RESIDENT dist executor is "
+                            "interpret-only (Mosaic gather restriction); "
+                            "running the XLA per-shard executor on TPU"
                         )
                         kern = "xla"
                     pair = DistEllPair.build(self.dist, kernel=kern)

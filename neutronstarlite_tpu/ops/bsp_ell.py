@@ -98,6 +98,11 @@ class BspEll:
     v_num: int = dataclasses.field(metadata=dict(static=True))
     dt: int = dataclasses.field(metadata=dict(static=True))
     vt: int = dataclasses.field(metadata=dict(static=True))
+    # RECTANGULAR form (the distributed per-shard case: dst rows are one
+    # device's vp vertices, srcs index the full all_gathered [P*vp, f]
+    # slab): src_num sizes the source tiling independently of v_num.
+    # 0 = square (src space == dst space), the single-chip default.
+    src_num: int = dataclasses.field(default=0, metadata=dict(static=True))
 
     @staticmethod
     def build(
@@ -109,10 +114,12 @@ class BspEll:
         vt: int = DEFAULT_VT,
         k_slots: int = DEFAULT_K,
         r_rows: int = DEFAULT_R,
+        src_num: int = 0,  # 0 = square; else rectangular (adj < src_num)
     ) -> "BspEll":
         K, R = int(k_slots), int(r_rows)
+        n_src = int(src_num) or int(v_num)
         t_dst = -(-v_num // dt)
-        t_src = -(-v_num // vt)
+        t_src = -(-n_src // vt)
         e_num = len(adj)
         deg = np.diff(offsets).astype(np.int64)
         dst_of_edge = np.repeat(np.arange(v_num, dtype=np.int64), deg)
@@ -253,6 +260,7 @@ class BspEll:
             v_num=int(v_num),
             dt=int(dt),
             vt=int(vt),
+            src_num=int(src_num),
         )
 
     def aggregate(self, x: jax.Array, interpret: bool = None) -> jax.Array:
@@ -268,12 +276,13 @@ class BspEll:
 
             interpret = pallas_interpret_default()
         f = x.shape[1]
+        n_src = self.src_num or self.v_num
         t_dst = -(-self.v_num // self.dt)
-        t_src = -(-self.v_num // self.vt)
+        t_src = -(-n_src // self.vt)
         B = self.nbr.shape[0]
         if B == 0 or f == 0:
             return jnp.zeros((self.v_num, f), x.dtype)
-        xp = jnp.pad(x, ((0, t_src * self.vt - self.v_num), (0, 0)))
+        xp = jnp.pad(x, ((0, t_src * self.vt - n_src), (0, 0)))
         out = _bsp_call(
             self.blk_key, self.nbr, self.wgt, self.ldst, xp,
             dt=self.dt, vt=self.vt, t_dst=t_dst, t_src=t_src,

@@ -110,7 +110,21 @@ def _dist_gcn_case(cfg, base_dir, mesh, edges=None):
         from neutronstarlite_tpu.parallel.dist_graph import DistGraph
 
         dist = DistGraph.build(host_graph, P, edge_chunk=cfg.edge_chunk or None)
-        if layer_kind == "ell" and cfg.kernel_tile > 0:
+        if (
+            layer_kind == "ell"
+            and getattr(cfg, "pallas_kernel", False)
+            and os.environ.get("NTS_PALLAS_RESIDENT", "0") != "1"
+        ):
+            # PALLAS:1 -> the per-shard rectangular Mosaic bsp kernel
+            # (same gate as DistGCNTrainer.build_model; main() forces
+            # compiled-Mosaic lowering at tool entry)
+            from neutronstarlite_tpu.ops.bsp_ell import DEFAULT_VT
+            from neutronstarlite_tpu.parallel.dist_bsp import DistBspPair
+
+            host_blocks = DistBspPair.build(
+                dist, vt=cfg.kernel_tile or DEFAULT_VT
+            )
+        elif layer_kind == "ell" and cfg.kernel_tile > 0:
             from neutronstarlite_tpu.parallel.dist_blocked import (
                 DistBlockedEllPair,
             )
@@ -200,6 +214,11 @@ def main(argv=None) -> int:
     # that no accelerator is ever claimed — the topology compile below goes
     # to the compiler, not to chips
     os.environ["JAX_PLATFORMS"] = "cpu"
+    # Pallas must emit real Mosaic while tracing on this CPU host — the
+    # interpret default would compile the emulation (set at TOOL entry,
+    # not inside the reusable _dist_gcn_case: a hidden env mutation there
+    # would flip every later pallas call in a shared process)
+    os.environ["NTS_PALLAS_FORCE_COMPILED"] = "1"
     from neutronstarlite_tpu.utils.platform import honor_platform_env
 
     honor_platform_env()

@@ -129,7 +129,7 @@ def main(argv=None) -> int:
 
     rows = []
     for order in ("standard", "eager"):
-        for path in ("scatter", "ell", "pallas"):
+        for path in ("scatter", "ell", "pallas", "bsp"):
             rows.append((order, path, bound_s(order, path, v, e)))
 
     measured = collect_measured(args.runs_dir)

@@ -203,6 +203,16 @@ def build_steps(out_dir: str):
             {},
         ),
         (
+            # full-scale 8-way AOT capacity check of the PALLAS:1 dist
+            # path (per-shard Mosaic bsp over the all_gathered slab)
+            "aot_dist_bsp",
+            [sys.executable, "-m", "neutronstarlite_tpu.tools.aot_check",
+             os.path.join(REPO, "configs", "gcn_reddit_full_dist_bsp.cfg"),
+             "--topology", "v5e:2x4", "--synthetic-scale", "1.0"],
+            3600,
+            {},
+        ),
+        (
             "bench_matrix",
             [sys.executable, "-m", "neutronstarlite_tpu.tools.bench_matrix",
              "--configs", os.path.join(REPO, "configs"),

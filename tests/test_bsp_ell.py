@@ -181,3 +181,29 @@ def test_bsp_native_fill_matches_numpy(rng, monkeypatch):
         np.testing.assert_array_equal(
             np.asarray(a.blk_key), np.asarray(b.blk_key)
         )
+
+
+def test_bsp_rectangular_matches_dense(rng):
+    """Rectangular form (the dist per-shard case): dst space and src space
+    sized independently; forward must match the dense [n_dst, n_src]
+    operator. Exercises tile counts that differ per side."""
+    from neutronstarlite_tpu.ops.bsp_ell import BspEll
+
+    n_dst, n_src, e_num, f = 40, 100, 300, 8
+    dst = rng.integers(0, n_dst, size=e_num)
+    src = rng.integers(0, n_src, size=e_num)
+    w = rng.standard_normal(e_num).astype(np.float32)
+    dense = np.zeros((n_dst, n_src))
+    np.add.at(dense, (dst, src), w)
+    order = np.argsort(dst, kind="stable")
+    deg = np.bincount(dst, minlength=n_dst)
+    offsets = np.concatenate([[0], np.cumsum(deg)])
+    bsp = BspEll.build(
+        n_dst, offsets, src[order], w[order],
+        dt=8, vt=16, k_slots=4, r_rows=8, src_num=n_src,
+    )
+    assert bsp.src_num == n_src
+    x = rng.standard_normal((n_src, f)).astype(np.float32)
+    out = np.asarray(bsp.aggregate(jnp.asarray(x)), np.float64)
+    np.testing.assert_allclose(out, dense @ x.astype(np.float64),
+                               rtol=1e-4, atol=1e-4)

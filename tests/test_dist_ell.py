@@ -206,10 +206,13 @@ def test_dist_ell_pallas_kernel_matches_xla(rng):
 
 
 @multidevice
-def test_dist_ell_pallas_trainer_matches_xla_trainer(rng):
-    """End-to-end DistGCN: PALLAS:1 on the dist path must produce the same
-    training losses as the XLA dist-ELL executor (same math, fused
-    per-shard kernel over merged stacked tables)."""
+def test_dist_ell_pallas_trainer_matches_xla_trainer(rng, monkeypatch):
+    """End-to-end DistGCN with the INTERPRET-only resident per-shard
+    executor (NTS_PALLAS_RESIDENT=1 + PALLAS:1 -> DistEll kernel='pallas'):
+    must produce the same training losses as the XLA dist-ELL executor.
+    The default PALLAS:1 dist route (the Mosaic bsp kernel) is covered by
+    tests/test_dist_bsp.py."""
+    monkeypatch.setenv("NTS_PALLAS_RESIDENT", "1")
     from neutronstarlite_tpu.graph.dataset import GNNDatum
     from neutronstarlite_tpu.models.base import get_algorithm
     from neutronstarlite_tpu.utils.config import InputInfo
