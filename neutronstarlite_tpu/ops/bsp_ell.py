@@ -310,6 +310,22 @@ def _bsp_kernel(key_ref, nbr_ref, wgt_ref, ldst_ref, x_ref, o_ref, *, dt, vt, t_
 
     x = x_ref[:]  # [vt, f]
     K, R = nbr_ref.shape[1], nbr_ref.shape[2]
+    # The one-hot W build is the ONLY Mosaic-expressible gather form —
+    # both direct alternatives were tried against the topology compiler
+    # and die inside Mosaic (2026-07-31):
+    # (a) pad the K*R slot indices to the slab height and use the legal
+    #     same-shape take_along_axis: "Gather indices and result have
+    #     different bitwidths" (i32 idx vs bf16 data), and with an f32
+    #     view: "Not implemented: Multiple source vregs along gather
+    #     dimension" — tpu.dynamic_gather only shuffles WITHIN one
+    #     8-sublane vreg, so any cross-slab row fetch is out.
+    # (b) the resident-table row gather (ops/pallas_kernels.py) — same
+    #     root cause.
+    # Numeric policy: W entries round to the slab dtype (bf16 in
+    # production) so the main dot runs at full MXU rate; accumulation is
+    # f32 (preferred_element_type) in-block and across blocks. The build
+    # costs O(K * R * vt) VPU compares per block — the lever that makes
+    # SMALLER src tiles attractive (the plan's bsp_vt_* sweep).
     col = lax.broadcasted_iota(jnp.int32, (R, vt), 1)
     w = jnp.zeros((R, vt), jnp.float32)
     for k in range(K):  # K is a small static constant: full unroll
@@ -317,9 +333,6 @@ def _bsp_kernel(key_ref, nbr_ref, wgt_ref, ldst_ref, x_ref, o_ref, *, dt, vt, t_
         wb = wgt_ref[0, k, :]
         # srcs within one packed row are distinct, so += never collides
         w = w + jnp.where(col == nb[:, None], wb[:, None], 0.0)
-    # numeric policy: the W entries round to the slab dtype (bf16 in
-    # production) so the main dot runs at full MXU rate; accumulation is
-    # f32 (preferred_element_type) in-block and across blocks
     acc = lax.dot_general(
         w.astype(x.dtype), x, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
