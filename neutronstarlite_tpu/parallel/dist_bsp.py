@@ -50,6 +50,14 @@ from neutronstarlite_tpu.ops.pallas_kernels import pallas_interpret_default
 from neutronstarlite_tpu.parallel.dist_ell import per_device_adjacency
 from neutronstarlite_tpu.parallel.dist_graph import DistGraph
 from neutronstarlite_tpu.parallel.mesh import PARTITION_AXIS
+from neutronstarlite_tpu.utils.logging import get_logger
+
+log = get_logger("dist_bsp")
+
+# per-chunk VMEM-stack budget for the kernel OUTPUT under shard_map (the
+# whole [t_dst*dt, fc] f32 chunk is stack-allocated there; ~36 MB leaves
+# room for the double-buffered slab blocks and the W matrix)
+_DIST_OUT_BUDGET_BYTES = 36 << 20
 
 
 @jax.tree_util.register_dataclass
@@ -143,15 +151,55 @@ class DistBsp:
     def _local_aggregate(self, tables, xg: jax.Array) -> jax.Array:
         nbr, wgt, ldst, key = tables
         n_src = self.partitions * self.vp
+        f = xg.shape[1]
         t_dst = -(-self.vp // self.dt)
         t_src = -(-n_src // self.vt)
         xp = jnp.pad(xg, ((0, t_src * self.vt - n_src), (0, 0)))
-        out = _bsp_call(
-            key, nbr, wgt, ldst, xp,
-            dt=self.dt, vt=self.vt, t_dst=t_dst, t_src=t_src,
-            interpret=pallas_interpret_default(),
-        )
-        return out[: self.vp].astype(xg.dtype)
+
+        def call(xc):
+            return _bsp_call(
+                key, nbr, wgt, ldst, xc,
+                dt=self.dt, vt=self.vt, t_dst=t_dst, t_src=t_src,
+                interpret=pallas_interpret_default(),
+            )[: self.vp]
+
+        # Under shard_map XLA:TPU stack-allocates the custom call's WHOLE
+        # output in VMEM (observed 2026-07-31: RESOURCE_EXHAUSTED at a
+        # 38 MB f32 [15872, 602] output that plain jit handles fine up to
+        # at least 140 MB). Feature-chunk the call so each chunk's
+        # [t_dst*dt, fc] f32 output fits the stack budget — columns are
+        # independent, so this is numerically free; the eager-order
+        # widths (128/41) stay single-chunk, the 602-wide standard-order
+        # exchange pays ~fc-fold table re-reads exactly like the resident
+        # design's f-chunking would have.
+        out_budget = _DIST_OUT_BUDGET_BYTES
+        fc_max = out_budget // (t_dst * self.dt * 4) // 128 * 128
+        if fc_max < 128:
+            # 128 lanes is the floor; past ~73k padded dst rows per shard
+            # even one chunk exceeds the stack budget — warn loudly, the
+            # compile error alone would not say why
+            log.warning(
+                "dist-bsp: per-shard output %d rows x 128 cols exceeds the "
+                "%d MiB VMEM-stack budget; shard_map compile may "
+                "RESOURCE_EXHAUST (raise PARTITIONS or lower dt)",
+                t_dst * self.dt, out_budget >> 20,
+            )
+            fc_max = 128
+        if f <= fc_max:
+            return call(xp).astype(xg.dtype)
+        # balance chunk widths: ceil-divide f into equal 128-multiple
+        # chunks instead of full fc_max chunks + a mostly-padding tail
+        # (f=602 under a 512 budget: 2x384 beats 512+512-with-422-zeros)
+        n_ch = -(-f // fc_max)
+        per_ch = -(-f // n_ch)
+        fc = -(-per_ch // 128) * 128
+        fpad = n_ch * fc - f
+        if fpad:
+            xp = jnp.pad(xp, ((0, 0), (0, fpad)))
+        return jnp.concatenate(
+            [call(xp[:, lo: lo + fc]) for lo in range(0, n_ch * fc, fc)],
+            axis=1,
+        )[:, :f].astype(xg.dtype)
 
 
 @jax.tree_util.register_dataclass
