@@ -41,8 +41,10 @@ HBM_GBS = 819.0  # v5e
 MXU_TFLOPS_BF16 = 197.0  # v5e peak
 ELL_PAD = 1.33  # measured fwd slot inflation at full scale (PERF.md 3b)
 # Mosaic bsp kernel (the PALLAS:1 path): measured full-scale block counts
-# per direction (nts.bsp_ell build logs, docs/perf_runs/round3/)
-BSP_BLOCKS = {8192: 140896, 4096: 174445}
+# per direction (nts.bsp_ell build logs, docs/perf_runs/round3/). vt=1024
+# is OUT: 375.6k blocks -> the 1.5 MB packed key overflows the 1 MB SMEM
+# (aotwarm_rpathbspkerneltile1024.json) and slot waste hits 3.36x
+BSP_BLOCKS = {8192: 140896, 4096: 174445, 2048: 258212}
 BSP_R = 128  # rows per block (one-hot matmul height)
 
 
