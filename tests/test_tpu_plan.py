@@ -99,19 +99,24 @@ def test_build_steps_shape():
 
 def test_roofline_model_sanity(capsys):
     """Roofline bounds: positive, ELL strictly under scatter (that is the
-    design bet), pallas never above the beyond-VMEM ELL regime, markdown
+    design bet), the bsp MXU model scales with the aggregation width
+    (eager's post-matmul widths strictly under standard's 602), markdown
     renders one row per (order, path)."""
     from neutronstarlite_tpu.tools import roofline as rf
 
     v, e = 232965, 114615892
     for order in ("standard", "eager"):
         assert 0 < rf.bound_s(order, "ell", v, e) < rf.bound_s(order, "scatter", v, e)
-    # standard order: f=602 table is beyond VMEM; the f-chunked pallas
-    # bound must beat the HBM-gather ELL bound
-    assert rf.bound_s("standard", "pallas", v, e) < rf.bound_s("standard", "ell", v, e)
+    # the pallas/bsp bound is MXU work ∝ aggregation width: the eager
+    # order (128/41) must beat the standard order (602-wide layer 1)
+    for path in ("pallas", "bsp"):
+        assert (
+            0 < rf.bound_s("eager", path, v, e)
+            < rf.bound_s("standard", path, v, e)
+        )
     rf.main(["--markdown", "--runs-dir", "/nonexistent"])
     out = capsys.readouterr().out
-    assert out.count("| standard |") == 3 and out.count("| eager |") == 3
+    assert out.count("| standard |") == 4 and out.count("| eager |") == 4
 
 
 def test_roofline_collect_measured(tmp_path):
