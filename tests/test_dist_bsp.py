@@ -123,3 +123,37 @@ def test_dist_bsp_trainer_matches_ell_trainer(rng):
         return tr.run()["loss"]
 
     np.testing.assert_allclose(run(True), run(False), rtol=1e-4, atol=1e-5)
+
+
+@multidevice
+def test_dist_bsp_serves_inherited_trainers(rng):
+    """GIN-dist inherits DistGCNTrainer's exchange machinery, so PALLAS:1
+    must flow through to the bsp exchange there too (engine decoupling,
+    reference §2.9.10 analog) — pinned by loss parity vs its XLA run."""
+    from neutronstarlite_tpu.graph.dataset import GNNDatum
+    from neutronstarlite_tpu.models.base import get_algorithm
+    from neutronstarlite_tpu.utils.config import InputInfo
+
+    V, E = 48, 320
+    src = rng.integers(0, V, size=E, dtype=np.uint32)
+    dst = rng.integers(0, V, size=E, dtype=np.uint32)
+    datum = GNNDatum.random_generate(V, 6, 3, seed=5)
+
+    def run(pallas: bool):
+        cfg = InputInfo()
+        cfg.algorithm = "GINDIST"
+        cfg.vertices = V
+        cfg.layer_string = "6-8-3"
+        cfg.epochs = 2
+        cfg.learn_rate = 0.01
+        cfg.weight_decay = 1e-4
+        cfg.decay_epoch = -1
+        cfg.drop_rate = 0.0
+        cfg.partitions = 4
+        cfg.optim_kernel = True
+        cfg.comm_layer = "ell"
+        cfg.pallas_kernel = pallas
+        tr = get_algorithm("GINDIST").from_arrays(cfg, src, dst, datum)
+        return tr.run()["loss"]
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-4, atol=1e-5)
