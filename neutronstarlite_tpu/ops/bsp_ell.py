@@ -391,11 +391,19 @@ class BspEllPair:
     @staticmethod
     def from_host(
         g: CSCGraph,
-        dt: int = DEFAULT_DT,
+        dt: int = 0,
         vt: int = DEFAULT_VT,
-        k_slots: int = DEFAULT_K,
+        k_slots: int = 0,
         r_rows: int = DEFAULT_R,
     ) -> "BspEllPair":
+        import os
+
+        # dt (dst-tile height: the scatter matmul's cost axis) and K
+        # (slots/row: trades rows-per-edge against per-row padding) are
+        # env-tunable so on-chip A/Bs need no code edits:
+        # NTS_BSP_DT / NTS_BSP_K
+        dt = dt or int(os.environ.get("NTS_BSP_DT", DEFAULT_DT))
+        k_slots = k_slots or int(os.environ.get("NTS_BSP_K", DEFAULT_K))
         fwd = BspEll.build(
             g.v_num, g.column_offset, g.row_indices, g.edge_weight_forward,
             dt, vt, k_slots, r_rows,
