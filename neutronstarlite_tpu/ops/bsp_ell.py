@@ -55,6 +55,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -396,8 +397,6 @@ class BspEllPair:
         k_slots: int = 0,
         r_rows: int = DEFAULT_R,
     ) -> "BspEllPair":
-        import os
-
         # dt (dst-tile height: the scatter matmul's cost axis) and K
         # (slots/row: trades rows-per-edge against per-row padding) are
         # env-tunable so on-chip A/Bs need no code edits:
