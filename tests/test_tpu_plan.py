@@ -136,3 +136,26 @@ def test_roofline_collect_measured(tmp_path):
     (tmp_path / "broken.json").write_text("{not json")
     got = rf.collect_measured(str(tmp_path))
     assert got == [("a", 1.5, "eager", "ell")], got
+
+
+def test_compiler_only_step_judged_by_compiler_probe(tmp_path, monkeypatch):
+    """A compiler-only step failing while the COMPILER answers must go
+    through the bounded-retry accounting even though the chip probe is
+    down (otherwise chip-down windows would retry it forever); with the
+    compiler also down it stays pending."""
+    import neutronstarlite_tpu.tools.tpu_plan as tp
+
+    monkeypatch.setattr(tp, "COMPILER_ONLY_STEPS", {"aotx", "aoty"})
+    plan = _mk(tmp_path)
+    plan.probe = lambda: None  # chip down throughout
+    plan.probe_compiler = lambda: True
+    cmd = [sys.executable, "-c", "raise SystemExit(1)"]
+    assert plan.run_step("aotx", cmd, timeout_s=30, env_over={})  # try 1
+    assert not os.path.exists(tmp_path / "aotx.failed")
+    assert plan.run_step("aotx", cmd, timeout_s=30, env_over={})  # try 2
+    assert os.path.exists(tmp_path / "aotx.failed")
+
+    # compiler ALSO down: a fresh step stays pending (no tries burned)
+    plan.probe_compiler = lambda: False
+    assert not plan.run_step("aoty", cmd, timeout_s=30, env_over={})
+    assert not os.path.exists(tmp_path / "aoty.failed")
