@@ -10,9 +10,10 @@ Here neither the feature table nor a 128-wide column of it fits on-chip,
 so the kernel streams BOTH sides: vertices are cut into destination tiles
 of ``dt`` rows and source tiles of ``vt`` rows; edges are packed into
 fixed-shape blocks, each block belonging to one (dst tile, src tile)
-pair. The pallas grid walks blocks sorted by destination tile with the
-[dt, f] output tile living in VMEM across every consecutive block of its
-tile (zeroed on first visit, spilled to HBM when the tile changes — the
+pair. The pallas grid walks blocks grouped per destination tile (each
+tile's blocks CONSECUTIVE — the ordering invariant) with the [dt, f]
+output tile living in VMEM across every consecutive block of its tile
+(zeroed on first visit, spilled to HBM when the tile changes — the
 revisiting-output accumulation pattern), while the [vt, f] source slab is
 DMA-streamed per block via a scalar-prefetched block->tile map
 (``pltpu.PrefetchScalarGridSpec``). HBM traffic per application:
@@ -79,6 +80,23 @@ DEFAULT_DT = 512  # dst tile rows (the VMEM-resident accumulator height)
 DEFAULT_VT = 4096  # src tile rows (the streamed slab height)
 DEFAULT_K = 8  # slots per packed row
 DEFAULT_R = 128  # rows per block (the 128-lane axis of the tables)
+# Max blocks per pallas_call: the [B] int32 scalar-prefetch key must fit
+# SMEM (~1 MB; round-3 AOT evidence: two ~600 KB maps RESOURCE_EXHAUSTED,
+# one packed ~700 KB array compiled). 224k blocks = 896 KB of keys leaves
+# headroom for Mosaic's own scalars. Past this the build SEGMENTS the
+# grid at dst-tile boundaries (see BspEll.build) — the compiled program
+# is then V-independent and there is no block-count ceiling at all.
+DEFAULT_MAX_BLOCKS = 224 * 1024
+
+
+def resolve_bsp_knobs(dt: int = 0, k_slots: int = 0) -> "tuple[int, int]":
+    """Resolve the NTS_BSP_DT / NTS_BSP_K env tunables (0 = use env or
+    default). Shared by the single-chip (BspEllPair.from_host) and dist
+    (parallel/dist_bsp.DistBsp.build) builders so on-chip A/B knobs
+    behave uniformly across paths."""
+    dt = int(dt) or int(os.environ.get("NTS_BSP_DT", DEFAULT_DT))
+    k_slots = int(k_slots) or int(os.environ.get("NTS_BSP_K", DEFAULT_K))
+    return dt, k_slots
 
 
 @jax.tree_util.register_dataclass
@@ -86,16 +104,22 @@ DEFAULT_R = 128  # rows per block (the 128-lane axis of the tables)
 class BspEll:
     """One direction's packed block tables (see module docstring)."""
 
-    nbr: jax.Array  # [B, K, R] int32 tile-local neighbor ids
-    wgt: jax.Array  # [B, K, R] f32 (0 on padding)
-    ldst: jax.Array  # [B, R] int32 tile-local destination row
-    # ONE packed per-block tile key: dst_tile * t_src + src_tile. The key
-    # array is the kernel's scalar-prefetch operand and lives in SMEM
-    # (1 MB): two separate [B] int32 maps overflowed it at full Reddit
-    # scale (B ~ 141-175k -> 552-684 KB EACH, AOT RESOURCE_EXHAUSTED,
-    # docs/perf_runs/round3/aot_eager_bsp2.json); packed, one array fits
-    # with room to ~250k blocks
-    blk_key: jax.Array  # [B] int32 packed (dst_tile, src_tile)
+    nbr: jax.Array  # [S*b_seg, K, R] int32 tile-local neighbor ids
+    wgt: jax.Array  # [S*b_seg, K, R] f32 (0 on padding)
+    ldst: jax.Array  # [S*b_seg, R] int32 tile-local destination row
+    # ONE packed per-block tile key: dst_tile_LOCAL * t_src + src_tile.
+    # The key array is the kernel's scalar-prefetch operand and lives in
+    # SMEM (1 MB): two separate [B] int32 maps overflowed it at full
+    # Reddit scale (B ~ 141-175k -> 552-684 KB EACH, AOT
+    # RESOURCE_EXHAUSTED, docs/perf_runs/round3/aot_eager_bsp2.json);
+    # packed, one array fits to ~250k blocks. Past the budget the build
+    # SEGMENTS the grid: blocks are cut at dst-tile boundaries into
+    # n_seg uniform calls of b_seg blocks x t_seg dst tiles each, keys
+    # are segment-LOCAL, and aggregate() runs one pallas_call per
+    # segment (same shapes -> ONE compiled program reused n_seg times).
+    # 10x-Reddit (~1.4M blocks) therefore compiles the same program as
+    # full Reddit; only the Python-level segment count grows.
+    blk_key: jax.Array  # [S*b_seg] int32 packed (local dst_tile, src_tile)
     v_num: int = dataclasses.field(metadata=dict(static=True))
     dt: int = dataclasses.field(metadata=dict(static=True))
     vt: int = dataclasses.field(metadata=dict(static=True))
@@ -104,6 +128,18 @@ class BspEll:
     # slab): src_num sizes the source tiling independently of v_num.
     # 0 = square (src space == dst space), the single-chip default.
     src_num: int = dataclasses.field(default=0, metadata=dict(static=True))
+    # SMEM-budget segmentation (see blk_key): n_seg calls of b_seg blocks
+    # each, call s covering the contiguous dst-tile range of seg_tiles[s]
+    # tiles (t_seg = the per-call OUTPUT tile count >= max(seg_tiles);
+    # trailing output tiles beyond a call's real range are never written
+    # or read). Defaults describe the unsegmented form (b_seg/t_seg = 0
+    # -> whole table / all tiles, one call).
+    n_seg: int = dataclasses.field(default=1, metadata=dict(static=True))
+    b_seg: int = dataclasses.field(default=0, metadata=dict(static=True))
+    t_seg: int = dataclasses.field(default=0, metadata=dict(static=True))
+    seg_tiles: tuple = dataclasses.field(
+        default=(), metadata=dict(static=True)
+    )
 
     @staticmethod
     def build(
@@ -116,8 +152,12 @@ class BspEll:
         k_slots: int = DEFAULT_K,
         r_rows: int = DEFAULT_R,
         src_num: int = 0,  # 0 = square; else rectangular (adj < src_num)
+        max_blocks: int = 0,  # 0 -> NTS_BSP_MAX_BLOCKS / DEFAULT_MAX_BLOCKS
     ) -> "BspEll":
         K, R = int(k_slots), int(r_rows)
+        max_blocks = int(max_blocks) or int(
+            os.environ.get("NTS_BSP_MAX_BLOCKS", DEFAULT_MAX_BLOCKS)
+        )
         n_src = int(src_num) or int(v_num)
         t_dst = -(-v_num // dt)
         t_src = -(-n_src // vt)
@@ -183,27 +223,22 @@ class BspEll:
             n_rows = n_data_blocks = 0
             row_block = row_slot = row_dst = row_key = np.zeros(0, np.int64)
 
-        # every dst tile needs >= 1 block so its output tile gets zeroed
-        # (an unvisited pallas output block would be uninitialized memory)
-        present = np.zeros(t_dst, dtype=bool)
+        # data blocks are created in key order, so bd is nondecreasing
         if n_data_blocks:
             blk_first = np.nonzero(
                 np.concatenate([[True], row_block[1:] != row_block[:-1]])
             )[0]
-            data_bd = (row_key[blk_first] // t_src).astype(np.int32)
+            data_bd = (row_key[blk_first] // t_src).astype(np.int64)
             data_bs = (row_key[blk_first] % t_src).astype(np.int32)
-            present[data_bd] = True
         else:
-            data_bd = data_bs = np.zeros(0, np.int32)
-        filler = np.nonzero(~present)[0].astype(np.int32)
-        B = n_data_blocks + len(filler)
+            data_bd = np.zeros(0, np.int64)
+            data_bs = np.zeros(0, np.int32)
 
-        nbr = np.zeros((B, K, R), dtype=np.int32)
-        wgt = np.zeros((B, K, R), dtype=np.float32)
-        ldst = np.zeros((B, R), dtype=np.int32)
-        bd = np.concatenate([data_bd, filler])
-        bs = np.concatenate([data_bs, np.zeros(len(filler), np.int32)])
-
+        # fill the DATA blocks into dense temp tables (block ids are the
+        # data block ids 0..n_data-1, exactly what row_block holds)
+        nbr_d = np.zeros((n_data_blocks, K, R), dtype=np.int32)
+        wgt_d = np.zeros((n_data_blocks, K, R), dtype=np.float32)
+        ldst_d = np.zeros((n_data_blocks, R), dtype=np.int32)
         if e_num:
             src_local = (ss - (ss // vt) * vt).astype(np.int32)
             run_ldst = (run_dst - (run_dst // dt) * dt).astype(np.int32)
@@ -214,7 +249,7 @@ class BspEll:
                     run_start, run_len, row_of_first, run_ldst,
                     row_block, row_slot, src_local,
                     np.ascontiguousarray(ws, np.float32), K, R,
-                    nbr, wgt, ldst,
+                    nbr_d, wgt_d, ldst_d,
                 )
             else:
                 # per-edge placement: row-relative slot position
@@ -224,44 +259,128 @@ class BspEll:
                 p = off % K
                 b_e = row_block[e_row]
                 s_e = row_slot[e_row]
-                nbr[b_e, p, s_e] = src_local
-                wgt[b_e, p, s_e] = ws
-                ldst[row_block, row_slot] = run_ldst[row_run]
-            waste = B * K * R / max(e_num, 1)
-            log.info(
-                "bsp ELL: %d blocks [%d slots x %d rows], %d dst x %d src "
-                "tiles, %d packed rows, slot waste %.2fx",
-                B, K, R, t_dst, t_src, n_rows, waste,
-            )
+                nbr_d[b_e, p, s_e] = src_local
+                wgt_d[b_e, p, s_e] = ws
+                ldst_d[row_block, row_slot] = run_ldst[row_run]
 
-        # blocks sorted by dst tile (stable: data blocks keep their src-tile
-        # grouping) so output-tile revisits are consecutive
-        order_b = np.argsort(bd, kind="stable")
-        nbr, wgt, ldst = nbr[order_b], wgt[order_b], ldst[order_b]
-        bd, bs = bd[order_b], bs[order_b]
-        # pad B to a multiple of 8: the kernel reads ldst through 8-row
-        # VMEM blocks. Pad blocks carry weight 0 and the LAST dst tile
-        # (keeps bd nondecreasing, so the zero-init revisit logic holds)
-        pad_b = (-B) % 8
-        if pad_b:
-            nbr = np.concatenate([nbr, np.zeros((pad_b, K, R), np.int32)])
-            wgt = np.concatenate([wgt, np.zeros((pad_b, K, R), np.float32)])
-            ldst = np.concatenate([ldst, np.zeros((pad_b, R), np.int32)])
-            bd = np.concatenate(
-                [bd, np.full(pad_b, bd[-1] if B else 0, np.int32)]
+        # --- SMEM-budget segmentation (VERDICT r3 item 3) -----------------
+        # Cut the grid into S contiguous dst-tile RANGES, each carrying at
+        # most `max_blocks` blocks, so every pallas_call's [b_seg] key fits
+        # SMEM. Ranges are packed greedily by BLOCK count (balanced: pad
+        # blocks don't scale with cross-segment degree skew) under a
+        # tile-count cap that bounds the per-call output buffer. When
+        # segmented, b_seg is pinned to the budget and t_seg rounds up to
+        # a 128-multiple so the compiled-program MENU is small and
+        # provable by AOT (tools/aot_bsp_scale.py); per-block geometry —
+        # the Mosaic lowering surface — is t_seg-invariant. A call's
+        # output tiles beyond its real range are never written or read
+        # (aggregate slices each call to its own range).
+        # Within a segment: data blocks first (grouped per tile), then one
+        # filler block per empty tile in range (every real tile must be
+        # visited once so its output is zero-initialized — an unvisited
+        # pallas output block would be uninitialized memory), then pad
+        # blocks repeating the last real block's key (weight 0:
+        # accumulate nothing, never re-zero). The kernel only needs each
+        # tile's blocks CONSECUTIVE, which all three groups preserve.
+        cap_eff = (max_blocks // 8) * 8
+        blocks_per_tile = np.bincount(data_bd, minlength=t_dst).astype(np.int64)
+        need = np.maximum(blocks_per_tile, 1)  # empty tiles need a filler
+        if t_dst and int(need.max()) > cap_eff:
+            raise ValueError(
+                f"bsp ELL: a single dst tile needs {int(need.max())} blocks,"
+                f" over the {max_blocks}-block SMEM key budget; raise dt/K/R"
+                " or NTS_BSP_MAX_BLOCKS"
             )
-            bs = np.concatenate([bs, np.zeros(pad_b, np.int32)])
+        total_need = int(need.sum())
+        s_est = max(1, -(-total_need // max(cap_eff, 1)))
+        t_seg_cap = min(t_dst, 2 * (-(-t_dst // s_est))) if t_dst else 0
+        seg_of_tile = np.empty(t_dst, np.int64)
+        first_tile = [0]
+        acc_b = acc_t = seg = 0
+        for tile in range(t_dst):  # t_dst ~ 4.5k at 10x Reddit: cheap
+            nb = int(need[tile])
+            if acc_t + 1 > t_seg_cap or acc_b + nb > cap_eff:
+                seg += 1
+                first_tile.append(tile)
+                acc_b = acc_t = 0
+            seg_of_tile[tile] = seg
+            acc_b += nb
+            acc_t += 1
+        S = seg + 1
+        first_tile = np.asarray(first_tile, np.int64)
+        tiles_in_seg = np.bincount(seg_of_tile, minlength=S)
+        seg_of_data = seg_of_tile[data_bd] if n_data_blocks else data_bd
+        counts_data = np.bincount(seg_of_data, minlength=S)
+        empty_tiles = np.nonzero(blocks_per_tile == 0)[0]
+        seg_of_fill = seg_of_tile[empty_tiles]
+        counts_fill = np.bincount(seg_of_fill, minlength=S)
+        used = counts_data + counts_fill
+        if S == 1:
+            t_seg = int(t_dst)
+            b_seg = int(used.max()) if t_dst else 0
+            b_seg += (-b_seg) % 8
+        else:  # quantized: a small provable program menu (see above).
+            # t_seg is a PURE 128-multiple (may exceed t_dst: trailing
+            # output tiles are never written or read), so every
+            # segmented program's t_seg is 128*k with k <= ceil((t_dst
+            # + 1) / 128) — the exact band tools/aot_bsp_scale compiles
+            t_seg = -(-int(tiles_in_seg.max()) // 128) * 128
+            b_seg = cap_eff
+        assert b_seg <= max_blocks  # the construction's SMEM invariant
+
+        B_total = S * b_seg
+        nbr = np.zeros((B_total, K, R), dtype=np.int32)
+        wgt = np.zeros((B_total, K, R), dtype=np.float32)
+        ldst = np.zeros((B_total, R), dtype=np.int32)
+        key = np.zeros(B_total, dtype=np.int32)
+        if n_data_blocks:
+            seg_first = np.concatenate([[0], np.cumsum(counts_data)[:-1]])
+            pos = (
+                seg_of_data * b_seg
+                + np.arange(n_data_blocks)
+                - seg_first[seg_of_data]
+            )
+            nbr[pos], wgt[pos], ldst[pos] = nbr_d, wgt_d, ldst_d
+            key[pos] = (data_bd - first_tile[seg_of_data]) * t_src + data_bs
+        if len(empty_tiles):
+            fill_first = np.concatenate(
+                [[0], np.cumsum(counts_fill)[:-1]]
+            )
+            key[
+                seg_of_fill * b_seg
+                + counts_data[seg_of_fill]
+                + np.arange(len(empty_tiles))
+                - fill_first[seg_of_fill]
+            ] = (empty_tiles - first_tile[seg_of_fill]) * t_src
+        if B_total:
+            idx = np.arange(B_total)
+            seg_idx = idx // b_seg
+            pad_mask = (idx % b_seg) >= used[seg_idx]
+            key[pad_mask] = key[
+                (seg_idx * b_seg + used[seg_idx] - 1)[pad_mask]
+            ]
+
+        if e_num:
+            waste = B_total * K * R / max(e_num, 1)
+            log.info(
+                "bsp ELL: %d blocks [%d slots x %d rows] in %d segment(s) "
+                "of %d, %d dst x %d src tiles, %d packed rows, slot waste "
+                "%.2fx",
+                B_total, K, R, S, b_seg, t_dst, t_src, n_rows, waste,
+            )
         return BspEll(
             nbr=jnp.asarray(nbr),
             wgt=jnp.asarray(wgt),
             ldst=jnp.asarray(ldst),
-            blk_key=jnp.asarray(
-                bd.astype(np.int32) * np.int32(t_src) + bs.astype(np.int32)
-            ),
+            blk_key=jnp.asarray(key),
             v_num=int(v_num),
             dt=int(dt),
             vt=int(vt),
             src_num=int(src_num),
+            n_seg=int(S),
+            b_seg=int(b_seg),
+            t_seg=int(t_seg),
+            seg_tiles=tuple(int(t) for t in tiles_in_seg),
         )
 
     def aggregate(self, x: jax.Array, interpret: bool = None) -> jax.Array:
@@ -284,11 +403,26 @@ class BspEll:
         if B == 0 or f == 0:
             return jnp.zeros((self.v_num, f), x.dtype)
         xp = jnp.pad(x, ((0, t_src * self.vt - n_src), (0, 0)))
-        out = _bsp_call(
-            self.blk_key, self.nbr, self.wgt, self.ldst, xp,
-            dt=self.dt, vt=self.vt, t_dst=t_dst, t_src=t_src,
-            interpret=interpret,
-        )
+        # one pallas_call per SMEM-budget segment: identical shapes, so
+        # ONE compiled program serves all n_seg calls (the program is
+        # V-independent; only this Python loop grows with scale). Each
+        # call's output is sliced to its segment's REAL tile range —
+        # trailing output tiles (t_seg is quantized) are never read.
+        t_seg = self.t_seg or t_dst
+        b_seg = self.b_seg or B
+        seg_tiles = self.seg_tiles or (t_dst,)
+        outs = []
+        for s in range(self.n_seg):
+            sl = slice(s * b_seg, (s + 1) * b_seg)
+            outs.append(
+                _bsp_call(
+                    self.blk_key[sl], self.nbr[sl], self.wgt[sl],
+                    self.ldst[sl], xp,
+                    dt=self.dt, vt=self.vt, t_dst=t_seg, t_src=t_src,
+                    interpret=interpret,
+                )[: seg_tiles[s] * self.dt]
+            )
+        out = outs[0] if self.n_seg == 1 else jnp.concatenate(outs, axis=0)
         return out[: self.v_num].astype(x.dtype)
 
 
@@ -401,8 +535,7 @@ class BspEllPair:
         # (slots/row: trades rows-per-edge against per-row padding) are
         # env-tunable so on-chip A/Bs need no code edits:
         # NTS_BSP_DT / NTS_BSP_K
-        dt = dt or int(os.environ.get("NTS_BSP_DT", DEFAULT_DT))
-        k_slots = k_slots or int(os.environ.get("NTS_BSP_K", DEFAULT_K))
+        dt, k_slots = resolve_bsp_knobs(dt, k_slots)
         fwd = BspEll.build(
             g.v_num, g.column_offset, g.row_indices, g.edge_weight_forward,
             dt, vt, k_slots, r_rows,

@@ -39,12 +39,11 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as PS
 
 from neutronstarlite_tpu.ops.bsp_ell import (
-    DEFAULT_DT,
-    DEFAULT_K,
     DEFAULT_R,
     DEFAULT_VT,
     BspEll,
     _bsp_call,
+    resolve_bsp_knobs,
 )
 from neutronstarlite_tpu.ops.pallas_kernels import pallas_interpret_default
 from neutronstarlite_tpu.parallel.dist_ell import per_device_adjacency
@@ -78,11 +77,12 @@ class DistBsp:
     def build(
         dist: DistGraph,
         transpose: bool,
-        dt: int = DEFAULT_DT,
-        vt: int = DEFAULT_VT,
-        k_slots: int = DEFAULT_K,
+        dt: int = 0,  # 0 -> NTS_BSP_DT env / DEFAULT_DT (same knobs as
+        vt: int = DEFAULT_VT,  # the single-chip BspEllPair.from_host)
+        k_slots: int = 0,
         r_rows: int = DEFAULT_R,
     ) -> "DistBsp":
+        dt, k_slots = resolve_bsp_knobs(dt, k_slots)
         P, vp = dist.partitions, dist.vp
         per_dev, _ = per_device_adjacency(dist, transpose)
         tables: List[BspEll] = [
@@ -92,6 +92,16 @@ class DistBsp:
             )
             for offs, nbr_g, w, _deg in per_dev
         ]
+        for t in tables:
+            # per-shard tables are ~20-30k blocks at full Reddit P=8; the
+            # stacked layout assumes the single-segment (global-key) form.
+            # A shard big enough to segment should raise P, not stack.
+            if t.n_seg != 1:
+                raise ValueError(
+                    f"dist-bsp: a shard's table segmented ({t.n_seg} segs of "
+                    f"{t.b_seg} blocks) — per-shard block count exceeds the "
+                    "SMEM key budget; raise PARTITIONS or dt/K"
+                )
         b_max = max(t.nbr.shape[0] for t in tables)
         # pad to a multiple of 8 ACROSS devices too (the kernel's 8-row
         # ldst blocks index by global block id)
@@ -110,8 +120,10 @@ class DistBsp:
                     [t.wgt, jnp.zeros((pad_b, k, r), jnp.float32)]
                 ),
                 jnp.concatenate([t.ldst, jnp.zeros((pad_b, r), jnp.int32)]),
-                # the device's LAST key: bd stays nondecreasing and the
-                # pad blocks never re-zero a tile (weight-0 accumulate)
+                # the device's LAST key: extends that tile's consecutive
+                # run (the kernel's ordering invariant — tables are
+                # data-then-filler grouped, NOT tile-sorted) and the pad
+                # blocks never re-zero a tile (weight-0 accumulate)
                 jnp.concatenate(
                     [t.blk_key, jnp.full(pad_b, t.blk_key[-1], jnp.int32)]
                 ),

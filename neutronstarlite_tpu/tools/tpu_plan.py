@@ -20,12 +20,17 @@ Plan steps — ``--list`` is authoritative; in execution order:
      Mosaic bsp kernel at the default src tile, eager_bsp/bsp_vt_* sweep
      the src-tile height (W-build cost vs block count)
   6. eager_scatter_fence: lane-pad A/B for the PERF §2a scatter cliff
-  7. aot_dist_blocked: full-scale 8-way KERNEL_TILE-dist capacity compile
+  7. aot_dist_blocked / aot_dist_bsp: full-scale 8-way dist capacity
+     compiles (compiler-only)
+  7a. aot_bsp_10x: segmented bsp kernel menu-band compile at 10x Reddit
+      (compiler-only, tools/aot_bsp_scale)
+  7b. ell_breakdown: NTS_DEBUGINFO per-phase breakdown of full-scale ELL
   8. bench_matrix: workload matrix over configs/ (tools/bench_matrix)
   9. sampled_bench: fan-out-sampled mini-batch at Reddit scale
   10. profile_trace: steady-state trace of standard/ELL (NTS_PROFILE_DIR)
 
-Artifacts land in docs/perf_runs/round2/: per-step .log (stderr tail),
+Artifacts land in the --out dir (default docs/perf_runs/round4/):
+per-step .log (stderr tail),
 .json (the step's final JSON line, when it prints one), .ok marker
 (resumability), and a `status` append-log with timestamps. The supervisor
 itself NEVER initializes the accelerator — probes and steps are
@@ -71,7 +76,7 @@ from bench import _PROBE_SRC  # noqa: E402
 # init hung on a wedged lease). Steps in this set need only the compiler —
 # when the chip probe fails, a cheap topology-compile probe decides whether
 # these can run anyway instead of idling the window away.
-COMPILER_ONLY_STEPS = {"aot_dist_blocked", "aot_dist_bsp"}
+COMPILER_ONLY_STEPS = {"aot_dist_blocked", "aot_dist_bsp", "aot_bsp_10x"}
 
 _COMPILER_PROBE_SRC = r"""
 import json, time
@@ -241,6 +246,26 @@ def build_steps(out_dir: str):
              "--topology", "v5e:2x4", "--synthetic-scale", "1.0"],
             3600,
             {},
+        ),
+        (
+            # round 4: the segmented bsp kernel's 10x-Reddit capacity
+            # proof (VERDICT r3 item 3) — envelope program at the SMEM
+            # cap against the topology compiler, no chip needed
+            "aot_bsp_10x",
+            [sys.executable, "-m", "neutronstarlite_tpu.tools.aot_bsp_scale",
+             "--scale", "10.0"],
+            1800,
+            {},
+        ),
+        (
+            # round 4: NTS_DEBUGINFO per-phase breakdown of the full-scale
+            # production path (VERDICT r3 item 2's attribution input) —
+            # separate from profile_trace so timer syncs can't pollute
+            # the profiler's steady-state capture
+            "ell_breakdown",
+            _bench("--order", "standard", "--path", "ell"),
+            1800,
+            {"NTS_DEBUGINFO": "1", "NTS_BENCH_DEADLINE_S": "1500"},
         ),
         (
             "bench_matrix",
@@ -432,7 +457,7 @@ class Plan:
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument(
-        "--out", default=os.path.join(REPO, "docs", "perf_runs", "round3")
+        "--out", default=os.path.join(REPO, "docs", "perf_runs", "round4")
     )
     ap.add_argument("--poll-s", type=float, default=120.0)
     ap.add_argument("--max-wall-s", type=float, default=32400.0)
