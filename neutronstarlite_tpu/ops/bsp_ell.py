@@ -48,8 +48,13 @@ scarce on-chip resource.
 
 Forward/backward pairing follows ops/ell.py: the backward is the same
 kernel over the transposed (CSR) layout, one ``custom_vjp``. Numeric
-policy: f32 row products, f32 accumulation (in-block and across blocks),
-one cast at the end. Off-TPU the kernel runs in interpret mode (tests).
+policy: the one-hot W entries ROUND TO THE SLAB DTYPE (bf16 in
+production) so the main dot runs at full MXU rate — a documented
+divergence from the XLA ELL path's f32 edge weights, bounded by the
+bf16 tolerance class (~5e-2 relative; on-chip check
+tests/test_tpu.py::test_tpu_bsp_bf16_and_segmented) — with f32
+accumulation in-block and across blocks and one cast at the end.
+Off-TPU the kernel runs in interpret mode (tests).
 """
 
 from __future__ import annotations

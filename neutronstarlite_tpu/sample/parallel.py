@@ -49,6 +49,35 @@ class _WorkerError:
         self.msg = msg
 
 
+def _worker_main(state, in_q, out_q):
+    """Spawn-context worker entry (must be module-level picklable): the
+    child reconstructs an inline sampler from the pickled state and
+    serves the queue protocol. Fork-context workers use the in-process
+    closure instead (graph shared copy-on-write, nothing pickled)."""
+    graph, batch_size, fanouts, base_seed = state
+    s = ParallelEpochSampler(
+        graph, np.zeros(0, np.int64), batch_size, fanouts,
+        seed=base_seed, workers=0,
+    )
+    _serve(s._make_one, in_q, out_q)
+
+
+def _serve(make_one, in_q, out_q):
+    while True:
+        item = in_q.get()
+        if item is None:
+            return
+        epoch, i, seeds = item
+        try:
+            out_q.put((epoch, i, make_one(seeds, epoch, i)))
+        except Exception as e:  # surface instead of silent death
+            import traceback
+
+            out_q.put((epoch, i, _WorkerError(
+                f"{e}\n{traceback.format_exc(limit=5)}"
+            )))
+
+
 def _jax_backend_live() -> bool:
     """True when a JAX backend has already been initialized in this
     process (fork-safety gate; checked WITHOUT triggering an init)."""
@@ -94,7 +123,7 @@ class ParallelEpochSampler:
         fanouts: Sequence[int],
         seed: int = 0,
         workers: int | None = None,
-        force_workers: bool = False,
+        ctx_method: str | None = None,
     ):
         self.graph = graph
         self.seed_nids = np.asarray(seed_nids, dtype=np.int64)
@@ -102,55 +131,64 @@ class ParallelEpochSampler:
         self.fanouts = list(fanouts)
         self.base_seed = int(seed)
         self.workers = default_workers() if workers is None else max(workers, 0)
+        # fork (default): workers share the CSC copy-on-write — zero pickling,
+        # but only safe BEFORE the first JAX backend touch. spawn: workers
+        # pickle the graph once at pool start — costs RSS + startup at Reddit
+        # scale, but is safe with a live multithreaded JAX runtime (the
+        # fork-after-threads hazard, and CPython's os.fork RuntimeWarning,
+        # don't apply). NTS_SAMPLE_CTX env overrides.
+        self.ctx_method = (
+            ctx_method or os.environ.get("NTS_SAMPLE_CTX") or "fork"
+        )
         self._procs: list = []
         self._in_q = self._out_q = None
-        # force_workers skips the live-backend gate — for callers that know
-        # their platform tolerates the fork (the CPU-only test rig)
-        if self.workers > 1 and not force_workers and _jax_backend_live():
+        # the invariant is simple: fork pools only BEFORE backend init,
+        # spawn pools otherwise (NTS_SAMPLE_CTX=spawn is the safe opt-in)
+        if (
+            self.workers > 1
+            and self.ctx_method == "fork"
+            and _jax_backend_live()
+        ):
             # the invariant "fork before the first JAX backend touch" only
             # holds for the first trainer in a pristine process; forking
             # with live PJRT runtime threads risks a child deadlocked on a
             # lock a forked-away thread held. Degrade to inline sampling
-            # loudly rather than gamble.
+            # loudly rather than gamble (NTS_SAMPLE_CTX=spawn opts into the
+            # pickling pool instead, which tolerates a live backend).
             log.warning(
                 "JAX backend already initialized in this process; "
                 "disabling %d sampling workers (fork-after-threads is "
-                "deadlock-prone) — sampling runs inline",
+                "deadlock-prone) — sampling runs inline "
+                "(NTS_SAMPLE_CTX=spawn keeps workers at a pickling cost)",
                 self.workers,
             )
             self.workers = 0
         if self.workers > 1:
-            # fork the persistent pool NOW, before any JAX backend touch
-            # (trainers construct their sampler before device work)
+            # start the persistent pool NOW — for fork, before any JAX
+            # backend touch (trainers construct their sampler first)
             self._start_pool()
 
     def _start_pool(self):
         import multiprocessing as mp
 
-        ctx = mp.get_context("fork")  # share the CSC copy-on-write
+        ctx = mp.get_context(self.ctx_method)
         self._in_q = ctx.Queue()
         self._out_q = ctx.Queue(maxsize=2 * self.workers)
         in_q, out_q = self._in_q, self._out_q
-        make_one = self._make_one
+        if self.ctx_method == "fork":
+            make_one = self._make_one  # graph shared copy-on-write
 
-        def worker():
-            while True:
-                item = in_q.get()
-                if item is None:
-                    return
-                epoch, i, seeds = item
-                try:
-                    out_q.put((epoch, i, make_one(seeds, epoch, i)))
-                except Exception as e:  # surface instead of silent death
-                    import traceback
+            def worker():
+                _serve(make_one, in_q, out_q)
 
-                    out_q.put((epoch, i, _WorkerError(
-                        f"{e}\n{traceback.format_exc(limit=5)}"
-                    )))
-
-        self._procs = [
-            ctx.Process(target=worker, daemon=True) for _ in range(self.workers)
-        ]
+            targets = [dict(target=worker) for _ in range(self.workers)]
+        else:  # spawn: module-level entry, graph pickled once per worker
+            state = (self.graph, self.batch_size, self.fanouts, self.base_seed)
+            targets = [
+                dict(target=_worker_main, args=(state, in_q, out_q))
+                for _ in range(self.workers)
+            ]
+        self._procs = [ctx.Process(daemon=True, **t) for t in targets]
         for p in self._procs:
             p.start()
 

@@ -74,7 +74,15 @@ def main(argv=None) -> int:
         merge_low_k_levels,
     )
 
-    rng = np.random.default_rng(args.seed)
+    def key_rng(key: str) -> np.random.Generator:
+        # one independent stream per builder key: array contents must not
+        # depend on demand order (an --ops-filtered triage run and a full
+        # run build resources in different orders; a shared stream would
+        # make them measure different random data)
+        import zlib
+
+        return np.random.default_rng([args.seed, zlib.crc32(key.encode())])
+
     out = {"platform": jax.default_backend(), "device": str(jax.devices()[0]),
            "V": V, "E": E, "ops": {}}
 
@@ -108,17 +116,22 @@ def main(argv=None) -> int:
         ),
         "bsp": lambda: BspEllPair.from_host(need("g"), dt=512, vt=8192),
         "x": lambda: jnp.asarray(
-            rng.standard_normal((V, F)).astype(np.float32), jnp.bfloat16
+            key_rng("x").standard_normal((V, F)).astype(np.float32),
+            jnp.bfloat16,
         ),
         "xw": lambda: jnp.asarray(
-            rng.standard_normal((V, F_WIDE)).astype(np.float32), jnp.bfloat16
+            key_rng("xw").standard_normal((V, F_WIDE)).astype(np.float32),
+            jnp.bfloat16,
         ),
         "w_mm": lambda: jnp.asarray(
-            rng.standard_normal((F_WIDE, F)).astype(np.float32), jnp.bfloat16
+            key_rng("w_mm").standard_normal((F_WIDE, F)).astype(np.float32),
+            jnp.bfloat16,
         ),
-        "idx": lambda: jnp.asarray(rng.integers(0, V, size=E), jnp.int32),
+        "idx": lambda: jnp.asarray(
+            key_rng("idx").integers(0, V, size=E), jnp.int32
+        ),
         "big": lambda: jnp.asarray(
-            rng.standard_normal(8 << 20).astype(np.float32)  # 32 MB
+            key_rng("big").standard_normal(8 << 20).astype(np.float32)  # 32 MB
         ),
     }
 

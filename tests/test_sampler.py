@@ -136,10 +136,12 @@ def test_parallel_sampler_worker_count_is_pure_throughput(rng):
     seeds = np.arange(0, V, 2)
 
     def epoch(workers, e=1):
-        # force_workers: jax is already live in the pytest process; the
-        # CPU rig tolerates the fork and this is exactly the mp-path test
+        # spawn context: jax is already live in the pytest process, so the
+        # fork pool would (rightly) degrade to inline AND CPython would
+        # emit the os.fork-under-threads RuntimeWarning; the pickling pool
+        # exercises the same queue/reorder protocol warning-free
         s = ParallelEpochSampler(
-            g, seeds, 32, [4, 3], seed=9, workers=workers, force_workers=True
+            g, seeds, 32, [4, 3], seed=9, workers=workers, ctx_method="spawn"
         )
         try:
             return list(s.sample_epoch(e))

@@ -203,6 +203,41 @@ if bcompiled is not None:
         lambda v: (bsp_gather_dst_from_src(bsp_pair, v) * c).sum()))
     r = np.asarray(bspg(jnp.asarray(x)), np.float64)
     out["checks"]["bsp_grad_f32"] = rel_err(r, dense.T @ c.astype(np.float64))
+    # round 4 — bf16 slab parity: production rounds the one-hot W entries
+    # to the slab dtype (bf16) for the main MXU dot (ops/bsp_ell.py
+    # numeric policy); quantify that rounding on chip against the f64
+    # golden — same tolerance class as the XLA bf16 aggregation checks.
+    # Guarded like the f32 compile: a dtype-specific lowering failure is
+    # recorded, never a module-killing crash
+    try:
+        r = np.asarray(bfn(bsp_pair, jnp.asarray(x, jnp.bfloat16)), np.float64)
+        out["checks"]["bsp_bf16"] = rel_err(r, golden)
+    except Exception as e:  # noqa: BLE001
+        out["bsp_bf16_error"] = f"{type(e).__name__}: {str(e)[:300]}"
+    # round 4 — SMEM-budget grid segmentation on chip: a budget of 8
+    # splits this graph's 16-block table (the 16-block build fits a
+    # 16-block cap in one segment); the per-segment calls must agree
+    # with the golden. Restore any rig-level budget setting afterwards.
+    import os as _os_seg
+    _prior_cap = _os_seg.environ.get("NTS_BSP_MAX_BLOCKS")
+    _os_seg.environ["NTS_BSP_MAX_BLOCKS"] = "8"
+    try:
+        seg_pair = BspEllPair.from_host(g, dt=64, vt=128, k_slots=8, r_rows=128)
+    finally:
+        if _prior_cap is None:
+            _os_seg.environ.pop("NTS_BSP_MAX_BLOCKS", None)
+        else:
+            _os_seg.environ["NTS_BSP_MAX_BLOCKS"] = _prior_cap
+    out["bsp_segments"] = int(seg_pair.fwd.n_seg)
+    if seg_pair.fwd.n_seg > 1:
+        try:
+            r = np.asarray(
+                jax.jit(bsp_gather_dst_from_src)(seg_pair, jnp.asarray(x)),
+                np.float64,
+            )
+            out["checks"]["bsp_seg_f32"] = rel_err(r, golden)
+        except Exception as e:  # noqa: BLE001
+            out["bsp_seg_error"] = f"{type(e).__name__}: {str(e)[:300]}"
 
 # round 3 — dist-bsp on real hardware with ONE chip: a P=1 mesh runs the
 # full shard_map + rectangular Mosaic kernel + feature-chunking machinery
@@ -366,6 +401,19 @@ def test_tpu_bsp_kernel(tpu_results):
         pytest.skip(f"bsp: {tpu_results.get('bsp')}")
     assert tpu_results["checks"]["bsp_f32"] < 1e-5, tpu_results
     assert tpu_results["checks"]["bsp_grad_f32"] < 1e-5, tpu_results
+
+
+def test_tpu_bsp_bf16_and_segmented(tpu_results):
+    """Round 4: (a) the bf16-slab numeric policy (W entries round to the
+    slab dtype for the MXU dot) stays within the bf16 tolerance class on
+    chip; (b) the SMEM-budget segmented grid computes the same result."""
+    if tpu_results.get("bsp") != "compiled":
+        pytest.skip(f"bsp: {tpu_results.get('bsp')}")
+    assert "bsp_bf16_error" not in tpu_results, tpu_results["bsp_bf16_error"]
+    assert tpu_results["checks"]["bsp_bf16"] < 0.05, tpu_results
+    assert tpu_results.get("bsp_segments", 0) > 1, tpu_results
+    assert "bsp_seg_error" not in tpu_results, tpu_results["bsp_seg_error"]
+    assert tpu_results["checks"]["bsp_seg_f32"] < 1e-5, tpu_results
 
 
 def test_tpu_dist_bsp_single_chip_mesh(tpu_results):
