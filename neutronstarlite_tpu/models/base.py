@@ -82,6 +82,12 @@ class ToolkitBase:
         self.datum: Optional[GNNDatum] = None
         self.host_ell = None  # optional prebuilt ops.ell.EllPair (shared)
         self.epoch_times = []
+        # per-epoch training losses, appended by every run loop — the
+        # trajectory-equality oracle (two backends computing the same math
+        # must produce the same CURVE, not just the same endpoint) reads
+        # this; reference analog: the per-epoch loss lines GCN_CPU.hpp
+        # prints each epoch
+        self.loss_history: list = []
 
     # dist trainers build their own partitioned layout; the single-device
     # DeviceGraph upload would be O(E) wasted HBM for them

@@ -245,6 +245,7 @@ class FullBatchTrainer(ToolkitBase):
             )
             jax.block_until_ready(loss)
             self.epoch_times.append(get_time() - t0)
+            self.loss_history.append(float(loss))
             if epoch % max(1, cfg.epochs // 20) == 0 or epoch == cfg.epochs - 1:
                 # per-epoch Train/Eval/Test accuracy from the training
                 # forward's logits, the reference's oracle cadence

@@ -444,6 +444,7 @@ class DistGCNTrainer(ToolkitBase):
             )
             jax.block_until_ready(loss)
             self.epoch_times.append(get_time() - t0)
+            self.loss_history.append(float(loss))
             self.ckpt_epoch_end(epoch)
             if epoch % max(1, cfg.epochs // 20) == 0 or epoch == cfg.epochs - 1:
                 log.info("Epoch %d loss %f", epoch, float(loss))

@@ -287,6 +287,7 @@ class DistGCNCacheTrainer(ToolkitBase):
             )
             jax.block_until_ready(loss)
             self.epoch_times.append(get_time() - t0)
+            self.loss_history.append(float(loss))
             self.ckpt_epoch_end(epoch)
             if epoch % max(1, cfg.epochs // 20) == 0 or epoch == cfg.epochs - 1:
                 log.info("Epoch %d loss %f", epoch, float(loss))

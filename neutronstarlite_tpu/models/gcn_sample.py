@@ -209,10 +209,13 @@ class GCNSampleTrainer(ToolkitBase):
                 losses.append(loss)
             jax.block_until_ready(loss)
             self.epoch_times.append(get_time() - t0)
+            self.loss_history.append(
+                float(np.mean([float(l) for l in losses]))
+            )
             if epoch % max(1, cfg.epochs // 10) == 0 or epoch == cfg.epochs - 1:
                 log.info(
                     "Epoch %d loss %f (%d batches)",
-                    epoch, float(np.mean([float(l) for l in losses])), len(losses),
+                    epoch, self.loss_history[-1], len(losses),
                 )
         # training is done: release the sampling worker pool (a sweep that
         # builds many trainers must not accumulate forked children; a

@@ -1,0 +1,187 @@
+"""Exact per-layer WIRE accounting for the dist exchanges (chip-free).
+
+VERDICT r3 item 7: the comm-layer ranking and the DepCache threshold were
+justified by CPU-mesh wall time, which ranks schedules noisily and says
+nothing about real ICI. The decisions' actual currency is WIRE VOLUME —
+an exact host-side count, no device needed — so this tool prints it and
+checks the auto policies against it:
+
+- per-device per-layer RECEIVED remote rows for each comm layer. The
+  dense exchanges (ring ppermute rotation, ell/blocked all_gather) each
+  deliver P-1 remote shard chunks of vp rows; the mirror all_to_all
+  delivers P-1 compacted chunks of Mb rows (the reference's active-only
+  message optimization, comm/network.cpp:505-518, as a layout property).
+  Mb <= vp always (compaction never grows a chunk), so COMM_LAYER:auto's
+  mirror-leaning tie-break is wire-sound; the tool verifies the choice
+  equals the wire argmin on the actual graph.
+- the DepCache split at a threshold ladder: mc cached (replicated hot
+  rows, shipped only on refresh epochs) vs mf fetched per layer, with the
+  per-layer amortized wire at refresh cadence R =
+  (P-1) * (mf + mc / R) rows — and whether REP_THRESHOLD:auto's choice
+  is the wire-minimizing threshold whose cache fits the HBM budget
+  (core/NtsScheduler.hpp:556-637 analog).
+
+Usage:
+  python -m neutronstarlite_tpu.tools.wire_accounting
+      [--scale 1.0 | --cora] [--partitions 8] [--feature 602]
+      [--refresh 3] [--budget-mib 256]
+Prints ONE JSON line; human-readable table to stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def accounting(g, P: int, f: int, refresh: int, budget_bytes: int,
+               thresholds=None) -> dict:
+    """All counts are per device per layer unless stated; bytes are f32
+    rows (itemsize 4) at feature width f."""
+    from neutronstarlite_tpu.parallel.feature_cache import CachedMirrorGraph
+    from neutronstarlite_tpu.parallel.mirror import MirrorGraph
+
+    mb, vp = MirrorGraph.estimate_mb(g, P)
+    dense_rows = (P - 1) * vp
+    mirror_rows = (P - 1) * mb
+    out = {
+        "P": P, "f": f, "vp": vp, "mb": mb,
+        "layers": {
+            "ring": dense_rows, "ell": dense_rows, "blocked": dense_rows,
+            "mirror": mirror_rows,
+        },
+        "bytes_per_layer": {
+            k: v * f * 4
+            for k, v in (
+                ("ring", dense_rows), ("ell", dense_rows),
+                ("blocked", dense_rows), ("mirror", mirror_rows),
+            )
+        },
+    }
+
+    # threshold ladder: degree percentiles of the mirror sources
+    if thresholds is None:
+        degs = g.out_degree[g.out_degree > 0]
+        qs = [50, 75, 90, 99]
+        thresholds = sorted(
+            {int(np.percentile(degs, q)) for q in qs} | {1}
+        )
+    ladder = []
+    for t in thresholds:
+        cm = CachedMirrorGraph.build(g, P, replication_threshold=t)
+        amortized = (P - 1) * (cm.mf + cm.mc / max(refresh, 1))
+        ladder.append({
+            "threshold": t, "mc": cm.mc, "mf": cm.mf,
+            "hot_fraction": round(float(cm.cached_fraction), 4),
+            "fetch_rows": (P - 1) * cm.mf,
+            "amortized_rows": round(amortized, 1),
+            "cached_bytes_device": P * cm.mc * f * 4,
+        })
+    out["depcache"] = ladder
+
+    # --- auto decisions vs the wire argmin --------------------------------
+    from neutronstarlite_tpu.models.gcn_dist import DistGCNTrainer
+    from neutronstarlite_tpu.utils.config import InputInfo
+
+    cfg = InputInfo()
+    cfg.comm_layer = "auto"
+    auto_choice = DistGCNTrainer.resolve_comm_layer(cfg, g, P)
+    wire_argmin = min(out["layers"], key=out["layers"].get)
+    out["comm_auto"] = {
+        "choice": auto_choice,
+        "wire_argmin": wire_argmin,
+        # mirror and the dense layers tie when compaction saturates
+        # (mb == vp); the auto tie-break prefers mirror (one all_to_all
+        # vs P-1 dependent rounds) — wire-equivalent, so still sound
+        "wire_optimal": out["layers"][auto_choice]
+        == out["layers"][wire_argmin],
+    }
+
+    t_auto = CachedMirrorGraph.choose_replication_threshold(
+        g, P, f, budget_bytes
+    )
+    cm_auto = CachedMirrorGraph.build(g, P, replication_threshold=t_auto)
+    fits = P * cm_auto.mc * f * 4 <= budget_bytes
+    # wire-minimality under the budget: no ladder threshold that FITS the
+    # budget ships strictly less per-layer wire (smaller mf) than the
+    # auto choice — compared by wire, not by threshold value (different
+    # thresholds can induce the same hot/cold split)
+    smaller_wire_fitting = [
+        e for e in ladder
+        if e["cached_bytes_device"] <= budget_bytes and e["mf"] < cm_auto.mf
+    ]
+    out["rep_auto"] = {
+        "threshold": t_auto, "mc": cm_auto.mc, "mf": cm_auto.mf,
+        "cached_bytes_device": P * cm_auto.mc * f * 4,
+        "budget_bytes": budget_bytes,
+        "fits": fits,
+        "wire_minimal_under_budget": not smaller_wire_fitting,
+    }
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=float, default=1.0)
+    ap.add_argument("--cora", action="store_true")
+    ap.add_argument("--partitions", type=int, default=8)
+    ap.add_argument("--feature", type=int, default=602)
+    ap.add_argument("--refresh", type=int, default=3)
+    ap.add_argument("--budget-mib", type=int, default=256)
+    args = ap.parse_args(argv)
+
+    if args.cora:
+        from neutronstarlite_tpu.graph.storage import (
+            build_graph, load_edges,
+        )
+
+        fix = os.path.join(REPO, "tests", "fixtures", "cora")
+        src, dst = load_edges(os.path.join(fix, "cora.2708.edge.self"))
+        g = build_graph(src, dst, 2708, weight="gcn_norm")
+        name = "cora"
+    else:
+        from bench import build_and_cache_graph, load_cached_graph
+
+        d, v_num, e_num, _ = build_and_cache_graph(args.scale)
+        g, _, _ = load_cached_graph(d)
+        name = f"reddit_synth_x{args.scale:g}"
+
+    out = accounting(
+        g, args.partitions, args.feature, args.refresh,
+        args.budget_mib << 20,
+    )
+    out["graph"] = name
+    print(
+        "\n".join(
+            [f"wire accounting: {name} P={out['P']} f={out['f']} "
+             f"vp={out['vp']} mb={out['mb']}"]
+            + [f"  {k:8s} {v:>12d} rows/dev/layer "
+               f"({out['bytes_per_layer'][k] / 2**20:.1f} MiB)"
+               for k, v in out["layers"].items()]
+            + [f"  depcache t={e['threshold']:>6d}: mc={e['mc']:>6d} "
+               f"mf={e['mf']:>6d} hot={e['hot_fraction']:.3f} "
+               f"amortized={e['amortized_rows']:>10.0f} rows/dev/layer"
+               for e in out["depcache"]]
+            + [f"  comm auto -> {out['comm_auto']['choice']} "
+               f"(wire argmin {out['comm_auto']['wire_argmin']}, "
+               f"optimal={out['comm_auto']['wire_optimal']})",
+               f"  rep auto -> t={out['rep_auto']['threshold']} "
+               f"fits={out['rep_auto']['fits']} "
+               f"minimal={out['rep_auto']['wire_minimal_under_budget']}"]
+        ),
+        file=sys.stderr,
+    )
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

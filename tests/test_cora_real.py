@@ -5,10 +5,11 @@ its Cora artifacts (binary self-loop edge list, labeltable, mask — the
 featuretable is not shipped) are committed under tests/fixtures/cora so
 correctness is anchored on REAL structure + labels + split, not only on
 synthetic planted problems. Features are the deterministic random fallback,
-so the asserted band is the STRUCTURE-ONLY accuracy: measured ~0.79 train /
-~0.64 eval / ~0.57 test at 60 epochs; the band leaves seed margin while
-staying far above 7-class chance (0.143). A broken aggregation path (wrong
-weights, dropped edges, bad mask parsing) lands at chance and fails loudly.
+so the asserted band is the STRUCTURE-ONLY accuracy: measured 0.7900 train /
+0.6431 eval / 0.5698 test at 60 epochs, pinned to +-0.03 (round 4; the old
+loose floor let a 10-point regression pass). The 60-epoch loss CURVES are
+additionally asserted equal across scatter/ell/blocked/bsp/dist — the
+trajectory oracle catches a path whose endpoint happens to land in band.
 """
 
 from __future__ import annotations
@@ -47,32 +48,89 @@ def test_cora_files_parse_to_known_stats(cora):
     assert (train, ev, test) == (1605, 566, 537)
 
 
-@pytest.mark.parametrize("path", ["scatter", "ell", "blocked"])
-def test_cora_structure_only_accuracy_band(cora, path):
-    """GCN on real structure/labels/split with random features must land in
-    the structure-only band (the reference's accuracy-as-oracle discipline,
-    toolkits/GCN_CPU.hpp:142-171) — on every aggregation backend (the
-    Pallas path is bit-equal to ell by tests/test_pallas.py parity)."""
+# Measured on this rig (2026-07-31, 60 epochs, seed-deterministic): the
+# four single-chip aggregation backends produce BIT-IDENTICAL curves and
+# accuracies; the P=4 dist engine tracks the curve within 4.7% max
+# pointwise relative (different reduction orders + padded-row bn stats).
+MEASURED_ACC = {"train": 0.7900, "eval": 0.6431, "test": 0.5698}
+MEASURED_DIST_ACC = {"train": 0.8025, "eval": 0.6502, "test": 0.5680}
+ACC_TOL = 0.03  # VERDICT r3 item 4a: measured +-0.03, not a loose floor
+
+
+@pytest.fixture(scope="module")
+def cora_runs(cora):
+    """One 60-epoch run per backend (scatter/ell/blocked/bsp + dist P=4),
+    each returning (result, loss_history) — shared by the band test and
+    the trajectory-equality test so the suite pays each training once."""
     from neutronstarlite_tpu.models.gcn import GCNTrainer
+    from neutronstarlite_tpu.models.gcn_dist import DistGCNTrainer
     from neutronstarlite_tpu.utils.config import InputInfo
 
     src, dst, datum = cora
-    cfg = InputInfo()
-    cfg.vertices = 2708
-    cfg.layer_string = "64-32-7"
-    cfg.epochs = 60
-    cfg.decay_epoch = -1
-    cfg.drop_rate = 0.3
-    cfg.optim_kernel = path != "scatter"
-    cfg.kernel_tile = 512 if path == "blocked" else 0
-    out = GCNTrainer.from_arrays(cfg, src, dst, datum).run()
 
-    assert out["acc"]["train"] >= 0.65, out["acc"]
-    assert out["acc"]["test"] >= 0.45, out["acc"]
+    def cfg_base():
+        cfg = InputInfo()
+        cfg.vertices = 2708
+        cfg.layer_string = "64-32-7"
+        cfg.epochs = 60
+        cfg.decay_epoch = -1
+        cfg.drop_rate = 0.3
+        return cfg
+
+    runs = {}
+    for path in ("scatter", "ell", "blocked", "bsp"):
+        cfg = cfg_base()
+        cfg.optim_kernel = path != "scatter"
+        cfg.kernel_tile = 512 if path in ("blocked", "bsp") else 0
+        cfg.pallas_kernel = path == "bsp"
+        tr = GCNTrainer.from_arrays(cfg, src, dst, datum)
+        runs[path] = (tr.run(), list(tr.loss_history))
+    cfg = cfg_base()
+    cfg.partitions = 4
+    tr = DistGCNTrainer.from_arrays(cfg, src, dst, datum)
+    runs["dist"] = (tr.run(), list(tr.loss_history))
+    return runs
+
+
+@pytest.mark.parametrize("path", ["scatter", "ell", "blocked", "bsp", "dist"])
+def test_cora_structure_only_accuracy_band(cora_runs, path):
+    """GCN on real structure/labels/split with random features must land
+    WITHIN +-0.03 of the measured structure-only accuracies (the
+    reference's accuracy-as-oracle discipline, toolkits/GCN_CPU.hpp:
+    142-171) — on every aggregation backend. A regression costing ~10
+    accuracy points (the band the old floor let through) now fails."""
+    out, _ = cora_runs[path]
+    want = MEASURED_DIST_ACC if path == "dist" else MEASURED_ACC
+    for split, value in want.items():
+        assert abs(out["acc"][split] - value) <= ACC_TOL, (
+            path, split, out["acc"], want
+        )
     # sanity ceiling: random-feature Cora cannot match real-feature Cora
     # (~0.81 test); if it "does", labels are leaking somewhere
     assert out["acc"]["test"] <= 0.75, out["acc"]
     assert np.isfinite(out["loss"])
+
+
+def test_cora_loss_trajectory_equality(cora_runs):
+    """VERDICT r3 item 4b: the 60-epoch loss CURVES (not just endpoints)
+    must agree across backends on real Cora structure. Single-chip paths
+    compute identical math in different layouts — measured bit-identical
+    on this rig, asserted to 2% pointwise for cross-platform reduction
+    slack; the dist engine's curve (different reduction order, padded bn
+    rows) tracks within 10% pointwise (measured 4.7% max)."""
+    ref = np.asarray(cora_runs["scatter"][1])
+    assert len(ref) == 60
+    for path in ("ell", "blocked", "bsp"):
+        h = np.asarray(cora_runs[path][1])
+        assert len(h) == len(ref)
+        rel = np.abs(h - ref) / np.maximum(np.abs(ref), 1e-3)
+        assert rel.max() <= 0.02, (path, float(rel.max()))
+    h = np.asarray(cora_runs["dist"][1])
+    rel = np.abs(h - ref) / np.maximum(np.abs(ref), 1e-3)
+    assert rel.max() <= 0.10, ("dist", float(rel.max()))
+    # every curve must actually DESCEND (a flat parity-preserving bug —
+    # e.g. all paths reading zeroed weights — would pass the equality)
+    assert ref[-1] < 0.6 * ref[0], (ref[0], ref[-1])
 
 
 @pytest.mark.parametrize(
