@@ -224,14 +224,35 @@ class ToolkitBase:
     def checkpoint_state(self):
         return {"params": self.params, "opt": self.opt_state}
 
+    def _ckpt_backend(self) -> str:
+        from neutronstarlite_tpu.utils.checkpoint import default_backend
+
+        backend = self.cfg.ckpt_backend or default_backend()
+        if backend not in ("npz", "orbax"):
+            raise ValueError(
+                f"unknown checkpoint backend {backend!r} "
+                "(CKPT_BACKEND / NTS_CKPT_BACKEND: npz | orbax)"
+            )
+        return backend
+
     def save(self, path: str, epoch: int) -> None:
         from neutronstarlite_tpu.utils.checkpoint import save_checkpoint
 
-        # params are replicated: one writer suffices, and concurrent writers
-        # on a shared checkpoint dir would race on the tmp file
+        backend = self._ckpt_backend()
+        if backend == "orbax":
+            # async + sharded: EVERY process participates (orbax
+            # coordinates the distributed write; dir is shared storage)
+            save_checkpoint(path, self.checkpoint_state(), epoch,
+                            backend="orbax")
+            return
+        # npz: params are replicated, one writer suffices, and concurrent
+        # writers on a shared checkpoint dir would race on the tmp file
         if jax.process_index() != 0:
             return
-        save_checkpoint(path, self.checkpoint_state(), epoch)
+        # the resolved backend is passed explicitly: an env-level
+        # NTS_CKPT_BACKEND=orbax must not override a cfg-level npz opt-out
+        # at the lower layer
+        save_checkpoint(path, self.checkpoint_state(), epoch, backend=backend)
 
     @staticmethod
     def _restore_like(template, arr):
@@ -250,7 +271,9 @@ class ToolkitBase:
         """Returns the epoch to resume from (0 when no checkpoint exists)."""
         from neutronstarlite_tpu.utils.checkpoint import restore_checkpoint
 
-        got = restore_checkpoint(path, self.checkpoint_state())
+        got = restore_checkpoint(
+            path, self.checkpoint_state(), backend=self._ckpt_backend()
+        )
         if got is None:
             return 0
         state, step = got
@@ -270,8 +293,23 @@ class ToolkitBase:
         core/graph.hpp:528-583)."""
         if not self.cfg.checkpoint_dir:
             return 0
+        backend = self._ckpt_backend()
         if jax.process_count() <= 1:
             return self.restore(self.cfg.checkpoint_dir)
+        if backend == "orbax":
+            from neutronstarlite_tpu.utils.checkpoint import ORBAX_SUBDIR
+
+            if os.path.isdir(
+                os.path.join(self.cfg.checkpoint_dir, ORBAX_SUBDIR)
+            ):
+                # orbax multi-host: the restore itself is symmetric —
+                # every process calls it and arrays land on their
+                # shardings from shared storage; no broadcast staging
+                return self.restore(self.cfg.checkpoint_dir)
+            # orbax requested but only npz files exist (backend switched
+            # mid-run): npz dirs may be process-0-local, so the restore
+            # MUST go through the broadcast path below — a symmetric
+            # per-rank npz read would desynchronize resume epochs
 
         # Multi-process: keep every step SYMMETRIC across ranks. A naive
         # per-rank restore deadlocks — device_put onto a multi-process
@@ -283,7 +321,9 @@ class ToolkitBase:
 
         from neutronstarlite_tpu.utils.checkpoint import restore_checkpoint
 
-        got = restore_checkpoint(self.cfg.checkpoint_dir, self.checkpoint_state())
+        got = restore_checkpoint(
+            self.cfg.checkpoint_dir, self.checkpoint_state(), backend="npz"
+        )
         step = int(multihost_utils.broadcast_one_to_all(np.int32(got[1] if got else 0)))
         if step == 0:  # no checkpoint anywhere: skip the model-sized broadcast
             return 0
@@ -308,6 +348,11 @@ class ToolkitBase:
     def ckpt_final(self) -> None:
         if self.cfg.checkpoint_dir:
             self.save(self.cfg.checkpoint_dir, self.cfg.epochs)
+            from neutronstarlite_tpu.utils.checkpoint import (
+                finalize_checkpoints,
+            )
+
+            finalize_checkpoints()  # drain async orbax writes (npz: no-op)
 
     # ---- accuracy / loss helpers ----------------------------------------
     @staticmethod

@@ -104,6 +104,9 @@ class InputInfo:
     precision: str = "float32"  # or "bfloat16" for the aggregation path
     checkpoint_dir: str = ""  # enable checkpoint/resume when set
     checkpoint_every: int = 0  # epochs between checkpoints (0 = end only)
+    ckpt_backend: str = ""  # "" -> NTS_CKPT_BACKEND env / npz; "orbax" =
+    # async + sharded saves (utils/checkpoint.py; dir must be shared
+    # storage on multi-host)
     # DepCache hybrid dependency management (parallel/feature_cache.py;
     # reference replication_threshold graph.hpp:179, FeatureCache
     # NtsScheduler.hpp:556). Active when PROC_REP:1.
@@ -218,6 +221,12 @@ class InputInfo:
             self.checkpoint_dir = value
         elif key == "CHECKPOINT_EVERY":
             self.checkpoint_every = int(value)
+        elif key == "CKPT_BACKEND":
+            if value not in ("npz", "orbax"):
+                raise ValueError(
+                    f"CKPT_BACKEND must be npz or orbax, got {value!r}"
+                )
+            self.ckpt_backend = value
         elif key == "REP_THRESHOLD":
             # "auto" -> -1: the cache build chooses the smallest threshold
             # whose replicated rows fit CACHE_BUDGET_MIB (the automatic

@@ -89,3 +89,71 @@ def test_dist_trainer_checkpoint_resume(rng, tmp_path):
     result = t2.run()  # resumes from 12
     assert len(t2.epoch_times) == 30 - 12
     assert result["acc"]["train"] > 0.8, result
+
+
+def test_orbax_roundtrip_and_trainer_resume(tmp_path):
+    """CKPT_BACKEND:orbax (round 4): async sharded saves through
+    orbax.checkpoint. Round-trip preserves values AND the trainer resume
+    flow matches the npz path's epoch accounting."""
+    import jax
+    from neutronstarlite_tpu.utils.checkpoint import finalize_checkpoints
+
+    state = {
+        "params": [{"W": jnp.arange(6.0).reshape(2, 3)}],
+        "opt": {"m": jnp.ones((2, 3)), "step": jnp.asarray(3, jnp.int32)},
+    }
+    save_checkpoint(str(tmp_path / "a"), state, step=4, backend="orbax")
+    finalize_checkpoints()
+    got, step = restore_checkpoint(str(tmp_path / "a"), state, backend="orbax")
+    assert step == 4
+    np.testing.assert_array_equal(
+        got["params"][0]["W"], np.arange(6.0).reshape(2, 3)
+    )
+    assert int(got["opt"]["step"]) == 3
+
+    src, dst, datum = _planted_data(seed=5)
+    cfg = _planted_cfg(epochs=20)
+    cfg.checkpoint_dir = str(tmp_path / "ckpt")
+    cfg.ckpt_backend = "orbax"
+    GCNTrainer.from_arrays(cfg, src, dst, datum).run()
+
+    cfg2 = _planted_cfg(epochs=30)
+    cfg2.checkpoint_dir = cfg.checkpoint_dir
+    cfg2.ckpt_backend = "orbax"
+    t2 = GCNTrainer.from_arrays(cfg2, src, dst, datum)
+    result = t2.run()
+    assert len(t2.epoch_times) == 10  # restored at 20, ran 20..29
+    assert result["acc"]["train"] > 0.85
+
+
+def test_orbax_sharded_restore_preserves_shardings(tmp_path):
+    """The scale-out property the npz path lacks: arrays saved from a
+    NamedSharding land back ON that sharding at restore (no host-side
+    broadcast staging) — asserted on the 8-virtual-device mesh."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as PS
+
+    from neutronstarlite_tpu.parallel.mesh import make_mesh
+    from neutronstarlite_tpu.utils.checkpoint import finalize_checkpoints
+
+    mesh = make_mesh(8)
+    axis = mesh.axis_names[0]
+    sharded = NamedSharding(mesh, PS(axis))
+    replicated = NamedSharding(mesh, PS())
+    state = {
+        "params": {
+            "emb": jax.device_put(
+                jnp.arange(64.0).reshape(16, 4), sharded
+            ),
+            "w": jax.device_put(jnp.ones((4, 4)), replicated),
+        }
+    }
+    save_checkpoint(str(tmp_path), state, step=1, backend="orbax")
+    finalize_checkpoints()
+    got, step = restore_checkpoint(str(tmp_path), state, backend="orbax")
+    assert step == 1
+    assert got["params"]["emb"].sharding == sharded
+    assert got["params"]["w"].sharding == replicated
+    np.testing.assert_array_equal(
+        np.asarray(got["params"]["emb"]), np.arange(64.0).reshape(16, 4)
+    )
