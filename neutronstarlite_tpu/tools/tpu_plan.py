@@ -11,8 +11,9 @@ a mid-plan tunnel death costs only the step in flight.
 Plan steps — ``--list`` is authoritative; in execution order:
   1. bench_full: north-star full-scale sweep + winner measurement (bench.py)
   2. micro_kernels: reproducible PERF §1 micro table (tools/micro_bench)
-  2a. fullv_{pallas_resident,pallas_fchunked,bsp}: hang-triage per-op
-      timings at the full 233k-row table, one isolated step each
+  2a. fullv_bsp: per-op timing of the bsp kernel at the full 233k-row
+      table (the resident/fchunked triage legs were cut in round 4:
+      proven un-lowerable, they would only burn window re-confirming it)
   3. tpu_tests: on-chip test module (tests/test_tpu.py, generous timeout)
   4. ell_chunk_{16,64,128}: NTS_ELL_CHUNK_MIB tuning on the eager/ELL path
   5. eager_pallas / standard_pallas / eager_bsp / bsp_vt_{2048,1024} /
@@ -121,28 +122,21 @@ def build_steps(out_dir: str):
             1800,
             {},
         ),
-        # round-3 hang triage: both full-scale pallas sweep legs timed out
-        # (2026-07-31); per-op timing at the FULL 233k-row table (--scale
-        # 2.0 doubles the §1 V) separates a Mosaic compile blowup from a
-        # slow-gather runtime. One op per step: a hung compile stalls the
-        # process inside C++ where no in-process timeout can reach it, so
-        # the isolation (and the kill) is this supervisor's per-step
-        # subprocess timeout, and a hang costs only its own step
-        *[
-            (
-                f"fullv_{tag}",
-                [sys.executable, "-m",
-                 "neutronstarlite_tpu.tools.micro_bench",
-                 "--scale", "2.0", "--ops", op],
-                1200,
-                {},
-            )
-            for tag, op in (
-                ("pallas_resident", "pallas_ell_resident"),
-                ("pallas_fchunked", "pallas_ell_fchunked"),
-                ("bsp", "bsp_streamed"),
-            )
-        ],
+        # round-3 hang triage, round-4 cut: the resident/fchunked pallas
+        # ops are PROVEN un-lowerable (the Mosaic gather reckoning,
+        # PERF.md §5) and the remote compile service hangs rather than
+        # erroring on them — each step would burn its full 1200 s of a
+        # chip window re-confirming a settled question. Only the bsp
+        # triage (the real PALLAS:1 kernel, per-op at the full 233k-row
+        # table) keeps its slot.
+        (
+            "fullv_bsp",
+            [sys.executable, "-m",
+             "neutronstarlite_tpu.tools.micro_bench",
+             "--scale", "2.0", "--ops", "bsp_streamed"],
+            1200,
+            {},
+        ),
         (
             "tpu_tests",
             [sys.executable, "-m", "pytest",
