@@ -94,6 +94,17 @@ DEFAULT_R = 128  # rows per block (the 128-lane axis of the tables)
 DEFAULT_MAX_BLOCKS = 224 * 1024
 
 
+def bsp_bseg_menu(cap_eff: int) -> "list[int]":
+    """The EXACT b_seg menu a segmented build can emit under this cap:
+    seven uniform quantum steps plus the cap itself (the quantum is
+    floor(cap/8) rounded down to a multiple of 8, which need not divide
+    the cap — the cap is its own 8th value). Shared with
+    tools/aot_bsp_scale so the AOT proof enumerates precisely these."""
+    quantum = max(8, (cap_eff // 8) - (cap_eff // 8) % 8)
+    menu = [k * quantum for k in range(1, 8) if k * quantum < cap_eff]
+    return menu + [cap_eff]
+
+
 def resolve_bsp_knobs(dt: int = 0, k_slots: int = 0) -> "tuple[int, int]":
     """Resolve the NTS_BSP_DT / NTS_BSP_K env tunables (0 = use env or
     default). Shared by the single-chip (BspEllPair.from_host) and dist
@@ -299,19 +310,50 @@ class BspEll:
         total_need = int(need.sum())
         s_est = max(1, -(-total_need // max(cap_eff, 1)))
         t_seg_cap = min(t_dst, 2 * (-(-t_dst // s_est))) if t_dst else 0
+        # BALANCED packing: close a segment at ceil(total/S) blocks, not
+        # at the cap — fill-to-cap left the LAST segment nearly empty and
+        # the uniform b_seg then padded it with cap-sized dead work
+        # (measured at full-scale vt=2048: 258k data blocks -> 458k padded
+        # grid steps, 1.78x; balancing + the quantized b_seg below holds
+        # that to ~1.1x). The cap stays the hard bound.
+        target = min(cap_eff, -(-total_need // s_est))
         seg_of_tile = np.empty(t_dst, np.int64)
         first_tile = [0]
         acc_b = acc_t = seg = 0
         for tile in range(t_dst):  # t_dst ~ 4.5k at 10x Reddit: cheap
             nb = int(need[tile])
-            if acc_t + 1 > t_seg_cap or acc_b + nb > cap_eff:
+            if acc_t and (
+                acc_t + 1 > t_seg_cap
+                or acc_b + nb > target
+            ):
                 seg += 1
                 first_tile.append(tile)
                 acc_b = acc_t = 0
             seg_of_tile[tile] = seg
             acc_b += nb
             acc_t += 1
-        S = seg + 1
+        # tail-merge the tile-granularity spill: closing at the balanced
+        # target can strand a near-empty final segment (full-scale
+        # vt=2048: a 0.4k-block 3rd segment that b_seg would pad with
+        # 143k dead blocks); fold trailing segments back while the cap
+        # and the tile bound both still hold
+        seg_blocks = np.bincount(
+            seg_of_tile, weights=need.astype(np.float64), minlength=seg + 1
+        ).astype(np.int64)
+        seg_tiles_n = np.bincount(seg_of_tile, minlength=seg + 1)
+        while (
+            len(first_tile) >= 2
+            and seg_blocks[-1] + seg_blocks[-2] <= cap_eff
+            and seg_tiles_n[-1] + seg_tiles_n[-2] <= t_seg_cap
+        ):
+            last = len(first_tile) - 1
+            seg_of_tile[seg_of_tile == last] = last - 1
+            seg_blocks[-2] += seg_blocks[-1]
+            seg_tiles_n[-2] += seg_tiles_n[-1]
+            seg_blocks = seg_blocks[:-1]
+            seg_tiles_n = seg_tiles_n[:-1]
+            first_tile.pop()
+        S = len(first_tile)
         first_tile = np.asarray(first_tile, np.int64)
         tiles_in_seg = np.bincount(seg_of_tile, minlength=S)
         seg_of_data = seg_of_tile[data_bd] if n_data_blocks else data_bd
@@ -328,9 +370,14 @@ class BspEll:
             # t_seg is a PURE 128-multiple (may exceed t_dst: trailing
             # output tiles are never written or read), so every
             # segmented program's t_seg is 128*k with k <= ceil((t_dst
-            # + 1) / 128) — the exact band tools/aot_bsp_scale compiles
+            # + 1) / 128) — the exact band tools/aot_bsp_scale compiles.
+            # b_seg snaps up to the 8-value menu bsp_bseg_menu(cap)
+            # shares with tools/aot_bsp_scale — the AOT proof compiles
+            # the exact (b_seg menu) x (t_seg band) lattice, so every
+            # program a segmented build can emit is pre-lowered.
             t_seg = -(-int(tiles_in_seg.max()) // 128) * 128
-            b_seg = cap_eff
+            u_max = int(used.max())
+            b_seg = next(v for v in bsp_bseg_menu(cap_eff) if v >= u_max)
         assert b_seg <= max_blocks  # the construction's SMEM invariant
 
         B_total = S * b_seg

@@ -8,9 +8,11 @@ contiguous dst-tile range, with segment-LOCAL keys — the compiled
 program is independent of V; only the Python-level segment count grows.
 
 Provability: when a build segments (n_seg > 1) it QUANTIZES the program
-shape — b_seg is pinned to the SMEM cap and t_seg (the per-call output
-tile count) rounds up to a 128-multiple — so every segmented program at
-any scale comes from a small menu: (b_seg = cap, t_seg in 128*k). The
+shape — b_seg snaps to the exact 8-value menu ``bsp_bseg_menu(cap)``
+(seven quantum steps + the cap) and t_seg (the per-call output tile
+count) rounds up to a 128-multiple — so every segmented program at any
+scale comes from the finite (b_seg menu) x (t_seg band) lattice, which
+this tool compiles in full. The
 per-BLOCK geometry (the Mosaic lowering surface: [1,K,R] tables, the
 [vt,f] slab, the [dt,f] output tile, the W one-hot build) is
 t_seg-invariant; t_seg only sizes the output HBM buffer and the index
@@ -83,6 +85,7 @@ def main(argv=None) -> int:
         DEFAULT_R,
         DEFAULT_VT,
         _bsp_call,
+        bsp_bseg_menu,
     )
 
     v_num = int(REDDIT_V * args.scale)
@@ -90,17 +93,19 @@ def main(argv=None) -> int:
     cap = int(os.environ.get("NTS_BSP_MAX_BLOCKS", DEFAULT_MAX_BLOCKS))
     t_dst = -(-v_num // dt)
     t_src = -(-v_num // vt)
-    b_seg = (cap // 8) * 8  # the builder's pinned segmented b_seg
-    # menu band: every segmented build's t_seg is a pure 128-multiple
+    cap_eff = (cap // 8) * 8
+    bseg_menu = bsp_bseg_menu(cap_eff)
+    # t_seg band: every segmented build's t_seg is a pure 128-multiple
     # bounded by roundup128(2*ceil(t_dst/s_est)) with s_est >= 2
     # whenever segmentation triggers, i.e. <= roundup128(t_dst + 1) —
-    # compile the smallest, a middle value, and that exact upper bound
+    # the smallest, a middle value, and that exact upper bound
     hi = -(-(t_dst + 1) // 128) * 128
     cands = sorted({128, -(-(hi // 2) // 128) * 128, hi})
     out = {
         "scale": args.scale, "v_num": v_num, "topology": args.topology,
-        "b_seg": b_seg, "t_src": t_src, "f": args.f,
-        "smem_key_kib": round(b_seg * 4 / 1024, 1), "programs": [],
+        "bseg_menu": bseg_menu, "t_src": t_src, "f": args.f,
+        "smem_key_kib_max": round(bseg_menu[-1] * 4 / 1024, 1),
+        "programs": [],
     }
     try:
         topo = topologies.get_topology_desc(
@@ -114,27 +119,33 @@ def main(argv=None) -> int:
 
         import jax.numpy as jnp
 
-        shapes = (
-            sds((b_seg,), jnp.int32),            # blk_key
-            sds((b_seg, K, R), jnp.int32),       # nbr
-            sds((b_seg, K, R), jnp.float32),     # wgt
-            sds((b_seg, R), jnp.int32),          # ldst
-            sds((t_src * vt, args.f), jnp.bfloat16),  # xp slab
-        )
-        for t_seg in cands:
-            t0 = time.time()
-            compiled = _bsp_call.lower(
-                *shapes, dt=dt, vt=vt, t_dst=t_seg, t_src=t_src,
-                interpret=False,
-            ).compile()
-            mem = compiled.memory_analysis()
-            out["programs"].append({
-                "t_seg": t_seg,
-                "compile_s": round(time.time() - t0, 1),
-                "argument_gib": round(mem.argument_size_in_bytes / 2**30, 3),
-                "temp_gib": round(mem.temp_size_in_bytes / 2**30, 3),
-                "output_gib": round(mem.output_size_in_bytes / 2**30, 3),
-            })
+        for b_seg in bseg_menu:
+            shapes = (
+                sds((b_seg,), jnp.int32),            # blk_key
+                sds((b_seg, K, R), jnp.int32),       # nbr
+                sds((b_seg, K, R), jnp.float32),     # wgt
+                sds((b_seg, R), jnp.int32),          # ldst
+                sds((t_src * vt, args.f), jnp.bfloat16),  # xp slab
+            )
+            for t_seg in cands:
+                t0 = time.time()
+                compiled = _bsp_call.lower(
+                    *shapes, dt=dt, vt=vt, t_dst=t_seg, t_src=t_src,
+                    interpret=False,
+                ).compile()
+                mem = compiled.memory_analysis()
+                out["programs"].append({
+                    "b_seg": b_seg,
+                    "t_seg": t_seg,
+                    "compile_s": round(time.time() - t0, 1),
+                    "argument_gib": round(
+                        mem.argument_size_in_bytes / 2**30, 3
+                    ),
+                    "temp_gib": round(mem.temp_size_in_bytes / 2**30, 3),
+                    "output_gib": round(
+                        mem.output_size_in_bytes / 2**30, 3
+                    ),
+                })
         out["ok"] = True
     except Exception as e:  # noqa: BLE001 — report, don't trace-dump
         out.update(ok=False, error=f"{type(e).__name__}: {str(e)[:500]}")

@@ -213,6 +213,28 @@ def test_bsp_segmented_matches_unsegmented(rng):
                                rtol=1e-4, atol=1e-4)
 
 
+def test_bsp_bseg_snaps_to_menu(rng):
+    """Segmented builds must emit b_seg values ONLY from the shared
+    bsp_bseg_menu lattice — the finite program set the AOT proof tool
+    compiles (a b_seg off the menu would be an un-pre-lowered program
+    triggering a full-scale Mosaic compile on chip)."""
+    from neutronstarlite_tpu.ops.bsp_ell import bsp_bseg_menu
+
+    menu = bsp_bseg_menu((100 // 8) * 8)
+    assert menu[-1] == 96 and all(v % 8 == 0 for v in menu)
+    assert menu == sorted(set(menu))
+    g, _ = tiny_graph(rng, v_num=67, e_num=520)
+    for budget in (24, 40, 100):
+        seg = BspEll.build(
+            g.v_num, g.column_offset, g.row_indices, g.edge_weight_forward,
+            dt=8, vt=8, k_slots=4, r_rows=8, max_blocks=budget,
+        )
+        if seg.n_seg > 1:
+            assert seg.b_seg in bsp_bseg_menu((budget // 8) * 8), (
+                budget, seg.b_seg
+            )
+
+
 def test_bsp_segmented_boundary_and_overflow(rng):
     """At the budget boundary the build must fit exactly; a single dst
     tile that cannot fit any budget must raise (not silently overflow
