@@ -1,0 +1,171 @@
+"""Summarize a jax.profiler trace into a top-ops attribution table.
+
+VERDICT r3 item 2 asks for the measured-residual attribution of the
+full-scale epoch ("gather effective bandwidth? dispatch? Adam/NN
+fraction?"). The `profile_trace` plan step captures the xplane trace;
+this tool turns it into numbers ON THIS RIG — the installed
+tensorboard_plugin_profile's converter is broken against this
+tensorflow build (pywrap mismatch), so the xplane proto is parsed
+directly via tensorflow's bundled schema.
+
+Aggregates per-op TOTAL duration over the busiest device plane (TPU
+planes preferred; an explicit --plane tpu/cpu request FAILS rather than
+silently summarizing the other kind), grouping repeated XLA program
+instances by stripping trailing `.N` suffixes. Semantics note: device
+op lines don't nest, so their totals partition busy time; HOST thread
+lines can nest/overlap (block_until_ready wrapping executor spans), so
+busy_ms on a cpu plane can exceed wall_ms. Prints a top-N table plus
+ONE JSON line for the plan's artifact collector.
+
+Usage: python -m neutronstarlite_tpu.tools.trace_summary <trace_dir>
+         [--top 25] [--plane tpu|cpu|auto]
+`trace_dir` is NTS_PROFILE_DIR or any parent of plugins/profile/*/.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from collections import defaultdict
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def find_xplanes(root: str) -> "list[str]":
+    hits = sorted(
+        glob.glob(os.path.join(root, "**", "*.xplane.pb"), recursive=True),
+        key=os.path.getmtime,
+    )
+    return hits
+
+
+def load_xspace(path: str):
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    xs = xplane_pb2.XSpace()
+    with open(path, "rb") as fh:
+        xs.ParseFromString(fh.read())
+    return xs
+
+
+def pick_plane(xs, prefer: str):
+    """TPU device plane when present (auto), else the busiest
+    non-metadata plane. An EXPLICIT tpu/cpu request with no matching
+    plane returns None — summarizing host threads as device attribution
+    (or vice versa) would be silently wrong."""
+    scored = []
+    for p in xs.planes:
+        n_events = sum(len(li.events) for li in p.lines)
+        if not n_events:
+            continue
+        is_tpu = "TPU" in p.name.upper()
+        scored.append((is_tpu, n_events, p))
+    if not scored:
+        return None
+    if prefer == "tpu":
+        scored = [s for s in scored if s[0]]
+    elif prefer == "cpu":
+        scored = [s for s in scored if not s[0]]
+    else:  # auto: any TPU plane outranks event count
+        scored.sort(key=lambda s: (s[0], s[1]))
+        return scored[-1][2] if scored else None
+    if not scored:
+        return None
+    scored.sort(key=lambda s: s[1])
+    return scored[-1][2]
+
+
+_SUFFIX = None  # compiled lazily
+
+
+def _group_name(name: str) -> str:
+    """fusion.123 / dot_general.7 -> fusion / dot_general (repeated XLA
+    program instances roll up into one attribution row)."""
+    global _SUFFIX
+    if _SUFFIX is None:
+        import re
+
+        _SUFFIX = re.compile(r"\.\d+$")
+    return _SUFFIX.sub("", name)
+
+
+def summarize(plane, top: int) -> dict:
+    md = {m_id: m.name for m_id, m in plane.event_metadata.items()}
+    tot = defaultdict(int)
+    cnt = defaultdict(int)
+    span_lo, span_hi = None, 0
+    for line in plane.lines:
+        # offsets are line-relative: anchor on each line's timestamp
+        base_ps = int(line.timestamp_ns) * 1000
+        for ev in line.events:
+            name = _group_name(md.get(ev.metadata_id, f"id{ev.metadata_id}"))
+            tot[name] += ev.duration_ps
+            cnt[name] += 1
+            lo = base_ps + ev.offset_ps
+            hi = lo + ev.duration_ps
+            span_lo = lo if span_lo is None else min(span_lo, lo)
+            span_hi = max(span_hi, hi)
+    wall_ps = (span_hi - (span_lo or 0)) or 1
+    busy_ps = sum(tot.values()) or 1
+    rows = sorted(tot.items(), key=lambda kv: -kv[1])[:top]
+    return {
+        "plane": plane.name,
+        "wall_ms": round(wall_ps / 1e9, 3),
+        "busy_ms": round(busy_ps / 1e9, 3),
+        "ops": [
+            {
+                "name": name[:120],
+                "total_ms": round(ps / 1e9, 3),
+                "count": cnt[name],
+                "pct_of_busy": round(100.0 * ps / busy_ps, 1),
+            }
+            for name, ps in rows
+        ],
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("trace_dir")
+    ap.add_argument("--top", type=int, default=25)
+    ap.add_argument("--plane", default="auto", choices=["auto", "tpu", "cpu"])
+    args = ap.parse_args(argv)
+
+    paths = find_xplanes(args.trace_dir)
+    if not paths:
+        print(json.dumps({"ok": False,
+                          "error": f"no *.xplane.pb under {args.trace_dir}"}))
+        return 1
+    xs = load_xspace(paths[-1])  # newest capture
+    plane = pick_plane(xs, args.plane)
+    if plane is None:
+        what = (
+            f"no {args.plane} plane with events"
+            if args.plane != "auto" else "no events in any plane"
+        )
+        print(json.dumps({"ok": False, "error": what}))
+        return 1
+    out = summarize(plane, args.top)
+    out.update(ok=True, xplane=paths[-1])
+    for op in out["ops"]:
+        print(
+            f"{op['total_ms']:>10.3f} ms {op['pct_of_busy']:>5.1f}% "
+            f"x{op['count']:<6d} {op['name']}",
+            file=sys.stderr,
+        )
+    print(
+        f"plane {out['plane']}: wall {out['wall_ms']} ms, "
+        f"busy {out['busy_ms']} ms ({paths[-1]})",
+        file=sys.stderr,
+    )
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
