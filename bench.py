@@ -484,21 +484,44 @@ def run_worker_config(
         "--cache-dir", cache_dir, "--kernel-tile", str(kernel_tile),
     ]
     t0 = time.time()
+    def forward_stdout(out: str, drop_last: bool) -> None:
+        # the framework's loggers write to STDOUT (utils/logging.py),
+        # which this pipe captures — forward it (minus the final JSON
+        # line on success) to stderr so trainer log output
+        # (NTS_DEBUGINFO breakdowns, build lines, partial-progress
+        # before a hang) survives into the supervisor's step log
+        lines = out.splitlines()
+        passthrough = "\n".join(lines[:-1] if drop_last else lines).strip()
+        if passthrough:
+            print(passthrough[-8000:], file=sys.stderr, flush=True)
+
     try:
         r = subprocess.run(
             cmd, stdout=subprocess.PIPE, text=True, timeout=timeout_s
         )
-    except subprocess.TimeoutExpired:
-        return {"error": f"TIMEOUT after {timeout_s:.0f}s", "wall_s": time.time() - t0}
+    except subprocess.TimeoutExpired as e:
+        out = (e.stdout or "").strip() if isinstance(e.stdout, str) else ""
+        forward_stdout(out, drop_last=False)
+        return {
+            "error": f"TIMEOUT after {timeout_s:.0f}s",
+            "stdout_tail": out[-2000:],
+            "wall_s": time.time() - t0,
+        }
     out = (r.stdout or "").strip()
     if r.returncode != 0 or not out:
+        forward_stdout(out, drop_last=False)  # keep the traceback's tail
         return {
-            "error": f"worker rc={r.returncode}", "wall_s": time.time() - t0,
+            "error": f"worker rc={r.returncode}",
+            "stdout_tail": out[-2000:],
+            "wall_s": time.time() - t0,
         }
     try:
         info = json.loads(out.splitlines()[-1])
     except json.JSONDecodeError:
-        return {"error": "unparseable worker output", "wall_s": time.time() - t0}
+        forward_stdout(out, drop_last=False)
+        return {"error": "unparseable worker output",
+                "stdout_tail": out[-2000:], "wall_s": time.time() - t0}
+    forward_stdout(out, drop_last=True)
     info["wall_s"] = round(time.time() - t0, 1)
     return info
 
