@@ -759,6 +759,7 @@ def main(argv=None) -> int:
             "precision": precision,
             "order": order,
             "path": path,
+            "kernel_tile": args.kernel_tile,
             "chips": n_chips,
             "edges_per_sec_per_chip": round(edges_per_sec_per_chip, 0),
             "final_loss": rec.get("loss"),

@@ -4,7 +4,7 @@ VERDICT round-2 item 2: state the bandwidth bound for the full-scale ELL
 epoch, measure the gap, close or explain it. This tool owns the BOUND
 side: a per-path byte model of one training epoch (forward + backward +
 Adam) over the Reddit-scale workload, evaluated against the v5e's ~819
-GB/s HBM, and — when `docs/perf_runs/round3/*.json` holds measured epoch
+GB/s HBM, and — when `docs/perf_runs/round4/*.json` holds measured epoch
 times — the achieved fraction per measured config.
 
 Byte model (per layer application; b = itemsize of the compute dtype):
@@ -25,7 +25,7 @@ scatter model's middle terms and padding waste can exceed the slot
 inflation measured host-side. Usage:
 
     python -m neutronstarlite_tpu.tools.roofline [--scale 1.0]
-        [--runs-dir docs/perf_runs/round3] [--markdown]
+        [--runs-dir docs/perf_runs/round4] [--markdown]
 """
 
 from __future__ import annotations
@@ -40,15 +40,18 @@ LAYERS = (602, 128, 41)
 HBM_GBS = 819.0  # v5e
 MXU_TFLOPS_BF16 = 197.0  # v5e peak
 ELL_PAD = 1.33  # measured fwd slot inflation at full scale (PERF.md 3b)
-# Mosaic bsp kernel (the PALLAS:1 path): measured full-scale block counts
-# per direction (nts.bsp_ell build logs, docs/perf_runs/round3/). vt=1024
-# is OUT: 375.6k blocks -> the 1.5 MB packed key overflows the 1 MB SMEM
-# (aotwarm_rpathbspkerneltile1024.json) and slot waste hits 3.36x
-BSP_BLOCKS = {8192: 140896, 4096: 174445, 2048: 258212}
+# Mosaic bsp kernel (the PALLAS:1 path): measured full-scale EXECUTED
+# block counts per direction (build logs; round 4 — the SMEM ceiling is
+# gone via grid segmentation, and the model prices the padded grid the
+# kernel actually runs: vt=2048 segments into 2 balanced calls of
+# 143,360, docs/perf_runs/round4/r4warm_eager_bsp_2048_balanced.log)
+BSP_BLOCKS = {8192: 140896, 4096: 174445, 2048: 286720}
 BSP_R = 128  # rows per block (one-hot matmul height)
 
 
-def epoch_bytes(order: str, path: str, v: int, e: int, b: int = 2) -> float:
+def epoch_bytes(
+    order: str, path: str, v: int, e: int, b: int = 2, vt: int = 0
+) -> float:
     """Approximate HBM bytes of one epoch (fwd+bwd, all layers, + Adam)."""
     widths = list(LAYERS)
     total = 0.0
@@ -65,7 +68,7 @@ def epoch_bytes(order: str, path: str, v: int, e: int, b: int = 2) -> float:
             # reads are an order smaller. Convert the FLOP bound into
             # equivalent "bytes" at the HBM rate so one epoch model serves
             # (bound_s divides by HBM_GBS).
-            vt = 8192 if path == "bsp" else 4096
+            vt = vt or (8192 if path == "bsp" else 4096)
             blocks = BSP_BLOCKS.get(vt, BSP_BLOCKS[4096]) * (v / REDDIT_V)
             mxu_flops = 2.0 * blocks * BSP_R * vt * f_agg
             agg = 2 * mxu_flops / (MXU_TFLOPS_BF16 * 1e12) * (HBM_GBS * 1e9)
@@ -88,18 +91,25 @@ def epoch_bytes(order: str, path: str, v: int, e: int, b: int = 2) -> float:
     return total
 
 
-def bound_s(order: str, path: str, v: int, e: int) -> float:
-    return epoch_bytes(order, path, v, e) / (HBM_GBS * 1e9)
+def bound_s(order: str, path: str, v: int, e: int, vt: int = 0) -> float:
+    return epoch_bytes(order, path, v, e, vt=vt) / (HBM_GBS * 1e9)
 
 
 def collect_measured(runs_dir: str):
-    """(name, epoch_s, order, path) from the plan's salvaged step JSONs."""
+    """(name, epoch_s, order, path, vt) from the plan's salvaged step
+    JSONs. Files are parsed from their LAST JSON line (raw stdout dumps
+    carry log-line prefixes); records without a measured value — AOT
+    warm/capacity artifacts — are skipped."""
     out = []
     for p in sorted(glob.glob(os.path.join(runs_dir, "*.json"))):
         try:
             with open(p) as fh:
-                rec = json.load(fh)
-        except (OSError, json.JSONDecodeError):
+                lines = [
+                    ln for ln in fh.read().strip().splitlines()
+                    if ln.startswith("{")
+                ]
+            rec = json.loads(lines[-1]) if lines else {}
+        except (OSError, json.JSONDecodeError, IndexError):
             continue
         extra = rec.get("extra") or {}
         if rec.get("value") is None or extra.get("stale"):
@@ -108,6 +118,7 @@ def collect_measured(runs_dir: str):
             out.append((
                 os.path.basename(p)[:-5], float(rec["value"]),
                 extra["order"], extra["path"],
+                int(extra.get("kernel_tile") or 0),
             ))
     return out
 
@@ -120,7 +131,7 @@ def main(argv=None) -> int:
         default=os.path.join(
             os.path.dirname(os.path.dirname(os.path.dirname(
                 os.path.abspath(__file__)))),
-            "docs", "perf_runs", "round3",
+            "docs", "perf_runs", "round4",
         ),
     )
     ap.add_argument("--markdown", action="store_true")
@@ -131,19 +142,30 @@ def main(argv=None) -> int:
 
     rows = []
     for order in ("standard", "eager"):
-        for path in ("scatter", "ell", "pallas", "bsp"):
-            rows.append((order, path, bound_s(order, path, v, e)))
+        for path in ("scatter", "ell", "pallas"):
+            rows.append((order, path, 0, bound_s(order, path, v, e)))
+        # the bsp src-tile sweep (plan steps eager_bsp / bsp_vt_*): one
+        # bound row per measured block count so every leg has ITS bound
+        for vt in sorted(BSP_BLOCKS, reverse=True):
+            rows.append((order, "bsp", vt, bound_s(order, "bsp", v, e, vt=vt)))
 
     measured = collect_measured(args.runs_dir)
-    meas_by = {(o, p): (n, t) for n, t, o, p in measured}
+    meas_by = {}
+    for n, t, o, p, vt in measured:
+        # bsp legs are vt-keyed (bench's default src tile is 8192 when
+        # the record predates the kernel_tile extra); other paths ignore
+        # the knob for row matching
+        key_vt = (vt or 8192) if p == "bsp" else 0
+        meas_by[(o, p, key_vt)] = (n, t)
 
     if args.markdown:
         print(f"| order | path | HBM bound (s) | measured (s) | % of roofline |")
         print("|---|---|---|---|---|")
     else:
         print(f"roofline @ scale {args.scale:g} (V={v} E={e}, {HBM_GBS:.0f} GB/s)")
-    for order, path, t_bound in rows:
-        m = meas_by.get((order, path))
+    for order, path, vt, t_bound in rows:
+        m = meas_by.get((order, path, vt))
+        path = f"{path}@vt{vt}" if vt else path
         if args.markdown:
             if m:
                 print(f"| {order} | {path} | {t_bound:.3f} | {m[1]:.3f} "

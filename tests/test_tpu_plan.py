@@ -116,7 +116,15 @@ def test_roofline_model_sanity(capsys):
         )
     rf.main(["--markdown", "--runs-dir", "/nonexistent"])
     out = capsys.readouterr().out
-    assert out.count("| standard |") == 4 and out.count("| eager |") == 4
+    # 3 fixed paths + one bsp row per swept src tile (BSP_BLOCKS)
+    n_rows = 3 + len(rf.BSP_BLOCKS)
+    assert out.count("| standard |") == n_rows
+    assert out.count("| eager |") == n_rows
+    # the bsp cost model: smaller src tiles lower the bound (the W-build
+    # + one-hot dot both scale with vt faster than the block count grows)
+    bs = [rf.bound_s("eager", "bsp", 232965, 114615892, vt=vt)
+          for vt in sorted(rf.BSP_BLOCKS, reverse=True)]
+    assert bs == sorted(bs, reverse=True), bs
 
 
 def test_roofline_collect_measured(tmp_path):
@@ -135,7 +143,16 @@ def test_roofline_collect_measured(tmp_path):
         (tmp_path / f"{name}.json").write_text(json.dumps(rec))
     (tmp_path / "broken.json").write_text("{not json")
     got = rf.collect_measured(str(tmp_path))
-    assert got == [("a", 1.5, "eager", "ell")], got
+    assert got == [("a", 1.5, "eager", "ell", 0)], got
+    # raw stdout dumps with log prefixes parse from their last JSON line
+    (tmp_path / "warm.json").write_text(
+        "[INFO] build log line\n"
+        + json.dumps({"metric": "m", "value": 2.0,
+                      "extra": {"order": "eager", "path": "bsp",
+                                "kernel_tile": 2048}})
+    )
+    got = rf.collect_measured(str(tmp_path))
+    assert ("warm", 2.0, "eager", "bsp", 2048) in got
 
 
 def test_compiler_only_step_judged_by_compiler_probe(tmp_path, monkeypatch):
