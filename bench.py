@@ -653,12 +653,15 @@ def main(argv=None) -> int:
         # ops/pallas_kernels.py docstring); its one-hot-MXU cost model
         # bounds the epoch ~10-100x under the XLA gather path's observed
         # time. blocked/bsp (explicit-tile A/B) stay behind --sweep full.
-        # pallas FIRST: on a tight deadline the budget-exhaustion break
-        # must drop the already-known round-2 paths, never the expected
-        # winner the sweep exists to measure (scatter last: its full-scale
-        # number is the round-2 record)
-        paths = ("pallas", "ell", "scatter") if args.sweep == "auto" else (
-            "pallas", "ell", "scatter", "blocked", "bsp"
+        # ELL FIRST (round 4): the roofline crowns eager/ell the expected
+        # winner (0.007 s bound vs pallas-bsp's 0.315 s — the old
+        # pallas-first rule dated from the dead resident kernel's 0.021 s
+        # figure), its tables build in seconds, and its executable-cache
+        # entries are seeded — on a tight deadline the budget-exhaustion
+        # break must drop the slower paths, never the winner. scatter
+        # last: its full-scale number is the round-2 record.
+        paths = ("ell", "pallas", "scatter") if args.sweep == "auto" else (
+            "ell", "pallas", "scatter", "blocked", "bsp"
         )
         grid = [
             (o, p, pr)
