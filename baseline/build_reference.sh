@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# Build the reference (NeutronStarLite, /root/reference) CPU-only against the
+# np=1 MPI shim + numa shim in baseline/shim/, linking the libtorch that ships
+# inside the pip torch wheel. Bypasses the reference's CMake (its
+# find_package(MPI REQUIRED) is unsatisfiable here — see
+# docs/perf_runs/round4/reference_cmake_attempt.log) but compiles the same
+# three translation units its CMakeLists names (toolkits/main.cpp,
+# core/GraphSegment.cpp, comm/network.cpp) with its release flags.
+# The reference tree is never written to.
+set -euo pipefail
+
+REF=/root/reference
+HERE="$(cd "$(dirname "$0")" && pwd)"
+OUT="$HERE/build"
+mkdir -p "$OUT"
+
+TORCH_DIR="$(python -c 'import torch, os; print(os.path.dirname(torch.__file__))')"
+TORCH_INC="$TORCH_DIR/include"
+TORCH_LIB="$TORCH_DIR/lib"
+
+# -std=c++17: reference asks for c++14 but torch 2.13 headers require >=17.
+# -w matches the reference's add_definitions(-w).
+FLAGS=(-O3 -std=c++17 -g -fopenmp -march=native -w
+  -D_GLIBCXX_USE_CXX11_ABI=1)
+
+INC=(-I"$HERE/shim"
+  -I"$REF" -I"$REF/core" -I"$REF/comm" -I"$REF/dep/gemini"
+  -I"$TORCH_INC" -I"$TORCH_INC/torch/csrc/api/include")
+
+# main.cpp is compiled through the inplace-compat wrapper (torch 1.9 -> 2.13
+# autograd strictness; see shim/main_inplace_compat.cpp).
+g++ "${FLAGS[@]}" "${INC[@]}" \
+  "$HERE/shim/main_inplace_compat.cpp" \
+  "$REF/core/GraphSegment.cpp" "$REF/comm/network.cpp" \
+  "$HERE/shim/mpi_shim.cpp" \
+  -L"$TORCH_LIB" -Wl,-rpath,"$TORCH_LIB" \
+  -ltorch -ltorch_cpu -lc10 -lpthread \
+  -o "$OUT/nts"
+
+echo "built: $OUT/nts"

@@ -1,0 +1,58 @@
+"""Generate featuretable text files for the reference baseline runs.
+
+The reference's featuretables come from DGL downloads
+(/root/reference/data/generate_nts_dataset.py:29-60) which this rig cannot
+fetch. Both sides therefore train on the SAME deterministic random features:
+this script writes, in the reference's text format (``id f1 .. fD`` per line,
+core/ntsDataloador.hpp:120-128), exactly the arrays our framework's
+``GNNDatum.read_feature_label_mask`` fallback generates
+(``default_rng(seed).standard_normal((V, D)) * 0.1``), so a reference run is
+an independent oracle for the framework's accuracy band, and both frameworks
+time an identical workload.
+
+Outputs (under baseline/data/):
+  cora64.featuretable   2708 x 64   (seed 0)  — oracle cross-validation dims
+  cora.featuretable     2708 x 1433 (seed 0)  — the as-shipped gcn_cora.cfg dims
+  citeseer.featuretable 3327 x 3703 — from data/citeseer/citeseer.featuretable.npy
+  pubmed.featuretable   19717 x 500 — from data/pubmed/pubmed.featuretable.npy
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+OUT = os.path.join(HERE, "data")
+
+
+def write_table(path: str, feat: np.ndarray) -> None:
+    v, d = feat.shape
+    with open(path, "w") as f:
+        for i in range(v):
+            f.write(str(i))
+            row = feat[i]
+            # %.9g: full float32 round-trip precision, so the reference parses
+            # back bit-identical values to the framework's in-memory arrays
+            f.write(" " + " ".join("%.9g" % x for x in row) + "\n")
+    print("wrote %s (%d x %d)" % (path, v, d))
+
+
+def main() -> None:
+    os.makedirs(OUT, exist_ok=True)
+
+    for name, v, d in (("cora64", 2708, 64), ("cora", 2708, 1433)):
+        feat = (
+            np.random.default_rng(0).standard_normal((v, d), dtype=np.float32) * 0.1
+        )
+        write_table(os.path.join(OUT, name + ".featuretable"), feat)
+
+    for ds in ("citeseer", "pubmed"):
+        npy = os.path.join(REPO, "data", ds, ds + ".featuretable.npy")
+        if os.path.exists(npy):
+            write_table(os.path.join(OUT, ds + ".featuretable"), np.load(npy))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,19 @@
+/* Compatibility wrapper around the reference's toolkits/main.cpp.
+ *
+ * The reference targets libtorch 1.9, which tolerated
+ * Dropout(...inplace(true)) applied to a saved ReLU output; torch 2.13's
+ * autograd rejects the in-place mutation ("modified by an inplace
+ * operation", saved_variable.cpp) on the first backward. Every `inplace`
+ * token in the reference is exactly the `DropoutOptions().p(..).inplace(b)`
+ * call shape (grep over toolkits/core/comm), so a function-like macro can
+ * rewrite them all to inplace(false) — numerically identical, one extra
+ * activation-sized buffer. Torch's own headers (which declare methods named
+ * `inplace`) are pre-included before the macro exists, and their include
+ * guards keep the reference's own torch includes from re-expanding under it.
+ * The reference tree itself is never modified.
+ */
+#include <torch/torch.h>
+
+#define inplace(x) inplace(false)
+
+#include "toolkits/main.cpp"

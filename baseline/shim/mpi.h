@@ -1,0 +1,86 @@
+/* Single-rank MPI shim for building the reference (NeutronStarLite) CPU-only
+ * on a box with no MPI installation.
+ *
+ * Scope: exactly the symbols the reference links (enumerated by grepping
+ * /root/reference/{core,comm,dep,toolkits,test} for MPI_*):
+ *   MPI_Init_thread / MPI_Finalize / MPI_Comm_rank / MPI_Comm_size
+ *   MPI_Barrier / MPI_Allreduce / MPI_Bcast / MPI_Wtime
+ *   MPI_Send / MPI_Recv / MPI_Probe / MPI_Get_count
+ * with np=1 semantics. Self-sends are real in the reference even at one
+ * rank (comm/network.cpp:589-617 posts to partition_id and the recv thread
+ * probes it back), so Send/Recv/Probe are backed by an in-process buffered
+ * queue with MPI (source, tag) matching — not no-ops. Collectives at np=1
+ * reduce to memcpy (or nothing for MPI_IN_PLACE).
+ *
+ * This is original shim code, not a copy of any MPI implementation.
+ */
+#ifndef NTS_BASELINE_MPI_SHIM_H
+#define NTS_BASELINE_MPI_SHIM_H
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef int MPI_Comm;
+typedef int MPI_Datatype;
+typedef int MPI_Op;
+
+#define MPI_COMM_WORLD ((MPI_Comm)0)
+
+/* Datatype tags; sizes resolved in mpi_shim.cpp. */
+#define MPI_CHAR ((MPI_Datatype)1)
+#define MPI_UNSIGNED_CHAR ((MPI_Datatype)2)
+#define MPI_INT ((MPI_Datatype)3)
+#define MPI_UNSIGNED ((MPI_Datatype)4)
+#define MPI_LONG ((MPI_Datatype)5)
+#define MPI_UNSIGNED_LONG ((MPI_Datatype)6)
+#define MPI_FLOAT ((MPI_Datatype)7)
+#define MPI_DOUBLE ((MPI_Datatype)8)
+
+#define MPI_SUM ((MPI_Op)1)
+#define MPI_MIN ((MPI_Op)2)
+#define MPI_MAX ((MPI_Op)3)
+
+#define MPI_THREAD_SINGLE 0
+#define MPI_THREAD_FUNNELED 1
+#define MPI_THREAD_SERIALIZED 2
+#define MPI_THREAD_MULTIPLE 3
+
+#define MPI_ANY_SOURCE (-1)
+#define MPI_ANY_TAG (-1)
+#define MPI_SUCCESS 0
+
+#define MPI_IN_PLACE ((void *)(-1))
+
+typedef struct MPI_Status {
+  int MPI_SOURCE;
+  int MPI_TAG;
+  int MPI_ERROR;
+  /* internal: matched message size in bytes */
+  int _nts_count_bytes;
+} MPI_Status;
+
+#define MPI_STATUS_IGNORE ((MPI_Status *)0)
+
+int MPI_Init_thread(int *argc, char ***argv, int required, int *provided);
+int MPI_Finalize(void);
+int MPI_Comm_rank(MPI_Comm comm, int *rank);
+int MPI_Comm_size(MPI_Comm comm, int *size);
+int MPI_Barrier(MPI_Comm comm);
+double MPI_Wtime(void);
+int MPI_Allreduce(const void *sendbuf, void *recvbuf, int count,
+                  MPI_Datatype datatype, MPI_Op op, MPI_Comm comm);
+int MPI_Bcast(void *buffer, int count, MPI_Datatype datatype, int root,
+              MPI_Comm comm);
+int MPI_Send(const void *buf, int count, MPI_Datatype datatype, int dest,
+             int tag, MPI_Comm comm);
+int MPI_Recv(void *buf, int count, MPI_Datatype datatype, int source, int tag,
+             MPI_Comm comm, MPI_Status *status);
+int MPI_Probe(int source, int tag, MPI_Comm comm, MPI_Status *status);
+int MPI_Get_count(const MPI_Status *status, MPI_Datatype datatype, int *count);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* NTS_BASELINE_MPI_SHIM_H */

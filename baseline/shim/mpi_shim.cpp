@@ -1,0 +1,177 @@
+/* np=1 MPI shim implementation — see mpi.h for scope and rationale.
+ *
+ * The message queue implements MPI point-to-point matching for the one
+ * (0 -> 0) channel that exists at a single rank: Send buffers a copy and
+ * returns (eager semantics — strictly more permissive than rendezvous, so
+ * anything that runs under a real MPI at np=1 runs here); Probe blocks until
+ * a message matching (source, tag) is queued and reports its byte count
+ * without consuming it; Recv consumes the first match. Matching scans the
+ * queue in arrival order per MPI non-overtaking rules for a same-(src,tag)
+ * pair; different tags may be matched out of order, as MPI allows.
+ */
+#include "mpi.h"
+
+#include <condition_variable>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <vector>
+
+#include <sys/time.h>
+
+namespace {
+
+size_t dtype_size(MPI_Datatype d) {
+  switch (d) {
+  case MPI_CHAR:
+  case MPI_UNSIGNED_CHAR:
+    return 1;
+  case MPI_INT:
+  case MPI_UNSIGNED:
+    return 4;
+  case MPI_LONG:
+  case MPI_UNSIGNED_LONG:
+    return 8;
+  case MPI_FLOAT:
+    return 4;
+  case MPI_DOUBLE:
+    return 8;
+  default:
+    std::fprintf(stderr, "mpi_shim: unknown datatype %d\n", d);
+    std::abort();
+  }
+}
+
+struct Message {
+  std::vector<char> data;
+  int tag;
+};
+
+std::mutex g_mu;
+std::condition_variable g_cv;
+std::deque<Message> g_queue; /* the single 0->0 channel */
+
+bool match(const Message &m, int source, int tag) {
+  (void)source; /* only rank 0 exists; MPI_ANY_SOURCE == 0 here */
+  return tag == MPI_ANY_TAG || m.tag == tag;
+}
+
+} // namespace
+
+extern "C" {
+
+int MPI_Init_thread(int *argc, char ***argv, int required, int *provided) {
+  (void)argc;
+  (void)argv;
+  (void)required;
+  if (provided)
+    *provided = MPI_THREAD_MULTIPLE;
+  return MPI_SUCCESS;
+}
+
+int MPI_Finalize(void) { return MPI_SUCCESS; }
+
+int MPI_Comm_rank(MPI_Comm, int *rank) {
+  if (rank)
+    *rank = 0;
+  return MPI_SUCCESS;
+}
+
+int MPI_Comm_size(MPI_Comm, int *size) {
+  if (size)
+    *size = 1;
+  return MPI_SUCCESS;
+}
+
+int MPI_Barrier(MPI_Comm) { return MPI_SUCCESS; }
+
+double MPI_Wtime(void) {
+  struct timeval tv;
+  gettimeofday(&tv, nullptr);
+  return (double)tv.tv_sec + (double)tv.tv_usec * 1e-6;
+}
+
+int MPI_Allreduce(const void *sendbuf, void *recvbuf, int count,
+                  MPI_Datatype datatype, MPI_Op op, MPI_Comm) {
+  (void)op; /* np=1: every reduction is the identity */
+  if (sendbuf != MPI_IN_PLACE && sendbuf != recvbuf)
+    std::memcpy(recvbuf, sendbuf, (size_t)count * dtype_size(datatype));
+  return MPI_SUCCESS;
+}
+
+int MPI_Bcast(void *, int, MPI_Datatype, int, MPI_Comm) { return MPI_SUCCESS; }
+
+int MPI_Send(const void *buf, int count, MPI_Datatype datatype, int dest,
+             int tag, MPI_Comm) {
+  if (dest != 0) {
+    std::fprintf(stderr, "mpi_shim: send to rank %d at np=1\n", dest);
+    std::abort();
+  }
+  Message m;
+  m.tag = tag;
+  m.data.resize((size_t)count * dtype_size(datatype));
+  std::memcpy(m.data.data(), buf, m.data.size());
+  {
+    std::lock_guard<std::mutex> lk(g_mu);
+    g_queue.push_back(std::move(m));
+  }
+  g_cv.notify_all();
+  return MPI_SUCCESS;
+}
+
+int MPI_Probe(int source, int tag, MPI_Comm, MPI_Status *status) {
+  std::unique_lock<std::mutex> lk(g_mu);
+  for (;;) {
+    for (const Message &m : g_queue) {
+      if (match(m, source, tag)) {
+        if (status) {
+          status->MPI_SOURCE = 0;
+          status->MPI_TAG = m.tag;
+          status->MPI_ERROR = MPI_SUCCESS;
+          status->_nts_count_bytes = (int)m.data.size();
+        }
+        return MPI_SUCCESS;
+      }
+    }
+    g_cv.wait(lk);
+  }
+}
+
+int MPI_Recv(void *buf, int count, MPI_Datatype datatype, int source, int tag,
+             MPI_Comm, MPI_Status *status) {
+  const size_t cap = (size_t)count * dtype_size(datatype);
+  std::unique_lock<std::mutex> lk(g_mu);
+  for (;;) {
+    for (auto it = g_queue.begin(); it != g_queue.end(); ++it) {
+      if (match(*it, source, tag)) {
+        if (it->data.size() > cap) {
+          /* real MPI raises MPI_ERR_TRUNCATE; silent truncation would turn a
+           * buffer-sizing bug into quietly corrupt baseline numbers */
+          std::fprintf(stderr, "mpi_shim: TRUNCATE recv cap=%zu msg=%zu tag=%d\n",
+                       cap, it->data.size(), it->tag);
+          std::abort();
+        }
+        std::memcpy(buf, it->data.data(), it->data.size());
+        if (status) {
+          status->MPI_SOURCE = 0;
+          status->MPI_TAG = it->tag;
+          status->MPI_ERROR = MPI_SUCCESS;
+          status->_nts_count_bytes = (int)it->data.size();
+        }
+        g_queue.erase(it);
+        return MPI_SUCCESS;
+      }
+    }
+    g_cv.wait(lk);
+  }
+}
+
+int MPI_Get_count(const MPI_Status *status, MPI_Datatype datatype, int *count) {
+  if (count)
+    *count = (int)((size_t)status->_nts_count_bytes / dtype_size(datatype));
+  return MPI_SUCCESS;
+}
+
+} // extern "C"

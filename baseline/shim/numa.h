@@ -1,0 +1,76 @@
+/* Single-node libnuma shim: the rig has libnuma.so.1 but no headers/dev
+ * symlink, and the build needs none of NUMA's actual placement behavior to
+ * produce a valid single-machine baseline. Every allocator maps to malloc
+ * (numa_free/realloc pair with it), topology queries report one node, and
+ * placement hints are accepted and ignored. Covers exactly the numa_*
+ * symbols the reference uses (grep over /root/reference).
+ */
+#ifndef NTS_BASELINE_NUMA_SHIM_H
+#define NTS_BASELINE_NUMA_SHIM_H
+
+#include <stdlib.h>
+#include <string.h>
+#include <unistd.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+struct bitmask {
+  unsigned long size;
+  unsigned long *maskp;
+};
+
+static inline int numa_available(void) { return 0; }
+static inline int numa_num_configured_nodes(void) { return 1; }
+static inline int numa_num_configured_cpus(void) {
+  long n = sysconf(_SC_NPROCESSORS_ONLN);
+  return n > 0 ? (int)n : 1;
+}
+static inline void *numa_alloc_onnode(size_t size, int node) {
+  (void)node;
+  void *p = malloc(size);
+  if (p)
+    memset(p, 0, size);
+  return p;
+}
+static inline void *numa_alloc_interleaved(size_t size) {
+  void *p = malloc(size);
+  if (p)
+    memset(p, 0, size);
+  return p;
+}
+static inline void *numa_realloc(void *old_addr, size_t old_size,
+                                 size_t new_size) {
+  (void)old_size;
+  return realloc(old_addr, new_size);
+}
+static inline void numa_free(void *mem, size_t size) {
+  (void)size;
+  free(mem);
+}
+static inline int numa_tonode_memory(void *start, size_t size, int node) {
+  (void)start;
+  (void)size;
+  (void)node;
+  return 0;
+}
+static inline int numa_run_on_node(int node) {
+  (void)node;
+  return 0;
+}
+static inline struct bitmask *numa_parse_nodestring(const char *string) {
+  (void)string;
+  static unsigned long one = 1UL;
+  static struct bitmask bm = {1, &one};
+  return &bm;
+}
+static inline void numa_set_interleave_mask(struct bitmask *nodemask) {
+  (void)nodemask;
+}
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* NTS_BASELINE_NUMA_SHIM_H */
