@@ -297,19 +297,19 @@ class ToolkitBase:
         if jax.process_count() <= 1:
             return self.restore(self.cfg.checkpoint_dir)
         if backend == "orbax":
-            from neutronstarlite_tpu.utils.checkpoint import ORBAX_SUBDIR
+            from neutronstarlite_tpu.utils.checkpoint import orbax_latest_step
 
-            if os.path.isdir(
-                os.path.join(self.cfg.checkpoint_dir, ORBAX_SUBDIR)
-            ):
+            if orbax_latest_step(self.cfg.checkpoint_dir) is not None:
                 # orbax multi-host: the restore itself is symmetric —
                 # every process calls it and arrays land on their
                 # shardings from shared storage; no broadcast staging
                 return self.restore(self.cfg.checkpoint_dir)
-            # orbax requested but only npz files exist (backend switched
-            # mid-run): npz dirs may be process-0-local, so the restore
-            # MUST go through the broadcast path below — a symmetric
-            # per-rank npz read would desynchronize resume epochs
+            # orbax requested but no COMPLETED orbax step exists (backend
+            # switched mid-run, or a first async save was interrupted —
+            # the subdir may exist yet be empty, ADVICE r4): npz dirs may
+            # be process-0-local, so the restore MUST go through the
+            # broadcast path below — a symmetric per-rank npz read would
+            # desynchronize resume epochs
 
         # Multi-process: keep every step SYMMETRIC across ranks. A naive
         # per-rank restore deadlocks — device_put onto a multi-process

@@ -105,6 +105,24 @@ def bsp_bseg_menu(cap_eff: int) -> "list[int]":
     return menu + [cap_eff]
 
 
+def bsp_tseg_menu(t_dst: int) -> "list[int]":
+    """The EXACT t_seg menu a segmented build can emit for this t_dst:
+    at most 16 quantum steps (quantum = ceil(hi/16) rounded up to a
+    128-multiple) capped by hi = roundup128(t_dst + 1), the band bound
+    (t_seg_cap <= t_dst always). Shared with tools/aot_bsp_scale so the
+    AOT proof compiles precisely the (b_seg menu) x (this menu) lattice
+    — an arbitrary roundup128(tiles) could land on any of ~t_dst/128
+    values the tool never pre-lowered (ADVICE r4), re-exposing the
+    full-scale Mosaic-compile hang the proof exists to retire. Snapping
+    up wastes only per-call output-buffer rows (trailing tiles are
+    never written or read): at most one quantum ~= 6% of the full
+    output, and none of the compute grid, which is sized by b_seg."""
+    hi = -(-(t_dst + 1) // 128) * 128
+    quantum = max(128, -(-(hi // 16) // 128) * 128)
+    menu = [k * quantum for k in range(1, 16) if k * quantum < hi]
+    return menu + [hi]
+
+
 def resolve_bsp_knobs(dt: int = 0, k_slots: int = 0) -> "tuple[int, int]":
     """Resolve the NTS_BSP_DT / NTS_BSP_K env tunables (0 = use env or
     default). Shared by the single-chip (BspEllPair.from_host) and dist
@@ -367,15 +385,15 @@ class BspEll:
             b_seg = int(used.max()) if t_dst else 0
             b_seg += (-b_seg) % 8
         else:  # quantized: a small provable program menu (see above).
-            # t_seg is a PURE 128-multiple (may exceed t_dst: trailing
-            # output tiles are never written or read), so every
-            # segmented program's t_seg is 128*k with k <= ceil((t_dst
-            # + 1) / 128) — the exact band tools/aot_bsp_scale compiles.
-            # b_seg snaps up to the 8-value menu bsp_bseg_menu(cap)
-            # shares with tools/aot_bsp_scale — the AOT proof compiles
-            # the exact (b_seg menu) x (t_seg band) lattice, so every
-            # program a segmented build can emit is pre-lowered.
-            t_seg = -(-int(tiles_in_seg.max()) // 128) * 128
+            # BOTH grid dims snap up to shared menus — b_seg to the
+            # 8-value bsp_bseg_menu(cap), t_seg to the <=16-value
+            # bsp_tseg_menu(t_dst) (trailing output tiles are never
+            # written or read, so the snap costs only padded output
+            # rows). tools/aot_bsp_scale compiles the exact
+            # (b_seg menu) x (t_seg menu) lattice, so every program a
+            # segmented build can emit is pre-lowered.
+            tiles_max = int(tiles_in_seg.max())
+            t_seg = next(v for v in bsp_tseg_menu(t_dst) if v >= tiles_max)
             u_max = int(used.max())
             b_seg = next(v for v in bsp_bseg_menu(cap_eff) if v >= u_max)
         assert b_seg <= max_blocks  # the construction's SMEM invariant

@@ -97,6 +97,21 @@ def save_checkpoint(
         json.dump(manifest, fh, indent=1)
 
 
+def orbax_latest_step(path: str) -> Optional[int]:
+    """Latest COMPLETED orbax step under ``path``, or None when the orbax
+    subdir is absent or holds no finished save (e.g. an interrupted first
+    async save). Callers choosing between the symmetric orbax restore and
+    the broadcast npz path must branch on this, not on the subdir's
+    existence — an empty orbax dir would otherwise fall through to a
+    per-rank npz read and desynchronize resume epochs (ADVICE r4)."""
+    if not os.path.isdir(os.path.join(path, ORBAX_SUBDIR)):
+        return None
+    mgr = _orbax_manager(path)
+    mgr.wait_until_finished()
+    step = mgr.latest_step()
+    return None if step is None else int(step)
+
+
 def restore_checkpoint(
     path: str, like: Dict[str, Any], backend: str = ""
 ) -> Optional[Tuple[Dict[str, Any], int]]:
@@ -106,15 +121,12 @@ def restore_checkpoint(
     orbax: arrays land directly on ``like``'s shardings (sharded restore;
     every process must call). Falls through to the npz files when the
     orbax directory has no steps — a rig can switch backends mid-run."""
-    if (backend or default_backend()) == "orbax" and os.path.isdir(
-        os.path.join(path, ORBAX_SUBDIR)
-    ):
+    if (backend or default_backend()) == "orbax":
         import orbax.checkpoint as ocp
 
-        mgr = _orbax_manager(path)
-        mgr.wait_until_finished()
-        step = mgr.latest_step()
+        step = orbax_latest_step(path)
         if step is not None:
+            mgr = _orbax_manager(path)
             abstract = jax.tree.map(
                 lambda a: jax.ShapeDtypeStruct(
                     np.shape(a),

@@ -214,16 +214,21 @@ def test_bsp_segmented_matches_unsegmented(rng):
 
 
 def test_bsp_bseg_snaps_to_menu(rng):
-    """Segmented builds must emit b_seg values ONLY from the shared
-    bsp_bseg_menu lattice — the finite program set the AOT proof tool
-    compiles (a b_seg off the menu would be an un-pre-lowered program
-    triggering a full-scale Mosaic compile on chip)."""
-    from neutronstarlite_tpu.ops.bsp_ell import bsp_bseg_menu
+    """Segmented builds must emit (b_seg, t_seg) pairs ONLY from the
+    shared bsp_bseg_menu x bsp_tseg_menu lattice — the finite program
+    set the AOT proof tool compiles (a value off either menu would be
+    an un-pre-lowered program triggering a full-scale Mosaic compile
+    on chip; ADVICE r4 caught exactly that for t_seg)."""
+    from neutronstarlite_tpu.ops.bsp_ell import bsp_bseg_menu, bsp_tseg_menu
 
     menu = bsp_bseg_menu((100 // 8) * 8)
     assert menu[-1] == 96 and all(v % 8 == 0 for v in menu)
     assert menu == sorted(set(menu))
     g, _ = tiny_graph(rng, v_num=67, e_num=520)
+    t_dst = -(-g.v_num // 8)
+    tmenu = bsp_tseg_menu(t_dst)
+    assert tmenu[-1] >= t_dst and all(v % 128 == 0 for v in tmenu)
+    assert tmenu == sorted(set(tmenu)) and len(tmenu) <= 16
     for budget in (24, 40, 100):
         seg = BspEll.build(
             g.v_num, g.column_offset, g.row_indices, g.edge_weight_forward,
@@ -233,6 +238,24 @@ def test_bsp_bseg_snaps_to_menu(rng):
             assert seg.b_seg in bsp_bseg_menu((budget // 8) * 8), (
                 budget, seg.b_seg
             )
+            assert seg.t_seg in tmenu, (budget, seg.t_seg, tmenu)
+
+
+def test_bsp_tseg_menu_covers_large_scale():
+    """At 10x-Reddit geometry (t_dst=4551 for dt=512) the menu must
+    contain a value >= every emittable roundup — the advisor's case:
+    real segmented t_seg ~640-768 fell outside the old 3-candidate
+    proof band. Menu coverage: for any tiles_max <= t_dst the snap
+    target exists and wastes at most one quantum."""
+    from neutronstarlite_tpu.ops.bsp_ell import bsp_tseg_menu
+
+    t_dst = -(-2329650 // 512)
+    menu = bsp_tseg_menu(t_dst)
+    assert menu[-1] >= t_dst + 1 and len(menu) <= 16
+    quantum = menu[0]
+    for tiles_max in (1, 127, 128, 640, 768, 2304, t_dst):
+        snap = next(v for v in menu if v >= tiles_max)
+        assert snap - tiles_max < quantum + 128
 
 
 def test_bsp_segmented_boundary_and_overflow(rng):

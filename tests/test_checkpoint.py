@@ -126,6 +126,31 @@ def test_orbax_roundtrip_and_trainer_resume(tmp_path):
     assert result["acc"]["train"] > 0.85
 
 
+def test_orbax_latest_step_empty_dir_is_none(tmp_path):
+    """ADVICE r4: an orbax subdir that exists but holds no COMPLETED save
+    (interrupted first async save) must read as "no orbax checkpoint" —
+    orbax_latest_step None — so the multi-process resume branch routes
+    through the broadcast npz path instead of a per-rank npz read that
+    can desynchronize resume epochs. With a completed save it reports
+    that step."""
+    import os
+
+    from neutronstarlite_tpu.utils.checkpoint import (
+        ORBAX_SUBDIR,
+        finalize_checkpoints,
+        orbax_latest_step,
+    )
+
+    assert orbax_latest_step(str(tmp_path / "a")) is None  # no dir at all
+    os.makedirs(tmp_path / "a" / ORBAX_SUBDIR)
+    assert orbax_latest_step(str(tmp_path / "a")) is None  # empty subdir
+
+    state = {"params": [{"W": jnp.arange(4.0)}]}
+    save_checkpoint(str(tmp_path / "a"), state, step=7, backend="orbax")
+    finalize_checkpoints()
+    assert orbax_latest_step(str(tmp_path / "a")) == 7
+
+
 def test_orbax_sharded_restore_preserves_shardings(tmp_path):
     """The scale-out property the npz path lacks: arrays saved from a
     NamedSharding land back ON that sharding at restore (no host-side
