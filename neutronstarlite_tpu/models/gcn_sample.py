@@ -168,7 +168,21 @@ class GCNSampleTrainer(ToolkitBase):
             return batch_forward(params, feature, nodes, hops, key, False)
 
         self._train_batch = train_batch
+        self._train_step = train_batch  # uniform tools/aot_check hook name
         self._eval_batch = eval_batch
+
+    def aot_args(self):
+        """The exact argument tuple run() passes to the jitted per-batch
+        train step (tools/aot_check lowers it for a topology without
+        executing). One host-side sample supplies the padded batch arrays —
+        their shapes are static (node_caps from FANOUT x BATCH_SIZE), so any
+        batch is shape-representative."""
+        b = next(self.samplers[0].sample_epoch(shuffle=False))
+        nodes, hops, seed_mask, seeds = _batch_arrays(b)
+        return (
+            self.params, self.opt_state, self.feature, self.label,
+            nodes, hops, seed_mask, seeds, jax.random.PRNGKey(self.seed + 1),
+        )
 
     def _evaluate(self, which: int, key) -> float:
         correct = total = 0

@@ -187,6 +187,9 @@ class BspEll:
         r_rows: int = DEFAULT_R,
         src_num: int = 0,  # 0 = square; else rectangular (adj < src_num)
         max_blocks: int = 0,  # 0 -> NTS_BSP_MAX_BLOCKS / DEFAULT_MAX_BLOCKS
+        keep_host: bool = False,  # True: leave tables as numpy (a caller
+        # that re-lays them — DistBsp's segmented stack — avoids a
+        # device round-trip at exactly the scale that segments)
     ) -> "BspEll":
         K, R = int(k_slots), int(r_rows)
         max_blocks = int(max_blocks) or int(
@@ -438,11 +441,12 @@ class BspEll:
                 "%.2fx",
                 B_total, K, R, S, b_seg, t_dst, t_src, n_rows, waste,
             )
+        conv = (lambda a: a) if keep_host else jnp.asarray
         return BspEll(
-            nbr=jnp.asarray(nbr),
-            wgt=jnp.asarray(wgt),
-            ldst=jnp.asarray(ldst),
-            blk_key=jnp.asarray(key),
+            nbr=conv(nbr),
+            wgt=conv(wgt),
+            ldst=conv(ldst),
+            blk_key=conv(key),
             v_num=int(v_num),
             dt=int(dt),
             vt=int(vt),

@@ -62,16 +62,34 @@ _DIST_OUT_BUDGET_BYTES = 36 << 20
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class DistBsp:
-    """One direction's stacked per-device rectangular bsp tables."""
+    """One direction's stacked per-device rectangular bsp tables.
 
-    nbr: jax.Array  # [P, B, K, R] int32 tile-local src ids
-    wgt: jax.Array  # [P, B, K, R] f32 (0 on padding)
-    ldst: jax.Array  # [P, B, R] int32 tile-local dst row
-    blk_key: jax.Array  # [P, B] int32 packed (dst_tile, src_tile)
+    Segmented form (round 5, VERDICT r4 item 6): when any shard's block
+    count exceeds the SMEM key budget, EVERY shard is re-laid to a uniform
+    (n_seg, b_seg, t_seg) geometry — b_seg/t_seg snapped to the shared AOT
+    menus (ops/bsp_ell.bsp_bseg_menu / bsp_tseg_menu) — because shard_map
+    traces ONE program for all shards. ``first_tile[p, s]`` carries each
+    shard's per-segment output placement as DATA (a traced int array, the
+    only per-shard-varying piece): segment outputs are placed with ordered
+    dynamic_update_slice, and a later segment's slice exactly overwrites
+    the quantized tail rows (t_seg snap) of the previous one, so no
+    masking is needed; the final segment's tail lands in a scratch margin.
+    Dummy segments (shards with fewer real segments) place at t_dst — the
+    scratch start — and cover zero real tiles."""
+
+    nbr: jax.Array  # [P, S*b_seg, K, R] int32 tile-local src ids
+    wgt: jax.Array  # [P, S*b_seg, K, R] f32 (0 on padding)
+    ldst: jax.Array  # [P, S*b_seg, R] int32 tile-local dst row
+    blk_key: jax.Array  # [P, S*b_seg] int32 packed segment-LOCAL (dst,src)
+    first_tile: jax.Array  # [P, S] int32 segment -> first dst tile (t_dst
+    #                         = scratch placement for dummy segments)
     partitions: int = dataclasses.field(metadata=dict(static=True))
     vp: int = dataclasses.field(metadata=dict(static=True))
     dt: int = dataclasses.field(metadata=dict(static=True))
     vt: int = dataclasses.field(metadata=dict(static=True))
+    n_seg: int = dataclasses.field(default=1, metadata=dict(static=True))
+    b_seg: int = dataclasses.field(default=0, metadata=dict(static=True))
+    t_seg: int = dataclasses.field(default=0, metadata=dict(static=True))
 
     @staticmethod
     def build(
@@ -82,63 +100,145 @@ class DistBsp:
         k_slots: int = 0,
         r_rows: int = DEFAULT_R,
     ) -> "DistBsp":
+        from neutronstarlite_tpu.ops.bsp_ell import (
+            bsp_bseg_menu,
+            bsp_tseg_menu,
+        )
+
         dt, k_slots = resolve_bsp_knobs(dt, k_slots)
         P, vp = dist.partitions, dist.vp
+        t_dst = -(-vp // dt)
         per_dev, _ = per_device_adjacency(dist, transpose)
         tables: List[BspEll] = [
             BspEll.build(
                 vp, offs, nbr_g, w, dt=dt, vt=vt, k_slots=k_slots,
                 r_rows=r_rows, src_num=P * vp,
+                # tables stay numpy: both stacked layouts below re-lay or
+                # pad them host-side, then upload ONCE via jnp.stack —
+                # jnp tables here would device-round-trip gigabytes at
+                # exactly the scale that segments (r5 review finding)
+                keep_host=True,
             )
             for offs, nbr_g, w, _deg in per_dev
         ]
-        for t in tables:
-            # per-shard tables are ~20-30k blocks at full Reddit P=8; the
-            # stacked layout assumes the single-segment (global-key) form.
-            # A shard big enough to segment should raise P, not stack.
-            if t.n_seg != 1:
-                raise ValueError(
-                    f"dist-bsp: a shard's table segmented ({t.n_seg} segs of "
-                    f"{t.b_seg} blocks) — per-shard block count exceeds the "
-                    "SMEM key budget; raise PARTITIONS or dt/K"
-                )
-        b_max = max(t.nbr.shape[0] for t in tables)
-        # pad to a multiple of 8 ACROSS devices too (the kernel's 8-row
-        # ldst blocks index by global block id)
-        b_max += (-b_max) % 8
+        S_max = max(t.n_seg for t in tables)
+        if S_max == 1:
+            # fast path: the pre-round-5 stacked single-segment layout
+            # (global keys, one call per shard, no placement arithmetic)
+            b_max = max(t.nbr.shape[0] for t in tables)
+            # pad to a multiple of 8 ACROSS devices too (the kernel's
+            # 8-row ldst blocks index by global block id)
+            b_max += (-b_max) % 8
 
-        def pad(t: BspEll):
-            pad_b = b_max - t.nbr.shape[0]
-            if pad_b == 0:
-                return t.nbr, t.wgt, t.ldst, t.blk_key
-            k, r = t.nbr.shape[1], t.nbr.shape[2]
-            return (
-                jnp.concatenate(
-                    [t.nbr, jnp.zeros((pad_b, k, r), jnp.int32)]
-                ),
-                jnp.concatenate(
-                    [t.wgt, jnp.zeros((pad_b, k, r), jnp.float32)]
-                ),
-                jnp.concatenate([t.ldst, jnp.zeros((pad_b, r), jnp.int32)]),
-                # the device's LAST key: extends that tile's consecutive
-                # run (the kernel's ordering invariant — tables are
-                # data-then-filler grouped, NOT tile-sorted) and the pad
-                # blocks never re-zero a tile (weight-0 accumulate)
-                jnp.concatenate(
-                    [t.blk_key, jnp.full(pad_b, t.blk_key[-1], jnp.int32)]
-                ),
+            def pad(t: BspEll):
+                pad_b = b_max - t.nbr.shape[0]
+                if pad_b == 0:
+                    return t.nbr, t.wgt, t.ldst, t.blk_key
+                k, r = t.nbr.shape[1], t.nbr.shape[2]
+                return (
+                    jnp.concatenate(
+                        [t.nbr, jnp.zeros((pad_b, k, r), jnp.int32)]
+                    ),
+                    jnp.concatenate(
+                        [t.wgt, jnp.zeros((pad_b, k, r), jnp.float32)]
+                    ),
+                    jnp.concatenate(
+                        [t.ldst, jnp.zeros((pad_b, r), jnp.int32)]
+                    ),
+                    # the device's LAST key: extends that tile's
+                    # consecutive run (the kernel's ordering invariant —
+                    # tables are data-then-filler grouped, NOT tile-
+                    # sorted) and the pad blocks never re-zero a tile
+                    # (weight-0 accumulate)
+                    jnp.concatenate(
+                        [t.blk_key, jnp.full(pad_b, t.blk_key[-1], jnp.int32)]
+                    ),
+                )
+
+            padded = [pad(t) for t in tables]
+            return DistBsp(
+                nbr=jnp.stack([p[0] for p in padded]),
+                wgt=jnp.stack([p[1] for p in padded]),
+                ldst=jnp.stack([p[2] for p in padded]),
+                blk_key=jnp.stack([p[3] for p in padded]),
+                first_tile=jnp.zeros((P, 1), jnp.int32),
+                partitions=P, vp=vp, dt=int(dt), vt=int(vt),
+                n_seg=1, b_seg=0, t_seg=0,
             )
 
-        padded = [pad(t) for t in tables]
+        # ---- segmented: re-lay every shard to uniform menu geometry ------
+        from neutronstarlite_tpu.ops.bsp_ell import DEFAULT_MAX_BLOCKS
+        import os as _os
+
+        cap = int(_os.environ.get("NTS_BSP_MAX_BLOCKS", DEFAULT_MAX_BLOCKS))
+        menu_b = bsp_bseg_menu((cap // 8) * 8)
+        need_b = max(
+            (t.b_seg or (-(-t.nbr.shape[0] // 8) * 8)) for t in tables
+        )
+        b_seg_u = next(v for v in menu_b if v >= need_b)
+        menu_t = bsp_tseg_menu(t_dst)
+        need_t = max(
+            max(t.seg_tiles) if t.seg_tiles else t_dst for t in tables
+        )
+        t_seg_u = next(v for v in menu_t if v >= need_t)
+
+        def relay(t: BspEll):
+            """[S_p * b_seg_p] arrays -> [S_max * b_seg_u] + first_tile."""
+            S_p = t.n_seg
+            b_p = t.b_seg or t.nbr.shape[0]
+            K, R = t.nbr.shape[1], t.nbr.shape[2]
+            nbr = np.zeros((S_max, b_seg_u, K, R), np.int32)
+            wgt = np.zeros((S_max, b_seg_u, K, R), np.float32)
+            ldst = np.zeros((S_max, b_seg_u, R), np.int32)
+            key = np.zeros((S_max, b_seg_u), np.int32)
+            src_n = np.asarray(t.nbr).reshape(S_p, b_p, K, R)
+            src_w = np.asarray(t.wgt).reshape(S_p, b_p, K, R)
+            src_l = np.asarray(t.ldst).reshape(S_p, b_p, R)
+            src_k = np.asarray(t.blk_key).reshape(S_p, b_p)
+            nbr[:S_p, :b_p] = src_n
+            wgt[:S_p, :b_p] = src_w
+            ldst[:S_p, :b_p] = src_l
+            key[:S_p, :b_p] = src_k
+            # in-segment pad: repeat each segment's last key (weight 0 -
+            # accumulate nothing, never re-zero); the source rows are
+            # already pad-terminated so src_k[:, -1] is each segment's
+            # last real tile's key
+            key[:S_p, b_p:] = src_k[:, -1:]
+            # dummy segments keep key 0 / weight 0: their single visited
+            # tile zero-inits locally and the output is placed at the
+            # scratch margin (first_tile = t_dst), never read
+            seg_tiles = list(t.seg_tiles) if t.seg_tiles else [t_dst]
+            first = np.full(S_max, t_dst, np.int32)
+            first[:S_p] = np.concatenate(
+                [[0], np.cumsum(seg_tiles[:-1], dtype=np.int64)]
+            ).astype(np.int32)
+            return (
+                nbr.reshape(S_max * b_seg_u, K, R),
+                wgt.reshape(S_max * b_seg_u, K, R),
+                ldst.reshape(S_max * b_seg_u, R),
+                key.reshape(S_max * b_seg_u),
+                first,
+            )
+
+        relaid = [relay(t) for t in tables]
+        total_blocks = P * S_max * b_seg_u
+        real_blocks = sum(t.nbr.shape[0] for t in tables)
+        log.info(
+            "dist-bsp: segmented stacked layout %d shard(s) x %d segment(s)"
+            " x %d blocks (t_seg %d, %.2fx stack pad over %d per-shard "
+            "padded blocks; per-shard slot waste is logged by each "
+            "BspEll.build line above)",
+            P, S_max, b_seg_u, t_seg_u,
+            total_blocks / max(real_blocks, 1), real_blocks,
+        )
         return DistBsp(
-            nbr=jnp.stack([p[0] for p in padded]),
-            wgt=jnp.stack([p[1] for p in padded]),
-            ldst=jnp.stack([p[2] for p in padded]),
-            blk_key=jnp.stack([p[3] for p in padded]),
-            partitions=P,
-            vp=vp,
-            dt=int(dt),
-            vt=int(vt),
+            nbr=jnp.stack([r[0] for r in relaid]),
+            wgt=jnp.stack([r[1] for r in relaid]),
+            ldst=jnp.stack([r[2] for r in relaid]),
+            blk_key=jnp.stack([r[3] for r in relaid]),
+            first_tile=jnp.stack([jnp.asarray(r[4]) for r in relaid]),
+            partitions=P, vp=vp, dt=int(dt), vt=int(vt),
+            n_seg=int(S_max), b_seg=int(b_seg_u), t_seg=int(t_seg_u),
         )
 
     def slot_count(self) -> int:
@@ -155,25 +255,51 @@ class DistBsp:
 
         return DistBsp(
             nbr=put(self.nbr), wgt=put(self.wgt), ldst=put(self.ldst),
-            blk_key=put(self.blk_key), partitions=self.partitions,
+            blk_key=put(self.blk_key), first_tile=put(self.first_tile),
+            partitions=self.partitions,
             vp=self.vp, dt=self.dt, vt=self.vt,
+            n_seg=self.n_seg, b_seg=self.b_seg, t_seg=self.t_seg,
         )
 
     # -- per-device body (collective-free given the gathered slab) ---------
     def _local_aggregate(self, tables, xg: jax.Array) -> jax.Array:
-        nbr, wgt, ldst, key = tables
+        nbr, wgt, ldst, key, first_tile = tables
         n_src = self.partitions * self.vp
         f = xg.shape[1]
         t_dst = -(-self.vp // self.dt)
         t_src = -(-n_src // self.vt)
         xp = jnp.pad(xg, ((0, t_src * self.vt - n_src), (0, 0)))
+        S = self.n_seg
+        t_call = self.t_seg if S > 1 else t_dst
+        b_seg = self.b_seg if S > 1 else key.shape[0]
 
         def call(xc):
-            return _bsp_call(
-                key, nbr, wgt, ldst, xc,
-                dt=self.dt, vt=self.vt, t_dst=t_dst, t_src=t_src,
-                interpret=pallas_interpret_default(),
-            )[: self.vp]
+            if S == 1:
+                return _bsp_call(
+                    key, nbr, wgt, ldst, xc,
+                    dt=self.dt, vt=self.vt, t_dst=t_dst, t_src=t_src,
+                    interpret=pallas_interpret_default(),
+                )[: self.vp]
+            # segmented: one identical-shape call per segment; outputs are
+            # placed by ordered dynamic_update_slice at first_tile[s]*dt.
+            # Segment s's quantized tail rows (t_seg snap-up, never written
+            # by the kernel) are exactly overwritten by segment s+1's
+            # placement (contiguous tile coverage), and the LAST segment's
+            # tail lands in the scratch margin below — so no masking.
+            buf = jnp.zeros(
+                (t_dst * self.dt + t_call * self.dt, xc.shape[1]), jnp.float32
+            )
+            for s in range(S):
+                sl = slice(s * b_seg, (s + 1) * b_seg)
+                seg = _bsp_call(
+                    key[sl], nbr[sl], wgt[sl], ldst[sl], xc,
+                    dt=self.dt, vt=self.vt, t_dst=t_call, t_src=t_src,
+                    interpret=pallas_interpret_default(),
+                )
+                buf = lax.dynamic_update_slice(
+                    buf, seg, (first_tile[s] * self.dt, 0)
+                )
+            return buf[: self.vp]
 
         # Under shard_map XLA:TPU stack-allocates the custom call's WHOLE
         # output in VMEM (observed 2026-07-31: RESOURCE_EXHAUSTED at a
@@ -185,16 +311,18 @@ class DistBsp:
         # exchange pays ~fc-fold table re-reads exactly like the resident
         # design's f-chunking would have.
         out_budget = _DIST_OUT_BUDGET_BYTES
-        fc_max = out_budget // (t_dst * self.dt * 4) // 128 * 128
+        # budget against the PER-CALL output (t_seg rows when segmented —
+        # segmentation also shrinks the VMEM-stack footprint)
+        fc_max = out_budget // (t_call * self.dt * 4) // 128 * 128
         if fc_max < 128:
-            # 128 lanes is the floor; past ~73k padded dst rows per shard
+            # 128 lanes is the floor; past ~73k padded dst rows per call
             # even one chunk exceeds the stack budget — warn loudly, the
             # compile error alone would not say why
             log.warning(
-                "dist-bsp: per-shard output %d rows x 128 cols exceeds the "
+                "dist-bsp: per-call output %d rows x 128 cols exceeds the "
                 "%d MiB VMEM-stack budget; shard_map compile may "
                 "RESOURCE_EXHAUST (raise PARTITIONS or lower dt)",
-                t_dst * self.dt, out_budget >> 20,
+                t_call * self.dt, out_budget >> 20,
             )
             fc_max = 128
         if f <= fc_max:
@@ -246,10 +374,10 @@ class DistBspPair:
 def _dist_bsp_apply(mesh: Mesh, dbsp: DistBsp, x: jax.Array) -> jax.Array:
     """all_gather + per-shard rectangular bsp kernel, as a shard_map."""
 
-    def body(nbr, wgt, ldst, key, xs):
+    def body(nbr, wgt, ldst, key, first, xs):
         xg = lax.all_gather(xs, PARTITION_AXIS, axis=0, tiled=True)
         return dbsp._local_aggregate(
-            (nbr[0], wgt[0], ldst[0], key[0]), xg
+            (nbr[0], wgt[0], ldst[0], key[0], first[0]), xg
         )
 
     fn = jax.shard_map(
@@ -261,13 +389,14 @@ def _dist_bsp_apply(mesh: Mesh, dbsp: DistBsp, x: jax.Array) -> jax.Array:
             PS(PARTITION_AXIS, None, None),
             PS(PARTITION_AXIS, None),
             PS(PARTITION_AXIS, None),
+            PS(PARTITION_AXIS, None),
         ),
         out_specs=PS(PARTITION_AXIS, None),
         # pallas_call cannot declare varying mesh axes on its out_shape
         # (same constraint as the dist-ELL pallas executor)
         check_vma=False,
     )
-    return fn(dbsp.nbr, dbsp.wgt, dbsp.ldst, dbsp.blk_key, x)
+    return fn(dbsp.nbr, dbsp.wgt, dbsp.ldst, dbsp.blk_key, dbsp.first_tile, x)
 
 
 def dist_bsp_gather_dst_from_src(
@@ -297,7 +426,11 @@ def dist_bsp_gather_simulated(dbsp: DistBsp, x: jax.Array) -> jax.Array:
     for p in range(dbsp.partitions):
         outs.append(
             dbsp._local_aggregate(
-                (dbsp.nbr[p], dbsp.wgt[p], dbsp.ldst[p], dbsp.blk_key[p]), x
+                (
+                    dbsp.nbr[p], dbsp.wgt[p], dbsp.ldst[p],
+                    dbsp.blk_key[p], dbsp.first_tile[p],
+                ),
+                x,
             )
         )
     return jnp.concatenate(outs, axis=0)
