@@ -111,6 +111,29 @@ def load_last_good(scale: float):
     return rec
 
 
+def _attach_cpu_anchor(extra: dict) -> None:
+    """Attach the round-5 MEASURED same-host CPU baseline (the shimmed
+    np=1 reference build vs this framework, identical synthetic Reddit
+    inputs — baseline/run_baseline.py) so a stale on-chip number still
+    ships with a real measured anchor: even the stale 7.02 s scatter epoch
+    is ~39x the measured 276.8 s reference CPU epoch."""
+    p = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "baseline", "results", "summary.json")
+    try:
+        with open(p) as fh:
+            row = json.load(fh).get("reddit", {})
+        ref = (row.get("reference") or {}).get("epoch_s")
+        fw = (row.get("framework") or {}).get("epoch_s")
+        if ref:
+            extra["cpu_anchor"] = {
+                "reference_np1_cpu_epoch_s": round(ref, 2),
+                "framework_cpu_epoch_s": round(fw, 2) if fw else None,
+                "source": "baseline/run_baseline.py (identical inputs)",
+            }
+    except Exception:
+        pass  # anchor is context, never a failure path
+
+
 def emit_stale_or_fail(scale: float, reason: str, diag: str = "",
                        rc_on_salvage: int = 0) -> int:
     """Print the last persisted same-scale measurement marked stale, or a
@@ -142,6 +165,7 @@ def emit_stale_or_fail(scale: float, reason: str, diag: str = "",
         if diag:
             stale["extra"]["last_probe"] = diag[-500:]
         stale["extra"]["measured_at"] = stale.pop("measured_at", None)
+        _attach_cpu_anchor(stale["extra"])
         print(json.dumps(stale))
         return rc_on_salvage
     print(json.dumps({

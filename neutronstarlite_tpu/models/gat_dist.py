@@ -19,6 +19,7 @@ path is exercised by dryrun_multichip and NTS_MULTIDEVICE=1 tests.
 
 from __future__ import annotations
 
+import os
 from typing import Any, Dict
 
 import jax
@@ -62,6 +63,12 @@ def dist_gat_layer(mesh, mg: MirrorGraph, tables, W, a, x, last: bool,
         score = jax.nn.leaky_relu(e_al + e_ar, negative_slope=LEAKY_SLOPE)
         s = deo.dist_edge_softmax_sim(mg, score)
         out = deo.dist_aggregate_dst_fuse_weight_sim(mg, s, mir[:, :, :f])
+    elif len(tables) == 7:
+        # chunked + rematerialized chain (full-scale HBM fit; the
+        # un-chunked form AOT-measured 14.8 of 15.75 GiB at full Reddit)
+        out = deo.dist_gated_chain_chunked(
+            mesh, mg, tables, payload, ar, f, LEAKY_SLOPE
+        )
     else:
         mir = deo.dist_get_dep_nbr(mesh, mg, tables, payload)
         e_al = deo.dist_scatter_src(mesh, mg, tables, mir[:, :, f:])
@@ -105,7 +112,35 @@ class DistGATTrainer(ToolkitBase):
         self.mg = MirrorGraph.build(self.host_graph, P)
         # the *_sim ops re-derive the tables from mg; only the sharded path
         # consumes device-put tables
-        self.tables = self.mg.shard(self.mesh) if self.mesh is not None else None
+        self.tables = None
+        if self.mesh is not None:
+            # dst-aligned edge chunking for the remat'd gated chain (the
+            # full-scale HBM fit — dist_edge_ops.dist_gated_chain_chunked;
+            # GGCN inherits). The [P, dp] zero probe carries the static
+            # chunk-dst capacity through the jit boundary as a shape.
+            # Only need_ids + the chunk tables ship: the uniform [P, El]
+            # per-edge tables are dead weight under the chunked chain
+            # (~234 MB/device at full Reddit — r5 review).
+            from neutronstarlite_tpu.parallel.mirror import chunk_edge_list
+
+            ec = int(os.environ.get("NTS_EDGE_CHUNK", 1_000_000))
+            ch = chunk_edge_list(self.mg, ec)
+            put = lambda a: jax.device_put(
+                jnp.asarray(a),
+                NamedSharding(self.mesh, PS(
+                    PARTITION_AXIS, *([None] * (np.ndim(a) - 1))
+                )),
+            )
+            self.tables = (
+                (put(self.mg.need_ids),)
+                + ch.shard(self.mesh)
+                + (put(jnp.zeros((self.mg.partitions, ch.dp), jnp.int32)),)
+            )
+            log.info(
+                "gated edge chain: %d chunk(s) x %d edges (dp=%d) — "
+                "remat'd per chunk",
+                ch.slot.shape[1], ch.slot.shape[2], ch.dp,
+            )
 
         pad = self.mg.pad_vertex_array
         if self.mesh is not None:

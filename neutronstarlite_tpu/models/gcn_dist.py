@@ -66,9 +66,11 @@ def dist_gcn_forward(
     no_exchange: bool = False,
 ):
     """``blocks`` selects the exchange: the [P, P, Eb] 3-tuple is the
-    ppermute ring, a DistEllPair is the OPTIM_KERNEL gather-only path, and
-    the 5-tuple of mirror tables is the compacted all_to_all exchange
-    (``dist`` is then the MirrorGraph). ``layer_nn`` is the per-layer vertex
+    ppermute ring, a DistEllPair is the OPTIM_KERNEL gather-only path, the
+    9-tuple is the round-5 SPLIT mirror exchange (remote-only all_to_all +
+    resident local edges; ``dist`` is then the SplitMirror — what
+    COMM_LAYER:mirror ships), and the legacy 5-tuple is the uniform
+    MirrorGraph all_to_all. ``layer_nn`` is the per-layer vertex
     NN over the exchanged aggregate — the fuse-op toolkits (GCN/GIN/CommNet)
     share the exchange engine and differ only here, exactly the reference's
     decoupled graph-op/NN-op split (ntsContext.hpp:86-95).
@@ -88,6 +90,7 @@ def dist_gcn_forward(
     )
     from neutronstarlite_tpu.parallel.dist_edge_ops import (
         dist_gather_dst_from_src_mirror,
+        dist_gather_dst_from_src_mirror_split,
     )
     from neutronstarlite_tpu.parallel.dist_ell import (
         DistEllPair,
@@ -106,6 +109,12 @@ def dist_gcn_forward(
             return dist_blocked_gather_dst_from_src(mesh, blocks, v)
         if isinstance(blocks, DistEllPair):
             return dist_ell_gather_dst_from_src(mesh, blocks, v)
+        if isinstance(blocks, tuple) and len(blocks) == 9:
+            # round 5: split layout — remote-only all_to_all + resident
+            # local edges (self-loop graphs saturate the uniform Mb at vp)
+            return dist_gather_dst_from_src_mirror_split(
+                mesh, dist, blocks, v
+            )
         if isinstance(blocks, tuple) and len(blocks) == 5:
             return dist_gather_dst_from_src_mirror(mesh, dist, blocks, v)
         return dist_gather_dst_from_src(
@@ -152,9 +161,11 @@ class DistGCNTrainer(ToolkitBase):
         interconnect), of vp shard rows (ring) vs Mb compacted mirror rows
         — and picks the smaller: the reference's active-mirror-only message
         optimization (comm/network.cpp:505-518) as a build-time decision.
-        Mb is priced by MirrorGraph.estimate_mb (pass 1 only), so a ring
-        verdict costs no mirror-table build."""
-        from neutronstarlite_tpu.parallel.mirror import MirrorGraph
+        mb is priced by SplitMirror.estimate_mb_remote (pass 1 over remote
+        edges only, since round 5 the mirror layer never ships the
+        resident diagonal), so a ring verdict costs no mirror-table
+        build."""
+        from neutronstarlite_tpu.parallel.mirror import SplitMirror
 
         if cfg.comm_layer in ("ring", "ell", "mirror"):
             return cfg.comm_layer
@@ -164,7 +175,7 @@ class DistGCNTrainer(ToolkitBase):
             return "ell"
         if P == 1:
             return "ring"  # degenerate: no wire traffic either way
-        mb, vp = MirrorGraph.estimate_mb(host_graph, P)
+        mb, vp = SplitMirror.estimate_mb_remote(host_graph, P)
         # tie goes to mirror: at equal wire volume it ships one all_to_all
         # instead of P-1 dependent ppermute rounds (measured faster on the
         # 8-device rig even at mb == vp; see docs/PERF.md comm-layer table)
@@ -184,14 +195,15 @@ class DistGCNTrainer(ToolkitBase):
         self.comm_layer = layer_kind
 
         if layer_kind == "mirror":
-            from neutronstarlite_tpu.parallel.mirror import MirrorGraph
+            from neutronstarlite_tpu.parallel.mirror import SplitMirror
 
-            self.dist = MirrorGraph.build(self.host_graph, P)
+            self.dist = SplitMirror.build(self.host_graph, P)
             self.blocks = self.dist.shard(self.mesh)
             log.info(
-                "COMM_LAYER mirror: compacted all_to_all exchange "
-                "(Mb=%d slots/pair, El=%d)",
-                self.dist.mb, self.dist.el,
+                "COMM_LAYER mirror (split): remote-only all_to_all "
+                "(mb=%d remote slots/pair vs vp=%d shard rows; Er=%d "
+                "remote + El=%d resident edges)",
+                self.dist.mb, self.dist.vp, self.dist.er, self.dist.el,
             )
         else:
             self.dist = DistGraph.build(

@@ -23,6 +23,9 @@ from neutronstarlite_tpu.models.gat_dist import DistGATTrainer
 from neutronstarlite_tpu.models.ggcn import GGCN_LEAKY_SLOPE, init_ggcn_params
 from neutronstarlite_tpu.nn.layers import dropout
 from neutronstarlite_tpu.parallel import dist_edge_ops as deo
+from neutronstarlite_tpu.utils.logging import get_logger
+
+log = get_logger("ggcn_dist")
 
 
 def dist_ggcn_layer(mesh, mg, tables, layer, x, last: bool,
@@ -44,6 +47,12 @@ def dist_ggcn_layer(mesh, mg, tables, layer, x, last: bool,
         score = jax.nn.leaky_relu(e_hs + e_hd, negative_slope=GGCN_LEAKY_SLOPE)
         a = deo.dist_edge_softmax_sim(mg, score)  # per-dst, per-channel
         out = deo.dist_aggregate_dst_fuse_weight_sim(mg, a, mir[:, :, :f])
+    elif len(tables) == 7:
+        # chunked + rematerialized chain (full-scale HBM fit; chunk tables
+        # built by DistGATTrainer.build_model, shared with GAT)
+        out = deo.dist_gated_chain_chunked(
+            mesh, mg, tables, payload, hd, f, GGCN_LEAKY_SLOPE
+        )
     else:
         mir = deo.dist_get_dep_nbr(mesh, mg, tables, payload)
         e_hs = deo.dist_scatter_src(mesh, mg, tables, mir[:, :, f:])

@@ -59,6 +59,25 @@ log = get_logger("dist_bsp")
 _DIST_OUT_BUDGET_BYTES = 36 << 20
 
 
+def bsp_call_width(t_call: int, dt: int, f: int) -> int:
+    """The per-call slab width the VMEM-stack budget allows for a kernel
+    call covering ``t_call`` dst tiles: f itself when it fits, else the
+    balanced 128-multiple chunk width (ceil-divide f into equal chunks
+    instead of full-budget chunks + a mostly-padding tail). ONE definition
+    shared by DistBsp._local_aggregate (the runtime chunking) and
+    tools/aot_bsp_scale (the compiled-program proof) — a drifted copy
+    would make the AOT tool seed programs at the wrong slab width
+    (r5 review)."""
+    fc_max = max(
+        _DIST_OUT_BUDGET_BYTES // (t_call * dt * 4) // 128 * 128, 128
+    )
+    if f <= fc_max:
+        return f
+    n_ch = -(-f // fc_max)
+    per_ch = -(-f // n_ch)
+    return -(-per_ch // 128) * 128
+
+
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class DistBsp:
@@ -310,11 +329,7 @@ class DistBsp:
         # widths (128/41) stay single-chunk, the 602-wide standard-order
         # exchange pays ~fc-fold table re-reads exactly like the resident
         # design's f-chunking would have.
-        out_budget = _DIST_OUT_BUDGET_BYTES
-        # budget against the PER-CALL output (t_seg rows when segmented —
-        # segmentation also shrinks the VMEM-stack footprint)
-        fc_max = out_budget // (t_call * self.dt * 4) // 128 * 128
-        if fc_max < 128:
+        if t_call * self.dt * 4 * 128 > _DIST_OUT_BUDGET_BYTES:
             # 128 lanes is the floor; past ~73k padded dst rows per call
             # even one chunk exceeds the stack budget — warn loudly, the
             # compile error alone would not say why
@@ -322,17 +337,15 @@ class DistBsp:
                 "dist-bsp: per-call output %d rows x 128 cols exceeds the "
                 "%d MiB VMEM-stack budget; shard_map compile may "
                 "RESOURCE_EXHAUST (raise PARTITIONS or lower dt)",
-                t_call * self.dt, out_budget >> 20,
+                t_call * self.dt, _DIST_OUT_BUDGET_BYTES >> 20,
             )
-            fc_max = 128
-        if f <= fc_max:
+        # balanced 128-multiple chunk width under the per-call budget
+        # (f=602 under a 512 budget: 2x384 beats 512+512-with-422-zeros);
+        # ONE shared definition with the AOT proof tool (bsp_call_width)
+        fc = bsp_call_width(t_call, self.dt, f)
+        if f <= fc:
             return call(xp).astype(xg.dtype)
-        # balance chunk widths: ceil-divide f into equal 128-multiple
-        # chunks instead of full fc_max chunks + a mostly-padding tail
-        # (f=602 under a 512 budget: 2x384 beats 512+512-with-422-zeros)
-        n_ch = -(-f // fc_max)
-        per_ch = -(-f // n_ch)
-        fc = -(-per_ch // 128) * 128
+        n_ch = -(-f // fc)
         fpad = n_ch * fc - f
         if fpad:
             xp = jnp.pad(xp, ((0, 0), (0, fpad)))

@@ -53,6 +53,15 @@ def main(argv=None) -> int:
     ap.add_argument("--scale", type=float, default=10.0)
     ap.add_argument("--topology", default="v5e:2x2")
     ap.add_argument("--f", type=int, default=602)
+    ap.add_argument(
+        "--dist", type=int, default=0, metavar="P",
+        help="compile the lattice at the DIST per-shard RECTANGULAR "
+        "geometry instead of the single-chip square one: dst space is one "
+        "shard's vp = roundup8(ceil(V/P)) rows, src space is the full "
+        "all_gathered P*vp slab (parallel/dist_bsp.py) — VERDICT r4 item 6's "
+        "'dist-bsp at 10x-Reddit AOT-green' without synthesizing a "
+        "1.15B-edge graph (the kernel program depends only on geometry)",
+    )
     args = ap.parse_args(argv)
 
     # contract: no accelerator claimed — CPU host, topology compiler only
@@ -90,8 +99,36 @@ def main(argv=None) -> int:
     v_num = int(REDDIT_V * args.scale)
     dt, vt, K, R = DEFAULT_DT, DEFAULT_VT, DEFAULT_K, DEFAULT_R
     cap = int(os.environ.get("NTS_BSP_MAX_BLOCKS", DEFAULT_MAX_BLOCKS))
-    t_dst = -(-v_num // dt)
-    t_src = -(-v_num // vt)
+    if args.dist > 0:
+        # per-shard rectangular geometry (parallel/dist_bsp.py): dst rows
+        # are one shard's padded vp, the src space is the all_gathered
+        # [P*vp] slab. vp must be EXACT (r5 review: the degree-balanced
+        # partition_offsets max span exceeds ceil(V/P) — a 2.4%-off vp
+        # shifts t_dst/t_src and every compiled program shape), so it is
+        # computed from the real generator's degree vector via the real
+        # partitioner — the one-shot edge draw is minutes at 10x, cheap
+        # next to a wrong cache seed.
+        import numpy as _np
+
+        from neutronstarlite_tpu.graph.storage import partition_offsets
+        from neutronstarlite_tpu.graph.synthetic import (
+            synthetic_power_law_graph,
+        )
+        from neutronstarlite_tpu.parallel.vertex_space import round_up
+
+        P = args.dist
+        e_num = max(int(114_615_892 * args.scale), 512)
+        src_a, dst_a = synthetic_power_law_graph(v_num, e_num, seed=7)
+        del src_a
+        in_deg = _np.bincount(dst_a, minlength=v_num).astype(_np.int64)
+        del dst_a
+        offs = partition_offsets(v_num, in_deg, P)
+        vp = round_up(max(int(_np.diff(offs).max()), 1), 8)
+        t_dst = -(-vp // dt)
+        t_src = -(-(P * vp) // vt)
+    else:
+        t_dst = -(-v_num // dt)
+        t_src = -(-v_num // vt)
     cap_eff = (cap // 8) * 8
     bseg_menu = bsp_bseg_menu(cap_eff)
     # t_seg menu: the builder snaps every segmented t_seg UP to
@@ -99,10 +136,20 @@ def main(argv=None) -> int:
     # the roundup128(tiles) values real builds emit, e.g. ~640-768 at
     # 10x Reddit), so compiling the full menu here makes every
     # emittable program literally pre-lowered.
-    cands = bsp_tseg_menu(t_dst)
+    # + exact t_dst, the call shape of the unsegmented fast path. Scope
+    # (r5 review): this lattice covers every SEGMENTED program exactly
+    # (segmented b_seg/t_seg are menu-snapped); an UNSEGMENTED program's
+    # block count is roundup8(data blocks) — data-dependent, not
+    # menu-aligned — so its exact (b, t_dst) pair is seeded by
+    # tools/aot_bench_path (which builds the real tables for each bench
+    # leg), not by this geometry-only tool. Unsegmented programs only
+    # arise under the SMEM cap, where Mosaic compiles have never hung.
+    cands = sorted(set(bsp_tseg_menu(t_dst)) | {t_dst})
     out = {
         "scale": args.scale, "v_num": v_num, "topology": args.topology,
-        "bseg_menu": bseg_menu, "t_src": t_src, "f": args.f,
+        "dist_partitions": args.dist or None,
+        "bseg_menu": bseg_menu, "t_src": t_src, "t_dst": t_dst,
+        "f": args.f,
         "smem_key_kib_max": round(bseg_menu[-1] * 4 / 1024, 1),
         "programs": [],
     }
@@ -118,33 +165,56 @@ def main(argv=None) -> int:
 
         import jax.numpy as jnp
 
+        # slab dtype is part of the program: the bench's production slab
+        # is bf16; the dist exchange's default (f-chunked standard order)
+        # feeds f32 — dist mode compiles both
+        slab_dtypes = (
+            (jnp.bfloat16, jnp.float32) if args.dist else (jnp.bfloat16,)
+        )
+
+        def call_width(t_call: int) -> int:
+            """The EXACT per-call slab width DistBsp._local_aggregate
+            feeds at this geometry — THE SAME function the runtime calls
+            (dist_bsp.bsp_call_width), so the tool cannot drift."""
+            if not args.dist:
+                return args.f
+            from neutronstarlite_tpu.parallel.dist_bsp import bsp_call_width
+
+            return bsp_call_width(t_call, dt, args.f)
+
         for b_seg in bseg_menu:
-            shapes = (
-                sds((b_seg,), jnp.int32),            # blk_key
-                sds((b_seg, K, R), jnp.int32),       # nbr
-                sds((b_seg, K, R), jnp.float32),     # wgt
-                sds((b_seg, R), jnp.int32),          # ldst
-                sds((t_src * vt, args.f), jnp.bfloat16),  # xp slab
-            )
-            for t_seg in cands:
-                t0 = time.time()
-                compiled = _bsp_call.lower(
-                    *shapes, dt=dt, vt=vt, t_dst=t_seg, t_src=t_src,
-                    interpret=False,
-                ).compile()
-                mem = compiled.memory_analysis()
-                out["programs"].append({
-                    "b_seg": b_seg,
-                    "t_seg": t_seg,
-                    "compile_s": round(time.time() - t0, 1),
-                    "argument_gib": round(
-                        mem.argument_size_in_bytes / 2**30, 3
-                    ),
-                    "temp_gib": round(mem.temp_size_in_bytes / 2**30, 3),
-                    "output_gib": round(
-                        mem.output_size_in_bytes / 2**30, 3
-                    ),
-                })
+            for slab_dt in slab_dtypes:
+                shapes = (
+                    sds((b_seg,), jnp.int32),            # blk_key
+                    sds((b_seg, K, R), jnp.int32),       # nbr
+                    sds((b_seg, K, R), jnp.float32),     # wgt
+                    sds((b_seg, R), jnp.int32),          # ldst
+                )
+                for t_seg in cands:
+                    f_call = call_width(t_seg)
+                    shapes = shapes[:4] + (
+                        sds((t_src * vt, f_call), slab_dt),  # xp slab
+                    )
+                    t0 = time.time()
+                    compiled = _bsp_call.lower(
+                        *shapes, dt=dt, vt=vt, t_dst=t_seg, t_src=t_src,
+                        interpret=False,
+                    ).compile()
+                    mem = compiled.memory_analysis()
+                    out["programs"].append({
+                        "b_seg": b_seg,
+                        "t_seg": t_seg,
+                        "f": f_call,
+                        "slab": jnp.dtype(slab_dt).name,
+                        "compile_s": round(time.time() - t0, 1),
+                        "argument_gib": round(
+                            mem.argument_size_in_bytes / 2**30, 3
+                        ),
+                        "temp_gib": round(mem.temp_size_in_bytes / 2**30, 3),
+                        "output_gib": round(
+                            mem.output_size_in_bytes / 2**30, 3
+                        ),
+                    })
         out["ok"] = True
     except Exception as e:  # noqa: BLE001 — report, don't trace-dump
         out.update(ok=False, error=f"{type(e).__name__}: {str(e)[:500]}")

@@ -105,12 +105,14 @@ def _dist_gcn_case(cfg, base_dir, mesh, edges=None):
 
     layer_kind = DistGCNTrainer.resolve_comm_layer(cfg, host_graph, P)
     if layer_kind == "mirror":
-        from neutronstarlite_tpu.parallel.mirror import MirrorGraph
+        # the GCN fused path ships the SPLIT layout since round 5
+        from neutronstarlite_tpu.parallel.mirror import SplitMirror
 
-        dist = MirrorGraph.build(host_graph, P)
+        dist = SplitMirror.build(host_graph, P)
         host_blocks = (
-            dist.need_ids, dist.edge_src_slot, dist.edge_dst,
-            dist.edge_weight, dist.edge_mask,
+            dist.need_ids, dist.r_src_slot, dist.r_dst, dist.r_weight,
+            dist.r_mask, dist.l_src, dist.l_dst, dist.l_weight,
+            dist.l_mask,
         )
     else:
         from neutronstarlite_tpu.parallel.dist_graph import DistGraph
@@ -248,12 +250,25 @@ def _dist_edge_case(cfg, base_dir, mesh, edges=None):
         sh = NamedSharding(mesh, PS(PARTITION_AXIS, *([None] * (a.ndim - 1))))
         return jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=sh)
 
-    tables = tuple(
-        tspec(t) for t in (
-            mg.need_ids, mg.edge_src_slot, mg.edge_dst,
-            mg.edge_weight, mg.edge_mask,
-        )
-    )
+    # BOTH edge-chain models compile their chunked + remat'd form (what
+    # the trainer builds; un-chunked the chains AOT-measured 76.9 GiB
+    # (GGCN) / 14.8 GiB (GAT) at full Reddit —
+    # docs/perf_runs/round5/aot_fullscale.log). Only need_ids + chunk
+    # tables ship (the trainer's 7-tuple).
+    import os as _os
+
+    import numpy as _np
+
+    from neutronstarlite_tpu.parallel.mirror import chunk_edge_list
+
+    ec = int(_os.environ.get("NTS_EDGE_CHUNK", 1_000_000))
+    ch = chunk_edge_list(mg, ec)
+    probe = _np.zeros((P, ch.dp), _np.int32)
+    tables = (tspec(mg.need_ids),) + tuple(
+        tspec(t) for t in (ch.slot, ch.dstl, ch.dstr, ch.mask, ch.base)
+    ) + (tspec(probe),)
+    geo_extra = {"n_chunks": int(ch.slot.shape[1]),
+                 "ec": int(ch.slot.shape[2]), "dp": int(ch.dp)}
     params = (
         init_ggcn_params(jax.random.PRNGKey(0), sizes)
         if is_ggcn else init_gat_params(jax.random.PRNGKey(0), sizes)
@@ -292,7 +307,9 @@ def _dist_edge_case(cfg, base_dir, mesh, edges=None):
         jax.ShapeDtypeStruct((pv,), jnp.float32, sharding=vsh1),
         jax.ShapeDtypeStruct((2,), jnp.uint32, sharding=rsh),
     )
-    return jax.jit(train_step), args, {"Mb": mg.mb, "El": mg.el, "vp": mg.vp}
+    geo = {"Mb": mg.mb, "El": mg.el, "vp": mg.vp}
+    geo.update(geo_extra)
+    return jax.jit(train_step), args, geo
 
 
 def main(argv=None) -> int:

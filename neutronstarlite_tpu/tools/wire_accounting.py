@@ -47,22 +47,29 @@ def accounting(g, P: int, f: int, refresh: int, budget_bytes: int,
     """All counts are per device per layer unless stated; bytes are f32
     rows (itemsize 4) at feature width f."""
     from neutronstarlite_tpu.parallel.feature_cache import CachedMirrorGraph
-    from neutronstarlite_tpu.parallel.mirror import MirrorGraph
+    from neutronstarlite_tpu.parallel.mirror import MirrorGraph, SplitMirror
 
-    mb, vp = MirrorGraph.estimate_mb(g, P)
+    mb_uni, vp = MirrorGraph.estimate_mb(g, P)
+    # the GCN fused path ships the SPLIT exchange since round 5: remote
+    # need-sets only (self-loop graphs saturate the uniform mb at vp);
+    # the uniform price is kept as a row for the GAT/DepCache chains that
+    # still use the [P, P*Mb] layout
+    mb, _ = SplitMirror.estimate_mb_remote(g, P)
     dense_rows = (P - 1) * vp
     mirror_rows = (P - 1) * mb
+    mirror_uni_rows = (P - 1) * mb_uni
     out = {
-        "P": P, "f": f, "vp": vp, "mb": mb,
+        "P": P, "f": f, "vp": vp, "mb": mb, "mb_uniform": mb_uni,
         "layers": {
             "ring": dense_rows, "ell": dense_rows, "blocked": dense_rows,
-            "mirror": mirror_rows,
+            "mirror": mirror_rows, "mirror_uniform": mirror_uni_rows,
         },
         "bytes_per_layer": {
             k: v * f * 4
             for k, v in (
                 ("ring", dense_rows), ("ell", dense_rows),
                 ("blocked", dense_rows), ("mirror", mirror_rows),
+                ("mirror_uniform", mirror_uni_rows),
             )
         },
     }
