@@ -412,3 +412,95 @@ def test_dist_ggcn_chunked_chain_invariant_to_chunking(rng, monkeypatch):
     loss_many, nch_many = run("16")  # force dst-aligned multi-chunk
     assert nch_many > max(nch_default, 1), (nch_default, nch_many)
     np.testing.assert_allclose(loss_many, loss_default, rtol=1e-5, atol=1e-6)
+
+
+def test_chunk_edge_list_invariants(rng):
+    """The dst-aligned chunker (round 5): chunks cover every real edge
+    exactly once, never split a dst across chunks, respect the target
+    unless a single hub dst exceeds it, and pad shards/chunks safely
+    (base == vp scratch for dummy chunks)."""
+    from neutronstarlite_tpu.parallel.mirror import chunk_edge_list
+
+    g, _, mg = _mirror_rig(rng, v_num=61, e_num=420, P=4)
+    for ec_target in (16, 64, 10_000):
+        ch = chunk_edge_list(mg, ec_target)
+        P, n_ch, Ec = ch.slot.shape
+        assert ch.base.shape == (P, n_ch)
+        total_real = int(ch.mask.sum())
+        assert total_real == g.e_num  # every edge exactly once
+        # the target is load-bearing: every chunk's REAL edge count stays
+        # under max(ec_target, heaviest dst) — a chunker that ignored the
+        # target (one giant chunk) fails here at ec_target=16
+        heaviest = max(
+            int(np.bincount(
+                mg.edge_dst[p][mg.edge_mask[p] > 0], minlength=mg.vp
+            ).max())
+            for p in range(P)
+        )
+        per_chunk_real = ch.mask.sum(axis=2)
+        assert per_chunk_real.max() <= max(ec_target, heaviest), (
+            ec_target, heaviest, per_chunk_real.max()
+        )
+        if ec_target == 16:
+            assert n_ch > 1  # small target must actually split
+        for p in range(P):
+            seen_dsts = set()
+            for k in range(n_ch):
+                m = ch.mask[p, k] > 0
+                if not m.any():
+                    assert ch.base[p, k] == mg.vp  # dummy -> scratch
+                    continue
+                d_local = ch.dstl[p, k][m]
+                d_rel = ch.dstr[p, k][m]
+                base = int(ch.base[p, k])
+                np.testing.assert_array_equal(d_local - base, d_rel)
+                assert d_rel.min() >= 0 and d_rel.max() < ch.dp
+                # dst-alignment: no dst appears in two chunks
+                these = set(d_local.tolist())
+                assert not (these & seen_dsts)
+                seen_dsts |= these
+
+
+def test_chunk_edge_list_hub_exceeds_target(rng):
+    """A single dst heavier than ec_target must widen Ec (the softmax
+    segment cannot be cut) rather than crash or split."""
+    from neutronstarlite_tpu.graph.storage import build_graph
+    from neutronstarlite_tpu.parallel.mirror import MirrorGraph, chunk_edge_list
+
+    V = 40
+    hub_deg = 60
+    src = rng.integers(0, V, size=hub_deg, dtype=np.uint32)
+    dst = np.full(hub_deg, 7, dtype=np.uint32)  # one hub dst
+    extra_s = rng.integers(0, V, size=50, dtype=np.uint32)
+    extra_d = rng.integers(0, V, size=50, dtype=np.uint32)
+    g = build_graph(np.concatenate([src, extra_s]),
+                    np.concatenate([dst, extra_d]), V, weight="ones")
+    mg = MirrorGraph.build(g, 2)
+    ch = chunk_edge_list(mg, 8)  # target far below the hub degree
+    assert ch.slot.shape[2] >= hub_deg  # Ec widened to hold the hub
+    assert int(ch.mask.sum()) == g.e_num
+
+
+def test_bsp_call_width_matches_runtime_semantics():
+    """bsp_call_width: full width when it fits the VMEM-stack budget,
+    else balanced 128-multiple chunks whose count covers f."""
+    from neutronstarlite_tpu.parallel.dist_bsp import (
+        _DIST_OUT_BUDGET_BYTES,
+        bsp_call_width,
+    )
+
+    assert bsp_call_width(10, 128, 602) == 602  # tiny call: fits
+    for t_call, dt, f in ((4551, 512, 602), (2304, 512, 602),
+                          (580, 512, 2048), (100_000, 512, 602)):
+        fc = bsp_call_width(t_call, dt, f)
+        if fc < f:
+            assert fc % 128 == 0
+            fc_max = max(
+                _DIST_OUT_BUDGET_BYTES // (t_call * dt * 4) // 128 * 128, 128
+            )
+            assert fc <= fc_max  # never exceeds the budget-derived cap
+            # BALANCED: same chunk count as full-budget chunks would give
+            # (no fc_max+padding-tail regression) at the smallest
+            # 128-multiple width achieving it
+            n_ch = -(-f // fc_max)
+            assert fc == -(-(-(-f // n_ch)) // 128) * 128, (fc, fc_max, f)
