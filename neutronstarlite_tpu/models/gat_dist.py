@@ -29,7 +29,7 @@ from jax.sharding import NamedSharding, PartitionSpec as PS
 
 from neutronstarlite_tpu.models.base import ToolkitBase, register_algorithm
 from neutronstarlite_tpu.models.gat import LEAKY_SLOPE, init_gat_params
-from neutronstarlite_tpu.nn.layers import dropout
+from neutronstarlite_tpu.nn.layers import compute_cast, dropout
 from neutronstarlite_tpu.nn.param import AdamConfig, adam_init, adam_update
 from neutronstarlite_tpu.parallel import dist_edge_ops as deo
 from neutronstarlite_tpu.parallel.mesh import PARTITION_AXIS
@@ -41,19 +41,31 @@ log = get_logger("gat_dist")
 
 
 def dist_gat_layer(mesh, mg: MirrorGraph, tables, W, a, x, last: bool,
-                   nn_only: bool = False):
+                   nn_only: bool = False, compute_dtype=None):
     """One GAT layer in the distributed edge-op chain. ``mesh=None`` selects
     the simulated (collective-free) ops. ``nn_only`` replaces the whole
     graph-op chain (mirror fetch + edge ops) with a zero aggregate at the
-    same shape — DEBUGINFO's nn_time program (models/debuginfo.py)."""
-    h = x @ W  # [P*vp, f'] — local matmul, params replicated
+    same shape — DEBUGINFO's nn_time program (models/debuginfo.py).
+
+    ``compute_dtype=jnp.bfloat16`` (PRECISION:bfloat16) runs the matmuls,
+    the mirror EXCHANGE and the edge chain in bf16 — the all_to_all ships
+    half the bytes, the dist path's dominant wire cost. Parameters stay
+    f32, per-dst segment sums accumulate in f32 (the chunked AND
+    non-chunked/sim aggregation bodies both upcast), and this path
+    re-materializes f32 activations at every layer boundary — stricter
+    than the GCN family's policy (models/gcn.py keeps bf16 activations
+    between layers and casts once at the end); the edge chain's softmax
+    is the numerically delicate part that earns the difference."""
+    cast = compute_cast(compute_dtype)
+    x = cast(x)
+    h = x @ cast(W)  # [P*vp, f'] — local matmul, params replicated
     f = h.shape[1]
-    al = h @ a[:f]  # [P*vp, 1] source half of the decomposed attention
-    ar = h @ a[f:]  # [P*vp, 1] dst half
+    al = h @ cast(a[:f])  # [P*vp, 1] source half of the decomposed attention
+    ar = h @ cast(a[f:])  # [P*vp, 1] dst half
     if nn_only:
         # the [f', 1] attention matvecs al/ar may be DCE'd here; they are
         # negligible next to the W matmul, so nn_time stays honest
-        out = jnp.zeros_like(h)
+        out = jnp.zeros_like(h, dtype=jnp.float32)
         return out if last else jax.nn.relu(out)
     payload = jnp.concatenate([h, al], axis=1)
     if mesh is None:
@@ -76,16 +88,17 @@ def dist_gat_layer(mesh, mg: MirrorGraph, tables, W, a, x, last: bool,
         score = jax.nn.leaky_relu(e_al + e_ar, negative_slope=LEAKY_SLOPE)
         s = deo.dist_edge_softmax(mesh, mg, tables, score)
         out = deo.dist_aggregate_dst_fuse_weight(mesh, mg, tables, s, mir[:, :, :f])
+    out = out.astype(jnp.float32)  # activations between layers stay f32
     return out if last else jax.nn.relu(out)
 
 
 def dist_gat_forward(mesh, mg, tables, params, x, key, drop_rate: float,
-                     train: bool, nn_only: bool = False):
+                     train: bool, nn_only: bool = False, compute_dtype=None):
     n = len(params)
     for i, layer in enumerate(params):
         x = dist_gat_layer(
             mesh, mg, tables, layer["W"], layer["a"], x, i == n - 1,
-            nn_only=nn_only,
+            nn_only=nn_only, compute_dtype=compute_dtype,
         )
         if train and i < n - 1:
             x = dropout(jax.random.fold_in(key, i), x, drop_rate, train)
@@ -175,6 +188,13 @@ class DistGATTrainer(ToolkitBase):
         masked_nll = self.masked_nll_loss
         adam_cfg = self.adam_cfg
         forward = type(self).model_forward_fn
+        if cfg.precision == "bfloat16":
+            # PRECISION:bfloat16 — same compute policy as the GCN family:
+            # bf16 matmuls + exchange (the all_to_all ships half the
+            # bytes), f32 params/activations, wide accumulation
+            from functools import partial as _partial
+
+            forward = _partial(forward, compute_dtype=jnp.bfloat16)
 
         # ``tables`` (O(E) sharded slot/dst/weight/mask arrays) rides the
         # jit boundary as an ARGUMENT — closure capture would inline it

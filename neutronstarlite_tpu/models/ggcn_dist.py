@@ -21,7 +21,7 @@ import jax.numpy as jnp
 from neutronstarlite_tpu.models.base import register_algorithm
 from neutronstarlite_tpu.models.gat_dist import DistGATTrainer
 from neutronstarlite_tpu.models.ggcn import GGCN_LEAKY_SLOPE, init_ggcn_params
-from neutronstarlite_tpu.nn.layers import dropout
+from neutronstarlite_tpu.nn.layers import compute_cast, dropout
 from neutronstarlite_tpu.parallel import dist_edge_ops as deo
 from neutronstarlite_tpu.utils.logging import get_logger
 
@@ -29,15 +29,20 @@ log = get_logger("ggcn_dist")
 
 
 def dist_ggcn_layer(mesh, mg, tables, layer, x, last: bool,
-                    nn_only: bool = False):
-    h = x @ layer["W"]  # [P*vp, f']
+                    nn_only: bool = False, compute_dtype=None):
+    # PRECISION:bfloat16 policy shared with dist_gat_layer (see its
+    # docstring): bf16 matmuls + exchange + chain, f32 params, f32
+    # per-dst accumulation, f32 activations at layer boundaries
+    cast = compute_cast(compute_dtype)
+    x = cast(x)
+    h = x @ cast(layer["W"])  # [P*vp, f']
     f = h.shape[1]
-    hs = h @ layer["Ws"]  # source half of the decomposed edge NN
-    hd = h @ layer["Wd"]  # dst half, stays local
+    hs = h @ cast(layer["Ws"])  # source half of the decomposed edge NN
+    hd = h @ cast(layer["Wd"])  # dst half, stays local
     if nn_only:
         # DEBUGINFO nn-only program: graph-op chain replaced by a zero
         # aggregate at the same shape (models/debuginfo.py)
-        out = jnp.zeros_like(h)
+        out = jnp.zeros_like(h, dtype=jnp.float32)
         return out if last else jax.nn.relu(out)
     payload = jnp.concatenate([h, hs], axis=1)
     if mesh is None:
@@ -60,15 +65,16 @@ def dist_ggcn_layer(mesh, mg, tables, layer, x, last: bool,
         score = jax.nn.leaky_relu(e_hs + e_hd, negative_slope=GGCN_LEAKY_SLOPE)
         a = deo.dist_edge_softmax(mesh, mg, tables, score)
         out = deo.dist_aggregate_dst_fuse_weight(mesh, mg, tables, a, mir[:, :, :f])
+    out = out.astype(jnp.float32)  # activations between layers stay f32
     return out if last else jax.nn.relu(out)
 
 
 def dist_ggcn_forward(mesh, mg, tables, params, x, key, drop_rate: float,
-                      train: bool, nn_only: bool = False):
+                      train: bool, nn_only: bool = False, compute_dtype=None):
     n = len(params)
     for i, layer in enumerate(params):
         x = dist_ggcn_layer(mesh, mg, tables, layer, x, i == n - 1,
-                            nn_only=nn_only)
+                            nn_only=nn_only, compute_dtype=compute_dtype)
         if train and i < n - 1:
             x = dropout(jax.random.fold_in(key, i), x, drop_rate, train)
     return x

@@ -41,6 +41,16 @@ def batch_norm_apply(
     return xn * p["gamma"] + p["beta"]
 
 
+def compute_cast(compute_dtype):
+    """The PRECISION compute-cast primitive: identity when compute_dtype is
+    None, else astype — ONE definition for every model family's bf16
+    policy (gat_dist/ggcn_dist; gcn.py's differs structurally by keeping
+    bf16 activations between layers)."""
+    if compute_dtype is None:
+        return lambda t: t
+    return lambda t: t.astype(compute_dtype)
+
+
 def dropout(key: jax.Array, x: jax.Array, rate: float, train: bool) -> jax.Array:
     if not train or rate <= 0.0:
         return x

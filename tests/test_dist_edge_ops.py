@@ -504,3 +504,41 @@ def test_bsp_call_width_matches_runtime_semantics():
             # 128-multiple width achieving it
             n_ch = -(-f // fc_max)
             assert fc == -(-(-(-f // n_ch)) // 128) * 128, (fc, fc_max, f)
+
+
+@multidevice
+def test_dist_gat_bf16_tracks_f32(rng):
+    """PRECISION:bfloat16 on the dist edge-chain models (round 5): bf16
+    matmuls + exchange + chain with f32 params and wide accumulation must
+    track the f32 run's loss closely and converge identically well (the
+    GCN family's policy extended to GAT/GGCN)."""
+    from neutronstarlite_tpu.graph.dataset import GNNDatum
+    from neutronstarlite_tpu.graph.synthetic import planted_partition_graph
+    from neutronstarlite_tpu.models.gat_dist import DistGATTrainer
+    from neutronstarlite_tpu.utils.config import InputInfo
+
+    v_num, classes, f = 96, 3, 8
+    src, dst, feature, label = planted_partition_graph(
+        v_num, classes, avg_degree=10, feature_size=f, seed=17
+    )
+    mask = (np.arange(v_num) % 3).astype(np.int32)
+    datum = GNNDatum(feature=feature, label=label.astype(np.int32), mask=mask)
+
+    def run(precision):
+        cfg = InputInfo()
+        cfg.vertices = v_num
+        cfg.layer_string = f"{f}-10-{classes}"
+        cfg.epochs = 10
+        cfg.learn_rate = 0.02
+        cfg.drop_rate = 0.0
+        cfg.decay_epoch = -1
+        cfg.partitions = 4
+        cfg.precision = precision
+        return DistGATTrainer.from_arrays(cfg, src, dst, datum).run()
+
+    out32 = run("")
+    out16 = run("bfloat16")
+    assert np.isfinite(out16["loss"]), out16
+    np.testing.assert_allclose(out16["loss"], out32["loss"], rtol=0.05,
+                               atol=0.02)
+    assert out16["acc"]["train"] >= out32["acc"]["train"] - 0.05
