@@ -119,6 +119,21 @@ class DistGATTrainer(ToolkitBase):
     def init_model_params(self, key):
         return init_gat_params(key, self.cfg.layer_sizes())
 
+    @classmethod
+    def bind_forward(cls, cfg):
+        """The forward fn with the cfg's precision policy bound — ONE
+        definition shared by build_model and tools/aot_check, so the AOT
+        capacity numbers always measure the program the trainer ships."""
+        forward = cls.model_forward_fn
+        if cfg.precision == "bfloat16":
+            # PRECISION:bfloat16 — same compute policy as the GCN family:
+            # bf16 matmuls + exchange (the all_to_all ships half the
+            # bytes), f32 params/activations, wide accumulation
+            from functools import partial
+
+            forward = partial(forward, compute_dtype=jnp.bfloat16)
+        return forward
+
     def build_model(self) -> None:
         cfg = self.cfg
         self.mesh, P = self.resolve_mesh()
@@ -187,14 +202,7 @@ class DistGATTrainer(ToolkitBase):
         drop_rate = cfg.drop_rate
         masked_nll = self.masked_nll_loss
         adam_cfg = self.adam_cfg
-        forward = type(self).model_forward_fn
-        if cfg.precision == "bfloat16":
-            # PRECISION:bfloat16 — same compute policy as the GCN family:
-            # bf16 matmuls + exchange (the all_to_all ships half the
-            # bytes), f32 params/activations, wide accumulation
-            from functools import partial as _partial
-
-            forward = _partial(forward, compute_dtype=jnp.bfloat16)
+        forward = type(self).bind_forward(cfg)
 
         # ``tables`` (O(E) sharded slot/dst/weight/mask arrays) rides the
         # jit boundary as an ARGUMENT — closure capture would inline it

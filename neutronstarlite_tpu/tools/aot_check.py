@@ -277,7 +277,9 @@ def _dist_edge_case(cfg, base_dir, mesh, edges=None):
         alpha=cfg.learn_rate, weight_decay=cfg.weight_decay,
         decay_rate=cfg.decay_rate, decay_epoch=cfg.decay_epoch,
     )
-    forward = cls.model_forward_fn
+    # the cfg's precision policy comes pre-bound by the trainer's own
+    # classmethod — the tool cannot drift from the shipped program
+    forward = cls.bind_forward(cfg)
     masked_nll = cls.masked_nll_loss
     drop_rate = cfg.drop_rate
 

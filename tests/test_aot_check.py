@@ -100,3 +100,30 @@ def test_dist_spec_parity_with_trainer(rng):
     b = jax.tree.map(sig, real)
     assert jax.tree.structure(a) == jax.tree.structure(b)
     assert jax.tree.leaves(a) == jax.tree.leaves(b)
+
+
+def test_bind_forward_precision_gate():
+    """The bf16 binding (ONE definition: DistGATTrainer.bind_forward,
+    shared with tools/aot_check) engages exactly on PRECISION:bfloat16
+    and passes compute_dtype through to the layer fn."""
+    import functools
+
+    import jax.numpy as jnp
+
+    from neutronstarlite_tpu.models.gat_dist import (
+        DistGATTrainer,
+        dist_gat_forward,
+    )
+    from neutronstarlite_tpu.models.ggcn_dist import DistGGCNTrainer
+    from neutronstarlite_tpu.utils.config import InputInfo
+
+    cfg = InputInfo()
+    assert DistGATTrainer.bind_forward(cfg) is dist_gat_forward  # f32: unbound
+    cfg.precision = "bfloat16"
+    bound = DistGATTrainer.bind_forward(cfg)
+    assert isinstance(bound, functools.partial)
+    assert bound.keywords == {"compute_dtype": jnp.bfloat16}
+    # GGCN inherits the binding with ITS forward
+    gbound = DistGGCNTrainer.bind_forward(cfg)
+    assert gbound.func is DistGGCNTrainer.model_forward_fn
+    assert gbound.keywords == {"compute_dtype": jnp.bfloat16}
