@@ -14,13 +14,17 @@ import jax
 from neutronstarlite_tpu.models.base import register_algorithm
 from neutronstarlite_tpu.models.commnet import init_commnet_params
 from neutronstarlite_tpu.models.gcn_dist import DistGCNTrainer
+from neutronstarlite_tpu.nn.layers import compute_cast
 from neutronstarlite_tpu.nn.layers import dropout
 
 
-def commnet_layer_nn(i, n_layers, layer, agg, x_in, valid_mask, key, drop_rate, train):
+def commnet_layer_nn(i, n_layers, layer, agg, x_in, valid_mask, key,
+                     drop_rate, train, compute_dtype=None):
     """Communication step over the exchanged aggregate — identical math to
     the single-chip twin (models/commnet.py:commnet_forward)."""
-    h = jax.nn.relu(agg @ layer["C"] + x_in @ layer["H"])
+    cast = compute_cast(compute_dtype)
+    agg, x_in = cast(agg), cast(x_in)
+    h = jax.nn.relu(agg @ cast(layer["C"]) + x_in @ cast(layer["H"]))
     if train and i < n_layers - 1:
         h = dropout(jax.random.fold_in(key, i), h, drop_rate, train)
     return h

@@ -29,7 +29,7 @@ from jax.sharding import NamedSharding, PartitionSpec as PS
 
 from neutronstarlite_tpu.models.base import ToolkitBase, register_algorithm
 from neutronstarlite_tpu.models.gcn import init_gcn_params
-from neutronstarlite_tpu.nn.layers import batch_norm_apply, dropout
+from neutronstarlite_tpu.nn.layers import batch_norm_apply, compute_cast, dropout
 from neutronstarlite_tpu.nn.param import AdamConfig, adam_init, adam_update
 from neutronstarlite_tpu.parallel.dist_graph import DistGraph
 from neutronstarlite_tpu.parallel.dist_ops import dist_gather_dst_from_src
@@ -40,14 +40,20 @@ from neutronstarlite_tpu.utils.timing import get_time
 log = get_logger("gcn_dist")
 
 
-def gcn_layer_nn(i, n_layers, layer, agg, x_in, valid_mask, key, drop_rate, train):
+def gcn_layer_nn(i, n_layers, layer, agg, x_in, valid_mask, key, drop_rate,
+                 train, compute_dtype=None):
     """GCN's per-layer NN over the exchanged aggregate (the reference's
-    vertexForward, GCN_CPU.hpp:215-228)."""
+    vertexForward, GCN_CPU.hpp:215-228). ``compute_dtype=bf16`` runs bn +
+    matmul in bf16 and RETURNS bf16, so the next layer's exchange ships
+    half the bytes (the single-chip family's policy, models/gcn.py)."""
+    cast = compute_cast(compute_dtype)
+    agg = cast(agg)
     if i == n_layers - 1:
-        return agg @ layer["W"]
+        return agg @ cast(layer["W"])
     if "bn" in layer:
-        agg = batch_norm_apply(layer["bn"], agg, valid_mask=valid_mask)
-    h = jax.nn.relu(agg @ layer["W"])
+        agg = batch_norm_apply(jax.tree.map(cast, layer["bn"]), agg,
+                               valid_mask=valid_mask)
+    h = jax.nn.relu(agg @ cast(layer["W"]))
     return dropout(jax.random.fold_in(key, i), h, drop_rate, train)
 
 
@@ -64,6 +70,7 @@ def dist_gcn_forward(
     layer_nn=gcn_layer_nn,
     eager: bool = False,
     no_exchange: bool = False,
+    compute_dtype=None,
 ):
     """``blocks`` selects the exchange: the [P, P, Eb] 3-tuple is the
     ppermute ring, a DistEllPair is the OPTIM_KERNEL gather-only path, the
@@ -121,6 +128,12 @@ def dist_gcn_forward(
             mesh, dist.partitions, dist.vp, dist.edge_chunk, blocks, v
         )
 
+    # PRECISION:bfloat16 — the layer_nn returns bf16 activations, so the
+    # exchange (ring ppermute / all_gather / all_to_all) ships HALF the
+    # bytes; every exchange's per-vertex reduction carries an explicit f32
+    # accumulator (ring bodies, ELL K-reduction, split-mirror body), and
+    # the logits return f32
+    x = compute_cast(compute_dtype)(x)
     n_layers = len(params)
     for i, layer in enumerate(params):
         if eager:
@@ -128,13 +141,13 @@ def dist_gcn_forward(
             # result (layer_nn's ``agg`` argument is the raw input here)
             x = exchange(
                 layer_nn(i, n_layers, layer, x, x, valid_mask, key,
-                         drop_rate, train)
+                         drop_rate, train, compute_dtype=compute_dtype)
             )
         else:
             h = exchange(x)
             x = layer_nn(i, n_layers, layer, h, x, valid_mask, key,
-                         drop_rate, train)
-    return x
+                         drop_rate, train, compute_dtype=compute_dtype)
+    return x.astype(jnp.float32)
 
 
 @register_algorithm("GCNDIST", "GCNTPUDIST")
@@ -334,6 +347,9 @@ class DistGCNTrainer(ToolkitBase):
         adam_cfg = self.adam_cfg
         layer_nn = type(self).layer_nn
         eager = type(self).eager
+        # PRECISION:bfloat16 -> bf16 exchange + NN compute (f32 params,
+        # wide accumulation, f32 logits)
+        compute_dtype = jnp.bfloat16 if cfg.precision == "bfloat16" else None
 
         # ``blocks`` (the O(E) sharded edge arrays) is a jit ARGUMENT, not a
         # closure: captured arrays are inlined into the HLO as constants,
@@ -344,7 +360,7 @@ class DistGCNTrainer(ToolkitBase):
             def loss_fn(p):
                 logits = dist_gcn_forward(
                     mesh, dist, blocks, p, feature, valid, key, drop_rate,
-                    True, layer_nn, eager,
+                    True, layer_nn, eager, compute_dtype=compute_dtype,
                 )
                 return masked_nll(logits, label, train01), logits
 
@@ -356,7 +372,7 @@ class DistGCNTrainer(ToolkitBase):
         def eval_logits(params, blocks, feature, valid, key):
             return dist_gcn_forward(
                 mesh, dist, blocks, params, feature, valid, key, 0.0, False,
-                layer_nn, eager,
+                layer_nn, eager, compute_dtype=compute_dtype,
             )
 
         self._train_step = train_step
@@ -369,6 +385,7 @@ class DistGCNTrainer(ToolkitBase):
             logits = dist_gcn_forward(
                 mesh, dist, blocks, params, feature, valid, key, drop_rate,
                 True, layer_nn, eager, no_exchange=no_exchange,
+                compute_dtype=compute_dtype,
             )
             return masked_nll(logits, label, train01)
 

@@ -121,6 +121,15 @@ class DistGCNCacheTrainer(ToolkitBase):
     def build_model(self) -> None:
         cfg = self.cfg
         self.mesh, P = self.resolve_mesh()
+        if cfg.precision == "bfloat16":
+            # loud, not silent: the DepCache exchange keeps f32 (the
+            # cached/fetched slot layout has no bf16 form yet); a user
+            # expecting the half-wire PRECISION behavior of the other dist
+            # trainers must learn the knob did nothing here
+            log.warning(
+                "PRECISION:bfloat16 is not implemented for the DepCache "
+                "trainer (%s); running f32", cfg.algorithm
+            )
 
         # PROC_REP off => threshold above any degree => no hot slots, pure
         # communication; the build degenerates to the plain MirrorGraph.

@@ -18,19 +18,22 @@ import jax
 from neutronstarlite_tpu.models.base import register_algorithm
 from neutronstarlite_tpu.models.gcn_dist import DistGCNTrainer
 from neutronstarlite_tpu.models.gin import init_gin_params
-from neutronstarlite_tpu.nn.layers import batch_norm_apply, dropout
+from neutronstarlite_tpu.nn.layers import batch_norm_apply, compute_cast, dropout
 
 
-def gin_layer_nn(i, n_layers, layer, agg, x_in, valid_mask, key, drop_rate, train):
+def gin_layer_nn(i, n_layers, layer, agg, x_in, valid_mask, key, drop_rate,
+                 train, compute_dtype=None):
     """GIN vertexForward over the exchanged aggregate: MLP((agg + x)) with
     bn on every layer's output, relu/dropout on hidden layers only — the
     same structure as the single-chip twin (models/gin.py:gin_forward),
     with the dist valid-mask excluded from the bn statistics."""
-    h = jax.nn.relu((agg + x_in) @ layer["W1"])
-    h = h @ layer["W2"]
+    cast = compute_cast(compute_dtype)
+    agg, x_in = cast(agg), cast(x_in)
+    h = jax.nn.relu((agg + x_in) @ cast(layer["W1"]))
+    h = h @ cast(layer["W2"])
     if i < n_layers - 1:
         h = jax.nn.relu(h)
-    h = batch_norm_apply(layer["bn"], h, valid_mask=valid_mask)
+    h = batch_norm_apply(jax.tree.map(cast, layer["bn"]), h, valid_mask=valid_mask)
     if train and i < n_layers - 1:
         h = dropout(jax.random.fold_in(key, i), h, drop_rate, train)
     return h

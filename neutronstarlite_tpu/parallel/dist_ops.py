@@ -42,7 +42,10 @@ def _ring_aggregate_local(block_src, block_dst, block_weight, x_local, *,
     """Per-device body. block_* are [P, Eb] (this device's dst row), x_local
     is [vp, f] (this device's feature shard)."""
     p = lax.axis_index(PARTITION_AXIS)
-    acc = jnp.zeros((vp, x_local.shape[1]), dtype=x_local.dtype)
+    # accumulate WIDE regardless of the exchange dtype (bf16 ships half
+    # the ppermute bytes; the per-vertex sum must not round per term —
+    # r5 review caught the bf16 accumulator here)
+    acc = jnp.zeros((vp, x_local.shape[1]), dtype=jnp.float32)
     cur = x_local
     fwd_perm = [(i, (i - 1) % partitions) for i in range(partitions)]
     for s in range(partitions):
@@ -55,7 +58,7 @@ def _ring_aggregate_local(block_src, block_dst, block_weight, x_local, *,
         )
         if s != partitions - 1:
             cur = lax.ppermute(cur, PARTITION_AXIS, fwd_perm)
-    return acc
+    return acc.astype(x_local.dtype)
 
 
 def _ring_aggregate_local_steps(step_blocks, x_local, *,
@@ -64,7 +67,8 @@ def _ring_aggregate_local_steps(step_blocks, x_local, *,
     already this device's block for ring step s (row p of the stacked
     [P, Eb_s] arrays), so there is no dynamic block indexing and each step
     pays only its own diagonal's padding (DistGraph.step_blocks)."""
-    acc = jnp.zeros((vp, x_local.shape[1]), dtype=x_local.dtype)
+    # f32 accumulator for the same reason as _ring_aggregate_local
+    acc = jnp.zeros((vp, x_local.shape[1]), dtype=jnp.float32)
     cur = x_local
     fwd_perm = [(i, (i - 1) % partitions) for i in range(partitions)]
     for s, (src, dst, w) in enumerate(step_blocks):
@@ -73,7 +77,7 @@ def _ring_aggregate_local_steps(step_blocks, x_local, *,
         )
         if s != partitions - 1:
             cur = lax.ppermute(cur, PARTITION_AXIS, fwd_perm)
-    return acc
+    return acc.astype(x_local.dtype)
 
 
 def dist_gather_dst_from_src(

@@ -170,11 +170,14 @@ def _dist_gcn_case(cfg, base_dir, mesh, edges=None):
     )
     masked_nll = DistGCNTrainer.masked_nll_loss
     drop_rate = cfg.drop_rate
+    # same precision binding as DistGCNTrainer.build_model
+    compute_dtype = jnp.bfloat16 if cfg.precision == "bfloat16" else None
 
     def train_step(params, opt_state, blocks, feature, label, train01, valid, key):
         def loss_fn(p):
             logits = dist_gcn_forward(
-                mesh, dist, blocks, p, feature, valid, key, drop_rate, True
+                mesh, dist, blocks, p, feature, valid, key, drop_rate, True,
+                compute_dtype=compute_dtype,
             )
             return masked_nll(logits, label, train01), logits
 

@@ -329,3 +329,45 @@ def test_dist_debuginfo_report(rng):
     }
     assert all(np.isfinite(v) and v >= 0 for v in vals.values()), vals
     assert vals["#all_train_step_time"] >= vals["#forward_time"] * 0.5
+
+
+@multidevice
+@pytest.mark.parametrize("comm_layer", ["ring", "ell", "mirror"])
+def test_dist_gcn_bf16_tracks_f32(rng, comm_layer):
+    """PRECISION:bfloat16 on the dist GCN engine (round 5): the exchange
+    ships bf16 activations (half the wire) on every comm layer while
+    params stay f32 and reductions accumulate wide — losses must track
+    the f32 run closely on the same data."""
+    from neutronstarlite_tpu.graph.dataset import GNNDatum
+    from neutronstarlite_tpu.graph.synthetic import planted_partition_graph
+    from neutronstarlite_tpu.models.base import get_algorithm
+    from neutronstarlite_tpu.utils.config import InputInfo
+
+    v_num, classes, f = 96, 3, 8
+    src, dst, feature, label = planted_partition_graph(
+        v_num, classes, avg_degree=10, feature_size=f, seed=21
+    )
+    mask = (np.arange(v_num) % 3).astype(np.int32)
+    datum = GNNDatum(feature=feature, label=label.astype(np.int32), mask=mask)
+
+    def run(precision):
+        cfg = InputInfo()
+        cfg.algorithm = "GCNDIST"
+        cfg.vertices = v_num
+        cfg.layer_string = f"{f}-10-{classes}"
+        cfg.epochs = 10
+        cfg.learn_rate = 0.02
+        cfg.drop_rate = 0.0
+        cfg.decay_epoch = -1
+        cfg.partitions = 4
+        cfg.comm_layer = comm_layer
+        cfg.precision = precision
+        tr = get_algorithm("GCNDIST").from_arrays(cfg, src, dst, datum)
+        return tr.run()
+
+    out32 = run("")
+    out16 = run("bfloat16")
+    assert np.isfinite(out16["loss"]), out16
+    np.testing.assert_allclose(out16["loss"], out32["loss"], rtol=0.05,
+                               atol=0.02)
+    assert out16["acc"]["train"] >= out32["acc"]["train"] - 0.05
