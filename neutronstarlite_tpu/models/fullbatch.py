@@ -51,8 +51,21 @@ class FullBatchTrainer(ToolkitBase):
         trainer-specific tables (GAT adds attention slot maps)."""
         return compute_graph
 
+    # trainers whose model_forward consumes cfg.precision (GCN family);
+    # the single-chip edge-chain models (GAT/GGCN/GIN/CommNet) run f32 —
+    # their op bodies are dtype-polymorphic but the accumulate-wide audit
+    # the dist chains got (round 5) has not been done for the single-chip
+    # custom_vjps, so the knob warns instead of silently half-applying
+    supports_precision = False
+
     def build_model(self) -> None:
         cfg = self.cfg
+        if cfg.precision == "bfloat16" and not type(self).supports_precision:
+            log.warning(
+                "PRECISION:bfloat16 is not implemented for the single-chip "
+                "%s trainer; running f32 (the dist twin supports it)",
+                cfg.algorithm,
+            )
         self.compute_graph = self.graph
         if self._wants_ell():
             # drop the (unused on this path) DeviceGraph edge arrays BEFORE

@@ -103,6 +103,7 @@ def gcn_forward(
 @register_algorithm("GCNCPU", "GCN", "GCNTPU")
 class GCNTrainer(FullBatchTrainer):
     supports_optim_kernel = True
+    supports_precision = True  # gcn_forward consumes cfg.precision
     weight_mode = "gcn_norm"
     eager = False
     with_bn = True

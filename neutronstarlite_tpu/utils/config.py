@@ -216,6 +216,13 @@ class InputInfo:
         elif key == "PARTITIONS":
             self.partitions = int(value)
         elif key == "PRECISION":
+            # validated like CKPT_BACKEND: a typo'd value (bf16, bfloat)
+            # would otherwise silently train f32 while the user benchmarks
+            # it as bf16 (r5 review)
+            if value not in ("float32", "bfloat16"):
+                raise ValueError(
+                    f"PRECISION must be float32 or bfloat16, got {value!r}"
+                )
             self.precision = value
         elif key == "CHECKPOINT_DIR":
             self.checkpoint_dir = value
