@@ -144,6 +144,12 @@ def test_stale_emission_content(tmp_path, monkeypatch, capsys):
     assert "every sweep config failed" in rec["extra"]["stale_reason"]
     assert rec["extra"]["measured_at"]
     assert "measured_at" not in rec  # moved into extra, schema unchanged
+    # round 5: the MEASURED same-host CPU baseline rides the stale record
+    # so even a chip-down round ships a real anchor (ref 276.84 s/epoch
+    # np=1 CPU from baseline/results/summary.json)
+    anchor = rec["extra"].get("cpu_anchor")
+    assert anchor and anchor["reference_np1_cpu_epoch_s"] > 0
+    assert "baseline/run_baseline.py" in anchor["source"]
 
 
 def test_bench_sample_contract(tmp_path, monkeypatch, capsys):
