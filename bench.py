@@ -480,6 +480,19 @@ def worker_main(args) -> int:
     )
     build_s = time.time() - t0
     epoch_s, result = _timed_run(trainer, args.warmup)
+    # the obs run_summary (epoch attribution, phase buckets, wire/memory
+    # counters) rides the worker JSON so the supervisor can attach it
+    # under extra.metrics; a salvage path (run() died mid-epoch) still
+    # finalizes from whatever was recorded
+    metrics_rec = getattr(trainer, "run_summary_record", None)
+    if metrics_rec is None:
+        try:
+            metrics_rec = trainer.finalize_metrics(
+                result if isinstance(result, dict) else None
+            )
+        except Exception as e:  # telemetry must never fail the measurement
+            print(f"metrics finalize failed: {e}", file=sys.stderr, flush=True)
+            metrics_rec = None
     print(json.dumps({
         "epoch_s": round(epoch_s, 4),
         "loss": result.get("loss"),
@@ -488,6 +501,7 @@ def worker_main(args) -> int:
         "tables_s": round(tables_s, 1),
         "build_s": round(build_s, 1),
         "device": str(jax.devices()[0]),
+        "metrics": metrics_rec,
     }))
     return 0
 
@@ -773,12 +787,19 @@ def main(argv=None) -> int:
     layers = len(sizes) - 1
     edges_per_sec_per_chip = e_num * layers * 2 / (epoch_s * n_chips)
 
+    # per-sweep-config run_summary records would bloat the one-line JSON;
+    # only the reported measurement keeps its attribution record
+    for r in sweep_results:
+        if r is not rec:
+            r.pop("metrics", None)
+
     out = {
         "metric": "gcn_reddit_full_batch_epoch_time",
         "value": round(epoch_s, 4),
         "unit": "s",
         "vs_baseline": round(BASELINE_EPOCH_S / epoch_s, 3),
         "extra": {
+            "metrics": rec.pop("metrics", None),
             "v_num": v_num,
             "e_num": e_num,
             "layers": LAYERS,

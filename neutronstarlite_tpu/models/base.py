@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from neutronstarlite_tpu import obs
 from neutronstarlite_tpu.graph.dataset import GNNDatum
 from neutronstarlite_tpu.graph.storage import CSCGraph, build_graph, load_edges
 from neutronstarlite_tpu.ops.device_graph import DeviceGraph
@@ -88,6 +89,13 @@ class ToolkitBase:
         # this; reference analog: the per-epoch loss lines GCN_CPU.hpp
         # prints each epoch
         self.loss_history: list = []
+        # run-metrics registry (obs/): counters + the per-epoch JSONL
+        # stream under NTS_METRICS_DIR; every run loop emits epoch events
+        # and one consolidated run_summary via finalize_metrics()
+        self.metrics = obs.open_run(
+            cfg.algorithm or type(self).__name__, cfg=cfg, seed=seed
+        )
+        self.run_summary_record: Optional[dict] = None
 
     # dist trainers build their own partitioned layout; the single-device
     # DeviceGraph upload would be O(E) wasted HBM for them
@@ -409,6 +417,56 @@ class ToolkitBase:
         name = {0: "Train", 1: "Eval", 2: "Test"}[which]
         log.info("%s Acc: %f %d %d", name, acc, n, correct)
         return acc
+
+    # ---- run metrics -----------------------------------------------------
+    def emit_epoch(self, epoch: int, seconds: float, loss=None, **extra):
+        """Record one trained epoch in the metrics stream (run loops call
+        this right after appending to epoch_times/loss_history)."""
+        return self.metrics.epoch_event(
+            epoch, seconds,
+            loss=float(loss) if loss is not None else None, **extra,
+        )
+
+    def record_epoch_wire(self, epoch: int, seconds: float, loss,
+                          bytes_fwd: int, exchanges: int, **extra):
+        """Epoch event + live wire counters in one step — the shared tail
+        of every dist trainer's epoch loop, so the counter names and the
+        event fields can never drift between trainers."""
+        self.metrics.counter_add("wire.bytes_fwd", bytes_fwd)
+        self.metrics.counter_add("wire.exchanges", exchanges)
+        return self.emit_epoch(
+            epoch, seconds, loss, wire_bytes_fwd=bytes_fwd, **extra
+        )
+
+    def finalize_metrics(self, result: Optional[dict] = None) -> dict:
+        """Emit the consolidated run_summary record (idempotent: a second
+        call returns the first record). Aggregates epoch timings,
+        compile-vs-steady-state attribution, phase buckets, the counter/
+        gauge snapshot (wire volume), device memory, and the final result.
+        """
+        if self.run_summary_record is not None:
+            return self.run_summary_record
+        from neutronstarlite_tpu.obs import collectors
+
+        fields: dict = {
+            "epochs": len(self.epoch_times),
+            "epoch_time": collectors.steady_state_stats(self.epoch_times),
+            "avg_epoch_s": self.avg_epoch_time(),
+            "epoch_times_s": [float(t) for t in self.epoch_times],
+            "loss_history": [float(v) for v in self.loss_history],
+            "phases": collectors.phase_snapshot(self.timers),
+            "memory": collectors.device_memory_stats(),
+            "compile_cache": collectors.compile_cache_info(),
+        }
+        if result is not None:
+            fields["result"] = {
+                "loss": result.get("loss"),
+                "acc": result.get("acc"),
+                "avg_epoch_s": result.get("avg_epoch_s"),
+            }
+        self.run_summary_record = self.metrics.run_summary(**fields)
+        self.metrics.close()
+        return self.run_summary_record
 
     # ---- run -------------------------------------------------------------
     def run(self):

@@ -257,8 +257,10 @@ class FullBatchTrainer(ToolkitBase):
                 self.label, self._train_mask01, ekey,
             )
             jax.block_until_ready(loss)
-            self.epoch_times.append(get_time() - t0)
+            dt = get_time() - t0
+            self.epoch_times.append(dt)
             self.loss_history.append(float(loss))
+            self.emit_epoch(epoch, dt, loss)
             if epoch % max(1, cfg.epochs // 20) == 0 or epoch == cfg.epochs - 1:
                 # per-epoch Train/Eval/Test accuracy from the training
                 # forward's logits, the reference's oracle cadence
@@ -301,8 +303,10 @@ class FullBatchTrainer(ToolkitBase):
         )
         # loss is None when a checkpoint restore resumed at/after cfg.epochs
         # (zero epochs ran): still report the restored model's accuracy
-        return {
+        result = {
             "loss": float(loss) if loss is not None else float("nan"),
             "acc": accs,
             "avg_epoch_s": avg,
         }
+        self.finalize_metrics(result)
+        return result

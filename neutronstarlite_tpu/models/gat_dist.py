@@ -119,6 +119,12 @@ class DistGATTrainer(ToolkitBase):
     def init_model_params(self, key):
         return init_gat_params(key, self.cfg.layer_sizes())
 
+    @staticmethod
+    def mirror_payload_width(f_out: int) -> int:
+        """Columns shipped per mirror row in the per-layer all_to_all:
+        GAT's payload is [h || h.a_src] (f'+1); GGCN overrides (2f')."""
+        return f_out + 1
+
     @classmethod
     def bind_forward(cls, cfg):
         """The forward fn with the cfg's precision policy bound — ONE
@@ -197,6 +203,30 @@ class DistGATTrainer(ToolkitBase):
             decay_epoch=cfg.decay_epoch,
         )
         self.opt_state = jax.tree.map(lambda a: put(a, rsh), adam_init(params))
+
+        # live wire counters (obs): the mirror all_to_all ships the
+        # compacted payload rows at each layer's payload width; priced by
+        # the same row formula tools/wire_accounting reports offline.
+        # ``wire.simulated=1`` marks the collective-free sim rig, where
+        # the volume is what WOULD cross a real interconnect.
+        from neutronstarlite_tpu.tools.wire_accounting import (
+            exchange_rows_per_device,
+        )
+
+        sizes = cfg.layer_sizes()
+        rows = exchange_rows_per_device(
+            "mirror", self.mg.partitions, self.mg.vp, self.mg.mb
+        )
+        cols = sum(type(self).mirror_payload_width(f) for f in sizes[1:])
+        itemsize = 2 if cfg.precision == "bfloat16" else 4
+        self._wire_exchanges_per_epoch = len(sizes) - 1
+        self._wire_bytes_fwd_per_epoch = rows * cols * itemsize
+        self.metrics.gauge_set("wire.comm_layer", "mirror")
+        self.metrics.gauge_set("wire.rows_per_layer", rows)
+        self.metrics.gauge_set(
+            "wire.bytes_per_epoch_fwd", self._wire_bytes_fwd_per_epoch
+        )
+        self.metrics.gauge_set("wire.simulated", int(self.mesh is None))
 
         mesh, mg, tables = self.mesh, self.mg, self.tables
         drop_rate = cfg.drop_rate
@@ -302,8 +332,13 @@ class DistGATTrainer(ToolkitBase):
                 ekey,
             )
             jax.block_until_ready(loss)
-            self.epoch_times.append(get_time() - t0)
+            dt = get_time() - t0
+            self.epoch_times.append(dt)
             self.loss_history.append(float(loss))
+            self.record_epoch_wire(
+                epoch, dt, loss, self._wire_bytes_fwd_per_epoch,
+                self._wire_exchanges_per_epoch,
+            )
             self.ckpt_epoch_end(epoch)
             if epoch % max(1, cfg.epochs // 20) == 0 or epoch == cfg.epochs - 1:
                 log.info("Epoch %d loss %f", epoch, float(loss))
@@ -319,8 +354,10 @@ class DistGATTrainer(ToolkitBase):
             log.info("%s", self.debug_info(key))
         # loss is None when a checkpoint restore resumed at/after cfg.epochs
         # (zero epochs ran): still report the restored model's accuracy
-        return {
+        result = {
             "loss": float(loss) if loss is not None else float("nan"),
             "acc": accs,
             "avg_epoch_s": avg,
         }
+        self.finalize_metrics(result)
+        return result

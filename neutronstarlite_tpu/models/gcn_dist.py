@@ -317,6 +317,32 @@ class DistGCNTrainer(ToolkitBase):
             else:
                 self.blocks = self.dist.shard(self.mesh)
 
+        # live wire counters (obs): per-epoch forward exchange volume at
+        # the actual per-layer exchange widths, priced by the SAME row
+        # formula tools/wire_accounting reports offline — the run loop
+        # increments these each epoch. The backward pass re-runs each
+        # exchange (transposed), mirroring the forward volume; counters
+        # carry the forward direction, run_summary documents the 2x.
+        from neutronstarlite_tpu.tools.wire_accounting import (
+            exchange_rows_per_device,
+        )
+
+        sizes = cfg.layer_sizes()
+        rows = exchange_rows_per_device(
+            layer_kind, P, self.dist.vp, getattr(self.dist, "mb", 0)
+        )
+        # standard order exchanges each layer's INPUT width; eager
+        # (NN-then-exchange) ships the post-matmul widths
+        widths = sizes[1:] if type(self).eager else sizes[:-1]
+        itemsize = 2 if cfg.precision == "bfloat16" else 4
+        self._wire_exchanges_per_epoch = len(widths)
+        self._wire_bytes_fwd_per_epoch = rows * sum(widths) * itemsize
+        self.metrics.gauge_set("wire.comm_layer", layer_kind)
+        self.metrics.gauge_set("wire.rows_per_layer", rows)
+        self.metrics.gauge_set(
+            "wire.bytes_per_epoch_fwd", self._wire_bytes_fwd_per_epoch
+        )
+
         # padded, sharded vertex-space data
         pad = self.dist.pad_vertex_array
         vsh = NamedSharding(self.mesh, PS(PARTITION_AXIS, None))
@@ -472,8 +498,13 @@ class DistGCNTrainer(ToolkitBase):
                 ekey,
             )
             jax.block_until_ready(loss)
-            self.epoch_times.append(get_time() - t0)
+            dt = get_time() - t0
+            self.epoch_times.append(dt)
             self.loss_history.append(float(loss))
+            self.record_epoch_wire(
+                epoch, dt, loss, self._wire_bytes_fwd_per_epoch,
+                self._wire_exchanges_per_epoch,
+            )
             self.ckpt_epoch_end(epoch)
             if epoch % max(1, cfg.epochs // 20) == 0 or epoch == cfg.epochs - 1:
                 log.info("Epoch %d loss %f", epoch, float(loss))
@@ -496,11 +527,13 @@ class DistGCNTrainer(ToolkitBase):
             log.info("%s", self.debug_info(key))
         # loss is None when a checkpoint restore resumed at/after cfg.epochs
         # (zero epochs ran): still report the restored model's accuracy
-        return {
+        result = {
             "loss": float(loss) if loss is not None else float("nan"),
             "acc": accs,
             "avg_epoch_s": avg,
         }
+        self.finalize_metrics(result)
+        return result
 
 
 @register_algorithm("GCNEAGERDIST", "GCNDISTEAGER", "GCNEAGERTPUDIST")

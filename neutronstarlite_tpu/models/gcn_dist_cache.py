@@ -264,6 +264,42 @@ class DistGCNCacheTrainer(ToolkitBase):
 
         self._refresh_caches = refresh_caches
 
+        # live wire counters (obs): the DepCache split prices partial
+        # fetches at mf rows and full fetches at mb rows per remote chunk
+        # (same formula tools/wire_accounting reports offline); the run
+        # loop picks per epoch, since refresh epochs re-fetch everything
+        from neutronstarlite_tpu.tools.wire_accounting import (
+            exchange_rows_per_device,
+        )
+
+        vp = getattr(self.cmg, "vp", 0)
+        self._wire_widths = cfg.layer_sizes()[:-1]
+        self._rows_full = exchange_rows_per_device(
+            "mirror", self.cmg.partitions, vp, self.cmg.mb
+        )
+        self._rows_partial = exchange_rows_per_device(
+            "mirror", self.cmg.partitions, vp, self.cmg.mf
+        )
+        self.metrics.gauge_set("wire.comm_layer", "mirror+depcache")
+        self.metrics.gauge_set("wire.rows_per_layer_full", self._rows_full)
+        self.metrics.gauge_set(
+            "wire.rows_per_layer_partial", self._rows_partial
+        )
+        self.metrics.gauge_set("wire.simulated", int(self.mesh is None))
+
+    def _epoch_wire_bytes_fwd(self, use_cached: bool, refresh: bool) -> int:
+        """Forward exchange bytes for one epoch at the f32 slot layout:
+        layer 0 serves hot rows from the exact replica, deep layers from
+        the historical cache when active; a refresh epoch adds a
+        full-fetch eval forward."""
+        widths = self._wire_widths
+        l0 = self._rows_partial if self.cached0 is not None else self._rows_full
+        deep = self._rows_partial if use_cached else self._rows_full
+        n = 4 * (l0 * widths[0] + deep * sum(widths[1:]))
+        if refresh:
+            n += 4 * self._rows_full * sum(widths)
+        return n
+
     def run(self) -> Dict[str, Any]:
         cfg = self.cfg
         key = jax.random.PRNGKey(self.seed + 1)
@@ -295,8 +331,15 @@ class DistGCNCacheTrainer(ToolkitBase):
                 self.cached0, self.caches if use_cached else None, ekey,
             )
             jax.block_until_ready(loss)
-            self.epoch_times.append(get_time() - t0)
+            dt = get_time() - t0
+            self.epoch_times.append(dt)
             self.loss_history.append(float(loss))
+            self.record_epoch_wire(
+                epoch, dt, loss,
+                self._epoch_wire_bytes_fwd(use_cached, refresh),
+                len(self._wire_widths) * (2 if refresh else 1),
+                cache_refresh=bool(refresh),
+            )
             self.ckpt_epoch_end(epoch)
             if epoch % max(1, cfg.epochs // 20) == 0 or epoch == cfg.epochs - 1:
                 log.info("Epoch %d loss %f", epoch, float(loss))
@@ -309,8 +352,10 @@ class DistGCNCacheTrainer(ToolkitBase):
         accs = self.dist_eval_report(logits_p, self.label_p, self.mask_p, self.valid_p)
         avg = self.avg_epoch_time()
         log.info("--avg epoch time %.4f s", avg)
-        return {
+        result = {
             "loss": float(loss) if loss is not None else float("nan"),
             "acc": accs,
             "avg_epoch_s": avg,
         }
+        self.finalize_metrics(result)
+        return result

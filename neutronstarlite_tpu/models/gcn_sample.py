@@ -171,6 +171,19 @@ class GCNSampleTrainer(ToolkitBase):
         self._train_step = train_batch  # uniform tools/aot_check hook name
         self._eval_batch = eval_batch
 
+        # live wire counters (obs): the minibatch path's data movement is
+        # the host->device gather of the padded input-node feature rows
+        # (capacity, not realized rows — the shape actually shipped).
+        # Priced at the STORED table dtype: the gather reads f32 rows and
+        # only the post-gather cast narrows, so bf16 runs move the same
+        # bytes here
+        itemsize = int(np.dtype(self.datum.feature.dtype).itemsize)
+        self._gather_bytes_per_batch = caps[0] * sizes[0] * itemsize
+        self.metrics.gauge_set(
+            "wire.feature_gather_bytes_per_batch",
+            self._gather_bytes_per_batch,
+        )
+
     def aot_args(self):
         """The exact argument tuple run() passes to the jitted per-batch
         train step (tools/aot_check lowers it for a topology without
@@ -222,9 +235,19 @@ class GCNSampleTrainer(ToolkitBase):
                 )
                 losses.append(loss)
             jax.block_until_ready(loss)
-            self.epoch_times.append(get_time() - t0)
+            dt = get_time() - t0
+            self.epoch_times.append(dt)
             self.loss_history.append(
                 float(np.mean([float(l) for l in losses]))
+            )
+            gather_bytes = len(losses) * self._gather_bytes_per_batch
+            self.metrics.counter_add("sample.batches", len(losses))
+            self.metrics.counter_add(
+                "wire.feature_gather_bytes", gather_bytes
+            )
+            self.emit_epoch(
+                epoch, dt, self.loss_history[-1],
+                batches=len(losses), feature_gather_bytes=gather_bytes,
             )
             if epoch % max(1, cfg.epochs // 10) == 0 or epoch == cfg.epochs - 1:
                 log.info(
@@ -242,4 +265,6 @@ class GCNSampleTrainer(ToolkitBase):
         }
         avg = float(np.mean(self.epoch_times[1:])) if len(self.epoch_times) > 1 else 0.0
         log.info("--avg epoch time %.4f s", avg)
-        return {"loss": float(loss), "acc": accs, "avg_epoch_s": avg}
+        result = {"loss": float(loss), "acc": accs, "avg_epoch_s": avg}
+        self.finalize_metrics(result)
+        return result

@@ -88,3 +88,9 @@ class DistGGCNTrainer(DistGATTrainer):
 
     def init_model_params(self, key):
         return init_ggcn_params(key, self.cfg.layer_sizes())
+
+    @staticmethod
+    def mirror_payload_width(f_out: int) -> int:
+        """GGCN's mirror payload is [h || Ws.h] — 2f' columns per row
+        (wire-counter pricing; see DistGATTrainer.mirror_payload_width)."""
+        return 2 * f_out

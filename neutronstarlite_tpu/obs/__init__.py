@@ -1,0 +1,37 @@
+"""Unified run-metrics subsystem (the observability spine).
+
+The reference attributes every epoch to compute/copy/wait/comm buckets via
+its ``DEBUGINFO()`` report (toolkits/GCN.hpp:308-353). This package gives the
+TPU port one machine-readable telemetry surface over the signals that were
+previously scattered across utils/timing (host phase timers),
+models/debuginfo (bucket decomposition), tools/wire_accounting (exchange
+volume) and ad-hoc bench prints:
+
+- :class:`MetricsRegistry` — counters, gauges, timing summaries, plus a
+  structured per-epoch JSONL event stream written under ``NTS_METRICS_DIR``;
+- :mod:`collectors` — device memory, compile-vs-steady-state step
+  attribution, phase-timer snapshots;
+- :mod:`schema` — the JSONL event schema and its validator (tests and
+  tools/metrics_report consume it).
+
+Every trainer run emits one ``run_summary`` record; ``tools/metrics_report``
+renders one or more streams into the reference-shaped ``#key=value(ms)``
+report and a cross-run comparison table. See docs/OBSERVABILITY.md.
+"""
+
+from neutronstarlite_tpu.obs.registry import (
+    MetricsRegistry,
+    config_fingerprint,
+    metrics_dir,
+    open_run,
+)
+from neutronstarlite_tpu.obs.schema import SCHEMA_VERSION, validate_event
+
+__all__ = [
+    "MetricsRegistry",
+    "SCHEMA_VERSION",
+    "config_fingerprint",
+    "metrics_dir",
+    "open_run",
+    "validate_event",
+]

@@ -1,0 +1,228 @@
+"""MetricsRegistry: counters/gauges/timings + the per-run JSONL event sink.
+
+One registry per trainer run (ToolkitBase constructs it). Metric state is
+always accumulated in memory — snapshots ride inside the ``run_summary``
+record that run()/bench.py attach to their results — and the JSONL event
+stream is additionally written to disk when ``NTS_METRICS_DIR`` is set.
+Multi-host: every process writes its own file (the name carries the JAX
+process index), so rank streams never interleave; tools/metrics_report
+accepts any number of files.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from neutronstarlite_tpu.obs.schema import SCHEMA_VERSION
+from neutronstarlite_tpu.utils.logging import get_logger, process_index
+
+log = get_logger("obs")
+
+
+def metrics_dir() -> Optional[str]:
+    """The JSONL output directory (``NTS_METRICS_DIR``), or None."""
+    return os.environ.get("NTS_METRICS_DIR") or None
+
+
+def config_fingerprint(cfg: Any) -> str:
+    """Stable 12-hex-digit digest of a run configuration (InputInfo, dict,
+    or any attribute bag) — the cross-run join key in metrics_report."""
+    if cfg is None:
+        return "none"
+    if dataclasses.is_dataclass(cfg) and not isinstance(cfg, type):
+        d = dataclasses.asdict(cfg)
+    elif isinstance(cfg, dict):
+        d = cfg
+    else:
+        d = {k: v for k, v in vars(cfg).items() if not k.startswith("_")}
+    blob = json.dumps(d, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:12]
+
+
+class _TimingStat:
+    """Streaming summary of observed durations (count/total/min/max)."""
+
+    __slots__ = ("count", "total_s", "min_s", "max_s")
+
+    def __init__(self) -> None:
+        self.count = 0
+        self.total_s = 0.0
+        self.min_s = float("inf")
+        self.max_s = 0.0
+
+    def observe(self, seconds: float) -> None:
+        self.count += 1
+        self.total_s += seconds
+        self.min_s = min(self.min_s, seconds)
+        self.max_s = max(self.max_s, seconds)
+
+    def as_dict(self) -> Dict[str, float]:
+        return {
+            "count": self.count,
+            "total_s": self.total_s,
+            "min_s": self.min_s if self.count else 0.0,
+            "max_s": self.max_s,
+            "avg_s": self.total_s / self.count if self.count else 0.0,
+        }
+
+
+class MetricsRegistry:
+    """Counters, gauges, timing summaries, and the JSONL event writer."""
+
+    def __init__(
+        self,
+        run_id: str,
+        algorithm: str = "",
+        fingerprint: str = "",
+        path: Optional[str] = None,
+    ) -> None:
+        self.run_id = run_id
+        self.algorithm = algorithm
+        self.fingerprint = fingerprint
+        self.path = path
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = {}
+        self._gauges: Dict[str, Any] = {}
+        self._timings: Dict[str, _TimingStat] = {}
+        self._seq = 0
+        # the sink opens LAZILY on the first substantive event (anything
+        # beyond run_start): tools that construct trainers without running
+        # them (aot_check, tests) must not litter NTS_METRICS_DIR with
+        # run_start-only streams or leak open handles. run_start lines are
+        # buffered and flushed with the first real write.
+        self._fh = None
+        self._pending: list = []
+        self.summary: Optional[Dict[str, Any]] = None
+
+    # ---- metric primitives ----------------------------------------------
+    def counter_add(self, name: str, value: float = 1.0) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0.0) + value
+
+    def gauge_set(self, name: str, value: Any) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    def observe(self, name: str, seconds: float) -> None:
+        with self._lock:
+            stat = self._timings.get(name)
+            if stat is None:
+                stat = self._timings[name] = _TimingStat()
+            stat.observe(float(seconds))
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "timings": {k: t.as_dict() for k, t in self._timings.items()},
+            }
+
+    # ---- event stream ----------------------------------------------------
+    def event(self, kind: str, **fields: Any) -> Dict[str, Any]:
+        """Emit one structured event; returns the record (written as one
+        JSONL line when a sink is open)."""
+        with self._lock:
+            seq = self._seq
+            self._seq += 1
+        rec: Dict[str, Any] = {
+            "event": kind,
+            "run_id": self.run_id,
+            "schema": SCHEMA_VERSION,
+            "ts": time.time(),
+            "seq": seq,
+        }
+        rec.update(fields)
+        if self.path is not None:
+            line = json.dumps(rec, default=str) + "\n"
+            if self._fh is None and kind == "run_start":
+                self._pending.append(line)
+            else:
+                try:
+                    if self._fh is None:
+                        self._fh = open(self.path, "a", encoding="utf-8")
+                        for p in self._pending:
+                            self._fh.write(p)
+                        self._pending.clear()
+                        log.info("metrics stream: %s", self.path)
+                    self._fh.write(line)
+                    self._fh.flush()
+                except OSError as e:  # telemetry must never kill a run
+                    log.warning("metrics write failed (%s); disabling sink", e)
+                    self._fh = None
+                    self.path = None
+        return rec
+
+    def epoch_event(
+        self, epoch: int, seconds: float, loss: Optional[float] = None,
+        **extra: Any,
+    ) -> Dict[str, Any]:
+        self.observe("epoch", seconds)
+        return self.event(
+            "epoch",
+            epoch=int(epoch),
+            seconds=float(seconds),
+            loss=float(loss) if loss is not None else None,
+            **extra,
+        )
+
+    def run_summary(self, **fields: Any) -> Dict[str, Any]:
+        """Emit the consolidated end-of-run record (metric snapshot + the
+        caller's aggregates); kept on ``self.summary``."""
+        snap = self.snapshot()
+        rec = self.event(
+            "run_summary",
+            algorithm=self.algorithm,
+            fingerprint=self.fingerprint,
+            counters=snap["counters"],
+            gauges=snap["gauges"],
+            timings=snap["timings"],
+            **fields,
+        )
+        self.summary = rec
+        return rec
+
+    def close(self) -> None:
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            finally:
+                self._fh = None
+
+
+def open_run(algorithm: str, cfg: Any = None, seed: int = 0) -> MetricsRegistry:
+    """Registry for one trainer run; opens the JSONL sink when
+    ``NTS_METRICS_DIR`` is set and emits the ``run_start`` event."""
+    fingerprint = config_fingerprint(cfg)
+    rank = process_index()
+    run_id = f"{(algorithm or 'run').lower()}-{fingerprint}-{os.getpid()}"
+    path = None
+    d = metrics_dir()
+    if d:
+        try:
+            os.makedirs(d, exist_ok=True)
+            fname = (
+                f"{time.strftime('%Y%m%d-%H%M%S')}-{run_id}-p{rank}.jsonl"
+            )
+            path = os.path.join(d, fname)
+        except OSError as e:
+            log.warning("NTS_METRICS_DIR %r unusable (%s); metrics stay "
+                        "in-memory only", d, e)
+            path = None
+    reg = MetricsRegistry(run_id, algorithm=algorithm,
+                          fingerprint=fingerprint, path=path)
+    reg.event(
+        "run_start",
+        algorithm=algorithm,
+        fingerprint=fingerprint,
+        seed=seed,
+        process_index=rank,
+        pid=os.getpid(),
+    )
+    return reg

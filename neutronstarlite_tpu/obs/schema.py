@@ -1,0 +1,113 @@
+"""The JSONL event schema (versioned) and its validator.
+
+Every line a MetricsRegistry writes is one JSON object carrying the common
+envelope plus kind-specific fields. tests/test_metrics.py validates live
+runs against this module; tools/metrics_report uses it to reject garbage
+before rendering. The schema is deliberately narrow — it pins the fields
+consumers rely on and allows extra keys (forward compatibility).
+
+Envelope (all events):
+  event: str       one of run_start | epoch | run_summary (open set)
+  run_id: str      "<algo>-<fingerprint>-<pid>"
+  schema: int      SCHEMA_VERSION
+  ts: float        wall-clock seconds (time.time())
+  seq: int         per-run monotonically increasing sequence number
+
+epoch:
+  epoch: int >= 0, seconds: number > 0, loss: number | null
+
+run_summary:
+  algorithm: str, fingerprint: str,
+  counters/gauges/timings: objects (the registry snapshot),
+  epochs: int >= 0,
+  epoch_time: object with first_s / warm_median_s / compile_overhead_s
+              (nullable when fewer than 2 epochs ran),
+  phases: object  name -> {total_s, count}  (PhaseTimers snapshot),
+  memory: object  with "available" bool; explicit nulls where the backend
+          exposes no memory_stats (CPU)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+SCHEMA_VERSION = 1
+
+_ENVELOPE = ("event", "run_id", "schema", "ts", "seq")
+
+
+def _fail(msg: str) -> None:
+    raise ValueError(f"metrics schema: {msg}")
+
+
+def _require_number(obj: Dict[str, Any], key: str, allow_none: bool = False):
+    v = obj.get(key)
+    if v is None and allow_none:
+        return
+    if not isinstance(v, (int, float)) or isinstance(v, bool):
+        _fail(f"{obj.get('event')}.{key} must be a number, got {v!r}")
+
+
+def validate_event(obj: Any) -> None:
+    """Raise ValueError when ``obj`` is not a valid metrics event."""
+    if not isinstance(obj, dict):
+        _fail(f"event must be an object, got {type(obj).__name__}")
+    for key in _ENVELOPE:
+        if key not in obj:
+            _fail(f"missing envelope field {key!r} in {obj!r}")
+    if not isinstance(obj["event"], str) or not obj["event"]:
+        _fail("event kind must be a non-empty string")
+    if obj["schema"] != SCHEMA_VERSION:
+        _fail(f"schema version {obj['schema']!r} != {SCHEMA_VERSION}")
+    if not isinstance(obj["run_id"], str) or not obj["run_id"]:
+        _fail("run_id must be a non-empty string")
+    _require_number(obj, "ts")
+    if not isinstance(obj["seq"], int) or obj["seq"] < 0:
+        _fail(f"seq must be a non-negative int, got {obj['seq']!r}")
+
+    kind = obj["event"]
+    if kind == "epoch":
+        if not isinstance(obj.get("epoch"), int) or obj["epoch"] < 0:
+            _fail(f"epoch.epoch must be a non-negative int, got "
+                  f"{obj.get('epoch')!r}")
+        _require_number(obj, "seconds")
+        if obj["seconds"] <= 0:
+            _fail(f"epoch.seconds must be > 0, got {obj['seconds']!r}")
+        _require_number(obj, "loss", allow_none=True)
+    elif kind == "run_summary":
+        for key in ("algorithm", "fingerprint"):
+            if not isinstance(obj.get(key), str):
+                _fail(f"run_summary.{key} must be a string")
+        for key in ("counters", "gauges", "timings", "phases"):
+            if not isinstance(obj.get(key), dict):
+                _fail(f"run_summary.{key} must be an object")
+        if not isinstance(obj.get("epochs"), int) or obj["epochs"] < 0:
+            _fail("run_summary.epochs must be a non-negative int")
+        et = obj.get("epoch_time")
+        if not isinstance(et, dict):
+            _fail("run_summary.epoch_time must be an object")
+        for key in ("first_s", "warm_median_s", "compile_overhead_s"):
+            if key not in et:
+                _fail(f"run_summary.epoch_time missing {key!r}")
+            _require_number(et, key, allow_none=True)
+        mem = obj.get("memory")
+        if not isinstance(mem, dict) or not isinstance(
+            mem.get("available"), bool
+        ):
+            _fail("run_summary.memory must be an object with an "
+                  "'available' bool")
+    elif kind == "run_start":
+        if not isinstance(obj.get("algorithm"), str):
+            _fail("run_start.algorithm must be a string")
+        if not isinstance(obj.get("fingerprint"), str):
+            _fail("run_start.fingerprint must be a string")
+
+
+def validate_stream(events) -> int:
+    """Validate an iterable of events; returns the count (ValueError on the
+    first bad record)."""
+    n = 0
+    for obj in events:
+        validate_event(obj)
+        n += 1
+    return n

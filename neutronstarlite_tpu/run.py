@@ -49,6 +49,19 @@ def main(argv=None) -> int:
     result = toolkit.run()
     print(toolkit.report())
     log.info("result: %s", result)
+    # every run ends with one consolidated run_summary record (obs/);
+    # run loops emit it themselves — this covers any trainer that predates
+    # the metrics integration
+    if getattr(toolkit, "run_summary_record", None) is None and hasattr(
+        toolkit, "finalize_metrics"
+    ):
+        toolkit.finalize_metrics(result if isinstance(result, dict) else None)
+    if getattr(toolkit, "metrics", None) is not None and toolkit.metrics.path:
+        log.info(
+            "run metrics: %s (render with python -m "
+            "neutronstarlite_tpu.tools.metrics_report %s)",
+            toolkit.metrics.path, toolkit.metrics.path,
+        )
     return 0
 
 

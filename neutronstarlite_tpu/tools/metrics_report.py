@@ -1,0 +1,235 @@
+"""Render obs JSONL metric streams into the reference-shaped report.
+
+The reference prints its epoch attribution as ``#key=value(ms)`` lines from
+DEBUGINFO() (toolkits/GCN.hpp:308-353). This CLI reads one or more JSONL
+files written under ``NTS_METRICS_DIR`` (or directories of them), validates
+each record against the obs schema, and renders:
+
+- per run: the ``#key=value(ms)`` block — epoch timing attribution
+  (first/warm/compile-overhead), the PhaseTimers buckets, then non-time
+  counters (wire bytes, batches) and memory as ``#key=value`` lines;
+- across runs: a comparison table keyed by run_id/algorithm/fingerprint.
+
+A file with epoch events but no run_summary (killed run) still renders:
+the summary is synthesized from the epoch events, marked ``(synthesized)``.
+
+Usage:
+  python -m neutronstarlite_tpu.tools.metrics_report <file-or-dir> [...]
+      [--json]
+Exit code 0 when every input yielded a report; 1 when nothing usable was
+found (or any input was unreadable).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from neutronstarlite_tpu.obs import schema  # noqa: E402
+from neutronstarlite_tpu.obs.collectors import steady_state_stats  # noqa: E402
+
+
+def expand_paths(args: List[str]) -> List[str]:
+    out: List[str] = []
+    for a in args:
+        if os.path.isdir(a):
+            out.extend(sorted(glob.glob(os.path.join(a, "*.jsonl"))))
+        else:
+            out.append(a)
+    return out
+
+
+def load_events(path: str) -> List[Dict[str, Any]]:
+    """Parse + validate one JSONL file; bad lines are reported to stderr
+    and skipped (a crashed writer may leave a torn final line)."""
+    events: List[Dict[str, Any]] = []
+    with open(path, "r", encoding="utf-8") as fh:
+        for ln, raw in enumerate(fh, 1):
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                obj = json.loads(raw)
+                schema.validate_event(obj)
+            except (json.JSONDecodeError, ValueError) as e:
+                print(f"{path}:{ln}: skipping bad record: {e}",
+                      file=sys.stderr)
+                continue
+            events.append(obj)
+    return events
+
+
+def summarize(path: str, events: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """The run_summary record for one stream (last one wins), synthesized
+    from epoch events when the run died before finalizing."""
+    summaries = [e for e in events if e["event"] == "run_summary"]
+    if summaries:
+        rec = dict(summaries[-1])
+        rec["synthesized"] = False
+        return rec
+    epochs = [e for e in events if e["event"] == "epoch"]
+    if not epochs:
+        return None
+    start = next((e for e in events if e["event"] == "run_start"), {})
+    times = [e["seconds"] for e in epochs]
+    losses = [e["loss"] for e in epochs if e.get("loss") is not None]
+    # same definition as ToolkitBase.avg_epoch_time: exclude the compile
+    # (first) epoch when more than one ran, so a synthesized summary is
+    # comparable to a finalized one under the same report key
+    warm = times[1:] if len(times) > 1 else times
+    return {
+        "event": "run_summary",
+        "run_id": epochs[-1]["run_id"],
+        "algorithm": start.get("algorithm", ""),
+        "fingerprint": start.get("fingerprint", ""),
+        "epochs": len(epochs),
+        "epoch_time": steady_state_stats(times),
+        "avg_epoch_s": sum(warm) / len(warm),
+        "epoch_times_s": times,
+        "loss_history": losses,
+        "phases": {},
+        "counters": {},
+        "gauges": {},
+        "timings": {},
+        "memory": {"available": False, "bytes_in_use": None,
+                   "peak_bytes_in_use": None, "devices": []},
+        "synthesized": True,
+    }
+
+
+def _ms(v: Optional[float]) -> str:
+    return f"{v * 1000:.3f}" if v is not None else "n/a"
+
+
+def render_run(path: str, rec: Dict[str, Any]) -> str:
+    """The reference-shaped #key=value(ms) block for one run."""
+    et = rec.get("epoch_time", {})
+    lines = [
+        f"== run {rec.get('run_id', '?')} "
+        f"[{rec.get('algorithm') or '?'} fp={rec.get('fingerprint') or '?'}]"
+        f"{' (synthesized)' if rec.get('synthesized') else ''} — {path}",
+        "--------------------finish algorithm !",
+        f"#epochs={rec.get('epochs', 0)}",
+        f"#avg_epoch_time={_ms(rec.get('avg_epoch_s'))}(ms)",
+        f"#first_epoch_time={_ms(et.get('first_s'))}(ms)",
+        f"#warm_median_epoch_time={_ms(et.get('warm_median_s'))}(ms)",
+        f"#compile_overhead={_ms(et.get('compile_overhead_s'))}(ms)",
+    ]
+    for name, ph in sorted((rec.get("phases") or {}).items()):
+        lines.append(
+            f"#{name}_time={_ms(ph.get('total_s'))}(ms) "
+            f"count={ph.get('count', 0)}"
+        )
+    for name, t in sorted((rec.get("timings") or {}).items()):
+        if name == "epoch":  # already attributed above
+            continue
+        lines.append(
+            f"#{name}_time={_ms(t.get('total_s'))}(ms) "
+            f"count={t.get('count', 0)} avg={_ms(t.get('avg_s'))}(ms)"
+        )
+    for name, v in sorted((rec.get("counters") or {}).items()):
+        v = int(v) if float(v).is_integer() else v
+        lines.append(f"#{name}={v}")
+    mem = rec.get("memory") or {}
+    if mem.get("available"):
+        lines.append(f"#peak_hbm_bytes={mem.get('peak_bytes_in_use')}")
+        lines.append(f"#hbm_bytes_in_use={mem.get('bytes_in_use')}")
+    else:
+        lines.append("#peak_hbm_bytes=null (backend exposes no memory_stats)")
+    loss = (rec.get("result") or {}).get("loss")
+    if loss is not None:
+        lines.append(f"#final_loss={loss}")
+    return "\n".join(lines)
+
+
+def render_table(rows: List[Dict[str, Any]]) -> str:
+    """Cross-run comparison keyed by run_id."""
+    header = ("run_id", "algo", "fp", "epochs", "warm_ms", "first_ms",
+              "wire_MiB", "peak_hbm_MiB")
+    table = [header]
+    for rec in rows:
+        et = rec.get("epoch_time", {})
+        counters = rec.get("counters") or {}
+        # None-checks, not truthiness: a legitimate 0 (P=1 dist run) must
+        # render as 0.00, distinguishable from "not instrumented"
+        wire = counters.get("wire.bytes_fwd")
+        if wire is None:
+            wire = counters.get("wire.feature_gather_bytes")
+        mem = rec.get("memory") or {}
+        peak = mem.get("peak_bytes_in_use")
+        table.append((
+            str(rec.get("run_id", "?"))[:40],
+            str(rec.get("algorithm") or "?"),
+            str(rec.get("fingerprint") or "?")[:12],
+            str(rec.get("epochs", 0)),
+            _ms(et.get("warm_median_s")),
+            _ms(et.get("first_s")),
+            f"{wire / 2**20:.2f}" if wire is not None else "n/a",
+            f"{peak / 2**20:.1f}" if peak is not None else "n/a",
+        ))
+    widths = [max(len(r[i]) for r in table) for i in range(len(header))]
+    return "\n".join(
+        "  ".join(cell.ljust(w) for cell, w in zip(row, widths)).rstrip()
+        for row in table
+    )
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="render obs JSONL metric streams into the "
+        "reference-shaped #key=value(ms) report"
+    )
+    ap.add_argument("paths", nargs="+",
+                    help="JSONL file(s) or NTS_METRICS_DIR-style directories")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON line (the summaries) instead of text")
+    args = ap.parse_args(argv)
+
+    paths = expand_paths(args.paths)
+    if not paths:
+        print("no .jsonl inputs found", file=sys.stderr)
+        return 1
+    rows: List[Dict[str, Any]] = []
+    failed = False
+    for p in paths:
+        try:
+            events = load_events(p)
+        except OSError as e:
+            print(f"{p}: {e}", file=sys.stderr)
+            failed = True
+            continue
+        rec = summarize(p, events)
+        if rec is None:
+            # a run_start-only stream (trainer constructed/crashed before
+            # its first epoch) is skippable noise, not a render failure —
+            # but a directory yielding NOTHING still exits 1 below
+            print(f"{p}: no run_summary or epoch events; skipping",
+                  file=sys.stderr)
+            continue
+        rec["_path"] = p
+        rows.append(rec)
+    if not rows:
+        return 1
+    if args.json:
+        print(json.dumps(
+            [{k: v for k, v in r.items() if k != "_path"} for r in rows]
+        ))
+    else:
+        for rec in rows:
+            print(render_run(rec["_path"], rec))
+            print()
+        if len(rows) > 1:
+            print(render_table(rows))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

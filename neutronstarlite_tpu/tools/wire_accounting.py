@@ -42,6 +42,24 @@ if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
 
+def exchange_rows_per_device(kind: str, P: int, vp: int, mb: int = 0) -> int:
+    """Per-device per-layer RECEIVED remote feature rows for one exchange.
+
+    The single formula bridged into the live ``obs`` wire counters (dist
+    trainers) AND used by :func:`accounting` below, so the offline report
+    and the run-time telemetry can never disagree. Dense exchanges (ring
+    ppermute rotation, ell/blocked all_gather) deliver P-1 remote shard
+    chunks of ``vp`` rows; the mirror all_to_all delivers P-1 compacted
+    chunks of ``mb`` rows (the reference's active-only message
+    optimization, comm/network.cpp:505-518, as a layout property).
+    """
+    if P <= 1:
+        return 0
+    if kind in ("mirror", "mirror_uniform"):
+        return (P - 1) * mb
+    return (P - 1) * vp
+
+
 def accounting(g, P: int, f: int, refresh: int, budget_bytes: int,
                thresholds=None) -> dict:
     """All counts are per device per layer unless stated; bytes are f32
@@ -55,9 +73,9 @@ def accounting(g, P: int, f: int, refresh: int, budget_bytes: int,
     # the uniform price is kept as a row for the GAT/DepCache chains that
     # still use the [P, P*Mb] layout
     mb, _ = SplitMirror.estimate_mb_remote(g, P)
-    dense_rows = (P - 1) * vp
-    mirror_rows = (P - 1) * mb
-    mirror_uni_rows = (P - 1) * mb_uni
+    dense_rows = exchange_rows_per_device("ring", P, vp)
+    mirror_rows = exchange_rows_per_device("mirror", P, vp, mb)
+    mirror_uni_rows = exchange_rows_per_device("mirror", P, vp, mb_uni)
     out = {
         "P": P, "f": f, "vp": vp, "mb": mb, "mb_uniform": mb_uni,
         "layers": {

@@ -3,10 +3,16 @@
 The reference uses compile-time-leveled printf macros; here a thin wrapper over
 the stdlib logger keeps the same level vocabulary and a similar one-line format,
 controlled by the NTS_LOG_LEVEL environment variable.
+
+Multi-host attribution: every record carries the JAX process index (``p0``,
+``p1``, ...) so interleaved multi-host logs are attributable to a rank.
+``NTS_LOG_JSON=1`` switches to a structured one-JSON-object-per-line
+formatter (ts / level / logger / rank / msg) for log pipelines.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import sys
@@ -22,15 +28,66 @@ _LEVELS = {
 _configured = False
 
 
+def process_index() -> int:
+    """The JAX process index WITHOUT initializing a backend: multi-host
+    launches populate jax's distributed global state at
+    jax.distributed.initialize() time; reading it (unlike
+    ``jax.process_index()``) never triggers device discovery. Single-host
+    (or pre-init) callers get 0."""
+    try:
+        from jax._src import distributed
+
+        pid = getattr(distributed.global_state, "process_id", None)
+        if pid is not None:
+            return int(pid)
+    except Exception:
+        pass
+    return 0
+
+
+class _RankFilter(logging.Filter):
+    """Stamp every record with the process index (lazily: a rank resolved
+    at configure time would freeze p0 into records emitted before
+    jax.distributed.initialize())."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        record.rank = process_index()
+        return True
+
+
+class _JsonFormatter(logging.Formatter):
+    """One JSON object per line (NTS_LOG_JSON=1)."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        out = {
+            "ts": round(record.created, 3),
+            "level": record.levelname,
+            "logger": record.name,
+            "rank": getattr(record, "rank", 0),
+            "msg": record.getMessage(),
+        }
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        return json.dumps(out)
+
+
+def _make_formatter() -> logging.Formatter:
+    if os.environ.get("NTS_LOG_JSON", "0") == "1":
+        return _JsonFormatter()
+    return logging.Formatter(
+        "[%(levelname)s] p%(rank)d %(asctime)s %(name)s - %(message)s",
+        "%H:%M:%S",
+    )
+
+
 def _configure() -> None:
     global _configured
     if _configured:
         return
     level = _LEVELS.get(os.environ.get("NTS_LOG_LEVEL", "INFO").upper(), logging.INFO)
     handler = logging.StreamHandler(sys.stdout)
-    handler.setFormatter(
-        logging.Formatter("[%(levelname)s] %(asctime)s %(name)s - %(message)s", "%H:%M:%S")
-    )
+    handler.setFormatter(_make_formatter())
+    handler.addFilter(_RankFilter())
     root = logging.getLogger("nts")
     root.setLevel(level)
     root.addHandler(handler)

@@ -48,3 +48,8 @@ def honor_platform_env(min_devices: Optional[int] = None) -> None:
                 jax.config.update("jax_num_cpu_devices", n)
     except RuntimeError:
         pass  # backends already live; use whatever exists
+    except AttributeError:
+        # older jax: no jax_num_cpu_devices config option; the
+        # --xla_force_host_platform_device_count flag already in XLA_FLAGS
+        # (set by the caller alongside JAX_PLATFORMS) covers it
+        pass

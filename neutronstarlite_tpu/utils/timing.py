@@ -21,18 +21,26 @@ def get_time() -> float:
 
 
 class Timer:
-    """Accumulating timer: ``t.start(); ...; t.stop()`` sums elapsed time."""
+    """Accumulating timer: ``t.start(); ...; t.stop()`` sums elapsed time.
+
+    Re-entrant: nested/overlapping ``start()`` calls stack their start
+    times, so ``stop()`` always closes the innermost open span (a single
+    ``_t0`` slot silently overwrote the outer start and corrupted totals).
+    Nested same-name spans each add their own elapsed time to ``total``.
+    """
 
     def __init__(self) -> None:
         self.total = 0.0
-        self._t0 = 0.0
+        self._starts: list = []
         self.count = 0
 
     def start(self) -> None:
-        self._t0 = get_time()
+        self._starts.append(get_time())
 
     def stop(self) -> float:
-        dt = get_time() - self._t0
+        if not self._starts:
+            raise RuntimeError("Timer.stop() without a matching start()")
+        dt = get_time() - self._starts.pop()
         self.total += dt
         self.count += 1
         return dt
@@ -40,6 +48,7 @@ class Timer:
     def reset(self) -> None:
         self.total = 0.0
         self.count = 0
+        self._starts.clear()
 
 
 class PhaseTimers:
@@ -59,6 +68,14 @@ class PhaseTimers:
 
     def total(self, name: str) -> float:
         return self._timers[name].total
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        """{name: {total_s, count}} — the machine-readable twin of
+        report(), consumed by the obs run_summary record."""
+        return {
+            name: {"total_s": t.total, "count": t.count}
+            for name, t in sorted(self._timers.items())
+        }
 
     def report(self) -> str:
         lines = ["--------------------finish algorithm !"]

@@ -75,6 +75,11 @@ def test_worker_subprocess_contract(tmp_path, monkeypatch):
     assert info["epoch_s"] > 0
     assert len(info["epoch_times"]) == 2  # warmup + measured
     assert np.isfinite(info["loss"])
+    # the obs run_summary record rides the worker JSON — the supervisor
+    # attaches it under extra.metrics so BENCH_*.json carries attribution
+    assert info["metrics"]["event"] == "run_summary"
+    assert info["metrics"]["epochs"] == 2
+    assert info["metrics"]["epoch_time"]["first_s"] > 0
 
 
 def test_bench_matrix_measures_one_cfg():

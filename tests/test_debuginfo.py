@@ -1,0 +1,67 @@
+"""models/debuginfo report arithmetic + utils/profiling no-op path
+(ISSUE 1 satellite coverage)."""
+
+from __future__ import annotations
+
+import os
+import re
+
+from neutronstarlite_tpu.models.debuginfo import format_dist_report
+
+
+def _kv(report: str):
+    out = {}
+    for line in report.splitlines()[1:]:
+        key, _, val = line[1:].partition("=")
+        out[key] = val
+    return out
+
+
+def test_format_dist_report_buckets():
+    # well-ordered timings: every derived bucket is a plain difference
+    kv = _kv(format_dist_report(0.002, 0.010, 0.018, 0.020))
+    assert kv["nn_time"] == "2.000(ms)"
+    assert kv["graph_time"] == "8.000(ms)"
+    assert kv["forward_time"] == "10.000(ms)"
+    assert kv["backward_time"] == "8.000(ms)"
+    assert kv["update_time"] == "2.000(ms)"
+    assert kv["all_train_step_time"] == "20.000(ms)"
+
+
+def test_format_dist_report_clamps_at_zero():
+    # measurement jitter can order the medians t_nn > t_fwd > t_grad;
+    # derived buckets must clamp at 0, never go negative
+    kv = _kv(format_dist_report(0.010, 0.008, 0.005, 0.020))
+    assert kv["graph_time"] == "0.000(ms)"
+    assert kv["backward_time"] == "0.000(ms)"
+    assert kv["update_time"] == "15.000(ms)"
+
+
+def test_format_dist_report_line_format():
+    report = format_dist_report(0.001, 0.002, 0.003, 0.004)
+    lines = report.splitlines()
+    assert lines[0] == "DEBUGINFO:"
+    for line in lines[1:]:
+        # the reference-shaped #key=value(ms) lines metrics_report and the
+        # driver's log scrapers rely on
+        assert re.fullmatch(r"#[a-z_]+=\d+\.\d{3}\(ms\)", line), line
+
+
+def test_maybe_trace_noop_without_profile_dir(monkeypatch, tmp_path):
+    monkeypatch.delenv("NTS_PROFILE_DIR", raising=False)
+    from neutronstarlite_tpu.utils import profiling
+
+    assert profiling.profile_dir() is None
+    before = set(os.listdir(tmp_path))
+    with profiling.maybe_trace("unit-noop"):
+        pass  # must not start a profiler session or touch the filesystem
+    assert set(os.listdir(tmp_path)) == before
+
+
+def test_maybe_trace_emits_trace_when_dir_set(monkeypatch, tmp_path):
+    from neutronstarlite_tpu.utils import profiling
+
+    monkeypatch.setenv("NTS_PROFILE_DIR", str(tmp_path / "prof"))
+    with profiling.maybe_trace("unit"):
+        pass
+    assert (tmp_path / "prof" / "unit").is_dir()

@@ -1,0 +1,67 @@
+"""Timer/PhaseTimers re-entrancy regression (ISSUE 1 satellite).
+
+The old Timer kept ONE ``_t0`` slot: a nested/overlapping ``start()`` on
+the same named phase silently overwrote it, so the outer ``stop()``
+measured from the inner start and the accumulated totals were corrupted.
+Start times now stack.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from neutronstarlite_tpu.utils import timing
+
+
+def _fake_clock(monkeypatch, ticks):
+    it = iter(ticks)
+    monkeypatch.setattr(timing, "get_time", lambda: next(it))
+
+
+def test_timer_nested_start_stop_keeps_outer_span(monkeypatch):
+    _fake_clock(monkeypatch, [0.0, 1.0, 3.0, 6.0])
+    t = timing.Timer()
+    t.start()  # outer @ 0.0
+    t.start()  # inner @ 1.0
+    assert t.stop() == pytest.approx(2.0)  # inner: 3.0 - 1.0
+    # before the fix this measured from the INNER start (6.0 - 1.0)
+    assert t.stop() == pytest.approx(6.0)  # outer: 6.0 - 0.0
+    assert t.total == pytest.approx(8.0)
+    assert t.count == 2
+
+
+def test_timer_unbalanced_stop_raises():
+    t = timing.Timer()
+    with pytest.raises(RuntimeError):
+        t.stop()
+
+
+def test_timer_reset_clears_open_spans():
+    t = timing.Timer()
+    t.start()
+    t.reset()
+    assert t.total == 0.0 and t.count == 0
+    with pytest.raises(RuntimeError):
+        t.stop()
+
+
+def test_phase_timers_nested_same_phase(monkeypatch):
+    _fake_clock(monkeypatch, [0.0, 1.0, 2.0, 10.0])
+    pt = timing.PhaseTimers()
+    with pt.phase("agg"):
+        with pt.phase("agg"):
+            pass
+    # inner span 1.0 + outer span 10.0; the pre-fix accumulator lost the
+    # outer start and summed 1.0 + 9.0-from-inner-start instead
+    assert pt.total("agg") == pytest.approx(11.0)
+    snap = pt.snapshot()
+    assert snap["agg"] == {"total_s": pytest.approx(11.0), "count": 2}
+
+
+def test_phase_timers_report_shape():
+    pt = timing.PhaseTimers()
+    with pt.phase("load"):
+        pass
+    rep = pt.report()
+    assert rep.splitlines()[0] == "--------------------finish algorithm !"
+    assert "#load_time=" in rep and "(ms)" in rep
