@@ -400,8 +400,13 @@ def _make_trainer(
 
 
 def _timed_run(trainer, warmup):
+    from neutronstarlite_tpu.resilience.supervisor import supervised_run
+
     try:
-        result = trainer.run()
+        # supervised: per-epoch health guards + rollback/retry from the
+        # last good checkpoint (resilience/) — a transient NaN or hung
+        # step costs a rollback, not the measurement
+        result = supervised_run(trainer)
     except Exception as e:
         # a post-training failure (e.g. the remote compile service dying
         # during a later program's compile) must not discard epoch timings

@@ -20,6 +20,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from neutronstarlite_tpu import obs
+from neutronstarlite_tpu.resilience import events as res_events
+from neutronstarlite_tpu.resilience import guards as res_guards
 from neutronstarlite_tpu.graph.dataset import GNNDatum
 from neutronstarlite_tpu.graph.storage import CSCGraph, build_graph, load_edges
 from neutronstarlite_tpu.ops.device_graph import DeviceGraph
@@ -96,6 +98,9 @@ class ToolkitBase:
             cfg.algorithm or type(self).__name__, cfg=cfg, seed=seed
         )
         self.run_summary_record: Optional[dict] = None
+        # fault/recovery records from any layer (fault injection, guard
+        # trips, checkpoint quarantine) land in this trainer's stream
+        res_events.set_sink(self.metrics)
 
     # dist trainers build their own partitioned layout; the single-device
     # DeviceGraph upload would be O(E) wasted HBM for them
@@ -233,15 +238,12 @@ class ToolkitBase:
         return {"params": self.params, "opt": self.opt_state}
 
     def _ckpt_backend(self) -> str:
-        from neutronstarlite_tpu.utils.checkpoint import default_backend
+        # resolve_backend also degrades gracefully: orbax requested on a
+        # machine without orbax installed warns and falls back to npz
+        # instead of dying on a bare ImportError mid-run
+        from neutronstarlite_tpu.utils.checkpoint import resolve_backend
 
-        backend = self.cfg.ckpt_backend or default_backend()
-        if backend not in ("npz", "orbax"):
-            raise ValueError(
-                f"unknown checkpoint backend {backend!r} "
-                "(CKPT_BACKEND / NTS_CKPT_BACKEND: npz | orbax)"
-            )
-        return backend
+        return resolve_backend(self.cfg.ckpt_backend)
 
     def save(self, path: str, epoch: int) -> None:
         from neutronstarlite_tpu.utils.checkpoint import save_checkpoint
@@ -271,7 +273,35 @@ class ToolkitBase:
         sh = getattr(template, "sharding", None)
         return jax.device_put(a, sh) if sh is not None else a
 
+    def _validate_restored(self, state) -> None:
+        """Reject a checkpoint whose leaf shapes no longer match the model
+        (e.g. HIDDEN changed between save and resume) BEFORE the tree.map
+        — the raw failure is an opaque broadcast error deep inside
+        device_put; this one names the offending keys."""
+        mismatches = []
+        for name, template in (("params", self.params), ("opt", self.opt_state)):
+            got = state.get(name)
+            if got is None:
+                continue
+            t_leaves = jax.tree_util.tree_flatten_with_path(template)[0]
+            g_leaves = jax.tree_util.tree_flatten(got)[0]
+            for (path, t_leaf), g_leaf in zip(t_leaves, g_leaves):
+                t_shape = tuple(np.shape(t_leaf))
+                g_shape = tuple(np.shape(g_leaf))
+                if t_shape != g_shape:
+                    mismatches.append(
+                        f"{name}{jax.tree_util.keystr(path)}: "
+                        f"checkpoint {g_shape} vs model {t_shape}"
+                    )
+        if mismatches:
+            raise ValueError(
+                "checkpoint does not fit this model (did LAYERS/HIDDEN "
+                "change between save and resume?); mismatched leaves: "
+                + "; ".join(mismatches)
+            )
+
     def _apply_restored(self, state) -> None:
+        self._validate_restored(state)
         self.params = jax.tree.map(self._restore_like, self.params, state["params"])
         self.opt_state = jax.tree.map(self._restore_like, self.opt_state, state["opt"])
 
@@ -290,6 +320,58 @@ class ToolkitBase:
         return step
 
     def ckpt_begin(self) -> int:
+        """Resume epoch for the run loop (0 without CHECKPOINT_DIR); a
+        mid-run resume is recorded as a ``recovery(action=resume)`` obs
+        event — the successor process of a crash/preemption announcing it
+        picked the run back up — except during an in-process supervised
+        retry, whose rollback the supervisor already recorded.
+
+        A supervised retry also rewinds epoch_times/loss_history to the
+        resume point: they describe the LOGICAL training trajectory, and
+        the rolled-back attempt's tail (including the poisoned epoch)
+        must not double-count in run_summary's epoch aggregates. Registry
+        counters and timing histograms are deliberately NOT rewound —
+        they measure PHYSICAL work done (bytes actually shipped, epochs
+        actually executed, replays included); the
+        ``resilience.replayed_epochs`` counter records the gap so the two
+        views reconcile. The per-epoch JSONL stream keeps the full
+        history either way.
+
+        If the supervisor chose rollback but every retained checkpoint
+        failed verification (restore quarantined them all and returned
+        nothing), re-entering with the poisoned in-memory state would
+        burn every restart on the same fault — rebuild the model from
+        scratch instead."""
+        retry = getattr(self, "_supervised_retry", False)
+        start = self._ckpt_resume()
+        if retry:
+            if start == 0 and retry == "rollback":
+                log.warning(
+                    "supervised rollback found no restorable checkpoint "
+                    "under %s; rebuilding the model from scratch",
+                    self.cfg.checkpoint_dir,
+                )
+                self.build_model()
+                res_events.emit_recovery(action="restart", epoch=0)
+            first = getattr(self, "_first_epoch_trained", None)
+            keep = max(start - (first if first is not None else 0), 0)
+            replayed = len(self.epoch_times) - keep
+            if replayed > 0:
+                self.metrics.counter_add(
+                    "resilience.replayed_epochs", replayed
+                )
+            del self.epoch_times[keep:]
+            del self.loss_history[keep:]
+            if keep == 0:
+                # lists emptied (restart, or a fallback below the
+                # anchor): the next trained epoch re-anchors the mapping
+                self._first_epoch_trained = None
+        elif start > 0:
+            res_events.emit_recovery(action="resume", epoch=start)
+        self._supervised_retry = False
+        return start
+
+    def _ckpt_resume(self) -> int:
         """Resume epoch for the run loop (0 without CHECKPOINT_DIR).
 
         Multi-host: only process 0 writes checkpoints (save()), and
@@ -421,11 +503,22 @@ class ToolkitBase:
     # ---- run metrics -----------------------------------------------------
     def emit_epoch(self, epoch: int, seconds: float, loss=None, **extra):
         """Record one trained epoch in the metrics stream (run loops call
-        this right after appending to epoch_times/loss_history)."""
-        return self.metrics.epoch_event(
+        this right after appending to epoch_times/loss_history), then run
+        the per-epoch health guards (resilience/guards) — every run loop
+        funnels through here, so a guard trip always happens AFTER the
+        faulty epoch is visible in the stream and BEFORE ckpt_epoch_end
+        could persist a poisoned checkpoint. Guards only raise when armed
+        (supervised_run / NTS_GUARDS=1)."""
+        if getattr(self, "_first_epoch_trained", None) is None:
+            # anchor for mapping epoch numbers onto epoch_times indices
+            # (a crash-resumed trainer's first trained epoch is not 0)
+            self._first_epoch_trained = epoch
+        rec = self.metrics.epoch_event(
             epoch, seconds,
             loss=float(loss) if loss is not None else None, **extra,
         )
+        res_guards.epoch_check(self, epoch, seconds, loss)
+        return rec
 
     def record_epoch_wire(self, epoch: int, seconds: float, loss,
                           bytes_fwd: int, exchanges: int, **extra):

@@ -17,6 +17,7 @@ import numpy as np
 
 from neutronstarlite_tpu.models.base import ToolkitBase
 from neutronstarlite_tpu.nn.param import AdamConfig, adam_init, adam_update
+from neutronstarlite_tpu.resilience.faults import fault_point
 from neutronstarlite_tpu.utils.logging import get_logger
 from neutronstarlite_tpu.utils.timing import get_time
 
@@ -257,6 +258,9 @@ class FullBatchTrainer(ToolkitBase):
                 self.label, self._train_mask01, ekey,
             )
             jax.block_until_ready(loss)
+            # chaos hook (NTS_FAULT_SPEC): nan_loss/stall/crash fire here,
+            # before the loss reaches history, guards, or a checkpoint
+            loss = fault_point("epoch_loss", epoch=epoch, value=loss)
             dt = get_time() - t0
             self.epoch_times.append(dt)
             self.loss_history.append(float(loss))

@@ -28,6 +28,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as PS
 
 from neutronstarlite_tpu.models.base import ToolkitBase, register_algorithm
+from neutronstarlite_tpu.resilience.faults import fault_point
 from neutronstarlite_tpu.models.gat import LEAKY_SLOPE, init_gat_params
 from neutronstarlite_tpu.nn.layers import compute_cast, dropout
 from neutronstarlite_tpu.nn.param import AdamConfig, adam_init, adam_update
@@ -332,6 +333,9 @@ class DistGATTrainer(ToolkitBase):
                 ekey,
             )
             jax.block_until_ready(loss)
+            # chaos hook (NTS_FAULT_SPEC): nan_loss/stall/crash fire here,
+            # before the loss reaches history, guards, or a checkpoint
+            loss = fault_point("epoch_loss", epoch=epoch, value=loss)
             dt = get_time() - t0
             self.epoch_times.append(dt)
             self.loss_history.append(float(loss))

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from neutronstarlite_tpu.models.base import ToolkitBase, register_algorithm
+from neutronstarlite_tpu.resilience.faults import fault_point
 from neutronstarlite_tpu.nn.layers import dropout
 from neutronstarlite_tpu.nn.param import (
     AdamConfig,
@@ -235,11 +236,15 @@ class GCNSampleTrainer(ToolkitBase):
                 )
                 losses.append(loss)
             jax.block_until_ready(loss)
+            # chaos hook (NTS_FAULT_SPEC): nan_loss/stall/crash fire here,
+            # before the loss reaches history or the guards
+            epoch_loss = fault_point(
+                "epoch_loss", epoch=epoch,
+                value=float(np.mean([float(l) for l in losses])),
+            )
             dt = get_time() - t0
             self.epoch_times.append(dt)
-            self.loss_history.append(
-                float(np.mean([float(l) for l in losses]))
-            )
+            self.loss_history.append(float(epoch_loss))
             gather_bytes = len(losses) * self._gather_bytes_per_batch
             self.metrics.counter_add("sample.batches", len(losses))
             self.metrics.counter_add(

@@ -125,14 +125,16 @@ class MetricsRegistry:
             }
 
     # ---- event stream ----------------------------------------------------
-    def event(self, kind: str, **fields: Any) -> Dict[str, Any]:
+    def event(self, event_kind: str, **fields: Any) -> Dict[str, Any]:
         """Emit one structured event; returns the record (written as one
-        JSONL line when a sink is open)."""
+        JSONL line when a sink is open). The positional name avoids
+        colliding with a ``kind=`` payload field (fault records carry
+        one)."""
         with self._lock:
             seq = self._seq
             self._seq += 1
         rec: Dict[str, Any] = {
-            "event": kind,
+            "event": event_kind,
             "run_id": self.run_id,
             "schema": SCHEMA_VERSION,
             "ts": time.time(),
@@ -141,7 +143,7 @@ class MetricsRegistry:
         rec.update(fields)
         if self.path is not None:
             line = json.dumps(rec, default=str) + "\n"
-            if self._fh is None and kind == "run_start":
+            if self._fh is None and event_kind == "run_start":
                 self._pending.append(line)
             else:
                 try:

@@ -7,7 +7,8 @@ before rendering. The schema is deliberately narrow — it pins the fields
 consumers rely on and allows extra keys (forward compatibility).
 
 Envelope (all events):
-  event: str       one of run_start | epoch | run_summary (open set)
+  event: str       one of run_start | epoch | run_summary | fault |
+                   recovery (open set)
   run_id: str      "<algo>-<fingerprint>-<pid>"
   schema: int      SCHEMA_VERSION
   ts: float        wall-clock seconds (time.time())
@@ -15,6 +16,16 @@ Envelope (all events):
 
 epoch:
   epoch: int >= 0, seconds: number > 0, loss: number | null
+
+fault (resilience/): a detected or injected fault occurrence
+  kind: str     nonfinite_loss | nonfinite_params | divergence | stall |
+                crash | ckpt_corrupt (open set)
+  epoch: int | absent, attempt: int | absent, injected: bool | absent
+
+recovery (resilience/): a recovery action taken
+  action: str   rollback | restart | resume | ckpt_fallback | giveup
+                (open set)
+  epoch/attempt/step: int | absent
 
 run_summary:
   algorithm: str, fingerprint: str,
@@ -101,6 +112,22 @@ def validate_event(obj: Any) -> None:
             _fail("run_start.algorithm must be a string")
         if not isinstance(obj.get("fingerprint"), str):
             _fail("run_start.fingerprint must be a string")
+    elif kind == "fault":
+        if not isinstance(obj.get("kind"), str) or not obj["kind"]:
+            _fail("fault.kind must be a non-empty string")
+        for key in ("epoch", "attempt"):
+            if key in obj and obj[key] is not None and not isinstance(
+                obj[key], int
+            ):
+                _fail(f"fault.{key} must be an int when present")
+    elif kind == "recovery":
+        if not isinstance(obj.get("action"), str) or not obj["action"]:
+            _fail("recovery.action must be a non-empty string")
+        for key in ("epoch", "attempt", "step"):
+            if key in obj and obj[key] is not None and not isinstance(
+                obj[key], int
+            ):
+                _fail(f"recovery.{key} must be an int when present")
 
 
 def validate_stream(events) -> int:

@@ -1,0 +1,64 @@
+"""Resilient-training subsystem: fault injection, guards, supervision.
+
+The reference NeutronStar assumes a fault-free cluster — its
+dump/restore primitives (core/graph.hpp:528-580) are never wired into any
+recovery path. This package closes that gap for the TPU port with three
+pillars (docs/RESILIENCE.md):
+
+- :mod:`faults` — deterministic, ``NTS_FAULT_SPEC``-driven fault
+  injection through named ``fault_point`` hooks planted in every trainer
+  run loop, so every recovery path is testable in tier-1 on CPU;
+- :mod:`guards` — per-epoch health checks (non-finite loss/params,
+  divergence vs. best-so-far, wall-clock stall) plus the hung-step
+  watchdog;
+- :mod:`supervisor` — ``supervised_run(toolkit)``: rollback to the last
+  good checkpoint, bounded retries with exponential backoff
+  (``NTS_MAX_RESTARTS`` / ``NTS_BACKOFF_BASE_S``), LR scale-down on
+  repeated divergence, non-zero exit only when retries are exhausted;
+- :mod:`events` — every fault, guard trip, rollback, and retry lands as
+  a typed ``fault``/``recovery`` record in the obs/ JSONL stream.
+
+Checkpoint integrity (per-array sha256 digests, atomic publication,
+keep-last-K retention, quarantine + fallback) lives with the checkpoint
+code in utils/checkpoint.py and reports through :mod:`events`.
+"""
+
+from neutronstarlite_tpu.resilience.events import (
+    emit_fault,
+    emit_recovery,
+    set_sink,
+)
+from neutronstarlite_tpu.resilience.faults import (
+    FaultSpec,
+    fault_point,
+    parse_fault_spec,
+)
+from neutronstarlite_tpu.resilience.guards import (
+    DivergenceError,
+    HealthError,
+    NonFiniteLossError,
+    NonFiniteParamsError,
+    StallError,
+    Watchdog,
+)
+from neutronstarlite_tpu.resilience.supervisor import (
+    RetriesExhaustedError,
+    supervised_run,
+)
+
+__all__ = [
+    "DivergenceError",
+    "FaultSpec",
+    "HealthError",
+    "NonFiniteLossError",
+    "NonFiniteParamsError",
+    "RetriesExhaustedError",
+    "StallError",
+    "Watchdog",
+    "emit_fault",
+    "emit_recovery",
+    "fault_point",
+    "parse_fault_spec",
+    "set_sink",
+    "supervised_run",
+]

@@ -1,0 +1,62 @@
+"""Typed ``fault`` / ``recovery`` records into the obs/ JSONL stream.
+
+The resilience layer (fault injection, guards, supervisor, checkpoint
+integrity) records everything it does as structured events so a run's
+failure-and-recovery history is reconstructable from its metrics stream
+alone (tools/metrics_report renders them as a recovery timeline). The
+emitting sites are spread across layers that must not own a registry —
+utils/checkpoint detects corruption, resilience/faults injects crashes —
+so the active trainer's MetricsRegistry is installed here as a process-
+level sink (ToolkitBase.__init__ sets it; the latest trainer wins, which
+matches "the run currently in its epoch loop").
+
+Emission is best-effort by construction: telemetry must never turn a
+recoverable fault into a fatal one, so a missing sink or a failing write
+degrades to a log line.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from neutronstarlite_tpu.utils.logging import get_logger
+
+log = get_logger("resilience")
+
+_sink = None  # the active trainer's MetricsRegistry (or None)
+
+
+def set_sink(registry) -> None:
+    """Install ``registry`` (a MetricsRegistry or None) as the fault/
+    recovery event sink for this process."""
+    global _sink
+    _sink = registry
+
+
+def get_sink():
+    return _sink
+
+
+def emit(event: str, **fields: Any) -> Optional[Dict[str, Any]]:
+    """Write one typed event into the active stream; None without a sink."""
+    if _sink is None:
+        return None
+    try:
+        return _sink.event(event, **fields)
+    except Exception as e:  # telemetry must never escalate a fault
+        log.warning("could not emit %s event (%s)", event, e)
+        return None
+
+
+def emit_fault(kind: str, **fields: Any) -> Optional[Dict[str, Any]]:
+    """A detected or injected fault occurrence (kind: nonfinite_loss,
+    nonfinite_params, divergence, stall, crash, ckpt_corrupt, ...)."""
+    log.warning("FAULT %s %s", kind, fields or "")
+    return emit("fault", kind=kind, **fields)
+
+
+def emit_recovery(action: str, **fields: Any) -> Optional[Dict[str, Any]]:
+    """A recovery action (action: rollback, restart, resume,
+    ckpt_fallback, lr_scale, giveup, ...)."""
+    log.info("RECOVERY %s %s", action, fields or "")
+    return emit("recovery", action=action, **fields)

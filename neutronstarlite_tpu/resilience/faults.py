@@ -1,0 +1,232 @@
+"""Deterministic, spec-driven fault injection (``NTS_FAULT_SPEC``).
+
+The reference assumes a fault-free MPI cluster; nothing in it (or in a
+plain JAX run) ever exercises a recovery path. This module makes faults a
+first-class, *testable* input: the env var ``NTS_FAULT_SPEC`` carries a
+spec like
+
+    nan_loss@epoch=3;crash@epoch=5,rank=0;ckpt_corrupt@save=1;stall@epoch=2,ms=5000
+
+and every trainer run loop plants named :func:`fault_point` hooks where
+the specs fire. Each entry is ``kind`` or ``kind@key=value,key=value``:
+
+========== ============================ =======================================
+kind       args                         effect at its fault point
+========== ============================ =======================================
+nan_loss   epoch (optional)             replaces the epoch loss with NaN
+crash      epoch, rank (optional)       hard process death (os._exit) — the
+                                        simulated preemption / OOM kill
+stall      epoch, ms (default 1000)     sleeps ms inside the epoch — the
+                                        simulated hung step for the watchdog
+ckpt_corrupt save (1-based save index)  bit-flips the just-published
+                                        arrays.npz — exercises digest
+                                        verification + quarantine fallback
+========== ============================ =======================================
+
+Common args: ``times`` (how often the spec may fire, default 1) makes
+every fault one-shot by default, so a supervised retry replays the same
+epochs *without* the fault — the property the chaos tier-1 tests rely on.
+
+Fault points currently planted:
+
+- ``epoch_loss`` — every trainer epoch loop, right after the step's loss
+  is materialized (models/fullbatch.py, gcn_dist.py, gcn_dist_cache.py,
+  gat_dist.py, gcn_sample.py). nan_loss/stall/crash fire here.
+- ``save`` — utils/checkpoint.save_checkpoint, right after the npz
+  checkpoint is atomically published. ckpt_corrupt fires here.
+
+State (parsed plan + per-spec fired counts + the save counter) is
+process-global on purpose: a supervised retry inside the same process
+must see the same plan with its fired counts intact. Tests call
+:func:`reset` between scenarios.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import List, Optional
+
+from neutronstarlite_tpu.resilience import events
+from neutronstarlite_tpu.utils.logging import get_logger, process_index
+
+log = get_logger("faults")
+
+FAULT_KINDS = ("nan_loss", "crash", "stall", "ckpt_corrupt")
+
+# exit code of a simulated crash — distinguishable from a real failure's
+# rc=1 so the chaos subprocess test can assert the death was the injected
+# one (overridable, some rigs reserve codes)
+CRASH_EXIT_CODE = int(os.environ.get("NTS_CRASH_EXIT_CODE", "41"))
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    kind: str
+    epoch: Optional[int] = None  # fire at this epoch (None: first chance)
+    rank: Optional[int] = None  # crash: only on this process index
+    save: Optional[int] = None  # ckpt_corrupt: 1-based save counter
+    ms: float = 1000.0  # stall: sleep duration
+    times: int = 1  # max firings (one-shot by default)
+    fired: int = 0
+
+    def exhausted(self) -> bool:
+        return self.fired >= self.times
+
+
+_INT_ARGS = ("epoch", "rank", "save", "times")
+_ALLOWED_ARGS = frozenset(_INT_ARGS) | {"ms"}
+
+
+def parse_fault_spec(text: str) -> List[FaultSpec]:
+    """Parse the ``NTS_FAULT_SPEC`` grammar; raises ValueError on an
+    unknown kind or malformed argument (a typo'd spec silently never
+    firing would defeat the whole point of a chaos test)."""
+    specs: List[FaultSpec] = []
+    for entry in (text or "").split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        kind, _, argstr = entry.partition("@")
+        kind = kind.strip()
+        if kind not in FAULT_KINDS:
+            raise ValueError(
+                f"unknown fault kind {kind!r} in NTS_FAULT_SPEC entry "
+                f"{entry!r}; known: {FAULT_KINDS}"
+            )
+        spec = FaultSpec(kind=kind)
+        for arg in argstr.split(","):
+            arg = arg.strip()
+            if not arg:
+                continue
+            key, eq, value = arg.partition("=")
+            key = key.strip()
+            # explicit allowlist, NOT hasattr: dataclass internals
+            # ("kind", "fired", the exhausted() method) must not be
+            # clobberable from the env
+            if not eq or key not in _ALLOWED_ARGS:
+                raise ValueError(
+                    f"bad fault arg {arg!r} in NTS_FAULT_SPEC entry {entry!r}"
+                )
+            try:
+                setattr(
+                    spec, key,
+                    int(value) if key in _INT_ARGS else float(value)
+                    if key == "ms" else value,
+                )
+            except ValueError:
+                raise ValueError(
+                    f"bad fault arg value {arg!r} in NTS_FAULT_SPEC entry "
+                    f"{entry!r}"
+                ) from None
+        specs.append(spec)
+    return specs
+
+
+# ---- process-global plan ---------------------------------------------------
+
+_plan: Optional[List[FaultSpec]] = None
+_plan_src: Optional[str] = None
+_save_count = 0
+
+
+def reset() -> None:
+    """Forget the parsed plan and all fired/save counters (tests)."""
+    global _plan, _plan_src, _save_count
+    _plan = None
+    _plan_src = None
+    _save_count = 0
+
+
+def active_plan() -> List[FaultSpec]:
+    """The parsed plan for the current ``NTS_FAULT_SPEC`` value; reparsed
+    (with fresh fired counts) whenever the env value changes."""
+    global _plan, _plan_src
+    src = os.environ.get("NTS_FAULT_SPEC", "")
+    if _plan is None or src != _plan_src:
+        _plan = parse_fault_spec(src)
+        _plan_src = src
+        if _plan:
+            log.warning("fault injection armed: %s", src)
+    return _plan
+
+
+# ---- injection implementations ---------------------------------------------
+
+
+def _corrupt_file(path: str) -> None:
+    """Bit-flip a 64-byte window in the middle of ``path`` (small files
+    are truncated instead) — the on-disk damage digest verification must
+    catch."""
+    size = os.path.getsize(path)
+    with open(path, "r+b") as fh:
+        if size >= 256:
+            fh.seek(size // 2)
+            window = fh.read(64)
+            fh.seek(size // 2)
+            fh.write(bytes(b ^ 0xFF for b in window))
+        else:
+            fh.truncate(max(size // 2, 1))
+
+
+def _epoch_matches(spec: FaultSpec, epoch: Optional[int]) -> bool:
+    return spec.epoch is None or spec.epoch == epoch
+
+
+def fault_point(point: str, *, epoch: Optional[int] = None, value=None,
+                path: Optional[str] = None):
+    """Named injection hook. Run loops call it with the point's context
+    and thread ``value`` (the epoch loss) through it; matching specs in
+    the active plan fire (at most ``times`` each) and may replace the
+    value, sleep, corrupt ``path``, or kill the process. A no-op (returns
+    ``value`` unchanged) when ``NTS_FAULT_SPEC`` is unset."""
+    plan = active_plan()
+    if not plan:
+        return value
+    global _save_count
+    if point == "save":
+        _save_count += 1
+    for spec in plan:
+        if spec.exhausted():
+            continue
+        if point == "epoch_loss" and spec.kind == "nan_loss":
+            if not _epoch_matches(spec, epoch):
+                continue
+            spec.fired += 1
+            log.warning("injecting nan_loss at epoch %s", epoch)
+            value = float("nan")
+        elif point == "epoch_loss" and spec.kind == "stall":
+            if not _epoch_matches(spec, epoch):
+                continue
+            spec.fired += 1
+            log.warning("injecting %.0f ms stall at epoch %s", spec.ms, epoch)
+            time.sleep(spec.ms / 1000.0)
+        elif point == "epoch_loss" and spec.kind == "crash":
+            if not _epoch_matches(spec, epoch):
+                continue
+            if spec.rank is not None and spec.rank != process_index():
+                continue
+            spec.fired += 1
+            # the one fault whose record can only come from the injection
+            # site — nothing survives to detect it afterwards
+            events.emit_fault(
+                "crash", point=point, epoch=epoch, injected=True,
+                rank=process_index(),
+            )
+            log.warning(
+                "injecting crash at epoch %s (exit %d)", epoch, CRASH_EXIT_CODE
+            )
+            os._exit(CRASH_EXIT_CODE)
+        elif point == "save" and spec.kind == "ckpt_corrupt":
+            if spec.save is not None and spec.save != _save_count:
+                continue
+            if path is None:
+                continue
+            spec.fired += 1
+            log.warning(
+                "injecting checkpoint corruption into %s (save #%d)",
+                path, _save_count,
+            )
+            _corrupt_file(path)
+    return value

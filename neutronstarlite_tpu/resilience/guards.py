@@ -1,0 +1,293 @@
+"""Per-epoch health checks + the hung-step watchdog.
+
+Detection layer of the resilience spine: every run loop funnels through
+``ToolkitBase.emit_epoch``, which calls :func:`epoch_check` right after the
+epoch's metrics record is written — so the faulty epoch is always visible
+in the obs stream *before* the guard trips, and always before
+``ckpt_epoch_end`` could persist a poisoned checkpoint (every run loop
+emits before it saves).
+
+Checks (all per epoch):
+
+- non-finite loss (NaN/inf) — :class:`NonFiniteLossError`;
+- non-finite parameter leaves (``NTS_GUARD_PARAMS_EVERY``, default every
+  epoch; 0 disables) — :class:`NonFiniteParamsError` naming the leaves;
+- divergence vs. best-so-far: loss > ``NTS_DIVERGENCE_FACTOR`` (default
+  50) x max(best, ``NTS_DIVERGENCE_FLOOR`` = 1.0) after
+  ``NTS_DIVERGENCE_WARMUP`` (default 3) epochs — :class:`DivergenceError`;
+- wall-clock stall: epoch seconds > ``NTS_EPOCH_TIMEOUT_S`` (0 = off),
+  skipped for the first epoch of each (re)start, which pays compile —
+  :class:`StallError`.
+
+Guards are ARMED only inside a supervised run (resilience/supervisor) or
+when ``NTS_GUARDS=1`` forces them on (``NTS_GUARDS=0`` forces off): an
+unsupervised run keeps the seed behavior (a NaN loss run completes and
+reports NaN) plus a warning log line.
+
+:class:`Watchdog` is the asynchronous complement for steps that never
+return at all: a daemon thread that interrupts the main thread when no
+epoch heartbeat lands within the timeout. Because an async interrupt can
+race with normal completion, the supervisor only arms it under
+``NTS_WATCHDOG_INTERRUPT=1``; the synchronous post-epoch stall check is
+the default, deterministic path.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import math
+import os
+import threading
+import time
+from typing import Callable, List, Optional
+
+import jax
+
+from neutronstarlite_tpu.utils.logging import get_logger
+
+log = get_logger("guards")
+
+
+class HealthError(RuntimeError):
+    """A guard trip; ``code`` is the obs ``fault`` record's kind."""
+
+    code = "health"
+
+    def __init__(self, msg: str, epoch: Optional[int] = None):
+        super().__init__(msg)
+        self.epoch = epoch
+
+
+class NonFiniteLossError(HealthError):
+    code = "nonfinite_loss"
+
+
+class NonFiniteParamsError(HealthError):
+    code = "nonfinite_params"
+
+
+class DivergenceError(HealthError):
+    code = "divergence"
+
+
+class StallError(HealthError):
+    code = "stall"
+
+
+# ---- arming ----------------------------------------------------------------
+
+_armed_depth = 0
+
+
+def guards_armed() -> bool:
+    env = os.environ.get("NTS_GUARDS", "")
+    if env == "0":
+        return False
+    if env == "1":
+        return True
+    return _armed_depth > 0
+
+
+@contextlib.contextmanager
+def armed():
+    """Arm the guards for the enclosed (supervised) run."""
+    global _armed_depth
+    _armed_depth += 1
+    try:
+        yield
+    finally:
+        _armed_depth -= 1
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        log.warning("bad %s=%r; using %s", name, os.environ.get(name), default)
+        return default
+
+
+# ---- checks ----------------------------------------------------------------
+
+
+def nonfinite_leaves(tree) -> List[str]:
+    """Key paths of floating leaves containing NaN/inf."""
+    import jax.numpy as jnp
+
+    bad: List[str] = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        try:
+            if not jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating):
+                continue
+            if not bool(jnp.all(jnp.isfinite(leaf))):
+                bad.append(jax.tree_util.keystr(path))
+        except TypeError:  # non-array leaf
+            continue
+    return bad
+
+
+def _state(toolkit) -> dict:
+    st = getattr(toolkit, "_guard_state", None)
+    if st is None:
+        st = toolkit._guard_state = {"best": None, "epochs_this_attempt": 0}
+    return st
+
+
+def new_attempt(toolkit) -> None:
+    """Reset the per-attempt counters (the supervisor calls this before a
+    retry); best-so-far loss survives — a rollback restores params that
+    earned it."""
+    _state(toolkit)["epochs_this_attempt"] = 0
+
+
+def epoch_check(toolkit, epoch: int, seconds: float,
+                loss: Optional[float]) -> None:
+    """The per-epoch health gate (called from ToolkitBase.emit_epoch)."""
+    heartbeat()
+    st = _state(toolkit)
+    first_of_attempt = st["epochs_this_attempt"] == 0
+    st["epochs_this_attempt"] += 1
+
+    finite = loss is not None and math.isfinite(float(loss))
+    if loss is not None and not finite and not guards_armed():
+        log.warning(
+            "non-finite loss %r at epoch %d (guards unarmed: run continues; "
+            "wrap with resilience.supervised_run or NTS_GUARDS=1 to recover)",
+            loss, epoch,
+        )
+    if not guards_armed():
+        return
+
+    if loss is not None and not finite:
+        raise NonFiniteLossError(
+            f"non-finite loss {loss!r} at epoch {epoch}", epoch=epoch
+        )
+
+    # divergence vs best-so-far (generous by default: a trip means the
+    # optimizer blew up, not normal fluctuation)
+    factor = _env_float("NTS_DIVERGENCE_FACTOR", 50.0)
+    floor = _env_float("NTS_DIVERGENCE_FLOOR", 1.0)
+    warmup = int(_env_float("NTS_DIVERGENCE_WARMUP", 3))
+    if finite:
+        best = st["best"]
+        if best is None or float(loss) < best:
+            st["best"] = float(loss)
+        elif (
+            factor > 0
+            and epoch >= warmup
+            and float(loss) > factor * max(best, floor)
+        ):
+            raise DivergenceError(
+                f"loss {float(loss):g} at epoch {epoch} diverged "
+                f"(> {factor:g} x max(best={best:g}, {floor:g}))",
+                epoch=epoch,
+            )
+
+    # wall-clock stall (skip the compile/restore-heavy first epoch of
+    # every attempt)
+    timeout_s = _env_float("NTS_EPOCH_TIMEOUT_S", 0.0)
+    if timeout_s > 0 and not first_of_attempt and seconds > timeout_s:
+        raise StallError(
+            f"epoch {epoch} took {seconds:.3f}s "
+            f"(> NTS_EPOCH_TIMEOUT_S={timeout_s:g}s watchdog budget)",
+            epoch=epoch,
+        )
+
+    # parameter health (params exist on every trainer after build_model)
+    every = int(_env_float("NTS_GUARD_PARAMS_EVERY", 1.0))
+    params = getattr(toolkit, "params", None)
+    if every > 0 and params is not None and epoch % every == 0:
+        bad = nonfinite_leaves(params)
+        if bad:
+            raise NonFiniteParamsError(
+                f"non-finite parameters at epoch {epoch}: "
+                f"{', '.join(bad[:8])}"
+                + (f" (+{len(bad) - 8} more)" if len(bad) > 8 else ""),
+                epoch=epoch,
+            )
+
+
+# ---- asynchronous watchdog -------------------------------------------------
+
+_active_watchdog: Optional["Watchdog"] = None
+
+
+def heartbeat() -> None:
+    """Signal liveness (every epoch_check beats the active watchdog)."""
+    wd = _active_watchdog
+    if wd is not None:
+        wd.beat()
+
+
+class Watchdog:
+    """Interrupts the main thread when no heartbeat lands within
+    ``timeout_s`` — the escape hatch for a step that never returns
+    (a wedged collective, a hung compile RPC). ``interrupt`` is
+    injectable for tests; the default raises KeyboardInterrupt in the
+    main thread, which the supervisor converts to a StallError via the
+    ``tripped`` flag.
+
+    Until the FIRST heartbeat of a run, ``first_beat_grace_s`` applies
+    instead of ``timeout_s`` — the attempt's first epoch pays graph
+    load, restore, and jit compile (tens of seconds on TPU), the same
+    exemption the synchronous post-epoch check grants."""
+
+    def __init__(self, timeout_s: float,
+                 interrupt: Optional[Callable[[], None]] = None,
+                 first_beat_grace_s: Optional[float] = None):
+        if interrupt is None:
+            import _thread
+
+            interrupt = _thread.interrupt_main
+        self.timeout_s = float(timeout_s)
+        self.first_beat_grace_s = (
+            float(first_beat_grace_s)
+            if first_beat_grace_s is not None
+            else max(10.0 * self.timeout_s, 60.0)
+        )
+        self.tripped = False
+        self._interrupt = interrupt
+        self._last_beat = time.monotonic()
+        self._beat_count = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def beat(self) -> None:
+        self._last_beat = time.monotonic()
+        self._beat_count += 1
+
+    def start(self) -> "Watchdog":
+        global _active_watchdog
+        self._last_beat = time.monotonic()  # not beat(): grace until #1
+        self._thread = threading.Thread(
+            target=self._loop, name="nts-watchdog", daemon=True
+        )
+        _active_watchdog = self
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        global _active_watchdog
+        self._stop.set()
+        if _active_watchdog is self:
+            _active_watchdog = None
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    def _loop(self) -> None:
+        poll = max(min(self.timeout_s / 4.0, 0.5), 0.01)
+        while not self._stop.wait(poll):
+            limit = (
+                self.timeout_s if self._beat_count > 0
+                else self.first_beat_grace_s
+            )
+            if time.monotonic() - self._last_beat > limit:
+                self.tripped = True
+                log.warning(
+                    "watchdog: no epoch heartbeat in %.1fs; interrupting",
+                    limit,
+                )
+                try:
+                    self._interrupt()
+                finally:
+                    return

@@ -1,0 +1,201 @@
+"""Supervised training: rollback to the last good checkpoint + bounded
+retries with exponential backoff.
+
+``supervised_run(toolkit)`` is the recovery loop run.py and bench.py wrap
+around every trainer. It arms the per-epoch guards (resilience/guards),
+runs ``toolkit.run()``, and on a :class:`HealthError`:
+
+1. emits one typed ``fault`` record (kind = the guard's code) into the
+   obs stream;
+2. gives up — :class:`RetriesExhaustedError` — once ``NTS_MAX_RESTARTS``
+   (default 2) retries are spent; the launcher turns that into a non-zero
+   exit;
+3. otherwise sleeps ``NTS_BACKOFF_BASE_S`` (default 0.5) x 2^(attempt-1);
+4. rolls back: when the run has a checkpoint dir with a restorable
+   checkpoint, the retry's ``run()`` re-enters through ``ckpt_begin`` and
+   resumes from the last good step (the guards fire *before*
+   ``ckpt_epoch_end``, so a poisoned epoch is never persisted). Without
+   one, the model is rebuilt from scratch (fresh params — the in-memory
+   state may be exactly what is poisoned);
+5. on repeated divergence, optionally scales the learning rate down by
+   ``NTS_LR_BACKOFF`` (default 0.5, 1.0 disables) and rebuilds the jitted
+   step so the new rate takes effect — the restore still happens over the
+   rebuilt params;
+6. emits one ``recovery`` record (action = rollback | restart | +
+   ``lr_scale`` detail) and retries.
+
+A run that was hard-killed (crash fault, preemption, OOM) has no
+in-process supervisor left; its recovery is the *next* invocation
+resuming from the retained checkpoint — ``ToolkitBase.ckpt_begin`` emits
+that ``recovery(action=resume)`` record.
+
+Simulated faults come from ``NTS_FAULT_SPEC`` (resilience/faults); real
+ones (a genuinely diverging run, an actually-hung step under
+``NTS_EPOCH_TIMEOUT_S``) take the same path.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, Optional
+
+from neutronstarlite_tpu.resilience import events, guards
+from neutronstarlite_tpu.utils.logging import get_logger
+
+log = get_logger("supervisor")
+
+
+from neutronstarlite_tpu.resilience.guards import _env_float
+
+
+class RetriesExhaustedError(RuntimeError):
+    """Raised when every allowed restart failed; carries the last fault."""
+
+    def __init__(self, msg: str, last_error: Optional[BaseException] = None):
+        super().__init__(msg)
+        self.last_error = last_error
+
+
+def _have_restorable_checkpoint(toolkit) -> bool:
+    """Structural probe only (manifest + arrays presence) — cheap on a
+    multi-GB checkpoint. Digest verification stays with the single
+    restore path; if that path then rejects every retained step, the
+    retry's ckpt_begin rebuilds the model (models/base.py) rather than
+    re-entering with the poisoned in-memory state."""
+    ckpt_dir = getattr(toolkit.cfg, "checkpoint_dir", "")
+    if not ckpt_dir:
+        return False
+    from neutronstarlite_tpu.utils.checkpoint import have_checkpoint
+
+    try:
+        return have_checkpoint(ckpt_dir, backend=toolkit._ckpt_backend())
+    except Exception as e:  # an unreadable dir counts as "no checkpoint"
+        log.warning("checkpoint probe of %s failed: %s", ckpt_dir, e)
+        return False
+
+
+def supervised_run(
+    toolkit,
+    max_restarts: Optional[int] = None,
+    backoff_base_s: Optional[float] = None,
+) -> Dict[str, Any]:
+    """Run ``toolkit.run()`` under guard supervision with rollback/retry.
+
+    Returns run()'s result dict; raises :class:`RetriesExhaustedError`
+    when the restart budget is spent (callers exit non-zero on that, and
+    only that)."""
+    if max_restarts is None:
+        max_restarts = int(_env_float("NTS_MAX_RESTARTS", 2.0))
+    if backoff_base_s is None:
+        backoff_base_s = _env_float("NTS_BACKOFF_BASE_S", 0.5)
+    lr_backoff = _env_float("NTS_LR_BACKOFF", 0.5)
+    watchdog_s = _env_float("NTS_EPOCH_TIMEOUT_S", 0.0)
+    use_interrupt = os.environ.get("NTS_WATCHDOG_INTERRUPT", "0") == "1"
+
+    metrics = getattr(toolkit, "metrics", None)
+    if metrics is not None:
+        events.set_sink(metrics)
+
+    attempt = 0
+    divergence_streak = 0
+    with guards.armed():
+        while True:
+            watchdog = None
+            if watchdog_s > 0 and use_interrupt:
+                grace = _env_float("NTS_WATCHDOG_GRACE_S", 0.0)
+                watchdog = guards.Watchdog(
+                    watchdog_s,
+                    first_beat_grace_s=grace if grace > 0 else None,
+                ).start()
+            try:
+                try:
+                    return toolkit.run()
+                except KeyboardInterrupt:
+                    # only a watchdog-initiated interrupt is a fault; a
+                    # real Ctrl-C must keep killing the run
+                    if watchdog is not None and watchdog.tripped:
+                        raise guards.StallError(
+                            f"watchdog: no epoch heartbeat within "
+                            f"{watchdog_s:g}s"
+                        ) from None
+                    raise
+                finally:
+                    # disarm BEFORE fault handling: the backoff sleep /
+                    # probe / rebuild below emit no heartbeats, and an
+                    # interrupt landing mid-handler would escape uncaught
+                    if watchdog is not None:
+                        watchdog.stop()
+                        watchdog = None
+            except guards.HealthError as err:
+                attempt += 1
+                if metrics is not None:
+                    metrics.counter_add("resilience.faults")
+                events.emit_fault(
+                    err.code, epoch=err.epoch, attempt=attempt,
+                    error=str(err),
+                )
+                log.warning(
+                    "supervised run attempt %d failed: [%s] %s",
+                    attempt, err.code, err,
+                )
+                if attempt > max_restarts:
+                    events.emit_recovery(
+                        action="giveup", attempt=attempt, epoch=err.epoch
+                    )
+                    raise RetriesExhaustedError(
+                        f"giving up after {attempt - 1} restart(s) "
+                        f"(NTS_MAX_RESTARTS={max_restarts}); last fault: "
+                        f"[{err.code}] {err}",
+                        last_error=err,
+                    ) from err
+                divergence_streak = (
+                    divergence_streak + 1
+                    if isinstance(err, guards.DivergenceError) else 0
+                )
+                if backoff_base_s > 0:
+                    delay = backoff_base_s * (2.0 ** (attempt - 1))
+                    log.info("backing off %.2fs before restart", delay)
+                    time.sleep(delay)
+
+                scale_lr = (
+                    divergence_streak >= 2 and lr_backoff > 0
+                    and lr_backoff != 1.0
+                )
+                if scale_lr:
+                    old = toolkit.cfg.learn_rate
+                    toolkit.cfg.learn_rate = old * lr_backoff
+                    log.warning(
+                        "repeated divergence: scaling LR %g -> %g",
+                        old, toolkit.cfg.learn_rate,
+                    )
+                rollback = _have_restorable_checkpoint(toolkit)
+                if scale_lr or not rollback:
+                    # fresh params + re-jitted step (the new LR lives in
+                    # the closed-over AdamConfig); with a checkpoint, the
+                    # retry's ckpt_begin restores over the rebuilt params
+                    toolkit.build_model()
+                if not rollback:
+                    # restart-from-scratch: the failed attempt's epoch
+                    # telemetry must not pollute run_summary aggregates
+                    # (rollbacks rewind in ckpt_begin instead; trainers
+                    # without ckpt_begin in their loop need this path)
+                    toolkit.epoch_times.clear()
+                    toolkit.loss_history.clear()
+                    toolkit._first_epoch_trained = None
+                action = "rollback" if rollback else "restart"
+                if metrics is not None:
+                    metrics.counter_add("resilience.restarts")
+                guards.new_attempt(toolkit)
+                # the retry resumes via ckpt_begin; the action string
+                # suppresses its duplicate recovery(action=resume) record
+                # and tells it whether a failed restore must fall back to
+                # a model rebuild (rollback chosen but every retained
+                # step turned out corrupt)
+                toolkit._supervised_retry = action
+                events.emit_recovery(
+                    action=action, attempt=attempt, epoch=err.epoch,
+                    fault=err.code,
+                    **({"lr_scaled_to": toolkit.cfg.learn_rate}
+                       if scale_lr else {}),
+                )

@@ -46,7 +46,21 @@ def main(argv=None) -> int:
     toolkit = cls(cfg, base_dir=os.path.dirname(os.path.abspath(cfg_path)))
     toolkit.init_graph()
     toolkit.init_nn()
-    result = toolkit.run()
+    # the supervised wrapper (resilience/): per-epoch health guards +
+    # rollback to the last good checkpoint with bounded retries; exits
+    # non-zero only when NTS_MAX_RESTARTS is exhausted
+    from neutronstarlite_tpu.resilience.supervisor import (
+        RetriesExhaustedError,
+        supervised_run,
+    )
+
+    try:
+        result = supervised_run(toolkit)
+    except RetriesExhaustedError as e:
+        log.error("run failed permanently: %s", e)
+        if getattr(toolkit, "run_summary_record", None) is None:
+            toolkit.finalize_metrics(None)  # salvage the partial stream
+        return 1
     print(toolkit.report())
     log.info("result: %s", result)
     # every run ends with one consolidated run_summary record (obs/);

@@ -8,6 +8,9 @@ each record against the obs schema, and renders:
 - per run: the ``#key=value(ms)`` block — epoch timing attribution
   (first/warm/compile-overhead), the PhaseTimers buckets, then non-time
   counters (wire bytes, batches) and memory as ``#key=value`` lines;
+- per run: the recovery timeline — every ``fault`` / ``recovery`` record
+  (resilience/) with its offset from the stream's first event, so a
+  run's failure-and-recovery history reads at a glance;
 - across runs: a comparison table keyed by run_id/algorithm/fingerprint.
 
 A file with epoch events but no run_summary (killed run) still renders:
@@ -109,6 +112,24 @@ def _ms(v: Optional[float]) -> str:
     return f"{v * 1000:.3f}" if v is not None else "n/a"
 
 
+_TIMELINE_SKIP = ("event", "run_id", "schema", "ts", "seq", "error")
+
+
+def recovery_timeline(events: List[Dict[str, Any]]) -> List[str]:
+    """``fault``/``recovery`` records as offset-stamped one-liners."""
+    t0 = events[0]["ts"] if events else 0.0
+    lines: List[str] = []
+    for e in events:
+        if e["event"] not in ("fault", "recovery"):
+            continue
+        detail = " ".join(
+            f"{k}={e[k]}" for k in sorted(e)
+            if k not in _TIMELINE_SKIP and e[k] is not None
+        )
+        lines.append(f"  +{e['ts'] - t0:8.2f}s {e['event']:<8s} {detail}")
+    return lines
+
+
 def render_run(path: str, rec: Dict[str, Any]) -> str:
     """The reference-shaped #key=value(ms) block for one run."""
     et = rec.get("epoch_time", {})
@@ -147,6 +168,10 @@ def render_run(path: str, rec: Dict[str, Any]) -> str:
     loss = (rec.get("result") or {}).get("loss")
     if loss is not None:
         lines.append(f"#final_loss={loss}")
+    timeline = rec.get("_timeline") or []
+    if timeline:
+        lines.append("recovery timeline:")
+        lines.extend(timeline)
     return "\n".join(lines)
 
 
@@ -215,12 +240,14 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             continue
         rec["_path"] = p
+        rec["_timeline"] = recovery_timeline(events)
         rows.append(rec)
     if not rows:
         return 1
     if args.json:
         print(json.dumps(
-            [{k: v for k, v in r.items() if k != "_path"} for r in rows]
+            [{k: v for k, v in r.items() if not k.startswith("_")}
+             for r in rows]
         ))
     else:
         for rec in rows:

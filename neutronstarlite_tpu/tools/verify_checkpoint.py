@@ -1,0 +1,108 @@
+"""Preflight checkpoint validator: manifest schema + per-array digests.
+
+Runs exactly the verification ``restore_checkpoint`` applies before
+trusting a step (utils/checkpoint.verify_step_dir) as a standalone CLI,
+so CI or an operator can validate a checkpoint directory *before*
+scheduling a resume on expensive accelerator time:
+
+  python -m neutronstarlite_tpu.tools.verify_checkpoint <ckpt-dir> [...]
+      [--quiet]
+
+For every ``step-<n>/`` dir under each given checkpoint root (plus a
+legacy flat-layout checkpoint, if present) it prints per-array status —
+sha256 digest, shape, and dtype checked against the manifest — and a
+verdict line. Quarantined ``*.corrupt`` dirs are listed as evidence but
+do not fail the check (restore already routes around them).
+
+Exit codes: 0 = every verifiable checkpoint is intact; 1 = corruption or
+an unreadable input; 2 = no checkpoint found at all.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from neutronstarlite_tpu.utils.checkpoint import (  # noqa: E402
+    ARRAYS,
+    CORRUPT_SUFFIX,
+    MANIFEST,
+    CheckpointCorruptError,
+    list_steps,
+    verify_step_dir,
+)
+
+
+def _verify_one(step_dir: str, quiet: bool) -> bool:
+    """Print per-array status for one step dir; True when intact."""
+    label = os.path.relpath(step_dir)
+    try:
+        manifest, status, _arrays = verify_step_dir(step_dir)
+    except CheckpointCorruptError as e:
+        print(f"{label}: CORRUPT")
+        for problem in e.problems:
+            print(f"  !! {problem}")
+        return False
+    if not quiet:
+        for name in sorted(status):
+            meta = manifest.get("arrays", {}).get(name, {})
+            print(
+                f"  {name:<24s} {status[name]:<4s} "
+                f"shape={tuple(meta.get('shape', ()))} "
+                f"dtype={meta.get('dtype')} "
+                f"sha256={meta.get('sha256', '')[:12]}"
+            )
+    n = len(status)
+    legacy_note = "" if manifest.get("format", 1) >= 2 else " (no digests: legacy format)"
+    print(f"{label}: OK step={manifest.get('step')} arrays={n}{legacy_note}")
+    return True
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="validate checkpoint manifest schema + sha256 digests"
+    )
+    ap.add_argument("paths", nargs="+", help="checkpoint dir(s) "
+                    "(CHECKPOINT_DIR roots or individual step-N dirs)")
+    ap.add_argument("--quiet", action="store_true",
+                    help="verdict lines only, no per-array detail")
+    args = ap.parse_args(argv)
+
+    found = 0
+    corrupt = 0
+    for root in args.paths:
+        if not os.path.isdir(root):
+            print(f"{root}: not a directory", file=sys.stderr)
+            corrupt += 1
+            continue
+        targets: List[str] = [d for _s, d in list_steps(root)]
+        if os.path.exists(os.path.join(root, MANIFEST)):
+            targets.append(root)  # legacy flat layout / direct step dir
+        for name in sorted(os.listdir(root)):
+            if CORRUPT_SUFFIX in name:
+                print(f"{os.path.join(os.path.relpath(root), name)}: "
+                      "quarantined (skipped)")
+        if not targets:
+            print(f"{root}: no checkpoint found "
+                  f"(no step-*/ dirs, no {MANIFEST})", file=sys.stderr)
+            continue
+        for step_dir in targets:
+            found += 1
+            if not _verify_one(step_dir, args.quiet):
+                corrupt += 1
+    if corrupt:
+        return 1
+    if not found:
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

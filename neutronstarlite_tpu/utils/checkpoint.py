@@ -20,27 +20,101 @@ Two backends (round 4, VERDICT r3 weak-item 8):
   directly onto the ``like`` tree's shardings (no host-side broadcast
   staging). This is the scale-out path; the npz default keeps small
   rigs dependency-light. ``finalize_checkpoints()`` drains in-flight
-  async saves (the trainers call it at run end).
+  async saves (the trainers call it at run end). When orbax is requested
+  but not installed, :func:`resolve_backend` logs a warning and falls
+  back to npz instead of dying mid-run on a bare ImportError.
+
+Integrity (the resilience PR): the npz backend writes each save into its
+own ``step-<n>/`` directory — arrays first, the manifest last as the
+commit marker, both published via tmp-write + ``os.replace`` so a crash
+mid-save never clobbers the previous good checkpoint — with a per-array
+sha256 digest in the manifest (format 2). Retention keeps the last K
+step dirs (``NTS_CKPT_KEEP``, default 2 — parity with the orbax
+manager's ``max_to_keep``). ``restore_checkpoint`` verifies every digest
+before trusting a step; a truncated or bit-flipped checkpoint is
+QUARANTINED (renamed ``*.corrupt``, a ``fault`` record in the obs
+stream) and restore falls back to the previous retained step instead of
+crashing or silently loading garbage. ``tools/verify_checkpoint`` runs
+the same verification as a CLI preflight. The pre-integrity flat layout
+(manifest.json + arrays.npz directly under the dir) restores fine —
+legacy manifests simply carry no digests to verify.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
-from typing import Any, Dict, Optional, Tuple
+import re
+import shutil
+import zipfile
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 
+from neutronstarlite_tpu.utils.logging import get_logger
+
+log = get_logger("checkpoint")
+
 MANIFEST = "manifest.json"
 ARRAYS = "arrays.npz"
 ORBAX_SUBDIR = "orbax"
+STEP_PREFIX = "step-"
+CORRUPT_SUFFIX = ".corrupt"
+MANIFEST_FORMAT = 2  # 1 = legacy flat layout without digests
 
 _managers: Dict[str, Any] = {}
 
 
 def default_backend() -> str:
     return os.environ.get("NTS_CKPT_BACKEND", "npz")
+
+
+_orbax_importable: Optional[bool] = None
+
+
+def _orbax_ok() -> bool:
+    """Memoized orbax-importability probe: resolve_backend runs several
+    times per checkpoint operation, and degraded mode must not pay a
+    failed sys.meta_path walk (plus a duplicate warning line) per save."""
+    global _orbax_importable
+    if _orbax_importable is None:
+        try:
+            import orbax.checkpoint  # noqa: F401
+
+            _orbax_importable = True
+        except ImportError as e:
+            log.warning(
+                "checkpoint backend orbax requested but orbax is not "
+                "importable (%s); falling back to the npz backend", e
+            )
+            _orbax_importable = False
+    return _orbax_importable
+
+
+def resolve_backend(requested: str = "") -> str:
+    """Validate + resolve a backend name, degrading gracefully: orbax
+    requested on a machine without orbax installed logs a warning (once)
+    and resolves to npz (the run keeps checkpointing instead of dying on
+    a bare ImportError mid-save)."""
+    backend = requested or default_backend()
+    if backend not in ("npz", "orbax"):
+        raise ValueError(
+            f"unknown checkpoint backend {backend!r} "
+            "(CKPT_BACKEND / NTS_CKPT_BACKEND: npz | orbax)"
+        )
+    if backend == "orbax" and not _orbax_ok():
+        return "npz"
+    return backend
+
+
+def keep_last_k() -> int:
+    """npz retention depth (``NTS_CKPT_KEEP``, default 2, min 1)."""
+    try:
+        return max(int(os.environ.get("NTS_CKPT_KEEP", "2")), 1)
+    except ValueError:
+        return 2
 
 
 def _orbax_manager(path: str):
@@ -65,14 +139,92 @@ def finalize_checkpoints() -> None:
         mgr.wait_until_finished()
 
 
+# ---- npz step-dir layout ----------------------------------------------------
+
+_STEP_RE = re.compile(rf"^{STEP_PREFIX}(\d+)$")
+
+
+def _step_dirname(step: int) -> str:
+    return f"{STEP_PREFIX}{int(step):08d}"
+
+
+def list_steps(path: str) -> List[Tuple[int, str]]:
+    """(step, absolute dir) of every intact step dir under ``path``,
+    ascending by step; quarantined ``*.corrupt`` dirs are excluded."""
+    if not os.path.isdir(path):
+        return []
+    out: List[Tuple[int, str]] = []
+    for name in os.listdir(path):
+        m = _STEP_RE.match(name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(path, name)))
+    return sorted(out)
+
+
+def _legacy_files(path: str) -> Optional[Tuple[str, str]]:
+    """(manifest, arrays) of a pre-integrity flat-layout checkpoint."""
+    manifest_path = os.path.join(path, MANIFEST)
+    arrays_path = os.path.join(path, ARRAYS)
+    if os.path.exists(manifest_path) and os.path.exists(arrays_path):
+        return manifest_path, arrays_path
+    return None
+
+
+def latest_npz_step(path: str) -> Optional[int]:
+    """Newest intact npz step under ``path`` (legacy flat layout reads as
+    its manifest step), or None."""
+    steps = list_steps(path)
+    if steps:
+        return steps[-1][0]
+    legacy = _legacy_files(path)
+    if legacy:
+        try:
+            with open(legacy[0]) as fh:
+                return int(json.load(fh)["step"])
+        except (OSError, ValueError, KeyError, json.JSONDecodeError):
+            return None
+    return None
+
+
+def have_checkpoint(path: str, backend: str = "") -> bool:
+    """True when ``path`` structurally holds a checkpoint (manifest +
+    arrays files present). Deliberately does NOT digest-verify — that
+    would read and hash a potentially multi-GB npz just for a bool, and
+    the restore path re-verifies anyway. A dir whose every step then
+    fails verification restores as None; the supervised-retry path in
+    ``ToolkitBase.ckpt_begin`` handles that by rebuilding the model."""
+    if resolve_backend(backend) == "orbax":
+        if orbax_latest_step(path) is not None:
+            return True
+        # restore_checkpoint falls through to npz files when the orbax
+        # dir has no steps; mirror that here
+    for _step, step_dir in reversed(list_steps(path)):
+        manifest = os.path.join(step_dir, MANIFEST)
+        arrays = os.path.join(step_dir, ARRAYS)
+        if (
+            os.path.isfile(manifest)
+            and os.path.isfile(arrays)
+            and os.path.getsize(arrays) > 0
+        ):
+            return True
+    return _legacy_files(path) is not None
+
+
+def _leaf_digest(arr: np.ndarray) -> str:
+    return hashlib.sha256(np.ascontiguousarray(arr).tobytes()).hexdigest()
+
+
 def save_checkpoint(
     path: str, state: Dict[str, Any], step: int, backend: str = ""
 ) -> None:
     """Serialize a dict of pytrees (e.g. {"params": ..., "opt": ...}).
 
-    npz: host-side, caller gates to one writer. orbax: ASYNC + sharded —
-    EVERY process must call (orbax coordinates the distributed write)."""
-    if (backend or default_backend()) == "orbax":
+    npz: host-side, caller gates to one writer; each save lands in its
+    own ``step-<n>/`` dir (arrays written before the manifest commit
+    marker, both via tmp + os.replace) and retention prunes to the last
+    ``NTS_CKPT_KEEP`` steps. orbax: ASYNC + sharded — EVERY process must
+    call (orbax coordinates the distributed write)."""
+    if resolve_backend(backend) == "orbax":
         import orbax.checkpoint as ocp
 
         _orbax_manager(path).save(
@@ -81,7 +233,12 @@ def save_checkpoint(
         return
     os.makedirs(path, exist_ok=True)
     flat: Dict[str, np.ndarray] = {}
-    manifest: Dict[str, Any] = {"step": step, "trees": {}}
+    manifest: Dict[str, Any] = {
+        "step": int(step),
+        "format": MANIFEST_FORMAT,
+        "trees": {},
+        "arrays": {},
+    }
     for name, tree in state.items():
         leaves, treedef = jax.tree.flatten(tree)
         manifest["trees"][name] = {
@@ -89,12 +246,53 @@ def save_checkpoint(
             "n_leaves": len(leaves),
         }
         for i, leaf in enumerate(leaves):
-            flat[f"{name}.{i}"] = np.asarray(leaf)
-    tmp = os.path.join(path, ARRAYS + ".tmp.npz")
-    np.savez(tmp, **flat)
-    os.replace(tmp, os.path.join(path, ARRAYS))
-    with open(os.path.join(path, MANIFEST), "w") as fh:
+            arr = np.asarray(leaf)
+            key = f"{name}.{i}"
+            flat[key] = arr
+            manifest["arrays"][key] = {
+                "sha256": _leaf_digest(arr),
+                "shape": list(arr.shape),
+                "dtype": str(arr.dtype),
+            }
+    step_dir = os.path.join(path, _step_dirname(step))
+    tmp_dir = os.path.join(path, f".tmp-{_step_dirname(step)}-{os.getpid()}")
+    if os.path.isdir(tmp_dir):
+        shutil.rmtree(tmp_dir)
+    os.makedirs(tmp_dir)
+    # arrays first, manifest second: the manifest is the commit marker, so
+    # a crash between the two writes leaves a dir restore will reject
+    tmp_npz = os.path.join(tmp_dir, ARRAYS + ".tmp.npz")
+    np.savez(tmp_npz, **flat)
+    os.replace(tmp_npz, os.path.join(tmp_dir, ARRAYS))
+    with open(os.path.join(tmp_dir, MANIFEST), "w") as fh:
         json.dump(manifest, fh, indent=1)
+    if os.path.isdir(step_dir):  # re-save of the same step replaces it
+        shutil.rmtree(step_dir)
+    os.replace(tmp_dir, step_dir)
+    # fault-injection hook (ckpt_corrupt@save=N): corruption is applied to
+    # the PUBLISHED npz, exactly what bit rot / torn writes would hit
+    if os.environ.get("NTS_FAULT_SPEC"):
+        from neutronstarlite_tpu.resilience.faults import fault_point
+
+        fault_point("save", path=os.path.join(step_dir, ARRAYS))
+    _prune(path, keep=keep_last_k())
+
+
+def _prune(path: str, keep: int) -> None:
+    """Drop the oldest intact step dirs beyond ``keep`` + stale tmp dirs.
+    Quarantined ``*.corrupt`` dirs are kept — they are evidence."""
+    steps = list_steps(path)
+    for _step, d in steps[:-keep] if keep > 0 else []:
+        try:
+            shutil.rmtree(d)
+        except OSError as e:  # retention is best-effort
+            log.warning("could not prune old checkpoint %s: %s", d, e)
+    try:
+        for name in os.listdir(path):
+            if name.startswith(".tmp-" + STEP_PREFIX):
+                shutil.rmtree(os.path.join(path, name), ignore_errors=True)
+    except OSError:
+        pass
 
 
 def orbax_latest_step(path: str) -> Optional[int]:
@@ -112,6 +310,137 @@ def orbax_latest_step(path: str) -> Optional[int]:
     return None if step is None else int(step)
 
 
+# ---- verification -----------------------------------------------------------
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A step dir failed structural or digest verification."""
+
+    def __init__(self, msg: str, problems: Optional[List[str]] = None):
+        super().__init__(msg)
+        self.problems = problems or [msg]
+
+
+def verify_step_dir(
+    step_dir: str,
+) -> Tuple[Dict[str, Any], Dict[str, str], Dict[str, np.ndarray]]:
+    """Structurally validate + digest-verify one npz step dir.
+
+    Returns (manifest, per-array status dict name -> "ok" | problem,
+    loaded arrays) — the arrays ride along so a restore that just
+    verified them does not re-read and re-decompress the whole npz.
+    Raises :class:`CheckpointCorruptError` when anything fails — missing
+    or torn files, manifest schema violations, shape/dtype drift, digest
+    mismatches."""
+    problems: List[str] = []
+    status: Dict[str, str] = {}
+    manifest_path = os.path.join(step_dir, MANIFEST)
+    arrays_path = os.path.join(step_dir, ARRAYS)
+    if not os.path.exists(manifest_path):
+        raise CheckpointCorruptError(
+            f"{step_dir}: missing {MANIFEST} (interrupted save?)"
+        )
+    try:
+        with open(manifest_path) as fh:
+            manifest = json.load(fh)
+    except (OSError, json.JSONDecodeError) as e:
+        raise CheckpointCorruptError(f"{step_dir}: unreadable manifest: {e}")
+    if not isinstance(manifest.get("step"), int) or not isinstance(
+        manifest.get("trees"), dict
+    ):
+        raise CheckpointCorruptError(
+            f"{step_dir}: manifest missing step/trees fields"
+        )
+    if not os.path.exists(arrays_path):
+        raise CheckpointCorruptError(f"{step_dir}: missing {ARRAYS}")
+    try:
+        with np.load(arrays_path) as data:
+            loaded = {k: data[k] for k in data.files}
+    except Exception as e:  # truncated/garbled zip: BadZipFile, OSError...
+        raise CheckpointCorruptError(f"{step_dir}: unreadable {ARRAYS}: {e}")
+    declared = manifest.get("arrays", {})
+    if manifest.get("format", 1) >= 2 and not isinstance(declared, dict):
+        raise CheckpointCorruptError(f"{step_dir}: manifest arrays not a dict")
+    for key, meta in declared.items():
+        if key not in loaded:
+            status[key] = "missing from arrays.npz"
+            problems.append(f"{key}: missing from {ARRAYS}")
+            continue
+        arr = loaded[key]
+        if list(arr.shape) != list(meta.get("shape", [])):
+            status[key] = (
+                f"shape {list(arr.shape)} != manifest {meta.get('shape')}"
+            )
+            problems.append(f"{key}: {status[key]}")
+            continue
+        if str(arr.dtype) != meta.get("dtype"):
+            status[key] = f"dtype {arr.dtype} != manifest {meta.get('dtype')}"
+            problems.append(f"{key}: {status[key]}")
+            continue
+        if _leaf_digest(arr) != meta.get("sha256"):
+            status[key] = "sha256 digest mismatch"
+            problems.append(f"{key}: sha256 digest mismatch")
+            continue
+        status[key] = "ok"
+    extra = set(loaded) - set(declared)
+    if declared and extra:
+        problems.append(f"undeclared arrays in {ARRAYS}: {sorted(extra)}")
+    if problems:
+        raise CheckpointCorruptError(
+            f"{step_dir}: {len(problems)} integrity violation(s): "
+            + "; ".join(problems[:4]),
+            problems=problems,
+        )
+    return manifest, status, loaded
+
+
+def _quarantine(step_dir: str, reason: str) -> None:
+    """Rename a corrupt step dir to ``*.corrupt`` (never loaded again,
+    kept as evidence) and record the fault in the obs stream. A failed
+    rename is reported as such — the record must not claim a quarantine
+    that did not happen (and the dir will keep satisfying the structural
+    probe until an operator removes it)."""
+    target = step_dir + CORRUPT_SUFFIX
+    n = 1
+    while os.path.exists(target):
+        target = f"{step_dir}{CORRUPT_SUFFIX}.{n}"
+        n += 1
+    quarantined = None
+    try:
+        os.replace(step_dir, target)
+        quarantined = os.path.basename(target)
+        log.warning("quarantined corrupt checkpoint %s -> %s (%s)",
+                    step_dir, quarantined, reason)
+    except OSError as e:
+        log.warning("could not quarantine %s: %s", step_dir, e)
+    from neutronstarlite_tpu.resilience import events
+
+    events.emit_fault(
+        "ckpt_corrupt", path=step_dir, quarantined=quarantined,
+        error=reason[:500],
+    )
+
+
+def _rebuild_state(
+    like: Dict[str, Any], manifest: Dict[str, Any],
+    data: Dict[str, np.ndarray],
+) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for name, tree in like.items():
+        leaves, treedef = jax.tree.flatten(tree)
+        n = manifest["trees"][name]["n_leaves"]
+        if n != len(leaves):
+            raise ValueError(
+                f"checkpoint tree {name!r} has {n} leaves; expected {len(leaves)}"
+            )
+        new_leaves = [
+            np.asarray(data[f"{name}.{i}"], dtype=np.asarray(l).dtype)
+            for i, l in enumerate(leaves)
+        ]
+        out[name] = jax.tree.unflatten(treedef, new_leaves)
+    return out
+
+
 def restore_checkpoint(
     path: str, like: Dict[str, Any], backend: str = ""
 ) -> Optional[Tuple[Dict[str, Any], int]]:
@@ -120,8 +449,13 @@ def restore_checkpoint(
 
     orbax: arrays land directly on ``like``'s shardings (sharded restore;
     every process must call). Falls through to the npz files when the
-    orbax directory has no steps — a rig can switch backends mid-run."""
-    if (backend or default_backend()) == "orbax":
+    orbax directory has no steps — a rig can switch backends mid-run.
+
+    npz: newest step first, digest-verified; a corrupt step is
+    quarantined (``*.corrupt`` + an obs ``fault`` record) and restore
+    falls back to the previous retained step (a ``recovery`` record names
+    the step that actually loaded)."""
+    if resolve_backend(backend) == "orbax":
         import orbax.checkpoint as ocp
 
         step = orbax_latest_step(path)
@@ -140,27 +474,56 @@ def restore_checkpoint(
                 step, args=ocp.args.StandardRestore(abstract)
             )
             return state, int(step)
-    manifest_path = os.path.join(path, MANIFEST)
-    arrays_path = os.path.join(path, ARRAYS)
-    if not (os.path.exists(manifest_path) and os.path.exists(arrays_path)):
-        return None
-    with open(manifest_path) as fh:
-        manifest = json.load(fh)
-    data = np.load(arrays_path)
-    out: Dict[str, Any] = {}
-    for name, tree in like.items():
-        leaves, treedef = jax.tree.flatten(tree)
-        n = manifest["trees"][name]["n_leaves"]
-        if n != len(leaves):
-            raise ValueError(
-                f"checkpoint tree {name!r} has {n} leaves; expected {len(leaves)}"
+    quarantined = 0
+    for step, step_dir in reversed(list_steps(path)):
+        try:
+            manifest, _status, arrays = verify_step_dir(step_dir)
+            state = _rebuild_state(like, manifest, arrays)
+        except CheckpointCorruptError as e:
+            _quarantine(step_dir, str(e))
+            quarantined += 1
+            continue
+        if quarantined:
+            from neutronstarlite_tpu.resilience import events
+
+            events.emit_recovery(
+                action="ckpt_fallback", step=step,
+                quarantined=quarantined,
             )
-        new_leaves = [
-            np.asarray(data[f"{name}.{i}"], dtype=np.asarray(l).dtype)
-            for i, l in enumerate(leaves)
-        ]
-        out[name] = jax.tree.unflatten(treedef, new_leaves)
-    return out, int(manifest["step"])
+            log.warning(
+                "restored step %d after quarantining %d newer corrupt "
+                "checkpoint(s)", step, quarantined,
+            )
+        return state, int(manifest["step"])
+    # legacy flat layout (pre-integrity saves): no digests to verify,
+    # but a torn/garbled file must still degrade to "no checkpoint"
+    # (rename to *.corrupt + fault record), not an uncaught BadZipFile
+    legacy = _legacy_files(path)
+    if legacy is None:
+        return None
+    manifest_path, arrays_path = legacy
+    try:
+        with open(manifest_path) as fh:
+            manifest = json.load(fh)
+        with np.load(arrays_path) as data:
+            state = _rebuild_state(
+                like, manifest, {k: data[k] for k in data.files}
+            )
+        return state, int(manifest["step"])
+    except (OSError, ValueError, KeyError, json.JSONDecodeError,
+            zipfile.BadZipFile) as e:
+        for p in (manifest_path, arrays_path):
+            try:
+                os.replace(p, p + CORRUPT_SUFFIX)
+            except OSError:
+                pass
+        log.warning("legacy checkpoint in %s unreadable (%s); quarantined",
+                    path, e)
+        from neutronstarlite_tpu.resilience import events
+
+        events.emit_fault("ckpt_corrupt", path=path, legacy=True,
+                          error=str(e)[:500])
+        return None
 
 
 def dump_vertex_array(path: str, name: str, arr: np.ndarray) -> None:

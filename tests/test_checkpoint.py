@@ -1,17 +1,32 @@
-"""Checkpoint/resume tests (gap-fill subsystem, SURVEY.md section 5)."""
+"""Checkpoint/resume tests (gap-fill subsystem, SURVEY.md section 5).
+
+Integrity additions (resilience PR): per-array sha256 digests, atomic
+step-dir publication with keep-last-K retention, corrupt-checkpoint
+quarantine + fallback, orbax-missing degradation, shape-mismatch
+validation, and the bitwise resume-equivalence oracle."""
+
+import os
+import subprocess
+import sys
 
 import numpy as np
+import pytest
 
 import jax.numpy as jnp
 
 from neutronstarlite_tpu.models.gcn import GCNTrainer
 from neutronstarlite_tpu.utils.checkpoint import (
+    ARRAYS,
     dump_vertex_array,
+    list_steps,
+    resolve_backend,
     restore_checkpoint,
     restore_vertex_array,
     save_checkpoint,
 )
 from tests.test_models import _planted_cfg, _planted_data
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def test_save_restore_roundtrip(tmp_path):
@@ -89,6 +104,205 @@ def test_dist_trainer_checkpoint_resume(rng, tmp_path):
     result = t2.run()  # resumes from 12
     assert len(t2.epoch_times) == 30 - 12
     assert result["acc"]["train"] > 0.8, result
+
+
+def test_keep_last_k_retention(tmp_path, monkeypatch):
+    """npz retention keeps the newest NTS_CKPT_KEEP step dirs (parity
+    with the orbax manager's max_to_keep)."""
+    state = {"params": [{"W": jnp.arange(4.0)}]}
+    for step in range(1, 6):
+        save_checkpoint(str(tmp_path), state, step=step)
+    assert [s for s, _ in list_steps(str(tmp_path))] == [4, 5]
+    monkeypatch.setenv("NTS_CKPT_KEEP", "3")
+    for step in range(6, 9):
+        save_checkpoint(str(tmp_path), state, step=step)
+    assert [s for s, _ in list_steps(str(tmp_path))] == [6, 7, 8]
+
+
+def _corrupt(path, how):
+    size = os.path.getsize(path)
+    with open(path, "r+b") as fh:
+        if how == "truncate":
+            fh.truncate(size // 2)
+        else:  # bit-flip a window in the middle
+            fh.seek(size // 2)
+            window = fh.read(64)
+            fh.seek(size // 2)
+            fh.write(bytes(b ^ 0xFF for b in window))
+
+
+@pytest.mark.parametrize("how", ["truncate", "bitflip"])
+def test_corrupt_checkpoint_quarantined_and_fallback(tmp_path, how):
+    """Acceptance: a truncated/bit-flipped arrays.npz is caught by digest
+    verification, quarantined to *.corrupt, and restore falls back to the
+    previous retained checkpoint instead of crashing or silently loading
+    garbage."""
+    state1 = {"params": [{"W": jnp.arange(6.0).reshape(2, 3)}]}
+    state2 = {"params": [{"W": jnp.arange(6.0).reshape(2, 3) * 10}]}
+    save_checkpoint(str(tmp_path), state1, step=1)
+    save_checkpoint(str(tmp_path), state2, step=2)
+    steps = dict(list_steps(str(tmp_path)))
+    _corrupt(os.path.join(steps[2], ARRAYS), how)
+    got, step = restore_checkpoint(str(tmp_path), state1)
+    assert step == 1
+    np.testing.assert_array_equal(
+        got["params"][0]["W"], np.arange(6.0).reshape(2, 3)
+    )
+    names = os.listdir(tmp_path)
+    assert any(n.endswith(".corrupt") for n in names)
+    assert [s for s, _ in list_steps(str(tmp_path))] == [1]
+
+
+def test_all_checkpoints_corrupt_restores_none(tmp_path):
+    state = {"params": [{"W": jnp.arange(4.0)}]}
+    save_checkpoint(str(tmp_path), state, step=1)
+    (_, d), = list_steps(str(tmp_path))
+    _corrupt(os.path.join(d, ARRAYS), "truncate")
+    assert restore_checkpoint(str(tmp_path), state) is None
+
+
+def test_interrupted_save_is_invisible(tmp_path):
+    """A crash mid-save leaves only a .tmp- dir — never a half-written
+    step dir — so restore keeps returning the previous good step."""
+    state = {"params": [{"W": jnp.arange(4.0)}]}
+    save_checkpoint(str(tmp_path), state, step=1)
+    # simulate the torn tmp dir a killed writer leaves behind
+    torn = tmp_path / ".tmp-step-00000009-12345"
+    torn.mkdir()
+    (torn / ARRAYS).write_bytes(b"partial")
+    got, step = restore_checkpoint(str(tmp_path), state)
+    assert step == 1
+    # the next save sweeps stale tmp dirs
+    save_checkpoint(str(tmp_path), state, step=2)
+    assert not any(
+        n.startswith(".tmp-") for n in os.listdir(tmp_path)
+    )
+
+
+def test_shape_mismatch_restore_names_keys(tmp_path):
+    """Satellite: resuming with a changed HIDDEN must fail with an error
+    naming the mismatched leaves, not an opaque broadcast error."""
+    src, dst, datum = _planted_data(seed=5)
+    cfg = _planted_cfg(epochs=2)
+    cfg.checkpoint_dir = str(tmp_path / "ck")
+    GCNTrainer.from_arrays(cfg, src, dst, datum).run()
+
+    cfg2 = _planted_cfg(epochs=4)
+    cfg2.layer_string = "16-8-4"  # HIDDEN 32 -> 8
+    cfg2.checkpoint_dir = cfg.checkpoint_dir
+    t2 = GCNTrainer.from_arrays(cfg2, src, dst, datum)
+    with pytest.raises(ValueError, match=r"HIDDEN.*params.*\(\d+, 8\)"):
+        t2.run()
+
+
+def test_orbax_missing_falls_back_to_npz(tmp_path, monkeypatch):
+    """Satellite: CKPT_BACKEND:orbax without orbax installed must warn
+    and degrade to npz at backend resolution, not ImportError mid-run."""
+    from neutronstarlite_tpu.utils import checkpoint as cp
+
+    monkeypatch.setitem(sys.modules, "orbax", None)
+    monkeypatch.setitem(sys.modules, "orbax.checkpoint", None)
+    # clear the availability memo for this test; monkeypatch restores the
+    # pre-test value so later orbax tests re-probe the real modules
+    monkeypatch.setattr(cp, "_orbax_importable", None)
+    assert resolve_backend("orbax") == "npz"
+
+    src, dst, datum = _planted_data(seed=5)
+    cfg = _planted_cfg(epochs=2)
+    cfg.checkpoint_dir = str(tmp_path / "ck")
+    cfg.ckpt_backend = "orbax"
+    t = GCNTrainer.from_arrays(cfg, src, dst, datum)
+    t.run()  # checkpoints via npz instead of dying
+    assert list_steps(cfg.checkpoint_dir)
+    got, step = restore_checkpoint(
+        cfg.checkpoint_dir, t.checkpoint_state(), backend="npz"
+    )
+    assert step == 2
+
+
+def test_resolve_backend_rejects_unknown():
+    with pytest.raises(ValueError, match="unknown checkpoint backend"):
+        resolve_backend("tape_drive")
+
+
+_RESUME_EQ_SCRIPT = """
+import numpy as np, sys, jax
+sys.path.insert(0, %(repo)r)
+from tests.test_models import _planted_cfg, _planted_data
+from neutronstarlite_tpu.graph.storage import build_graph
+from neutronstarlite_tpu.models.gcn import GCNTrainer
+from neutronstarlite_tpu.models.gcn_dist_cache import DistGCNCacheTrainer
+
+tmp = sys.argv[1]
+
+def leaves(t):
+    return [np.asarray(l) for l in jax.tree.flatten(t.params)[0]]
+
+def check(make, ck):
+    straight = make(6, "")
+    r6 = straight.run()
+    half = make(3, ck)
+    half.run()
+    resumed = make(6, ck)
+    r36 = resumed.run()
+    assert len(resumed.epoch_times) == 3, len(resumed.epoch_times)
+    assert r6["loss"] == r36["loss"], (r6["loss"], r36["loss"])
+    for a, b in zip(leaves(straight), leaves(resumed)):
+        np.testing.assert_array_equal(a, b)
+
+src, dst, datum = _planted_data(seed=5)
+# ONE shared host graph: the native OpenMP adjacency builder orders
+# same-destination edges nondeterministically across builds, which
+# reorders float accumulation and wobbles params by ulps — a per-trainer
+# rebuild would mask checkpoint bugs behind that noise
+hg = build_graph(src, dst, 600, weight=GCNTrainer.weight_mode)
+
+def make_fullbatch(epochs, ck):
+    cfg = _planted_cfg(epochs=epochs)
+    cfg.checkpoint_dir = ck
+    return GCNTrainer.from_arrays(cfg, src, dst, datum, host_graph=hg)
+
+check(make_fullbatch, tmp + "/ck_fb")
+
+class SimTrainer(DistGCNCacheTrainer):
+    simulate = True
+
+def make_dist(epochs, ck):
+    cfg = _planted_cfg(epochs=epochs)
+    cfg.partitions = 2
+    cfg.checkpoint_dir = ck
+    return SimTrainer.from_arrays(cfg, src, dst, datum, host_graph=hg)
+
+check(make_dist, tmp + "/ck_dist")
+print("RESUME_EQUIVALENCE_OK")
+"""
+
+
+def test_resume_equivalence_bitwise(tmp_path):
+    """Satellite: 6 straight epochs vs 3 + checkpoint + restore + 3 must
+    be BITWISE identical (params and final loss) for fullbatch GCN and a
+    dist variant. Runs in a subprocess pinned to XLA's single-threaded
+    deterministic CPU config — the default threaded runtime reorders
+    reductions between runs (ulp-level wobble), which would mask a real
+    roundtrip bug behind a tolerance."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        "--xla_force_host_platform_device_count=8 "
+        "--xla_cpu_use_thunk_runtime=false "
+        "--xla_cpu_multi_thread_eigen=false "
+        "intra_op_parallelism_threads=1"
+    )
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("NTS_FAULT_SPEC", None)
+    env.pop("NTS_METRICS_DIR", None)
+    r = subprocess.run(
+        [sys.executable, "-c", _RESUME_EQ_SCRIPT % {"repo": REPO},
+         str(tmp_path)],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=500,
+    )
+    assert r.returncode == 0, (r.stdout[-3000:], r.stderr[-3000:])
+    assert "RESUME_EQUIVALENCE_OK" in r.stdout
 
 
 def test_orbax_roundtrip_and_trainer_resume(tmp_path):
@@ -182,3 +396,69 @@ def test_orbax_sharded_restore_preserves_shardings(tmp_path):
     np.testing.assert_array_equal(
         np.asarray(got["params"]["emb"]), np.arange(64.0).reshape(16, 4)
     )
+
+
+def test_verify_checkpoint_cli(tmp_path, capsys):
+    """Satellite: the preflight validator prints per-array status and
+    exits non-zero on corruption."""
+    from neutronstarlite_tpu.tools.verify_checkpoint import main as verify_main
+
+    state = {"params": [{"W": jnp.arange(6.0).reshape(2, 3)}],
+             "opt": {"m": jnp.ones((2, 3))}}
+    save_checkpoint(str(tmp_path), state, step=1)
+    save_checkpoint(str(tmp_path), state, step=2)
+
+    assert verify_main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "params.0" in out and "sha256=" in out
+    assert out.count(": OK step=") == 2
+
+    steps = dict(list_steps(str(tmp_path)))
+    # silent value tampering: a VALID npz with wrong bytes — only the
+    # sha256 digest layer can catch this (zip CRC still passes)
+    np.savez(
+        os.path.join(steps[2], ARRAYS),
+        **{"params.0": np.zeros((2, 3), np.float32),
+           "opt.0": np.ones((2, 3), np.float32)},
+    )
+    assert verify_main([str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "CORRUPT" in out and "digest mismatch" in out
+
+    # torn file: the zip layer itself reports unreadable
+    _corrupt(os.path.join(steps[1], ARRAYS), "truncate")
+    assert verify_main([str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "unreadable" in out
+
+    assert verify_main([str(tmp_path / "nothing_here")]) == 1
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert verify_main([str(empty)]) == 2
+
+
+def test_legacy_corrupt_checkpoint_degrades_to_none(tmp_path):
+    """A torn pre-integrity flat-layout checkpoint must quarantine and
+    restore as None — not escape as an uncaught BadZipFile."""
+    import json
+
+    import jax
+
+    state = {"params": [{"W": jnp.arange(4.0)}]}
+    flat, manifest = {}, {"step": 3, "trees": {}}
+    for name, tree in state.items():
+        leaves, treedef = jax.tree.flatten(tree)
+        manifest["trees"][name] = {
+            "treedef": str(treedef), "n_leaves": len(leaves),
+        }
+        for i, leaf in enumerate(leaves):
+            flat[f"{name}.{i}"] = np.asarray(leaf)
+    np.savez(os.path.join(tmp_path, ARRAYS), **flat)
+    with open(os.path.join(tmp_path, "manifest.json"), "w") as fh:
+        json.dump(manifest, fh)
+    got, step = restore_checkpoint(str(tmp_path), state)
+    assert step == 3  # intact legacy layout restores
+
+    _corrupt(os.path.join(tmp_path, ARRAYS), "truncate")
+    assert restore_checkpoint(str(tmp_path), state) is None
+    assert any(n.endswith(".corrupt") for n in os.listdir(tmp_path))

@@ -191,3 +191,26 @@ def test_metrics_report_fails_on_empty(tmp_path):
     empty = tmp_path / "empty.jsonl"
     empty.write_text("")
     assert report_main([str(empty)]) == 1
+
+
+def test_metrics_report_renders_recovery_timeline(tmp_path, capsys):
+    """fault/recovery records (resilience/) render as an offset-stamped
+    recovery timeline under the run's #key=value block."""
+    from neutronstarlite_tpu.tools import metrics_report
+
+    reg = registry.MetricsRegistry(
+        "run-tl", algorithm="GCN", fingerprint="f",
+        path=str(tmp_path / "tl.jsonl"),
+    )
+    reg.event("run_start", algorithm="GCN", fingerprint="f")
+    reg.epoch_event(0, 0.5, loss=1.0)
+    reg.event("fault", kind="nonfinite_loss", epoch=1, attempt=1)
+    reg.event("recovery", action="rollback", epoch=1, attempt=1)
+    reg.epoch_event(1, 0.4, loss=0.9)
+    reg.close()
+
+    assert metrics_report.main([str(tmp_path / "tl.jsonl")]) == 0
+    out = capsys.readouterr().out
+    assert "recovery timeline:" in out
+    assert "fault" in out and "kind=nonfinite_loss" in out
+    assert "recovery" in out and "action=rollback" in out

@@ -1,0 +1,493 @@
+"""Chaos tier-1 suite: injected faults must be survived, on CPU.
+
+Every recovery path the resilience subsystem promises is exercised here
+with deterministic faults from ``NTS_FAULT_SPEC`` (resilience/faults):
+nan_loss -> guard trip -> supervised rollback; ckpt_corrupt -> digest
+quarantine -> fallback restore; crash -> hard process death (subprocess)
+-> resume on the next invocation; stall -> wall-clock watchdog ->
+rollback. Each scenario also asserts the matching ``fault``/``recovery``
+records landed in the obs JSONL stream — the recovery story must be
+reconstructable from telemetry alone.
+"""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from neutronstarlite_tpu.models.gcn import GCNTrainer
+from neutronstarlite_tpu.obs.schema import validate_stream
+from neutronstarlite_tpu.resilience import events, faults, guards
+from neutronstarlite_tpu.resilience.faults import parse_fault_spec
+from neutronstarlite_tpu.resilience.supervisor import (
+    RetriesExhaustedError,
+    supervised_run,
+)
+from tests.test_models import _planted_cfg, _planted_data
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state(monkeypatch):
+    """Fault plans + save counters are process-global by design (a
+    supervised retry must see its fired counts); tests must not."""
+    monkeypatch.delenv("NTS_FAULT_SPEC", raising=False)
+    monkeypatch.setenv("NTS_BACKOFF_BASE_S", "0")
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _stream_events(metrics_dir):
+    files = sorted(glob.glob(os.path.join(metrics_dir, "*.jsonl")))
+    assert files, f"no metrics stream under {metrics_dir}"
+    evs = []
+    for f in files:
+        with open(f) as fh:
+            evs.extend(json.loads(line) for line in fh if line.strip())
+    validate_stream(evs)
+    return evs
+
+
+def _of(evs, kind):
+    return [e for e in evs if e["event"] == kind]
+
+
+# ---- fault-spec grammar -----------------------------------------------------
+
+
+def test_fault_spec_parse():
+    specs = parse_fault_spec(
+        "nan_loss@epoch=3;crash@epoch=5,rank=0;ckpt_corrupt@save=1;"
+        "stall@epoch=2,ms=5000"
+    )
+    assert [s.kind for s in specs] == [
+        "nan_loss", "crash", "ckpt_corrupt", "stall"
+    ]
+    assert specs[0].epoch == 3 and specs[0].times == 1
+    assert specs[1].rank == 0
+    assert specs[2].save == 1
+    assert specs[3].ms == 5000.0
+    assert parse_fault_spec("") == []
+    assert parse_fault_spec("nan_loss")[0].epoch is None
+
+
+def test_fault_spec_rejects_garbage():
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        parse_fault_spec("meteor_strike@epoch=1")
+    with pytest.raises(ValueError, match="bad fault arg"):
+        parse_fault_spec("nan_loss@epoch")
+    with pytest.raises(ValueError, match="bad fault arg"):
+        parse_fault_spec("nan_loss@epoch=three")
+
+
+def test_fault_point_noop_without_spec():
+    assert faults.fault_point("epoch_loss", epoch=1, value=0.5) == 0.5
+
+
+# ---- guards -----------------------------------------------------------------
+
+
+class _FakeToolkit:
+    params = None
+
+
+def test_guards_unarmed_never_raise():
+    tk = _FakeToolkit()
+    guards.epoch_check(tk, 3, 0.01, float("nan"))  # logs, returns
+
+
+def test_guard_nonfinite_loss(monkeypatch):
+    monkeypatch.setenv("NTS_GUARDS", "1")
+    tk = _FakeToolkit()
+    with pytest.raises(guards.NonFiniteLossError):
+        guards.epoch_check(tk, 3, 0.01, float("nan"))
+
+
+def test_guard_divergence(monkeypatch):
+    monkeypatch.setenv("NTS_GUARDS", "1")
+    tk = _FakeToolkit()
+    guards.epoch_check(tk, 0, 0.01, 1.2)  # establishes best
+    guards.epoch_check(tk, 1, 0.01, 0.9)
+    guards.epoch_check(tk, 2, 0.01, 40.0)  # within warmup: tolerated
+    with pytest.raises(guards.DivergenceError):
+        # > 50 x max(best=0.9, floor=1.0)
+        guards.epoch_check(tk, 5, 0.01, 75.0)
+
+
+def test_guard_nonfinite_params_names_leaf(monkeypatch):
+    monkeypatch.setenv("NTS_GUARDS", "1")
+    tk = _FakeToolkit()
+    tk.params = {"layer0": {"W": jnp.asarray([1.0, float("nan")])},
+                 "layer1": {"W": jnp.asarray([1.0])}}
+    with pytest.raises(guards.NonFiniteParamsError, match="layer0"):
+        guards.epoch_check(tk, 0, 0.01, 0.5)
+
+
+def test_guard_stall_skips_first_epoch_of_attempt(monkeypatch):
+    monkeypatch.setenv("NTS_GUARDS", "1")
+    monkeypatch.setenv("NTS_EPOCH_TIMEOUT_S", "0.5")
+    tk = _FakeToolkit()
+    guards.epoch_check(tk, 0, 9.0, 0.5)  # compile epoch: no trip
+    with pytest.raises(guards.StallError):
+        guards.epoch_check(tk, 1, 9.0, 0.5)
+    guards.new_attempt(tk)  # supervisor retry resets the skip
+    guards.epoch_check(tk, 1, 9.0, 0.5)
+
+
+def test_watchdog_trips_on_stale_heartbeat():
+    interrupts = []
+    wd = guards.Watchdog(0.05, interrupt=lambda: interrupts.append(1))
+    wd.start()
+    try:
+        wd.beat()  # first epoch done; normal budget applies from here
+        deadline = time.monotonic() + 2.0
+        while not wd.tripped and time.monotonic() < deadline:
+            time.sleep(0.01)
+    finally:
+        wd.stop()
+    assert wd.tripped and interrupts == [1]
+
+
+def test_watchdog_first_epoch_grace():
+    """Before the first heartbeat (the attempt's compile/restore-heavy
+    first epoch) the grace budget applies, not the steady-state one."""
+    interrupts = []
+    wd = guards.Watchdog(0.05, interrupt=lambda: interrupts.append(1),
+                         first_beat_grace_s=10.0)
+    wd.start()
+    try:
+        time.sleep(0.4)  # well past timeout_s, within grace
+        assert not wd.tripped
+    finally:
+        wd.stop()
+    assert not interrupts
+
+
+def test_watchdog_beat_keeps_it_quiet():
+    interrupts = []
+    wd = guards.Watchdog(0.2, interrupt=lambda: interrupts.append(1))
+    wd.start()
+    try:
+        for _ in range(8):
+            time.sleep(0.05)
+            wd.beat()
+    finally:
+        wd.stop()
+    assert not wd.tripped and not interrupts
+
+
+# ---- chaos: nan_loss (the acceptance scenario) ------------------------------
+
+
+def test_nan_loss_rollback_matches_fault_free_run(tmp_path, monkeypatch):
+    """nan_loss@epoch=3 in a 6-epoch fullbatch GCN run: the supervisor
+    rolls back to the last good checkpoint, the retry replays epochs 3-5
+    without the (one-shot) fault, and the result matches the fault-free
+    run; the stream carries exactly one fault and one recovery record."""
+    src, dst, datum = _planted_data(seed=11)
+    base = GCNTrainer.from_arrays(_planted_cfg(epochs=6), src, dst, datum).run()
+
+    monkeypatch.setenv("NTS_METRICS_DIR", str(tmp_path / "obs"))
+    monkeypatch.setenv("NTS_FAULT_SPEC", "nan_loss@epoch=3")
+    monkeypatch.setenv("NTS_MAX_RESTARTS", "2")
+    faults.reset()
+    cfg = _planted_cfg(epochs=6)
+    cfg.checkpoint_dir = str(tmp_path / "ck")
+    cfg.checkpoint_every = 1
+    trainer = GCNTrainer.from_arrays(cfg, src, dst, datum)
+    result = supervised_run(trainer)
+
+    assert np.isfinite(result["loss"])
+    # rollback replays the exact epochs the fault-free run took (only the
+    # loss value was poisoned, params were never touched), so the final
+    # accuracy is within noise — here within float ulps — of fault-free
+    assert result["loss"] == pytest.approx(base["loss"], abs=1e-5)
+    assert result["acc"]["train"] == pytest.approx(
+        base["acc"]["train"], abs=0.02
+    )
+    evs = _stream_events(tmp_path / "obs")
+    fault_recs = _of(evs, "fault")
+    recovery_recs = _of(evs, "recovery")
+    assert len(fault_recs) == 1, fault_recs
+    assert fault_recs[0]["kind"] == "nonfinite_loss"
+    assert fault_recs[0]["epoch"] == 3
+    assert len(recovery_recs) == 1, recovery_recs
+    assert recovery_recs[0]["action"] == "rollback"
+    # the nan epoch is visible in the stream (recorded before the trip)
+    nan_epochs = [e for e in _of(evs, "epoch")
+                  if e["loss"] is not None and not np.isfinite(e["loss"])]
+    assert len(nan_epochs) == 1 and nan_epochs[0]["epoch"] == 3
+
+
+def test_retries_exhausted_raises(tmp_path, monkeypatch):
+    """A fault that refires every attempt exhausts NTS_MAX_RESTARTS and
+    surfaces as RetriesExhaustedError (the launchers' non-zero exit)."""
+    monkeypatch.setenv("NTS_METRICS_DIR", str(tmp_path / "obs"))
+    monkeypatch.setenv("NTS_FAULT_SPEC", "nan_loss@times=100")
+    monkeypatch.setenv("NTS_MAX_RESTARTS", "1")
+    faults.reset()
+    src, dst, datum = _planted_data(seed=11)
+    cfg = _planted_cfg(epochs=4)
+    cfg.checkpoint_dir = str(tmp_path / "ck")
+    cfg.checkpoint_every = 1
+    trainer = GCNTrainer.from_arrays(cfg, src, dst, datum)
+    with pytest.raises(RetriesExhaustedError, match="nonfinite_loss"):
+        supervised_run(trainer)
+    evs = _stream_events(tmp_path / "obs")
+    giveups = [e for e in _of(evs, "recovery") if e["action"] == "giveup"]
+    assert len(giveups) == 1
+    # faults: one per failed attempt (initial + 1 allowed restart)
+    assert len(_of(evs, "fault")) == 2
+
+
+# ---- chaos: stall -----------------------------------------------------------
+
+
+def test_stall_watchdog_rollback(tmp_path, monkeypatch):
+    """stall@epoch=2 blows the NTS_EPOCH_TIMEOUT_S budget; the post-epoch
+    watchdog raises StallError, the supervisor rolls back, and the retry
+    (fault exhausted) completes."""
+    monkeypatch.setenv("NTS_METRICS_DIR", str(tmp_path / "obs"))
+    monkeypatch.setenv("NTS_FAULT_SPEC", "stall@epoch=2,ms=2000")
+    monkeypatch.setenv("NTS_EPOCH_TIMEOUT_S", "0.5")
+    monkeypatch.setenv("NTS_MAX_RESTARTS", "2")
+    faults.reset()
+    src, dst, datum = _planted_data(seed=3)
+    cfg = _planted_cfg(epochs=5)
+    cfg.checkpoint_dir = str(tmp_path / "ck")
+    cfg.checkpoint_every = 1
+    trainer = GCNTrainer.from_arrays(cfg, src, dst, datum)
+    result = supervised_run(trainer)
+    assert np.isfinite(result["loss"])
+    evs = _stream_events(tmp_path / "obs")
+    fault_recs = _of(evs, "fault")
+    assert [f["kind"] for f in fault_recs] == ["stall"]
+    assert fault_recs[0]["epoch"] == 2
+    assert [r["action"] for r in _of(evs, "recovery")] == ["rollback"]
+
+
+# ---- chaos: checkpoint corruption -------------------------------------------
+
+
+def test_ckpt_corrupt_quarantine_and_fallback(tmp_path, monkeypatch):
+    """ckpt_corrupt@save=3 poisons the final save; the next resume
+    digest-verifies, quarantines it, falls back to the previous retained
+    step, and the stream records the fault + ckpt_fallback recovery."""
+    monkeypatch.setenv("NTS_METRICS_DIR", str(tmp_path / "obs"))
+    src, dst, datum = _planted_data(seed=7)
+    ck = str(tmp_path / "ck")
+
+    monkeypatch.setenv("NTS_FAULT_SPEC", "ckpt_corrupt@save=3")
+    faults.reset()
+    cfg = _planted_cfg(epochs=2)
+    cfg.checkpoint_dir = ck
+    cfg.checkpoint_every = 1
+    GCNTrainer.from_arrays(cfg, src, dst, datum).run()
+    # saves: step-1 (epoch 0), step-2 (epoch 1), step-2 re-save (final,
+    # save #3 -> corrupted)
+
+    monkeypatch.delenv("NTS_FAULT_SPEC")
+    faults.reset()
+    cfg2 = _planted_cfg(epochs=4)
+    cfg2.checkpoint_dir = ck
+    t2 = GCNTrainer.from_arrays(cfg2, src, dst, datum)
+    result = t2.run()
+    assert np.isfinite(result["loss"])
+    # fell back to step-1: epochs 1..3 ran
+    assert len(t2.epoch_times) == 3
+    assert any(d.endswith(".corrupt") for d in os.listdir(ck))
+    evs = _stream_events(tmp_path / "obs")
+    assert [f["kind"] for f in _of(evs, "fault")] == ["ckpt_corrupt"]
+    actions = [r["action"] for r in _of(evs, "recovery")]
+    assert "ckpt_fallback" in actions and "resume" in actions
+
+
+# ---- chaos: crash (hard process death, subprocess) --------------------------
+
+_CRASH_SCRIPT = """
+import os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np
+from neutronstarlite_tpu.graph.dataset import GNNDatum
+from neutronstarlite_tpu.graph.synthetic import planted_partition_graph
+from neutronstarlite_tpu.models.gcn import GCNTrainer
+from neutronstarlite_tpu.resilience.supervisor import supervised_run
+from neutronstarlite_tpu.utils.config import InputInfo
+
+v, classes, f = 200, 3, 8
+src, dst, feature, label = planted_partition_graph(
+    v, classes, avg_degree=8, feature_size=f, seed=13)
+mask = (np.arange(v) % 3).astype(np.int32)
+datum = GNNDatum(feature=feature, label=label.astype(np.int32), mask=mask)
+cfg = InputInfo()
+cfg.algorithm = "GCNCPU"
+cfg.vertices = v
+cfg.layer_string = "%d-8-%d" % (f, classes)
+cfg.epochs = 4
+cfg.learn_rate = 0.01
+cfg.decay_epoch = -1
+cfg.drop_rate = 0.0
+cfg.checkpoint_dir = sys.argv[1]
+cfg.checkpoint_every = 1
+t = GCNTrainer.from_arrays(cfg, src, dst, datum)
+result = supervised_run(t)
+print("EPOCHS_RAN", len(t.epoch_times))
+print("FINAL_LOSS", result["loss"])
+"""
+
+
+def test_crash_kills_then_next_invocation_resumes(tmp_path):
+    """crash@epoch=2 hard-kills the process (the simulated preemption /
+    OOM kill — no in-process supervisor survives it); the NEXT invocation
+    resumes from the retained checkpoint, runs only the remaining epochs,
+    and records recovery(action=resume)."""
+    ck = str(tmp_path / "ck")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("NTS_FAULT_SPEC", None)
+
+    env1 = dict(env)
+    env1["NTS_FAULT_SPEC"] = "crash@epoch=2"
+    env1["NTS_METRICS_DIR"] = str(tmp_path / "obs1")
+    r1 = subprocess.run(
+        [sys.executable, "-c", _CRASH_SCRIPT, ck],
+        env=env1, cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+    assert r1.returncode == faults.CRASH_EXIT_CODE, (
+        r1.returncode, r1.stdout[-2000:], r1.stderr[-2000:],
+    )
+    evs1 = _stream_events(tmp_path / "obs1")
+    crash_faults = [f for f in _of(evs1, "fault") if f["kind"] == "crash"]
+    assert len(crash_faults) == 1 and crash_faults[0]["injected"] is True
+
+    env2 = dict(env)
+    env2["NTS_METRICS_DIR"] = str(tmp_path / "obs2")
+    r2 = subprocess.run(
+        [sys.executable, "-c", _CRASH_SCRIPT, ck],
+        env=env2, cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+    assert r2.returncode == 0, (r2.stdout[-2000:], r2.stderr[-2000:])
+    # crashed after training epoch 2 but before its save: steps 1,2 exist
+    # -> resume at 2, run epochs 2..3
+    assert "EPOCHS_RAN 2" in r2.stdout
+    loss = float(r2.stdout.split("FINAL_LOSS")[1].strip().split()[0])
+    assert np.isfinite(loss)
+    evs2 = _stream_events(tmp_path / "obs2")
+    resumes = [r for r in _of(evs2, "recovery") if r["action"] == "resume"]
+    assert len(resumes) == 1 and resumes[0]["epoch"] == 2
+
+
+# ---- supervised restart without a checkpoint --------------------------------
+
+
+def test_supervised_restart_without_checkpoint(tmp_path, monkeypatch):
+    """No CHECKPOINT_DIR: the in-memory state may be poisoned, so the
+    supervisor rebuilds the model (fresh params) and restarts from epoch
+    0 instead of rolling back."""
+    monkeypatch.setenv("NTS_METRICS_DIR", str(tmp_path / "obs"))
+    monkeypatch.setenv("NTS_FAULT_SPEC", "nan_loss@epoch=1")
+    monkeypatch.setenv("NTS_MAX_RESTARTS", "1")
+    faults.reset()
+    src, dst, datum = _planted_data(seed=2)
+    trainer = GCNTrainer.from_arrays(_planted_cfg(epochs=3), src, dst, datum)
+    result = supervised_run(trainer)
+    assert np.isfinite(result["loss"])
+    evs = _stream_events(tmp_path / "obs")
+    assert [r["action"] for r in _of(evs, "recovery")] == ["restart"]
+
+
+# ---- event plumbing ---------------------------------------------------------
+
+
+def test_events_emit_without_sink_is_noop():
+    events.set_sink(None)
+    assert events.emit_fault("nonfinite_loss", epoch=1) is None
+    assert events.emit_recovery("rollback") is None
+
+
+def test_fault_events_validate_against_schema(tmp_path, monkeypatch):
+    from neutronstarlite_tpu.obs.registry import MetricsRegistry
+    from neutronstarlite_tpu.obs.schema import validate_event
+
+    reg = MetricsRegistry("run-x", algorithm="GCN", fingerprint="f")
+    events.set_sink(reg)
+    try:
+        rec_f = events.emit_fault("stall", epoch=4, attempt=1)
+        rec_r = events.emit_recovery("rollback", epoch=4, attempt=1)
+    finally:
+        events.set_sink(None)
+    validate_event(rec_f)
+    validate_event(rec_r)
+
+
+def test_fault_spec_rejects_internal_fields():
+    """The arg allowlist must protect dataclass internals — a spec like
+    exhausted=2 would otherwise clobber the method and crash mid-run."""
+    for bad in ("nan_loss@exhausted=2", "nan_loss@fired=0",
+                "nan_loss@kind=crash"):
+        with pytest.raises(ValueError, match="bad fault arg"):
+            parse_fault_spec(bad)
+
+
+def test_corrupt_only_checkpoint_dir_restarts_fresh(tmp_path, monkeypatch):
+    """When every retained checkpoint turns out corrupt, the retry must
+    NOT re-enter with the poisoned in-memory params (that would burn
+    every restart on the same guard trip): the supervisor's structural
+    probe chooses rollback, the restore quarantines everything and
+    returns nothing, and ckpt_begin falls back to a model rebuild."""
+    monkeypatch.setenv("NTS_METRICS_DIR", str(tmp_path / "obs"))
+    monkeypatch.setenv("NTS_MAX_RESTARTS", "1")
+    src, dst, datum = _planted_data(seed=4)
+
+    # every save is corrupted as it lands, so when nan_loss trips at
+    # epoch 1 the dir looks structurally fine (rollback chosen) but the
+    # retry's restore quarantines everything and comes back empty
+    monkeypatch.setenv(
+        "NTS_FAULT_SPEC", "ckpt_corrupt@times=99;nan_loss@epoch=1"
+    )
+    faults.reset()
+    cfg2 = _planted_cfg(epochs=3)
+    cfg2.checkpoint_dir = str(tmp_path / "ck")
+    cfg2.checkpoint_every = 1
+    trainer = GCNTrainer.from_arrays(cfg2, src, dst, datum)
+    result = supervised_run(trainer)
+    assert np.isfinite(result["loss"])
+    assert all(np.isfinite(v) for v in trainer.loss_history)
+    evs = _stream_events(tmp_path / "obs")
+    retry_actions = [r["action"] for r in _of(evs, "recovery")
+                     if r["action"] in ("rollback", "restart")]
+    # rollback attempted (structurally the dir looked fine), then the
+    # failed restore downgraded it to a fresh-params restart
+    assert retry_actions == ["rollback", "restart"]
+    assert [f["kind"] for f in _of(evs, "fault")].count("ckpt_corrupt") >= 1
+
+
+def test_retry_rewinds_epoch_telemetry(tmp_path, monkeypatch):
+    """A rolled-back attempt's tail (incl. the poisoned epoch) must not
+    double-count: after recovery, epoch_times/loss_history cover each
+    trained epoch exactly once and carry no NaN."""
+    monkeypatch.setenv("NTS_METRICS_DIR", str(tmp_path / "obs"))
+    monkeypatch.setenv("NTS_FAULT_SPEC", "nan_loss@epoch=3")
+    faults.reset()
+    src, dst, datum = _planted_data(seed=11)
+    cfg = _planted_cfg(epochs=6)
+    cfg.checkpoint_dir = str(tmp_path / "ck")
+    cfg.checkpoint_every = 1
+    trainer = GCNTrainer.from_arrays(cfg, src, dst, datum)
+    result = supervised_run(trainer)
+    assert len(trainer.epoch_times) == 6
+    assert len(trainer.loss_history) == 6
+    assert all(np.isfinite(v) for v in trainer.loss_history)
+    summary = trainer.run_summary_record
+    assert summary["epochs"] == 6
+    assert result["avg_epoch_s"] > 0
