@@ -224,7 +224,11 @@ class GCNSampleTrainer(ToolkitBase):
             cfg.batch_size, self.fanouts, cfg.epochs, self.sample_workers,
         )
         loss = None
-        for epoch in range(cfg.epochs):
+        # checkpoint/resume parity with the full-batch and dist trainers
+        # (base.ckpt_* hooks) — also what hands trained weights to serve/:
+        # the inference engine restores exactly these step dirs
+        start_epoch = self.ckpt_begin()
+        for epoch in range(start_epoch, cfg.epochs):
             t0 = get_time()
             losses = []
             for bi, b in enumerate(self.par_sampler.sample_epoch(epoch)):
@@ -259,6 +263,8 @@ class GCNSampleTrainer(ToolkitBase):
                     "Epoch %d loss %f (%d batches)",
                     epoch, self.loss_history[-1], len(losses),
                 )
+            self.ckpt_epoch_end(epoch)
+        self.ckpt_final()
         # training is done: release the sampling worker pool (a sweep that
         # builds many trainers must not accumulate forked children; a
         # second run() on the same trainer samples inline, same batches)
@@ -270,6 +276,12 @@ class GCNSampleTrainer(ToolkitBase):
         }
         avg = float(np.mean(self.epoch_times[1:])) if len(self.epoch_times) > 1 else 0.0
         log.info("--avg epoch time %.4f s", avg)
-        result = {"loss": float(loss), "acc": accs, "avg_epoch_s": avg}
+        # loss is None when a checkpoint restore resumed at/after cfg.epochs
+        # (zero epochs ran): still report the restored model's accuracy
+        result = {
+            "loss": float(loss) if loss is not None else float("nan"),
+            "acc": accs,
+            "avg_epoch_s": avg,
+        }
         self.finalize_metrics(result)
         return result

@@ -143,22 +143,31 @@ class MetricsRegistry:
         rec.update(fields)
         if self.path is not None:
             line = json.dumps(rec, default=str) + "\n"
-            if self._fh is None and event_kind == "run_start":
-                self._pending.append(line)
-            else:
-                try:
-                    if self._fh is None:
-                        self._fh = open(self.path, "a", encoding="utf-8")
-                        for p in self._pending:
-                            self._fh.write(p)
-                        self._pending.clear()
-                        log.info("metrics stream: %s", self.path)
-                    self._fh.write(line)
-                    self._fh.flush()
-                except OSError as e:  # telemetry must never kill a run
-                    log.warning("metrics write failed (%s); disabling sink", e)
-                    self._fh = None
-                    self.path = None
+            # sink state + writes stay under the lock: serving emits events
+            # from multiple threads (batcher flusher + shedding clients),
+            # and an unlocked lazy open could double-open the file while
+            # interleaved buffered writes tear lines mid-record
+            with self._lock:
+                if self.path is None:  # another thread disabled the sink
+                    return rec
+                if self._fh is None and event_kind == "run_start":
+                    self._pending.append(line)
+                else:
+                    try:
+                        if self._fh is None:
+                            self._fh = open(self.path, "a", encoding="utf-8")
+                            for p in self._pending:
+                                self._fh.write(p)
+                            self._pending.clear()
+                            log.info("metrics stream: %s", self.path)
+                        self._fh.write(line)
+                        self._fh.flush()
+                    except OSError as e:  # telemetry must never kill a run
+                        log.warning(
+                            "metrics write failed (%s); disabling sink", e
+                        )
+                        self._fh = None
+                        self.path = None
         return rec
 
     def epoch_event(
@@ -191,11 +200,12 @@ class MetricsRegistry:
         return rec
 
     def close(self) -> None:
-        if self._fh is not None:
-            try:
-                self._fh.close()
-            finally:
-                self._fh = None
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                finally:
+                    self._fh = None
 
 
 def open_run(algorithm: str, cfg: Any = None, seed: int = 0) -> MetricsRegistry:

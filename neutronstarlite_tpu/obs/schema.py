@@ -8,7 +8,8 @@ consumers rely on and allows extra keys (forward compatibility).
 
 Envelope (all events):
   event: str       one of run_start | epoch | run_summary | fault |
-                   recovery (open set)
+                   recovery | serve_request | batch_flush | shed |
+                   serve_summary (open set)
   run_id: str      "<algo>-<fingerprint>-<pid>"
   schema: int      SCHEMA_VERSION
   ts: float        wall-clock seconds (time.time())
@@ -26,6 +27,26 @@ recovery (resilience/): a recovery action taken
   action: str   rollback | restart | resume | ckpt_fallback | giveup
                 (open set)
   epoch/attempt/step: int | absent
+
+serve_request (serve/): one answered (or shed) inference request
+  n_seeds: int > 0, status: str (ok | cached | shed, open set),
+  total_ms: number | null (null only for a request that never completed)
+
+batch_flush (serve/): one micro-batch leaving the queue for the device
+  n_requests: int > 0, n_seeds: int >= 0 (0 = fully cache-served),
+  reason: str (size | deadline | drain), bucket: int | null (the AOT
+  shape bucket executed; null when nothing reached the device)
+
+shed (serve/): an overload rejection (bounded queue, reject-with-reason)
+  reason: str, queue_depth: int | absent
+
+serve_summary (serve/): consolidated end-of-serving record (the serving
+  analog of run_summary; SLO telemetry)
+  requests: int >= 0, shed: int >= 0,
+  latency_ms: object with p50 / p95 / p99 (nullable),
+  throughput_rps: number | null,
+  counters: object (the registry snapshot: serve.* counters incl.
+  per-bucket compile counts)
 
 run_summary:
   algorithm: str, fingerprint: str,
@@ -128,6 +149,42 @@ def validate_event(obj: Any) -> None:
                 obj[key], int
             ):
                 _fail(f"recovery.{key} must be an int when present")
+    elif kind == "serve_request":
+        if not isinstance(obj.get("n_seeds"), int) or obj["n_seeds"] <= 0:
+            _fail(f"serve_request.n_seeds must be a positive int, got "
+                  f"{obj.get('n_seeds')!r}")
+        if not isinstance(obj.get("status"), str) or not obj["status"]:
+            _fail("serve_request.status must be a non-empty string")
+        _require_number(obj, "total_ms", allow_none=True)
+    elif kind == "batch_flush":
+        if not isinstance(obj.get("n_requests"), int) or obj["n_requests"] <= 0:
+            _fail("batch_flush.n_requests must be a positive int")
+        if not isinstance(obj.get("n_seeds"), int) or obj["n_seeds"] < 0:
+            _fail("batch_flush.n_seeds must be a non-negative int")
+        if not isinstance(obj.get("reason"), str) or not obj["reason"]:
+            _fail("batch_flush.reason must be a non-empty string")
+        b = obj.get("bucket")
+        if b is not None and not isinstance(b, int):
+            _fail(f"batch_flush.bucket must be an int or null, got {b!r}")
+    elif kind == "shed":
+        if not isinstance(obj.get("reason"), str) or not obj["reason"]:
+            _fail("shed.reason must be a non-empty string")
+        if "queue_depth" in obj and not isinstance(obj["queue_depth"], int):
+            _fail("shed.queue_depth must be an int when present")
+    elif kind == "serve_summary":
+        for key in ("requests", "shed"):
+            if not isinstance(obj.get(key), int) or obj[key] < 0:
+                _fail(f"serve_summary.{key} must be a non-negative int")
+        lat = obj.get("latency_ms")
+        if not isinstance(lat, dict):
+            _fail("serve_summary.latency_ms must be an object")
+        for key in ("p50", "p95", "p99"):
+            if key not in lat:
+                _fail(f"serve_summary.latency_ms missing {key!r}")
+            _require_number(lat, key, allow_none=True)
+        _require_number(obj, "throughput_rps", allow_none=True)
+        if not isinstance(obj.get("counters"), dict):
+            _fail("serve_summary.counters must be an object")
 
 
 def validate_stream(events) -> int:

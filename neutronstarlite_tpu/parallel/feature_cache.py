@@ -57,6 +57,18 @@ from neutronstarlite_tpu.utils.logging import get_logger
 log = get_logger("feature_cache")
 
 
+def hot_vertex_mask(g: CSCGraph, threshold: int) -> np.ndarray:
+    """[V] bool: ``out_degree >= threshold`` — the hot/cold split rule.
+
+    This single predicate decides cacheability everywhere hybrid dependency
+    management applies: training-side it marks mirror slots worth
+    replicating (CachedMirrorGraph.build below), serving-side it marks
+    vertices whose inference embeddings are worth keeping in the LRU cache
+    (serve/sampling.py) — a high-out-degree vertex is referenced by many
+    consumers/requests, so its cached row amortizes."""
+    return np.asarray(g.out_degree) >= threshold
+
+
 def _mirror_pass1(g: CSCGraph, P: int):
     """Shared mirror preprocessing: (offsets, owner, u, u_pq, u_src) where
     ``u`` enumerates the deduplicated (consumer p, owner q, source vertex)
@@ -200,7 +212,7 @@ class CachedMirrorGraph(MirrorGraph):
         pair = (p_of_edge * P + q_of_edge) * g.v_num + src
 
         # pass 1 split: hot/cold per deduplicated (p, q) source set
-        u_hot = g.out_degree[u_src] >= replication_threshold
+        u_hot = hot_vertex_mask(g, replication_threshold)[u_src]
         pq_counts = np.bincount(u_pq, minlength=P * P)
         u_starts = np.concatenate([[0], np.cumsum(pq_counts)])
 

@@ -24,7 +24,19 @@ def apply_launcher_overrides(cfg: InputInfo) -> InputInfo:
     (run_nts.sh:2)."""
     slots = os.environ.get("NTS_PARTITIONS_OVERRIDE", "")
     if slots:
-        cfg.partitions = int(slots)
+        try:
+            cfg.partitions = int(slots)
+        except ValueError:
+            raise SystemExit(
+                f"NTS_PARTITIONS_OVERRIDE={slots!r} is not an integer slot "
+                "count (run_nts.sh <cfg> <slots> passes it through; unset "
+                "it to use the cfg's PARTITIONS)"
+            ) from None
+        if cfg.partitions < 0:
+            raise SystemExit(
+                f"NTS_PARTITIONS_OVERRIDE={slots!r} must be >= 0 "
+                "(0 = use all devices in the mesh)"
+            )
     return cfg
 
 

@@ -64,21 +64,31 @@ class Sampler:
         fanouts: Sequence[int],
         seed: int = 0,
         use_native: Optional[bool] = None,
+        rng: Optional[np.random.Generator] = None,
     ):
         self.graph = graph
         self.seed_nids = np.asarray(seed_nids, dtype=np.int64)
         self.batch_size = batch_size
+        if use_native and rng is not None:
+            # the native sampler seeds its own PRNG from ``seed`` and would
+            # silently ignore the injected Generator — contradictory args
+            raise ValueError(
+                "use_native=True cannot honor an injected rng; pass one or "
+                "the other"
+            )
         if use_native is None:
+            # an injected Generator must actually drive the draws (see
+            # above), so default to the NumPy path when one is supplied
             from neutronstarlite_tpu import native
 
-            use_native = native.available()
+            use_native = native.available() if rng is None else False
         self.use_native = bool(use_native)
         self._native_seed = seed
         # fanouts listed outermost-first in the cfg (FANOUT:5-10-10); hop h
         # (input -> output) uses fanouts[h] reversed so the seed-adjacent hop
         # gets the last entry, matching init_gnnctx_fanout's layer indexing.
         self.fanouts = list(fanouts)
-        self.rng = np.random.default_rng(seed)
+        self.rng = np.random.default_rng(seed) if rng is None else rng
         # per-layer node capacities, seeds outward
         n_hops = len(self.fanouts)
         caps = [batch_size]
@@ -168,6 +178,21 @@ class Sampler:
         return SampledBatch(
             nodes=list(nodes), hops=list(hops), seed_mask=seed_mask, seeds=seeds_pad
         )
+
+    def sample_batch(self, seeds) -> SampledBatch:
+        """One padded batch for an arbitrary seed set (<= batch_size) —
+        the online-serving entry point (serve/sampling.py): a request's
+        fresh-node fan-out, same capacities and distribution as the
+        training epoch walk."""
+        seeds = np.asarray(seeds, dtype=np.int64)
+        if seeds.ndim != 1 or len(seeds) == 0:
+            raise ValueError("sample_batch needs a non-empty 1-D seed array")
+        if len(seeds) > self.batch_size:
+            raise ValueError(
+                f"{len(seeds)} seeds exceed this sampler's batch capacity "
+                f"{self.batch_size}"
+            )
+        return self._make_batch(seeds)
 
     def sample_epoch(self, shuffle: bool = True):
         """Yield SampledBatch for every seed batch (the work-queue walk,

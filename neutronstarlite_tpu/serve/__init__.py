@@ -1,0 +1,43 @@
+"""serve/ — online GNN inference serving (docs/SERVING.md).
+
+The training-only reproduction turned into a service: a digest-verified
+checkpoint is reconstructed in eval mode, a ladder of shape-bucketed
+forward executables is AOT-compiled once (engine.py), per-node requests
+coalesce in a deadline/size micro-batching queue with explicit overload
+shedding (batcher.py), fresh-node fan-outs reuse the training sampler with
+an LRU inference embedding cache on top (sampling.py), and every serving
+event is a typed obs/ record (server.py) rendered by tools/metrics_report.
+
+Entry points:
+  python -m neutronstarlite_tpu.serve.server <cfg> [<ckpt_dir>]
+  python -m neutronstarlite_tpu.tools.serve_bench <cfg> [--train] ...
+"""
+
+import importlib
+
+# lazy re-exports: importing the package (or its light modules — batcher,
+# sampling — e.g. from the jax-free report CLI) must not pull jax via
+# engine/server
+_EXPORTS = {
+    "MicroBatcher": "batcher",
+    "RequestShedError": "batcher",
+    "ServeOptions": "batcher",
+    "ServeRequest": "batcher",
+    "latency_percentiles": "batcher",
+    "InferenceEngine": "engine",
+    "ServeSetupError": "engine",
+    "EmbeddingCache": "sampling",
+    "ServeSampler": "sampling",
+    "InferenceServer": "server",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    return getattr(
+        importlib.import_module(f"neutronstarlite_tpu.serve.{mod}"), name
+    )

@@ -1,0 +1,248 @@
+"""Inference engine: digest-verified checkpoint -> AOT bucket executables.
+
+Turns a trained sampled-GCN toolkit into an online scorer:
+
+1. **Checkpoint load.** The model is reconstructed through the trainer's
+   own lifecycle (``get_algorithm`` -> ``init_graph``/``init_nn``) and the
+   weights restored via utils/checkpoint.py — the same digest-verified,
+   quarantine-on-corruption restore path training resume uses, so a
+   bit-flipped checkpoint can never silently serve garbage.
+
+2. **Eval-mode forward.** The per-bucket forward is the exact eval-mode
+   computation of the sampled trainer (models/gcn_sample.py
+   ``batch_forward`` with ``train=False``): feature gather ->
+   per-hop ``minibatch_gather`` + matmul (+ relu between layers), dropout
+   compiled out entirely. Served logits are therefore bit-identical to the
+   toolkit's own eval forward on the same sampled batch (the parity oracle
+   in tests/test_serve.py).
+
+3. **AOT shape buckets.** Request batches vary in size, but XLA recompiles
+   per shape — fatal for tail latency. So a small ladder of batch-size
+   buckets (ServeOptions.ladder) is compiled ahead of time via
+   ``jax.jit(...).lower(...).compile()``; every flush pads to the smallest
+   covering bucket and replays that executable. ``compile_counts`` proves
+   the discipline: exactly one compilation per bucket, ever — the
+   fixed-shape compile-once design the sampler's padded capacities were
+   built for (SURVEY.md "pad to fanout capacity ... to avoid
+   recompilation"; Accel-GCN's fixed-shape execution argument).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neutronstarlite_tpu.ops.minibatch import get_feature, minibatch_gather
+from neutronstarlite_tpu.sample.sampler import SampledBatch
+from neutronstarlite_tpu.serve.batcher import ServeOptions
+from neutronstarlite_tpu.serve.sampling import ServeSampler
+from neutronstarlite_tpu.utils.config import InputInfo
+from neutronstarlite_tpu.utils.logging import get_logger
+
+log = get_logger("serve")
+
+
+class ServeSetupError(RuntimeError):
+    """Unservable configuration (no checkpoint, unsupported model, ...)."""
+
+
+def _eval_forward_fn(caps: List[int], compute_dtype):
+    """The bucket's eval-mode forward — textually the ``train=False`` path
+    of GCNSampleTrainer.build_model's batch_forward (dropout never traced),
+    closed over this bucket's node capacities."""
+
+    def cast(a):
+        return a.astype(compute_dtype) if compute_dtype is not None else a
+
+    def forward(params, feature, nodes, hops):
+        x = cast(get_feature(feature, nodes[0]))
+        for i, (p, (src_l, dst_l, w)) in enumerate(zip(params, hops)):
+            agg = minibatch_gather(src_l, dst_l, w, x, caps[i + 1])
+            h = cast(agg) @ cast(p["W"])
+            if i < len(params) - 1:
+                h = jax.nn.relu(h)
+            x = h
+        return x.astype(jnp.float32)  # [bucket, n_classes]
+
+    return forward
+
+
+def batch_device_args(batch: SampledBatch):
+    """SampledBatch -> the (nodes, hops) device pytree, one conversion for
+    both the AOT lowering and every steady-state call (shapes and dtypes
+    must match the compiled executable's avals exactly)."""
+    nodes = [jnp.asarray(n) for n in batch.nodes]
+    hops = [
+        (jnp.asarray(h.src_local), jnp.asarray(h.dst_local),
+         jnp.asarray(h.weight))
+        for h in batch.hops
+    ]
+    return nodes, hops
+
+
+class InferenceEngine:
+    """Checkpoint-backed scorer with a ladder of AOT bucket executables."""
+
+    def __init__(
+        self,
+        toolkit: Any,
+        ckpt_dir: str,
+        options: Optional[ServeOptions] = None,
+        metrics: Any = None,
+        rng: Optional[np.random.Generator] = None,
+    ):
+        self.toolkit = toolkit
+        self.cfg = toolkit.cfg
+        self.opts = options or ServeOptions.from_cfg(self.cfg)
+        self.metrics = metrics if metrics is not None else toolkit.metrics
+        # structural check FIRST: an unservable parameter family must fail
+        # with this message, not an opaque tree-mismatch inside restore
+        self._check_servable(toolkit.params)
+        self._restore(ckpt_dir)
+        self.params = toolkit.params
+        self.feature = toolkit.feature
+        fanouts = getattr(toolkit, "fanouts", None)
+        if not fanouts:
+            sizes = self.cfg.layer_sizes()
+            fanouts = self.cfg.fanouts()[-(len(sizes) - 1):]
+        if not fanouts:
+            raise ServeSetupError(
+                "serving samples per-request fan-outs; the cfg needs FANOUT"
+            )
+        self.fanouts = list(fanouts)
+        self.compute_dtype = (
+            jnp.bfloat16 if self.cfg.precision == "bfloat16" else None
+        )
+        self.sampler = ServeSampler(
+            toolkit.host_graph, self.fanouts, self.opts.ladder(), rng=rng
+        )
+        self.buckets = self.sampler.buckets
+        self._compiled: Dict[int, Any] = {}
+        self.compile_counts: Dict[int, int] = {}
+
+    # ---- construction ----------------------------------------------------
+    @classmethod
+    def from_config(
+        cls,
+        cfg: InputInfo,
+        base_dir: Optional[str] = None,
+        ckpt_dir: str = "",
+        options: Optional[ServeOptions] = None,
+        rng: Optional[np.random.Generator] = None,
+    ) -> "InferenceEngine":
+        """Full lifecycle from a cfg file's contents: load graph + datum,
+        build the model, restore the checkpoint."""
+        from neutronstarlite_tpu.models import get_algorithm
+
+        ckpt = ckpt_dir or cfg.checkpoint_dir
+        if not ckpt:
+            raise ServeSetupError(
+                "no checkpoint directory: pass one explicitly or set "
+                "CHECKPOINT_DIR in the cfg"
+            )
+        # serving never consumes the training batch stream — suppress the
+        # sampled trainer's forked worker pool for this construction
+        prev = os.environ.get("NTS_SAMPLE_WORKERS")
+        os.environ["NTS_SAMPLE_WORKERS"] = "0"
+        try:
+            toolkit = get_algorithm(cfg.algorithm)(cfg, base_dir=base_dir)
+            toolkit.init_graph()
+            toolkit.init_nn()
+        finally:
+            if prev is None:
+                os.environ.pop("NTS_SAMPLE_WORKERS", None)
+            else:
+                os.environ["NTS_SAMPLE_WORKERS"] = prev
+        return cls(toolkit, ckpt, options=options, rng=rng)
+
+    def _restore(self, ckpt_dir: str) -> None:
+        from neutronstarlite_tpu.utils.checkpoint import have_checkpoint
+
+        if not ckpt_dir or not have_checkpoint(
+            ckpt_dir, getattr(self.cfg, "ckpt_backend", "")
+        ):
+            raise ServeSetupError(
+                f"no checkpoint under {ckpt_dir!r} — train first "
+                "(CHECKPOINT_DIR + a run), or point serving at an "
+                "existing one"
+            )
+        step = self.toolkit.restore(ckpt_dir)  # digest-verified restore
+        if step == 0 and not have_checkpoint(
+            ckpt_dir, getattr(self.cfg, "ckpt_backend", "")
+        ):
+            # every retained step failed verification and was quarantined
+            raise ServeSetupError(
+                f"every checkpoint under {ckpt_dir!r} failed integrity "
+                "verification (quarantined *.corrupt)"
+            )
+        self.ckpt_step = step
+        log.info("serving checkpoint step %d from %s", step, ckpt_dir)
+
+    def _check_servable(self, p) -> None:
+        """The engine serves the sampled-GCN parameter family: a list of
+        layers each holding exactly one dense ``W``. Anything else (bn
+        stats, attention params) would silently skip math — refuse."""
+        ok = isinstance(p, (list, tuple)) and len(p) > 0 and all(
+            isinstance(layer, dict) and set(layer) == {"W"} for layer in p
+        )
+        if not ok:
+            raise ServeSetupError(
+                f"ALGORITHM {self.cfg.algorithm!r} checkpoints are not "
+                "servable: the engine supports the sampled-GCN family "
+                "(params = [{'W': ...}, ...]); train with "
+                "ALGORITHM:GCNSAMPLESINGLE"
+            )
+
+    # ---- AOT bucket executables ------------------------------------------
+    def warmup(self, buckets: Optional[List[int]] = None) -> None:
+        """Compile the executable ladder ahead of traffic."""
+        for b in buckets if buckets is not None else self.buckets:
+            self._ensure_compiled(int(b))
+
+    def _ensure_compiled(self, bucket: int):
+        compiled = self._compiled.get(bucket)
+        if compiled is not None:
+            return compiled
+        caps = self.sampler.node_caps(bucket)
+        forward = _eval_forward_fn(caps, self.compute_dtype)
+        # one host-side sample supplies shape-representative args: padded
+        # capacities are static per bucket, so any seed set works
+        rep = self.sampler.sample(
+            bucket, np.zeros(1, np.int64)
+        )
+        nodes, hops = batch_device_args(rep)
+        t0 = time.perf_counter()
+        compiled = jax.jit(forward).lower(
+            self.params, self.feature, nodes, hops
+        ).compile()
+        dt = time.perf_counter() - t0
+        self._compiled[bucket] = compiled
+        self.compile_counts[bucket] = self.compile_counts.get(bucket, 0) + 1
+        if self.metrics is not None:
+            self.metrics.counter_add(f"serve.compiles.bucket_{bucket}")
+            self.metrics.observe("serve.compile", dt)
+        log.info("AOT-compiled bucket %d (caps %s) in %.3fs", bucket, caps, dt)
+        return compiled
+
+    # ---- scoring ---------------------------------------------------------
+    def forward_batch(self, batch: SampledBatch,
+                      bucket: Optional[int] = None) -> np.ndarray:
+        """Logits [bucket, n_classes] for a prepared SampledBatch (rows
+        beyond the real seed count are padding)."""
+        b = int(bucket) if bucket is not None else len(batch.seeds)
+        compiled = self._ensure_compiled(b)
+        nodes, hops = batch_device_args(batch)
+        return np.asarray(compiled(self.params, self.feature, nodes, hops))
+
+    def predict(self, node_ids: np.ndarray) -> np.ndarray:
+        """Fresh-sampled logits [n, n_classes] for arbitrary vertex ids."""
+        ids = np.asarray(node_ids, dtype=np.int64).reshape(-1)
+        bucket = self.sampler.bucket_for(len(ids))
+        batch = self.sampler.sample(bucket, ids)
+        logits = self.forward_batch(batch, bucket)
+        return logits[: len(ids)]

@@ -1,0 +1,266 @@
+"""In-process inference server + CLI entrypoint.
+
+``InferenceServer`` composes the three serving pieces: requests enter the
+micro-batching queue (serve/batcher.py), flushes look up the inference
+embedding cache then sample + execute the remainder on the smallest
+covering AOT bucket (serve/sampling.py + serve/engine.py), and every event
+lands in the obs stream as a typed record (serve_request / batch_flush /
+shed / serve_summary) — serving runs produce the same JSONL + report
+artifacts as training runs (tools/metrics_report renders them).
+
+The request API is deliberately transport-free: ``submit()`` returns a
+future, ``predict()`` blocks — an HTTP/RPC front end is a thin loop over
+it, and the load generator (tools/serve_bench.py) drives it directly.
+
+CLI: ``python -m neutronstarlite_tpu.serve.server <cfg> [<ckpt_dir>]
+[--requests N]`` loads the checkpoint, warms the bucket ladder, serves a
+batch of random requests, and prints the latency summary (docs/SERVING.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from neutronstarlite_tpu.serve.batcher import (  # noqa: E402
+    MicroBatcher,
+    ServeOptions,
+    ServeRequest,
+    latency_percentiles,
+)
+from neutronstarlite_tpu.serve.engine import InferenceEngine  # noqa: E402
+from neutronstarlite_tpu.serve.sampling import EmbeddingCache  # noqa: E402
+from neutronstarlite_tpu.utils.logging import get_logger  # noqa: E402
+
+log = get_logger("serve")
+
+
+class InferenceServer:
+    """Micro-batched, cache-fronted serving over one InferenceEngine."""
+
+    def __init__(self, engine: InferenceEngine,
+                 options: Optional[ServeOptions] = None):
+        self.engine = engine
+        self.opts = options or engine.opts
+        self.metrics = engine.metrics
+        self.cache = EmbeddingCache.for_graph(
+            engine.toolkit.host_graph,
+            self.opts.cache_cap,
+            self.opts.cache_max_age_s,
+            self.opts.hot_threshold,
+        )
+        self.batcher = MicroBatcher(self._flush, self.opts, self.metrics)
+        self._stats_lock = threading.Lock()
+        self._latencies_ms: List[float] = []
+        self._t_first: Optional[float] = None
+        self._t_last: Optional[float] = None
+        self.request_count = 0
+        self._closed = False
+
+    # ---- request API -----------------------------------------------------
+    def submit(self, node_ids) -> ServeRequest:
+        """Enqueue one request (any 1..max_batch vertex ids); returns the
+        future. Overload rejects with RequestShedError on the future."""
+        return self.batcher.submit(node_ids)
+
+    def predict(self, node_ids, timeout: Optional[float] = 60.0) -> np.ndarray:
+        """Blocking convenience wrapper: logits [n, n_classes]."""
+        return self.submit(node_ids).result(timeout)
+
+    # ---- the flush path (batcher thread) ---------------------------------
+    def _flush(self, requests: List[ServeRequest], reason: str) -> None:
+        t0 = time.perf_counter()
+        # cache pass: per requested id, a fresh cached row or a compute slot
+        all_ids: List[int] = []
+        seen = set()
+        cached_rows: Dict[int, np.ndarray] = {}
+        for r in requests:
+            for vid in r.node_ids.tolist():
+                if vid in seen:
+                    continue
+                seen.add(vid)
+                row = self.cache.lookup(vid)
+                if row is not None:
+                    cached_rows[vid] = row
+                else:
+                    all_ids.append(vid)
+        bucket = None
+        rows: Dict[int, np.ndarray] = dict(cached_rows)
+        if all_ids:
+            uniq = np.asarray(all_ids, dtype=np.int64)
+            bucket = self.engine.sampler.bucket_for(len(uniq))
+            batch = self.engine.sampler.sample(bucket, uniq)
+            logits = self.engine.forward_batch(batch, bucket)
+            for i, vid in enumerate(uniq.tolist()):
+                rows[vid] = logits[i]
+            self.cache.insert(uniq, logits[: len(uniq)])
+        exec_ms = (time.perf_counter() - t0) * 1000.0
+
+        for r in requests:
+            out = np.stack([rows[v] for v in r.node_ids.tolist()])
+            status = "cached" if all(
+                v in cached_rows for v in r.node_ids.tolist()
+            ) else "ok"
+            r._complete(out, status)
+        self._record(requests, reason, bucket, len(all_ids), exec_ms)
+
+    def _record(self, requests: List[ServeRequest], reason: str,
+                bucket: Optional[int], n_seeds: int, exec_ms: float) -> None:
+        now = time.perf_counter()
+        with self._stats_lock:
+            if self._t_first is None:
+                self._t_first = requests[0].t_submit
+            self._t_last = now
+            self.request_count += len(requests)
+            for r in requests:
+                if r.total_ms is not None:
+                    self._latencies_ms.append(r.total_ms)
+        if self.metrics is None:
+            return
+        self.metrics.counter_add("serve.batches")
+        self.metrics.counter_add("serve.requests", len(requests))
+        if bucket is not None:
+            self.metrics.counter_add("serve.computed_seeds", n_seeds)
+            self.metrics.counter_add(
+                "serve.padded_seeds", max(bucket - n_seeds, 0)
+            )
+        self.metrics.observe("serve.exec", exec_ms / 1000.0)
+        self.metrics.event(
+            "batch_flush", n_requests=len(requests), n_seeds=n_seeds,
+            reason=reason, bucket=bucket, exec_ms=exec_ms,
+        )
+        for r in requests:
+            if r.status == "cached":
+                self.metrics.counter_add("serve.cached_requests")
+            self.metrics.event(
+                "serve_request", n_seeds=len(r.node_ids), status=r.status,
+                total_ms=r.total_ms, queue_ms=r.queue_ms,
+            )
+
+    # ---- SLO telemetry ---------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        with self._stats_lock:
+            lat = latency_percentiles(self._latencies_ms)
+            span = (
+                self._t_last - self._t_first
+                if self._t_first is not None and self._t_last is not None
+                else None
+            )
+            served = self.request_count
+        rps = served / span if span and span > 0 else None
+        return {
+            "requests": served,
+            "shed": self.batcher.shed_count,
+            "latency_ms": lat,
+            "throughput_rps": rps,
+            "cache": self.cache.stats(),
+            "compile_counts": dict(self.engine.compile_counts),
+        }
+
+    def close(self) -> Dict[str, Any]:
+        """Drain the queue, emit the consolidated serve_summary record, and
+        return the stats dict (idempotent)."""
+        if self._closed:
+            return self.stats()
+        self._closed = True
+        self.batcher.close()
+        s = self.stats()
+        if self.metrics is not None:
+            snap = self.metrics.snapshot()
+            self.metrics.event(
+                "serve_summary",
+                requests=s["requests"],
+                shed=s["shed"],
+                latency_ms=s["latency_ms"],
+                throughput_rps=s["throughput_rps"],
+                counters=snap["counters"],
+                cache=s["cache"],
+                compile_counts={
+                    str(k): v for k, v in s["compile_counts"].items()
+                },
+                ckpt_step=self.engine.ckpt_step,
+            )
+            self.metrics.close()
+        return s
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    from neutronstarlite_tpu.utils.config import InputInfo
+    from neutronstarlite_tpu.utils.platform import honor_platform_env
+
+    honor_platform_env()
+    ap = argparse.ArgumentParser(
+        description="serve a trained checkpoint: load, AOT-warm the bucket "
+        "ladder, answer --requests random per-node predictions, print SLOs"
+    )
+    ap.add_argument("cfg", help="training .cfg (LAYERS/FANOUT/paths)")
+    ap.add_argument("ckpt", nargs="?", default="",
+                    help="checkpoint dir (default: the cfg's CHECKPOINT_DIR)")
+    ap.add_argument("--requests", type=int, default=50)
+    ap.add_argument("--seeds-per-request", type=int, default=1)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    cfg = InputInfo.read_from_cfg_file(args.cfg)
+    base_dir = os.path.dirname(os.path.abspath(args.cfg))
+    from neutronstarlite_tpu.serve.engine import ServeSetupError
+
+    try:
+        engine = InferenceEngine.from_config(
+            cfg, base_dir=base_dir, ckpt_dir=args.ckpt,
+            rng=np.random.default_rng(args.seed),
+        )
+    except ServeSetupError as e:
+        print(f"serve: {e}", file=sys.stderr)
+        return 2
+    engine.warmup()
+    server = InferenceServer(engine)
+    rng = np.random.default_rng(args.seed + 1)
+    v_num = engine.toolkit.host_graph.v_num
+    pending = [
+        server.submit(rng.integers(0, v_num, size=args.seeds_per_request))
+        for _ in range(args.requests)
+    ]
+    errors = 0
+    for req in pending:
+        try:
+            req.result(timeout=120.0)
+        except Exception:
+            errors += 1
+    s = server.close()
+    lat = s["latency_ms"]
+
+    def _fmt(v):
+        return f"{v:.2f}ms" if v is not None else "n/a"
+
+    print(
+        f"served {s['requests']} requests (shed {s['shed']}, errors {errors})"
+        f" | p50 {_fmt(lat['p50'])} p95 {_fmt(lat['p95'])} "
+        f"p99 {_fmt(lat['p99'])}"
+        + (f" | {s['throughput_rps']:.1f} req/s"
+           if s["throughput_rps"] else "")
+    )
+    if engine.metrics is not None and engine.metrics.path:
+        print(f"metrics stream: {engine.metrics.path} (render with "
+              f"python -m neutronstarlite_tpu.tools.metrics_report "
+              f"{engine.metrics.path})")
+    return 0 if errors == 0 else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

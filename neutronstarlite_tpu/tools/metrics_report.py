@@ -112,6 +112,86 @@ def _ms(v: Optional[float]) -> str:
     return f"{v * 1000:.3f}" if v is not None else "n/a"
 
 
+# ---- serving streams (serve/) ----------------------------------------------
+
+
+def summarize_serve(events: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """The serve_summary record for a stream that served requests (last one
+    wins), synthesized from serve_request records when the server died
+    before close() — mirroring summarize()'s contract for training runs."""
+    serves = [e for e in events if e["event"] == "serve_summary"]
+    if serves:
+        rec = dict(serves[-1])
+        rec["synthesized"] = False
+        return rec
+    reqs = [e for e in events if e["event"] == "serve_request"]
+    if not reqs:
+        return None
+    served = [e for e in reqs if e["status"] != "shed"]
+    lat = [e["total_ms"] for e in served if e.get("total_ms") is not None]
+    # same percentile definition as the live serve_summary
+    # (serve.batcher.latency_percentiles — jax-free import), so a
+    # died-server report stays comparable to a clean one
+    from neutronstarlite_tpu.serve.batcher import latency_percentiles
+
+    ts = [e["ts"] for e in served]
+    span = (max(ts) - min(ts)) if len(ts) > 1 else 0.0
+    return {
+        "event": "serve_summary",
+        "run_id": reqs[-1]["run_id"],
+        # "requests" counts ANSWERED requests, matching the live record
+        # (InferenceServer.request_count only counts flushed requests;
+        # sheds are separate there too)
+        "requests": len(served),
+        "shed": sum(1 for e in reqs if e["status"] == "shed"),
+        "latency_ms": latency_percentiles(lat),
+        "throughput_rps": (len(ts) / span) if span > 0 else None,
+        "counters": {},
+        "synthesized": True,
+    }
+
+
+def _lat_ms(v: Optional[float]) -> str:
+    return f"{v:.3f}" if v is not None else "n/a"
+
+
+def render_serve(path: str, rec: Dict[str, Any],
+                 events: List[Dict[str, Any]]) -> str:
+    """The #key=value(ms) block for one serving stream."""
+    lat = rec.get("latency_ms") or {}
+    rps = rec.get("throughput_rps")
+    lines = [
+        f"== serve {rec.get('run_id', '?')}"
+        f"{' (synthesized)' if rec.get('synthesized') else ''} — {path}",
+        "--------------------finish serving !",
+        f"#requests={rec.get('requests', 0)}",
+        f"#shed={rec.get('shed', 0)}",
+        f"#p50_latency={_lat_ms(lat.get('p50'))}(ms)",
+        f"#p95_latency={_lat_ms(lat.get('p95'))}(ms)",
+        f"#p99_latency={_lat_ms(lat.get('p99'))}(ms)",
+        f"#throughput={f'{rps:.2f}' if rps is not None else 'n/a'}(req/s)",
+    ]
+    flushes = [e for e in events if e["event"] == "batch_flush"]
+    if flushes:
+        reasons: Dict[str, int] = {}
+        for e in flushes:
+            reasons[e["reason"]] = reasons.get(e["reason"], 0) + 1
+        lines.append(
+            f"#batches={len(flushes)} ("
+            + " ".join(f"{k}={v}" for k, v in sorted(reasons.items())) + ")"
+        )
+    for name, v in sorted((rec.get("counters") or {}).items()):
+        v = int(v) if float(v).is_integer() else v
+        lines.append(f"#{name}={v}")
+    cache = rec.get("cache")
+    if isinstance(cache, dict):
+        lines.append(
+            "#cache_hits={hits} misses={misses} entries={entries} "
+            "expired={expired}".format(**cache)
+        )
+    return "\n".join(lines)
+
+
 _TIMELINE_SKIP = ("event", "run_id", "schema", "ts", "seq", "error")
 
 
@@ -232,16 +312,22 @@ def main(argv=None) -> int:
             failed = True
             continue
         rec = summarize(p, events)
-        if rec is None:
+        srec = summarize_serve(events)
+        if rec is None and srec is None:
             # a run_start-only stream (trainer constructed/crashed before
             # its first epoch) is skippable noise, not a render failure —
             # but a directory yielding NOTHING still exits 1 below
-            print(f"{p}: no run_summary or epoch events; skipping",
+            print(f"{p}: no run_summary, epoch, or serving events; skipping",
                   file=sys.stderr)
             continue
-        rec["_path"] = p
-        rec["_timeline"] = recovery_timeline(events)
-        rows.append(rec)
+        if rec is not None:
+            rec["_path"] = p
+            rec["_timeline"] = recovery_timeline(events)
+        if srec is not None:
+            srec["_path"] = p
+            srec["_events"] = events
+            srec["_serve"] = True
+        rows.extend(r for r in (rec, srec) if r is not None)
     if not rows:
         return 1
     if args.json:
@@ -251,10 +337,14 @@ def main(argv=None) -> int:
         ))
     else:
         for rec in rows:
-            print(render_run(rec["_path"], rec))
+            if rec.get("_serve"):
+                print(render_serve(rec["_path"], rec, rec["_events"]))
+            else:
+                print(render_run(rec["_path"], rec))
             print()
-        if len(rows) > 1:
-            print(render_table(rows))
+        train_rows = [r for r in rows if not r.get("_serve")]
+        if len(train_rows) > 1:
+            print(render_table(train_rows))
     return 1 if failed else 0
 
 

@@ -1,0 +1,298 @@
+"""Serving load generator: closed/open-loop SLO measurement.
+
+``python -m neutronstarlite_tpu.tools.serve_bench <cfg> [<ckpt_dir>]
+[--train] [--mode closed|open] [--clients C | --rps R] [--requests N]``
+
+Drives the in-process serving stack (serve/server.py) and reports tail
+latency + throughput **from the obs records**: the serving run writes its
+typed JSONL stream (serve_request / batch_flush / shed / serve_summary)
+under NTS_METRICS_DIR (a temp dir when unset), and the percentiles printed
+here are computed by re-reading that stream — the measurement artifact is
+the same one tools/metrics_report renders, not a private side channel.
+
+Two load models:
+- **closed** (default): C concurrent clients, each submits its next
+  request only after the previous completes — measures capacity at a
+  fixed concurrency (the classic closed-loop knee).
+- **open**: requests arrive at a fixed rate R regardless of completions —
+  measures behavior under offered load, including the shedding path once
+  R exceeds capacity.
+
+``--train`` first runs the cfg's training loop (with CHECKPOINT_DIR set
+to the serving checkpoint dir) when no checkpoint exists yet — the
+zero-to-serving path for smoke configs.
+
+Prints ONE BENCH_*-compatible JSON line:
+  {"metric": "serve_p99_latency_ms", "value": ..., "unit": "ms",
+   "vs_baseline": null, "extra": {p50/p95/p99, throughput, sheds, ...}}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+from typing import Any, Dict, List
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from neutronstarlite_tpu.utils.logging import get_logger  # noqa: E402
+
+log = get_logger("serve_bench")
+
+
+def ensure_checkpoint(cfg, base_dir: str, ckpt_dir: str, train: bool) -> None:
+    """Train the cfg's toolkit into ``ckpt_dir`` when empty and --train."""
+    from neutronstarlite_tpu.utils.checkpoint import have_checkpoint
+
+    if have_checkpoint(ckpt_dir, getattr(cfg, "ckpt_backend", "")):
+        return
+    if not train:
+        raise SystemExit(
+            f"no checkpoint under {ckpt_dir!r}; pass --train to train one "
+            "from the cfg first"
+        )
+    from neutronstarlite_tpu.models import get_algorithm
+
+    log.info("no checkpoint under %s; training %d epochs first",
+             ckpt_dir, cfg.epochs)
+    prev = os.environ.get("NTS_SAMPLE_WORKERS")
+    os.environ.setdefault("NTS_SAMPLE_WORKERS", "0")
+    try:
+        toolkit = get_algorithm(cfg.algorithm)(cfg, base_dir=base_dir)
+        toolkit.init_graph()
+        toolkit.init_nn()
+        toolkit.run()
+    finally:
+        if prev is None:
+            os.environ.pop("NTS_SAMPLE_WORKERS", None)
+
+
+def run_closed_loop(server, v_num: int, n_requests: int, clients: int,
+                    seeds_per_request: int, seed: int) -> int:
+    """C clients, each with one request outstanding; returns error count."""
+    counter = {"next": 0, "errors": 0}
+    lock = threading.Lock()
+
+    def client(idx: int) -> None:
+        rng = np.random.default_rng(seed + 1000 + idx)
+        while True:
+            with lock:
+                if counter["next"] >= n_requests:
+                    return
+                counter["next"] += 1
+            req = server.submit(rng.integers(0, v_num, seeds_per_request))
+            try:
+                req.result(timeout=120.0)
+            except Exception:
+                with lock:
+                    counter["errors"] += 1
+
+    threads = [
+        threading.Thread(target=client, args=(i,), daemon=True)
+        for i in range(max(clients, 1))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return counter["errors"]
+
+
+def run_open_loop(server, v_num: int, n_requests: int, rps: float,
+                  seeds_per_request: int, seed: int) -> int:
+    """Fixed arrival rate; sheds count as completed-with-error."""
+    rng = np.random.default_rng(seed + 2000)
+    interval = 1.0 / max(rps, 1e-6)
+    pending = []
+    t_next = time.perf_counter()
+    for _ in range(n_requests):
+        now = time.perf_counter()
+        if now < t_next:
+            time.sleep(t_next - now)
+        t_next += interval
+        pending.append(
+            server.submit(rng.integers(0, v_num, seeds_per_request))
+        )
+    errors = 0
+    for req in pending:
+        try:
+            req.result(timeout=120.0)
+        except Exception:
+            errors += 1
+    return errors
+
+
+def percentiles_from_stream(path: str) -> Dict[str, Any]:
+    """Recompute the SLO numbers from the serving obs JSONL records."""
+    from neutronstarlite_tpu.obs import schema
+
+    lat: List[float] = []
+    ts: List[float] = []
+    shed = 0
+    flushes = 0
+    summary = None
+    with open(path, "r", encoding="utf-8") as fh:
+        for raw in fh:
+            raw = raw.strip()
+            if not raw:
+                continue
+            obj = json.loads(raw)
+            schema.validate_event(obj)
+            if obj["event"] == "serve_request":
+                if obj["status"] == "shed":
+                    shed += 1
+                elif obj.get("total_ms") is not None:
+                    lat.append(obj["total_ms"])
+                    ts.append(obj["ts"])
+            elif obj["event"] == "batch_flush":
+                flushes += 1
+            elif obj["event"] == "serve_summary":
+                summary = obj
+    out: Dict[str, Any] = {"served": len(lat), "shed": shed,
+                           "batches": flushes, "summary": summary}
+    if lat:
+        p50, p95, p99 = np.percentile(np.asarray(lat), [50, 95, 99])
+        out["latency_ms"] = {
+            "p50": float(p50), "p95": float(p95), "p99": float(p99),
+        }
+        span = max(ts) - min(ts)
+        out["throughput_rps"] = len(lat) / span if span > 0 else None
+    else:
+        out["latency_ms"] = {"p50": None, "p95": None, "p99": None}
+        out["throughput_rps"] = None
+    return out
+
+
+def main(argv=None) -> int:
+    from neutronstarlite_tpu.utils.platform import honor_platform_env
+
+    honor_platform_env()
+    ap = argparse.ArgumentParser(
+        description="closed/open-loop serving benchmark over the serve/ "
+        "stack; prints one BENCH-compatible JSON line"
+    )
+    ap.add_argument("cfg")
+    ap.add_argument("ckpt", nargs="?", default="",
+                    help="checkpoint dir (default: cfg CHECKPOINT_DIR, "
+                    "or a temp dir with --train)")
+    ap.add_argument("--train", action="store_true",
+                    help="train the cfg first when no checkpoint exists")
+    ap.add_argument("--mode", choices=("closed", "open"), default="closed")
+    ap.add_argument("--clients", type=int, default=4,
+                    help="closed-loop concurrency")
+    ap.add_argument("--rps", type=float, default=200.0,
+                    help="open-loop arrival rate")
+    ap.add_argument("--requests", type=int, default=200)
+    ap.add_argument("--seeds-per-request", type=int, default=1)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    from neutronstarlite_tpu.utils.config import InputInfo
+
+    cfg = InputInfo.read_from_cfg_file(args.cfg)
+    base_dir = os.path.dirname(os.path.abspath(args.cfg))
+    scratch = None
+    ckpt_dir = args.ckpt or cfg.checkpoint_dir
+    if not ckpt_dir:
+        if not args.train:
+            raise SystemExit(
+                "no checkpoint dir: pass one, set CHECKPOINT_DIR in the "
+                "cfg, or use --train"
+            )
+        scratch = tempfile.mkdtemp(prefix="nts_serve_bench_")
+        ckpt_dir = os.path.join(scratch, "ckpt")
+    cfg.checkpoint_dir = ckpt_dir
+    if not os.environ.get("NTS_METRICS_DIR"):
+        # the SLO numbers below are read back from this stream
+        os.environ["NTS_METRICS_DIR"] = (
+            scratch or tempfile.mkdtemp(prefix="nts_serve_bench_")
+        )
+
+    ensure_checkpoint(cfg, base_dir, ckpt_dir, args.train)
+
+    from neutronstarlite_tpu.serve.engine import (
+        InferenceEngine,
+        ServeSetupError,
+    )
+    from neutronstarlite_tpu.serve.server import InferenceServer
+
+    try:
+        engine = InferenceEngine.from_config(
+            cfg, base_dir=base_dir, ckpt_dir=ckpt_dir,
+            rng=np.random.default_rng(args.seed),
+        )
+    except ServeSetupError as e:
+        raise SystemExit(f"serve_bench: {e}")
+    t0 = time.perf_counter()
+    engine.warmup()
+    warmup_s = time.perf_counter() - t0
+    server = InferenceServer(engine)
+    v_num = engine.toolkit.host_graph.v_num
+
+    t0 = time.perf_counter()
+    if args.mode == "closed":
+        errors = run_closed_loop(
+            server, v_num, args.requests, args.clients,
+            args.seeds_per_request, args.seed,
+        )
+    else:
+        errors = run_open_loop(
+            server, v_num, args.requests, args.rps,
+            args.seeds_per_request, args.seed,
+        )
+    wall_s = time.perf_counter() - t0
+    stats = server.close()
+
+    stream_path = engine.metrics.path
+    if stream_path and os.path.exists(stream_path):
+        obs_view = percentiles_from_stream(stream_path)
+    else:  # metrics dir unusable: fall back to the in-memory view
+        obs_view = {
+            "served": stats["requests"], "shed": stats["shed"],
+            "batches": None, "latency_ms": stats["latency_ms"],
+            "throughput_rps": stats["throughput_rps"], "summary": None,
+        }
+    lat = obs_view["latency_ms"]
+    result = {
+        "metric": "serve_p99_latency_ms",
+        "value": lat["p99"],
+        "unit": "ms",
+        "vs_baseline": None,
+        "extra": {
+            "mode": args.mode,
+            "clients": args.clients if args.mode == "closed" else None,
+            "rps_offered": args.rps if args.mode == "open" else None,
+            "requests": args.requests,
+            "seeds_per_request": args.seeds_per_request,
+            "p50_ms": lat["p50"],
+            "p95_ms": lat["p95"],
+            "p99_ms": lat["p99"],
+            "throughput_rps": obs_view["throughput_rps"],
+            "served": obs_view["served"],
+            "shed": obs_view["shed"],
+            "errors": errors,
+            "batches": obs_view["batches"],
+            "warmup_compile_s": warmup_s,
+            "compile_counts": {
+                str(k): v for k, v in stats["compile_counts"].items()
+            },
+            "cache": stats["cache"],
+            "wall_s": wall_s,
+            "metrics_stream": stream_path,
+        },
+    }
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -145,6 +145,18 @@ class InputInfo:
     # to the chunked-scatter layouts (DeviceGraph, DistGraph) — the ELL and
     # mirror-slot layouts have their own slot sizing. Tests/dryruns set it
     # small to force the multi-chunk scan regime.
+    # Online inference serving (serve/; docs/SERVING.md). Every knob has an
+    # NTS_SERVE_* env override (launcher parity, like NTS_PARTITIONS_OVERRIDE)
+    # resolved in serve.batcher.ServeOptions.from_cfg.
+    serve_max_batch: int = 16  # micro-batch flush size == largest AOT bucket
+    serve_max_wait_ms: float = 5.0  # deadline coalescing window per flush
+    serve_max_queue: int = 256  # pending-request bound; beyond it: shed
+    serve_buckets: str = ""  # dash-separated AOT bucket ladder override
+    # (SERVE_BUCKETS:1-4-16); "" = geometric x4 ladder up to max_batch
+    serve_cache_cap: int = 0  # inference embedding cache entries (0 = off)
+    serve_cache_max_age_s: float = 60.0  # cache staleness bound (seconds)
+    serve_hot_threshold: int = 0  # out-degree >= threshold => cacheable
+    # ("hot", the feature_cache hot/cold split rule); 0 = every vertex
 
     @staticmethod
     def read_from_cfg_file(path: str) -> "InputInfo":
@@ -254,6 +266,20 @@ class InputInfo:
             self.undirected = bool(int(value))
         elif key == "DATA_FORMAT":
             self.data_format = value.strip().lower()
+        elif key == "SERVE_MAX_BATCH":
+            self.serve_max_batch = int(value)
+        elif key == "SERVE_MAX_WAIT_MS":
+            self.serve_max_wait_ms = float(value)
+        elif key == "SERVE_MAX_QUEUE":
+            self.serve_max_queue = int(value)
+        elif key == "SERVE_BUCKETS":
+            self.serve_buckets = value
+        elif key == "SERVE_CACHE_CAP":
+            self.serve_cache_cap = int(value)
+        elif key == "SERVE_CACHE_MAX_AGE_S":
+            self.serve_cache_max_age_s = float(value)
+        elif key == "SERVE_HOT_THRESHOLD":
+            self.serve_hot_threshold = int(value)
         # unknown keys ignored, matching the reference's else-silence
 
     def layer_sizes(self) -> List[int]:
@@ -267,6 +293,13 @@ class InputInfo:
         if not self.fanout_string:
             return []
         return [int(tok) for tok in self.fanout_string.split("-") if tok]
+
+    def serve_bucket_list(self) -> List[int]:
+        """Parse SERVE_BUCKETS:1-4-16 -> [1, 4, 16] (the AOT batch-size
+        ladder; empty = derive geometrically, serve.batcher.ServeOptions)."""
+        if not self.serve_buckets:
+            return []
+        return [int(tok) for tok in self.serve_buckets.split("-") if tok]
 
     def gnn_context(self) -> GNNContext:
         sizes = self.layer_sizes()

@@ -231,3 +231,53 @@ def test_parallel_sampler_degrades_inline_with_live_jax(rng):
     s = ParallelEpochSampler(g, np.arange(V), 16, [3], seed=1, workers=4)
     assert s.workers == 0 and s._in_q is None
     assert len(list(s.sample_epoch(0))) == 4
+
+
+def test_sampler_injectable_rng_reproduces_fanouts(rng):
+    """An injected numpy Generator drives the draws (no monkeypatching):
+    same Generator state => bit-identical batches; the serving path and
+    tests rely on this (ISSUE 3 satellite)."""
+    g, _ = tiny_graph(rng, v_num=60, e_num=400)
+    seeds = np.arange(60)
+
+    def batches(sampler):
+        return [
+            (b.nodes, b.hops, b.seeds) for b in sampler.sample_epoch(shuffle=False)
+        ]
+
+    a = Sampler(g, seeds, batch_size=16, fanouts=[3, 4],
+                rng=np.random.default_rng(77))
+    b = Sampler(g, seeds, batch_size=16, fanouts=[3, 4],
+                rng=np.random.default_rng(77))
+    # the injected Generator implies the NumPy path even when the native
+    # sampler is available (it would ignore the Generator)
+    assert not a.use_native and not b.use_native
+    for (na, ha, sa), (nb, hb, sb) in zip(batches(a), batches(b)):
+        np.testing.assert_array_equal(sa, sb)
+        for x, y in zip(na, nb):
+            np.testing.assert_array_equal(x, y)
+        for hx, hy in zip(ha, hb):
+            np.testing.assert_array_equal(hx.src_local, hy.src_local)
+            np.testing.assert_array_equal(hx.dst_local, hy.dst_local)
+            np.testing.assert_array_equal(hx.weight, hy.weight)
+    # default path unchanged: seed-based construction still works
+    c = Sampler(g, seeds, batch_size=16, fanouts=[3, 4], seed=5)
+    assert isinstance(c.rng, np.random.Generator)
+    # contradictory args: the native sampler cannot honor an injected rng
+    with pytest.raises(ValueError, match="use_native"):
+        Sampler(g, seeds, batch_size=16, fanouts=[3], use_native=True,
+                rng=np.random.default_rng(1))
+
+
+def test_sampler_sample_batch_validates_and_pads(rng):
+    g, _ = tiny_graph(rng, v_num=40, e_num=250)
+    s = Sampler(g, np.arange(40), batch_size=8, fanouts=[3],
+                rng=np.random.default_rng(3))
+    b = s.sample_batch(np.array([5, 9, 11]))
+    assert b.seeds.shape == (8,)
+    assert b.seed_mask[:3].sum() == 3 and b.seed_mask[3:].sum() == 0
+    np.testing.assert_array_equal(b.seeds[:3], [5, 9, 11])
+    with pytest.raises(ValueError):
+        s.sample_batch(np.arange(9))  # exceeds batch capacity
+    with pytest.raises(ValueError):
+        s.sample_batch(np.empty(0, np.int64))

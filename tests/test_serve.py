@@ -1,0 +1,334 @@
+"""serve/ subsystem: batcher/cache units, serving/training parity, the
+compile-once-per-bucket oracle, and the tier-1 smoke (train the smoke cfg,
+serve 50 requests, render the report) — the ISSUE 3 acceptance paths."""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from neutronstarlite_tpu.models.gcn_sample import GCNSampleTrainer, _batch_arrays
+from neutronstarlite_tpu.serve.batcher import (
+    MicroBatcher,
+    RequestShedError,
+    ServeOptions,
+)
+from neutronstarlite_tpu.serve.engine import InferenceEngine, ServeSetupError
+from neutronstarlite_tpu.serve.sampling import EmbeddingCache
+from neutronstarlite_tpu.serve.server import InferenceServer
+from neutronstarlite_tpu.utils.config import InputInfo
+from tests.test_models import _planted_data
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---- options / ladder -------------------------------------------------------
+
+
+def test_serve_options_ladder_and_overrides(monkeypatch):
+    o = ServeOptions(max_batch=16)
+    assert o.ladder() == [1, 4, 16]
+    assert ServeOptions(max_batch=1).ladder() == [1]
+    assert ServeOptions(max_batch=5).ladder() == [1, 4, 5]
+    assert ServeOptions(max_batch=16, buckets=(8, 2)).ladder() == [2, 8, 16]
+
+    cfg = InputInfo()
+    cfg.serve_max_batch = 32
+    cfg.serve_buckets = "2-8-32"
+    cfg.serve_cache_cap = 10
+    o = ServeOptions.from_cfg(cfg)
+    assert o.max_batch == 32 and o.ladder() == [2, 8, 32]
+    assert o.cache_cap == 10
+    # env wins over cfg (launcher parity)
+    monkeypatch.setenv("NTS_SERVE_MAX_BATCH", "8")
+    monkeypatch.setenv("NTS_SERVE_BUCKETS", "1-8")
+    o = ServeOptions.from_cfg(cfg)
+    assert o.max_batch == 8 and o.ladder() == [1, 8]
+
+
+# ---- micro-batcher ----------------------------------------------------------
+
+
+class _Recorder:
+    """flush_fn stub: completes every request, records (sizes, reason)."""
+
+    def __init__(self, delay_s: float = 0.0):
+        self.flushes = []
+        self.delay_s = delay_s
+
+    def __call__(self, requests, reason):
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        self.flushes.append(([len(r.node_ids) for r in requests], reason))
+        for r in requests:
+            r._complete(np.zeros((len(r.node_ids), 2)), "ok")
+
+
+def test_batcher_size_flush():
+    rec = _Recorder()
+    mb = MicroBatcher(rec, ServeOptions(max_batch=4, max_wait_ms=5000))
+    reqs = [mb.submit([i]) for i in range(4)]
+    for r in reqs:
+        r.result(timeout=10)
+    mb.close()
+    assert rec.flushes and rec.flushes[0][1] == "size"
+    assert sum(rec.flushes[0][0]) == 4
+
+
+def test_batcher_deadline_flush():
+    rec = _Recorder()
+    mb = MicroBatcher(rec, ServeOptions(max_batch=64, max_wait_ms=20))
+    r = mb.submit([1, 2])
+    out = r.result(timeout=10)
+    assert out.shape == (2, 2)
+    mb.close()
+    assert rec.flushes[0] == ([2], "deadline")
+    assert r.total_ms is not None and r.queue_ms is not None
+
+
+def test_batcher_sheds_with_reason():
+    rec = _Recorder(delay_s=0.2)  # slow device keeps the queue occupied
+    mb = MicroBatcher(rec, ServeOptions(max_batch=1, max_wait_ms=1, max_queue=2))
+    reqs = [mb.submit([i]) for i in range(30)]
+    shed = [r for r in reqs if r.status == "shed"]
+    assert shed, "queue bound never tripped"
+    with pytest.raises(RequestShedError) as e:
+        shed[0].result(timeout=1)
+    assert "queue_full" in str(e.value)
+    # malformed requests reject immediately with their own reasons
+    with pytest.raises(RequestShedError, match="request_too_large"):
+        mb.submit(np.arange(5)).result(timeout=1)
+    with pytest.raises(RequestShedError, match="empty_request"):
+        mb.submit([]).result(timeout=1)
+    mb.close()
+    ok = [r for r in reqs if r.status == "ok"]
+    assert ok, "non-shed requests must still complete"
+
+
+def test_batcher_close_drains_pending():
+    rec = _Recorder(delay_s=0.05)
+    mb = MicroBatcher(rec, ServeOptions(max_batch=2, max_wait_ms=10_000))
+    r = mb.submit([7])  # alone: below max_batch, far-off deadline
+    mb.close()
+    assert r.result(timeout=1).shape == (1, 2)
+    assert any(reason in ("drain", "deadline") for _, reason in rec.flushes)
+
+
+# ---- embedding cache --------------------------------------------------------
+
+
+def test_embedding_cache_lru_staleness_and_hot_split():
+    clock = {"t": 0.0}
+    hot = np.array([True, True, False, True])
+    c = EmbeddingCache(capacity=2, max_age_s=10.0, hot_mask=hot,
+                       clock=lambda: clock["t"])
+    rows = np.arange(8, dtype=np.float32).reshape(4, 2)
+    assert c.insert(np.arange(4), rows) == 3  # vid 2 is cold; cap evicts 0
+    assert c.lookup(2) is None  # cold: never cached
+    assert c.lookup(0) is None  # LRU-evicted by capacity
+    np.testing.assert_array_equal(c.lookup(3), rows[3])
+    clock["t"] = 11.0  # everything is now stale
+    assert c.lookup(3) is None
+    assert c.stats()["expired"] == 1
+    # capacity 0 disables without branching at call sites
+    off = EmbeddingCache(capacity=0)
+    assert off.insert(np.array([1]), rows[:1]) == 0
+    assert off.lookup(1) is None
+
+
+# ---- engine: parity + compile-once ------------------------------------------
+
+
+def _serve_cfg(v_num=300, classes=4, f=16, epochs=2):
+    cfg = InputInfo()
+    cfg.algorithm = "GCNSAMPLESINGLE"
+    cfg.vertices = v_num
+    cfg.layer_string = f"{f}-24-{classes}"
+    cfg.fanout_string = "3-3"
+    cfg.batch_size = 16
+    cfg.epochs = epochs
+    cfg.learn_rate = 0.01
+    cfg.weight_decay = 1e-4
+    cfg.decay_epoch = -1
+    cfg.drop_rate = 0.3
+    return cfg
+
+
+@pytest.fixture(scope="module")
+def trained(tmp_path_factory):
+    """One trained + checkpointed sampled-GCN toolkit for all engine tests."""
+    os.environ["NTS_SAMPLE_WORKERS"] = "0"
+    try:
+        cfg = _serve_cfg()
+        cfg.checkpoint_dir = str(tmp_path_factory.mktemp("serve") / "ckpt")
+        src, dst, datum = _planted_data(v_num=300, seed=11)
+        toolkit = GCNSampleTrainer.from_arrays(cfg, src, dst, datum)
+        toolkit.run()
+    finally:
+        os.environ.pop("NTS_SAMPLE_WORKERS", None)
+    return toolkit, cfg
+
+
+def test_engine_requires_checkpoint(trained, tmp_path):
+    toolkit, _cfg = trained
+    with pytest.raises(ServeSetupError, match="no checkpoint"):
+        InferenceEngine(toolkit, str(tmp_path / "nope"))
+
+
+def test_served_logits_match_eval_forward_bitwise(trained):
+    """Serving/training parity: the engine's AOT bucket executable must
+    reproduce the toolkit's eval-mode forward BITWISE on CPU for the same
+    sampled batch of training-graph vertices."""
+    import jax
+
+    toolkit, cfg = trained
+    engine = InferenceEngine(toolkit, cfg.checkpoint_dir)
+    train_nids = np.where(toolkit.datum.mask == 0)[0][: cfg.batch_size]
+    batch = toolkit.samplers[0].sample_batch(train_nids)
+    served = engine.forward_batch(batch, bucket=cfg.batch_size)
+
+    nodes, hops, _mask, _seeds = _batch_arrays(batch)
+    expected = np.asarray(
+        toolkit._eval_batch(
+            toolkit.params, toolkit.feature, nodes, hops,
+            jax.random.PRNGKey(0),
+        )
+    )
+    assert served.shape == expected.shape
+    np.testing.assert_array_equal(served, expected)  # bitwise, not approx
+
+
+def test_exactly_one_compilation_per_bucket(trained):
+    """N>1 same-bucket requests => exactly one compilation: steady state
+    replays the AOT executable (the fixed-shape discipline)."""
+    toolkit, cfg = trained
+    engine = InferenceEngine(
+        toolkit, cfg.checkpoint_dir, rng=np.random.default_rng(0)
+    )
+    assert engine.compile_counts == {}  # nothing compiled before traffic
+    for _ in range(5):
+        out = engine.predict(np.array([1, 2, 3]))  # -> bucket 4
+        assert out.shape == (3, cfg.layer_sizes()[-1])
+    assert engine.compile_counts == {4: 1}
+    engine.warmup()  # the rest of the ladder compiles once each
+    for _ in range(3):
+        engine.predict(np.array([5]))
+        engine.predict(np.arange(10))
+    assert engine.compile_counts == {b: 1 for b in engine.buckets}
+
+
+def test_server_cache_serves_repeats(trained):
+    toolkit, cfg = trained
+    opts = ServeOptions(max_batch=8, max_wait_ms=1, cache_cap=64,
+                        cache_max_age_s=300.0)
+    engine = InferenceEngine(toolkit, cfg.checkpoint_dir, options=opts,
+                             rng=np.random.default_rng(1))
+    server = InferenceServer(engine)
+    first = server.predict([42])
+    again = server.predict([42])  # same vertex: embedding-cache hit
+    np.testing.assert_array_equal(first, again)
+    stats = server.close()
+    assert stats["cache"]["hits"] >= 1
+    assert stats["requests"] == 2 and stats["shed"] == 0
+
+
+# ---- tier-1 smoke: cfg -> train -> checkpoint -> serve -> report ------------
+
+
+def test_serve_smoke_end_to_end(tmp_path, monkeypatch, capsys):
+    """The acceptance path on configs/serve_cora_smoke.cfg: serve_bench
+    trains the checkpoint, serves 50 requests on CPU with zero sheds, the
+    obs stream validates, and metrics_report renders the serving block."""
+    from neutronstarlite_tpu.obs import schema
+    from neutronstarlite_tpu.tools import metrics_report, serve_bench
+
+    metrics_dir = tmp_path / "metrics"
+    metrics_dir.mkdir()
+    monkeypatch.setenv("NTS_METRICS_DIR", str(metrics_dir))
+    ckpt = str(tmp_path / "ckpt")
+    rc = serve_bench.main([
+        os.path.join(REPO, "configs", "serve_cora_smoke.cfg"), ckpt,
+        "--train", "--requests", "50", "--clients", "2",
+    ])
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    assert rc == 0
+    bench = json.loads(out)
+    assert bench["metric"] == "serve_p99_latency_ms"
+    assert bench["unit"] == "ms" and bench["value"] is not None
+    extra = bench["extra"]
+    assert extra["served"] == 50 and extra["shed"] == 0
+    assert extra["errors"] == 0
+    assert extra["p50_ms"] is not None and extra["throughput_rps"] > 0
+    # exactly one steady-state compilation per exercised bucket
+    assert extra["compile_counts"]
+    assert all(v == 1 for v in extra["compile_counts"].values())
+
+    # the stream is schema-valid and carries the typed serving records
+    files = sorted(glob.glob(os.path.join(str(metrics_dir), "*.jsonl")))
+    assert files
+    events = [
+        json.loads(line) for f in files for line in open(f) if line.strip()
+    ]
+    assert schema.validate_stream(events) == len(events)
+    kinds = {e["event"] for e in events}
+    assert {"serve_request", "batch_flush", "serve_summary"} <= kinds
+    assert "run_summary" in kinds  # the training run rode the same dir
+
+    # the report CLI renders both the training and the serving block
+    rc = metrics_report.main([str(metrics_dir)])
+    report = capsys.readouterr().out
+    assert rc == 0
+    assert "#p99_latency=" in report and "#requests=" in report
+    assert "finish serving !" in report
+
+
+def test_engine_refuses_unservable_params(trained, tmp_path):
+    """A checkpoint whose params carry more than the sampled-GCN family's
+    {'W'} layers (e.g. bn stats) must be refused, not silently mis-served."""
+    toolkit, cfg = trained
+    orig = toolkit.params
+    toolkit.params = [{"W": orig[0]["W"], "bn": {"g": np.ones(3)}}]
+    try:
+        with pytest.raises(ServeSetupError, match="not\\s+servable"):
+            InferenceEngine(toolkit, cfg.checkpoint_dir)
+    finally:
+        toolkit.params = orig
+
+
+def test_sampled_trainer_resume_at_end_reports_restored_accuracy(trained):
+    """gcn_sample now runs the ckpt hooks: a second run() over an
+    already-finished checkpoint restores at cfg.epochs, trains zero
+    epochs, and must still finish cleanly (loss=nan, real accuracies) —
+    the regression found driving the CLI resume path."""
+    toolkit, cfg = trained
+    src, dst, datum = _planted_data(v_num=300, seed=11)
+    os.environ["NTS_SAMPLE_WORKERS"] = "0"
+    try:
+        t2 = GCNSampleTrainer.from_arrays(cfg, src, dst, datum)
+        result = t2.run()
+    finally:
+        os.environ.pop("NTS_SAMPLE_WORKERS", None)
+    assert np.isnan(result["loss"])  # zero epochs ran
+    assert result["acc"]["train"] > 0.3  # restored weights, not fresh init
+
+
+# ---- satellite: launcher override validation --------------------------------
+
+
+def test_launcher_override_rejects_garbage(monkeypatch):
+    from neutronstarlite_tpu.run import apply_launcher_overrides
+
+    cfg = InputInfo()
+    monkeypatch.setenv("NTS_PARTITIONS_OVERRIDE", "two")
+    with pytest.raises(SystemExit, match="not an integer"):
+        apply_launcher_overrides(cfg)
+    monkeypatch.setenv("NTS_PARTITIONS_OVERRIDE", "-3")
+    with pytest.raises(SystemExit, match=">= 0"):
+        apply_launcher_overrides(cfg)
+    monkeypatch.setenv("NTS_PARTITIONS_OVERRIDE", "4")
+    assert apply_launcher_overrides(cfg).partitions == 4
