@@ -170,7 +170,34 @@ class ToolkitBase:
             )
         self._finalize_datum()
 
+    # trainers whose build_model honors the DIST_PATH selector (the
+    # fuse-op dist family, models/gcn_dist.py) set this True; everywhere
+    # else an explicit DIST_PATH must refuse loudly instead of silently
+    # running a different exchange than the user is benchmarking
+    supports_dist_path = False
+
+    def _check_dist_path(self) -> None:
+        cfg = self.cfg
+        if getattr(type(self), "supports_dist_path", False):
+            return
+        dist_path = getattr(cfg, "dist_path", "")
+        if dist_path not in ("", "auto"):
+            raise ValueError(
+                f"DIST_PATH:{dist_path} is not available for ALGORITHM "
+                f"{cfg.algorithm!r}: DIST_PATH selects the dense-feature "
+                "dist aggregation path (all_gather family / ring_blocked) "
+                "and serves the fuse-op dist family (GCNDIST / GINDIST / "
+                "COMMNETDIST and their eager variants)"
+            )
+        if getattr(cfg, "wire_dtype", "") or os.environ.get("NTS_WIRE_DTYPE"):
+            log.warning(
+                "WIRE_DTYPE/NTS_WIRE_DTYPE only applies to "
+                "DIST_PATH:ring_blocked on the fuse-op dist family; "
+                "ALGORITHM %s ignores it", cfg.algorithm,
+            )
+
     def _finalize_datum(self) -> None:
+        self._check_dist_path()
         self.feature = jnp.asarray(self.datum.feature)
         self.label = jnp.asarray(self.datum.label.astype(np.int32))
         self.mask = jnp.asarray(self.datum.mask)

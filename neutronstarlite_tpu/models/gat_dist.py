@@ -141,6 +141,10 @@ class DistGATTrainer(ToolkitBase):
             forward = partial(forward, compute_dtype=jnp.bfloat16)
         return forward
 
+    # DIST_PATH/WIRE_DTYPE refusal lives in ToolkitBase._check_dist_path
+    # (supports_dist_path stays False: the attention exchange is
+    # mirror-based, not a dense-feature DIST_PATH)
+
     def build_model(self) -> None:
         cfg = self.cfg
         self.mesh, P = self.resolve_mesh()

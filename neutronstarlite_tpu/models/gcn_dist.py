@@ -72,9 +72,13 @@ def dist_gcn_forward(
     eager: bool = False,
     no_exchange: bool = False,
     compute_dtype=None,
+    wire_dtype=None,
 ):
     """``blocks`` selects the exchange: the [P, P, Eb] 3-tuple is the
-    ppermute ring, a DistEllPair is the OPTIM_KERNEL gather-only path, the
+    ppermute ring, a DistEllPair is the OPTIM_KERNEL gather-only path, a
+    RingBlockedPair is the DIST_PATH:ring_blocked pipelined ring
+    (parallel/dist_ring_blocked.py — ``wire_dtype`` optionally narrows its
+    ICI shipments; ``mesh=None`` selects its collective-free sim twin), the
     9-tuple is the round-5 SPLIT mirror exchange (remote-only all_to_all +
     resident local edges; ``dist`` is then the SplitMirror — what
     COMM_LAYER:mirror ships), and the legacy 5-tuple is the uniform
@@ -104,6 +108,11 @@ def dist_gcn_forward(
         DistEllPair,
         dist_ell_gather_dst_from_src,
     )
+    from neutronstarlite_tpu.parallel.dist_ring_blocked import (
+        RingBlockedPair,
+        dist_ring_blocked_gather_dst_from_src,
+        dist_ring_blocked_gather_simulated,
+    )
 
     def exchange(v):
         if no_exchange:
@@ -111,6 +120,14 @@ def dist_gcn_forward(
             # matmuls, the graph exchange replaced by identity — the
             # nn_time/graph_time split (models/debuginfo.py)
             return v
+        if isinstance(blocks, RingBlockedPair):
+            if mesh is None:
+                return dist_ring_blocked_gather_simulated(
+                    blocks, v, wire_dtype
+                )
+            return dist_ring_blocked_gather_dst_from_src(
+                mesh, blocks, v, wire_dtype
+            )
         if isinstance(blocks, DistBspPair):
             return dist_bsp_gather_dst_from_src(mesh, blocks, v)
         if isinstance(blocks, DistBlockedEllPair):
@@ -158,6 +175,7 @@ class DistGCNTrainer(ToolkitBase):
     needs_device_graph = False
     weight_mode = "gcn_norm"
     with_bn = True
+    supports_dist_path = True  # build_model honors DIST_PATH/WIRE_DTYPE
     # per-layer NN over the exchanged aggregate; fuse-op model variants
     # (DistGINTrainer) override this and init_model_params only
     layer_nn = staticmethod(gcn_layer_nn)
@@ -203,12 +221,79 @@ class DistGCNTrainer(ToolkitBase):
 
     def build_model(self) -> None:
         cfg = self.cfg
-        self.mesh = make_mesh(cfg.partitions or None)
-        P = self.mesh.devices.size
-        layer_kind = self.resolve_comm_layer(cfg, self.host_graph, P)
+        self.wire_dtype = None
+        self._ring_plan = None
+        if cfg.dist_path in ("ring_blocked", "ring_blocked_sim"):
+            # the pipelined ring (parallel/dist_ring_blocked.py); the _sim
+            # spelling forces the collective-free twin (single-core CI) —
+            # NTS_DIST_SIMULATE=1 does the same for the bare spelling
+            if cfg.dist_path == "ring_blocked_sim":
+                self.simulate = True
+            self.mesh, P = self.resolve_mesh()
+            layer_kind = "ring_blocked"
+        else:
+            self.mesh = make_mesh(cfg.partitions or None)
+            P = self.mesh.devices.size
+            if cfg.dist_path == "all_gather":
+                # explicit opt-out of the ring: the gather-only family
+                # (OPTIM_KERNEL ell / blocked / bsp, selected below)
+                layer_kind = "ell"
+            else:
+                layer_kind = self.resolve_comm_layer(cfg, self.host_graph, P)
+            if cfg.wire_dtype or os.environ.get("NTS_WIRE_DTYPE"):
+                # loud, not silent (the PRECISION-typo lesson): a user
+                # A/B-ing bf16 wire on the all_gather/mirror paths would
+                # otherwise measure an unchanged f32 exchange
+                log.warning(
+                    "WIRE_DTYPE/NTS_WIRE_DTYPE only applies to "
+                    "DIST_PATH:ring_blocked; the %s exchange ships the "
+                    "compute dtype (use PRECISION:bfloat16 to narrow it)",
+                    layer_kind,
+                )
         self.comm_layer = layer_kind
 
-        if layer_kind == "mirror":
+        if layer_kind == "ring_blocked":
+            from neutronstarlite_tpu.parallel.dist_ring_blocked import (
+                RingBlockedPair,
+                default_ring_vt,
+            )
+            from neutronstarlite_tpu.parallel.ring_schedule import (
+                resolve_wire_dtype,
+            )
+
+            if getattr(cfg, "pallas_kernel", False):
+                # loud, not silent: the ring's per-step compute is the
+                # XLA blocked scan only — there is no Mosaic ring body yet
+                log.warning(
+                    "PALLAS:1 ignored: DIST_PATH:ring_blocked runs the "
+                    "XLA blocked step tables (no Mosaic ring executor)"
+                )
+            self.dist = DistGraph.build(
+                self.host_graph, P, edge_chunk=cfg.edge_chunk or None
+            )
+            stats = self.dist.padding_stats()
+            # KERNEL_TILE caps the per-gather table exactly as on the
+            # all_gather blocked path; the shared default keeps whole-
+            # shard-ish tiles (one definition with comm_bench)
+            vt = default_ring_vt(self.dist.vp, cfg.kernel_tile)
+            pair = RingBlockedPair.build(self.dist, vt=vt)
+            est = pair.padding_stats(stats["real_edges"])
+            self.blocks = (
+                pair.shard(self.mesh) if self.mesh is not None else pair
+            )
+            self.wire_dtype = resolve_wire_dtype(cfg.wire_dtype)
+            log.info(
+                "DIST_PATH ring_blocked%s: double-buffered ring (vt=%d, "
+                "%d/%d work steps, %d hops, wire dtype %s, %.2fx/%.2fx "
+                "fwd/bwd slot padding; peak exchange residency 2*vp=%d "
+                "rows vs all_gather P*vp=%d)",
+                " (sim)" if self.mesh is None else "", vt,
+                len(pair.fwd.work_steps()), P, pair.fwd.n_transfers(),
+                self.wire_dtype or "compute",
+                est["fwd_waste_ratio"], est["bwd_waste_ratio"],
+                2 * self.dist.vp, P * self.dist.vp,
+            )
+        elif layer_kind == "mirror":
             from neutronstarlite_tpu.parallel.mirror import SplitMirror
 
             self.dist = SplitMirror.build(self.host_graph, P)
@@ -336,6 +421,10 @@ class DistGCNTrainer(ToolkitBase):
         # (NN-then-exchange) ships the post-matmul widths
         widths = sizes[1:] if type(self).eager else sizes[:-1]
         itemsize = 2 if cfg.precision == "bfloat16" else 4
+        if self.wire_dtype is not None:
+            # WIRE_DTYPE narrows what rides the ICI independently of the
+            # compute precision — price the wire at the wire dtype
+            itemsize = self.wire_dtype.itemsize
         self._wire_exchanges_per_epoch = len(widths)
         self._wire_bytes_fwd_per_epoch = rows * sum(widths) * itemsize
         self.metrics.gauge_set("wire.comm_layer", layer_kind)
@@ -343,30 +432,75 @@ class DistGCNTrainer(ToolkitBase):
         self.metrics.gauge_set(
             "wire.bytes_per_epoch_fwd", self._wire_bytes_fwd_per_epoch
         )
+        if layer_kind == "ring_blocked":
+            from neutronstarlite_tpu.parallel.dist_ring_blocked import (
+                ring_wire_plan,
+            )
 
-        # padded, sharded vertex-space data
+            # static per-epoch ring facts -> typed per-step ring_step
+            # records (run loop) + the exchange-residency gauge the smoke
+            # test pins against wire_accounting
+            self._ring_plan = ring_wire_plan(
+                self.blocks.fwd, widths, itemsize
+            )
+            # the live counter must equal the per-hop record sum: a
+            # trimmed skip SUFFIX ships fewer hops than the dense
+            # (P-1)*vp formula prices (ring_schedule.trim_transfers)
+            self._wire_bytes_fwd_per_epoch = sum(
+                s["bytes"] for s in self._ring_plan["steps"]
+            )
+            self.metrics.gauge_set(
+                "wire.rows_per_layer",
+                self._ring_plan["transfers"] * self.dist.vp,
+            )
+            self.metrics.gauge_set(
+                "wire.bytes_per_epoch_fwd", self._wire_bytes_fwd_per_epoch
+            )
+            self.metrics.gauge_set(
+                "wire.peak_resident_rows",
+                self._ring_plan["peak_resident_rows"],
+            )
+            self.metrics.gauge_set(
+                "ring.skipped_steps",
+                len(self._ring_plan["skipped_steps"]),
+            )
+            self.metrics.gauge_set(
+                "ring.transfers", self._ring_plan["transfers"]
+            )
+        elif layer_kind == "ell":
+            # the all_gather family materializes every shard per device
+            self.metrics.gauge_set("wire.peak_resident_rows", P * self.dist.vp)
+
+        # padded, sharded vertex-space data (the sim twin — mesh None —
+        # keeps everything as single logical host-backed arrays, the
+        # DistGCNCacheTrainer placement convention)
         pad = self.dist.pad_vertex_array
-        vsh = NamedSharding(self.mesh, PS(PARTITION_AXIS, None))
-        vsh1 = NamedSharding(self.mesh, PS(PARTITION_AXIS))
-        self.feature_p = jax.device_put(pad(self.datum.feature), vsh)
-        self.label_p = jax.device_put(pad(self.datum.label.astype(np.int32)), vsh1)
-        self.valid_p = jax.device_put(self.dist.valid_mask(), vsh1)
+        if self.mesh is not None:
+            vsh = NamedSharding(self.mesh, PS(PARTITION_AXIS, None))
+            vsh1 = NamedSharding(self.mesh, PS(PARTITION_AXIS))
+            rsh = NamedSharding(self.mesh, PS())
+            put = jax.device_put
+        else:
+            vsh = vsh1 = rsh = None
+            put = lambda a, s: jax.tree.map(jnp.asarray, a)  # noqa: E731
+        self.feature_p = put(pad(self.datum.feature), vsh)
+        self.label_p = put(pad(self.datum.label.astype(np.int32)), vsh1)
+        self.valid_p = put(self.dist.valid_mask(), vsh1)
         train01 = (self.datum.mask == 0).astype(np.float32)
-        self.train01_p = jax.device_put(pad(train01), vsh1)
+        self.train01_p = put(pad(train01), vsh1)
         # pad fill -1 so padding rows match no mask split in the eval counters
-        self.mask_p = jax.device_put(pad(self.datum.mask, fill=-1), vsh1)
+        self.mask_p = put(pad(self.datum.mask, fill=-1), vsh1)
 
-        rsh = NamedSharding(self.mesh, PS())
         key = jax.random.PRNGKey(self.seed)
         params = self.init_model_params(key)
-        self.params = jax.device_put(params, rsh)
+        self.params = put(params, rsh)
         self.adam_cfg = AdamConfig(
             alpha=cfg.learn_rate,
             weight_decay=cfg.weight_decay,
             decay_rate=cfg.decay_rate,
             decay_epoch=cfg.decay_epoch,
         )
-        self.opt_state = jax.device_put(adam_init(self.params), rsh)
+        self.opt_state = put(adam_init(self.params), rsh)
 
         mesh, dist, blocks = self.mesh, self.dist, self.blocks
         drop_rate = cfg.drop_rate
@@ -377,6 +511,7 @@ class DistGCNTrainer(ToolkitBase):
         # PRECISION:bfloat16 -> bf16 exchange + NN compute (f32 params,
         # wide accumulation, f32 logits)
         compute_dtype = jnp.bfloat16 if cfg.precision == "bfloat16" else None
+        wire_dtype = self.wire_dtype
 
         # ``blocks`` (the O(E) sharded edge arrays) is a jit ARGUMENT, not a
         # closure: captured arrays are inlined into the HLO as constants,
@@ -388,6 +523,7 @@ class DistGCNTrainer(ToolkitBase):
                 logits = dist_gcn_forward(
                     mesh, dist, blocks, p, feature, valid, key, drop_rate,
                     True, layer_nn, eager, compute_dtype=compute_dtype,
+                    wire_dtype=wire_dtype,
                 )
                 return masked_nll(logits, label, train01), logits
 
@@ -400,6 +536,7 @@ class DistGCNTrainer(ToolkitBase):
             return dist_gcn_forward(
                 mesh, dist, blocks, params, feature, valid, key, 0.0, False,
                 layer_nn, eager, compute_dtype=compute_dtype,
+                wire_dtype=wire_dtype,
             )
 
         self._train_step = train_step
@@ -412,7 +549,7 @@ class DistGCNTrainer(ToolkitBase):
             logits = dist_gcn_forward(
                 mesh, dist, blocks, params, feature, valid, key, drop_rate,
                 True, layer_nn, eager, no_exchange=no_exchange,
-                compute_dtype=compute_dtype,
+                compute_dtype=compute_dtype, wire_dtype=wire_dtype,
             )
             return masked_nll(logits, label, train01)
 
@@ -509,6 +646,18 @@ class DistGCNTrainer(ToolkitBase):
                 epoch, dt, loss, self._wire_bytes_fwd_per_epoch,
                 self._wire_exchanges_per_epoch,
             )
+            if self._ring_plan is not None:
+                # typed per-rotation-hop records: bytes shipped per device
+                # this epoch (all layer exchanges, forward direction) and
+                # the static skip verdict. Per-hop wall time is not
+                # separable inside one XLA program — ``seconds`` is null
+                # here; parallel/comm_bench.py measures it standalone.
+                for hop in self._ring_plan["steps"]:
+                    self.metrics.event(
+                        "ring_step", epoch=epoch, step=hop["step"],
+                        bytes=int(hop["bytes"]), skipped=hop["skipped"],
+                        seconds=None,
+                    )
             self.ckpt_epoch_end(epoch)
             if epoch % max(1, cfg.epochs // 20) == 0 or epoch == cfg.epochs - 1:
                 log.info("Epoch %d loss %f", epoch, float(loss))

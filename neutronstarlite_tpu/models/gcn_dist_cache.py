@@ -119,6 +119,10 @@ class DistGCNCacheTrainer(ToolkitBase):
     weight_mode = "gcn_norm"
     with_bn = True
 
+    # DIST_PATH/WIRE_DTYPE refusal lives in ToolkitBase._check_dist_path
+    # (supports_dist_path stays False: the DepCache exchange is the
+    # compacted mirror-slot all_to_all)
+
     def build_model(self) -> None:
         cfg = self.cfg
         self.mesh, P = self.resolve_mesh()

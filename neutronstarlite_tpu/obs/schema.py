@@ -7,8 +7,8 @@ before rendering. The schema is deliberately narrow — it pins the fields
 consumers rely on and allows extra keys (forward compatibility).
 
 Envelope (all events):
-  event: str       one of run_start | epoch | run_summary | fault |
-                   recovery | serve_request | batch_flush | shed |
+  event: str       one of run_start | epoch | ring_step | run_summary |
+                   fault | recovery | serve_request | batch_flush | shed |
                    serve_summary (open set)
   run_id: str      "<algo>-<fingerprint>-<pid>"
   schema: int      SCHEMA_VERSION
@@ -17,6 +17,16 @@ Envelope (all events):
 
 epoch:
   epoch: int >= 0, seconds: number > 0, loss: number | null
+
+ring_step (parallel/dist_ring_blocked.py): one rotation hop of the
+  ring-pipelined exchange, per epoch — bytes shipped per device across
+  that epoch's layer exchanges and the static skip verdict
+  step: int > 0 (hop index; step 0 computes on the resident shard and
+  ships nothing), bytes: int >= 0, skipped: bool | absent (compute at
+  this step dropped by the static skip schedule),
+  seconds: number | null (per-hop wall time is not separable inside one
+  XLA program; comm_bench fills it from standalone measurement),
+  epoch: int | absent
 
 fault (resilience/): a detected or injected fault occurrence
   kind: str     nonfinite_loss | nonfinite_params | divergence | stall |
@@ -133,6 +143,20 @@ def validate_event(obj: Any) -> None:
             _fail("run_start.algorithm must be a string")
         if not isinstance(obj.get("fingerprint"), str):
             _fail("run_start.fingerprint must be a string")
+    elif kind == "ring_step":
+        if not isinstance(obj.get("step"), int) or obj["step"] <= 0:
+            _fail(f"ring_step.step must be a positive int (hop index), "
+                  f"got {obj.get('step')!r}")
+        if not isinstance(obj.get("bytes"), int) or obj["bytes"] < 0:
+            _fail(f"ring_step.bytes must be a non-negative int, got "
+                  f"{obj.get('bytes')!r}")
+        if "skipped" in obj and not isinstance(obj["skipped"], bool):
+            _fail("ring_step.skipped must be a bool when present")
+        _require_number(obj, "seconds", allow_none=True)
+        if "epoch" in obj and obj["epoch"] is not None and not isinstance(
+            obj["epoch"], int
+        ):
+            _fail("ring_step.epoch must be an int when present")
     elif kind == "fault":
         if not isinstance(obj.get("kind"), str) or not obj["kind"]:
             _fail("fault.kind must be a non-empty string")

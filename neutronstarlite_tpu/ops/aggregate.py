@@ -111,21 +111,66 @@ def _aggregate_bwd(v_num, edge_chunk, res, g):
 _aggregate.defvjp(_aggregate_fwd, _aggregate_bwd)
 
 
-def _lane_pad_width(f: int) -> int:
+_LANE_WIDTH = 128
+# the cliff only manifests at full scale (docs/PERF.md section 2a: 5%
+# scale shows eager within 2% of standard; full scale shows 15x) — below
+# this edge count the fence would only tax small runs with pad traffic
+_LANE_PAD_MIN_EDGES = 1 << 20
+_lane_pad_logged: set = set()
+
+
+def _lane_pad_width(f: int, e_pad: int) -> int:
     """The eager/scatter full-scale cliff fence (docs/PERF.md section 2a:
     eager/scatter measured 15x slower than standard/scatter at full Reddit
-    scale ONLY — the 41-wide scatter-add over 114.6M updates appears to
-    fall out of XLA's vectorized sorted-update regime below the 128-lane
-    width). Hypothesis-fix: pad narrow features to the lane width before
-    the scatter and slice after — 3x slot traffic at f=41 in exchange for
-    the vectorized regime. Gated by NTS_SCATTER_LANE_PAD=1 until the
-    on-chip A/B (tpu_plan step eager_scatter_fence) decides the default;
-    returns the padded width, or f when the fence is off / not applicable."""
+    scale ONLY — the 41-wide scatter-add over 114.6M updates falls out of
+    XLA's vectorized sorted-update regime below the 128-lane width).
+    Fix: pad narrow features to the lane width before the scatter and
+    slice after — 3x slot traffic at f=41 in exchange for the vectorized
+    regime. ON by default for full-scale scatters (>= _LANE_PAD_MIN_EDGES
+    padded edges); NTS_SCATTER_LANE_PAD=1 forces it at any size,
+    NTS_SCATTER_LANE_PAD=0 disables it — with a one-line warning either
+    way, so the 110-vs-7-second regression can't silently return."""
     import os
 
-    if f >= 128 or os.environ.get("NTS_SCATTER_LANE_PAD", "0") != "1":
+    from neutronstarlite_tpu.utils.logging import get_logger
+
+    if f >= _LANE_WIDTH:
         return f
-    return 128
+    mode = os.environ.get("NTS_SCATTER_LANE_PAD", "auto")
+    log = get_logger("aggregate")
+    if mode not in ("", "auto", "0", "1"):
+        # historical semantics: any non-"1" value disabled the fence, so
+        # an existing opt-out spelling (false/off/no) must keep opting
+        # out when the default flips to auto — but say so, loudly
+        if ("spelling", mode) not in _lane_pad_logged:
+            _lane_pad_logged.add(("spelling", mode))
+            log.warning(
+                "NTS_SCATTER_LANE_PAD=%r is not a recognized value "
+                "(use 0/1/auto); treating it as 0 (fence off) for "
+                "backward compatibility", mode,
+            )
+        mode = "0"
+    if mode == "0":
+        if e_pad >= _LANE_PAD_MIN_EDGES and ("off", f) not in _lane_pad_logged:
+            _lane_pad_logged.add(("off", f))
+            log.warning(
+                "narrow scatter width %d < lane width %d over %d edges "
+                "with NTS_SCATTER_LANE_PAD=0 — this is the PERF.md "
+                "section-2a 15x regime; expect a serialized scatter", f,
+                _LANE_WIDTH, e_pad,
+            )
+        return f
+    if mode != "1" and e_pad < _LANE_PAD_MIN_EDGES:
+        return f
+    if ("pad", f) not in _lane_pad_logged:
+        _lane_pad_logged.add(("pad", f))
+        log.warning(
+            "scatter width %d below the %d-lane width over %d edges: "
+            "routing through lane padding (%.1fx slot traffic; "
+            "NTS_SCATTER_LANE_PAD=0 opts out)", f, _LANE_WIDTH, e_pad,
+            _LANE_WIDTH / max(f, 1),
+        )
+    return _LANE_WIDTH
 
 
 def gather_dst_from_src(graph, x: jax.Array) -> jax.Array:
@@ -160,7 +205,7 @@ def gather_dst_from_src(graph, x: jax.Array) -> jax.Array:
     if isinstance(graph, EllPair):
         return ell_gather_dst_from_src(graph, x)
     f = x.shape[1]
-    fp = _lane_pad_width(f)
+    fp = _lane_pad_width(f, int(graph.csc_src.shape[0]))
     if fp != f:
         x = jnp.pad(x, ((0, 0), (0, fp - f)))
     out = _aggregate(
@@ -199,7 +244,13 @@ def gather_src_from_dst(graph, y: jax.Array) -> jax.Array:
         return pallas_gather_src_from_dst(graph, y)
     if isinstance(graph, EllPair):
         return ell_gather_src_from_dst(graph, y)
-    return _aggregate(
+    # same narrow-width fence as the CSC direction (the scatter regime is
+    # direction-agnostic)
+    f = y.shape[1]
+    fp = _lane_pad_width(f, int(graph.csr_dst.shape[0]))
+    if fp != f:
+        y = jnp.pad(y, ((0, 0), (0, fp - f)))
+    out = _aggregate(
         graph.v_num,
         graph.edge_chunk,
         graph.csr_dst,
@@ -210,6 +261,7 @@ def gather_src_from_dst(graph, y: jax.Array) -> jax.Array:
         graph.csc_weight,
         y,
     )
+    return out[:, :f] if fp != f else out
 
 
 def aggregate_dst_max(graph: DeviceGraph, x: jax.Array) -> jax.Array:

@@ -108,6 +108,8 @@ class BlockedEll:
         slot_chunk: int = DEFAULT_SLOT_CHUNK,  # kept for API compat; byte
         # budget (NTS_ELL_CHUNK_MIB) governs chunking at trace time
         src_num: int | None = None,  # source rows (default: square, = v_num)
+        log_stats: bool = True,  # the ring builder runs P*P tiny builds and
+        # logs ONE consolidated line itself (parallel/dist_ring_blocked.py)
     ) -> "BlockedEll":
         from neutronstarlite_tpu import native as native_rt
 
@@ -211,7 +213,7 @@ class BlockedEll:
                 pad_slots += n_tiles * n_l * K - int(d.sum())
                 real_slots += int(d.sum())
             K *= 2
-        if real_slots:
+        if real_slots and log_stats:
             log.info(
                 "blocked ELL: %d tiles of %d, %d levels, padding waste %.2fx "
                 "(%d real / %d padded slots)",
@@ -246,6 +248,15 @@ class BlockedEll:
         varying without naming the mesh axis here (the same move as
         ops/aggregate._scatter_accumulate, so this op runs identically
         inside and outside shard_map)."""
+        acc = jnp.zeros((self.v_num, x.shape[1]), jnp.float32)
+        return self.aggregate_into(acc, x).astype(x.dtype)
+
+    def aggregate_into(self, acc: jax.Array, x: jax.Array) -> jax.Array:
+        """``aggregate`` over an EXISTING [V, f] f32 accumulator, returned
+        un-cast — the ring-pipelined distributed path
+        (parallel/dist_ring_blocked.py) adds one source partition's
+        contribution per ring step into the same f32 carry, so the
+        cross-step sum never rounds in a narrow dtype."""
         f = x.shape[1]
         src_num = self.src_num or self.v_num
         v_pad = self.n_tiles * self.vt - src_num
@@ -288,14 +299,15 @@ class BlockedEll:
                 acc = level_add(acc, x_tile, nbr, wgt, dstr)
             return acc, None
 
-        acc = jnp.zeros((self.v_num, f), jnp.float32)
         tables = list(zip(self.nbr, self.wgt, self.dst_row))
+        if not tables:
+            return acc
         # first tile outside the scan (varying-carry peel, see above)
         acc, _ = body(acc, (xt[0], [(n[0], w[0], d[0]) for n, w, d in tables]))
         if self.n_tiles > 1:
             rest = [(n[1:], w[1:], d[1:]) for n, w, d in tables]
             acc, _ = lax.scan(body, acc, (xt[1:], rest))
-        return acc.astype(x.dtype)
+        return acc
 
 
 @jax.tree_util.register_dataclass

@@ -1,16 +1,23 @@
-"""Compare the three distributed aggregation exchanges on the current mesh.
+"""Compare the four distributed aggregation exchanges on the current mesh.
 
 ``python -m neutronstarlite_tpu.parallel.comm_bench [--vertices N]
 [--avg-degree D] [--feature F] [--partitions P] [--steps K]``
 
 For each comm layer (ring = dense ppermute rotation, ell = all_gather +
-gather-only ELL tables, mirror = compacted active-mirror all_to_all) this
-builds the layout, jits one fused aggregate + backward step, and reports:
+gather-only ELL tables, mirror = compacted active-mirror all_to_all,
+ring_blocked = the pipelined blocked ring, parallel/dist_ring_blocked.py)
+this builds the layout, jits one fused aggregate + backward step, and
+reports:
 
 - wire rows/device/layer (the analytic comm volume — what the reference
   tunes with its active-mirror-only messages, comm/network.cpp:505-518);
+- peak LIVE exchange-buffer rows/bytes (the memory half of the decision:
+  the all_gather family is O(P*vp), the double-buffered rings O(2*vp) —
+  tools/wire_accounting.peak_resident_rows);
 - measured step time on the current mesh (virtual CPU devices in tests,
-  real chips on a pod).
+  real chips on a pod), plus — for ring_blocked — the per-hop compute
+  time of each ring step's stacked tables measured standalone (the
+  ``seconds`` the obs ``ring_step`` records leave null in-run).
 
 The GCNDIST trainer's COMM_LAYER:auto heuristic picks mirror vs ring by the
 same wire-row comparison printed here; this tool is the measurement that
@@ -75,19 +82,38 @@ def bench_layers(v_num, avg_degree, f, partitions, steps, seed=3,
 
         return jax.jit(jax.value_and_grad(loss))
 
+    from neutronstarlite_tpu.parallel.dist_ring_blocked import (
+        RingBlockedPair,
+        default_ring_vt,
+        dist_ring_blocked_gather_dst_from_src,
+    )
+    from neutronstarlite_tpu.tools.wire_accounting import peak_resident_rows
+
+    ring_vt = default_ring_vt(dist.vp, kernel_tile)
+    rblk = RingBlockedPair.build(dist, vt=ring_vt).shard(mesh)
+
     paths = {
         "ring": (
             loss_of(lambda x: dist_gather_dst_from_src(
                 mesh, dist.partitions, dist.vp, dist.edge_chunk, blocks, x)),
             (P - 1) * dist.vp,
+            peak_resident_rows("ring", P, dist.vp),
         ),
         "ell": (
             loss_of(lambda x: dist_ell_gather_dst_from_src(mesh, ell, x)),
             (P - 1) * dist.vp,  # all_gather ships the same shard rows
+            peak_resident_rows("ell", P, dist.vp),
         ),
         "mirror": (
             loss_of(lambda x: dist_gather_dst_from_src_mirror(mesh, mg, tables, x)),
             (P - 1) * mg.mb,  # the p->p all_to_all chunk stays on-device
+            peak_resident_rows("mirror", P, dist.vp, mg.mb),
+        ),
+        "ring_blocked": (
+            loss_of(lambda x: dist_ring_blocked_gather_dst_from_src(
+                mesh, rblk, x)),
+            (P - 1) * dist.vp,  # same total volume, chunked over P-1 hops
+            peak_resident_rows("ring_blocked", P, dist.vp),
         ),
     }
     if kernel_tile:
@@ -95,10 +121,11 @@ def bench_layers(v_num, avg_degree, f, partitions, steps, seed=3,
         paths["blocked"] = (
             loss_of(lambda x: dist_blocked_gather_dst_from_src(mesh, blk, x)),
             (P - 1) * dist.vp,  # same all_gather wire volume as ell
+            peak_resident_rows("blocked", P, dist.vp),
         )
 
     results = {}
-    for name, (fn, wire_rows) in paths.items():
+    for name, (fn, wire_rows, peak_rows) in paths.items():
         val, grad = fn(x)  # compile
         jax.block_until_ready(grad)
         t0 = time.time()
@@ -110,14 +137,48 @@ def bench_layers(v_num, avg_degree, f, partitions, steps, seed=3,
             "step_s": round(dt, 5),
             "wire_rows_per_dev_layer": int(wire_rows),
             "wire_mb_per_dev_layer_f32": round(wire_rows * f * 4 / 2**20, 2),
+            "peak_live_rows": int(peak_rows),
+            "peak_live_mb_f32": round(peak_rows * f * 4 / 2**20, 2),
             "check": float(val),
         }
+    results["ring_blocked"]["per_step_compute_s"] = ring_step_times(
+        rblk.fwd, f, steps
+    )
     results["meta"] = {
         "v_num": v_num, "e_num": int(g.e_num), "feature": f, "P": P,
         "vp": dist.vp, "mb": mg.mb, "eb": dist.eb, "el": mg.el,
+        "ring_vt": ring_vt, "ring_work_steps": rblk.fwd.work_steps(),
         "device": str(jax.devices()[0]),
     }
     return results
+
+
+def ring_step_times(rbe, f: int, steps: int, seed: int = 5):
+    """Per-ring-hop COMPUTE time, measured standalone: one jitted
+    aggregate of device 0's stacked tables for each work step over a
+    random [vp, f] shard — the honest fill for the ``seconds`` field the
+    in-run ``ring_step`` records leave null (one XLA program cannot be
+    split per hop from outside)."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.standard_normal((rbe.vp, f)).astype(np.float32))
+    out = {}
+    for s in rbe.work_steps():
+        view = rbe._device_step_view(
+            [jnp.asarray(n[0]) for n in rbe.nbr[s]],
+            [jnp.asarray(w[0]) for w in rbe.wgt[s]],
+            [jnp.asarray(d[0]) for d in rbe.dst_row[s]],
+        )
+        fn = jax.jit(lambda v, view=view: view.aggregate(v))
+        jax.block_until_ready(fn(x))  # compile
+        t0 = time.time()
+        for _ in range(steps):
+            r = fn(x)
+        jax.block_until_ready(r)
+        out[str(s)] = round((time.time() - t0) / steps, 6)
+    return out
 
 
 def main(argv=None) -> int:

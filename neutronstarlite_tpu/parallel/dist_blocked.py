@@ -48,7 +48,7 @@ from jax.sharding import Mesh, PartitionSpec as PS
 from neutronstarlite_tpu.ops.blocked_ell import BlockedEll
 from neutronstarlite_tpu.parallel.dist_ell import per_device_adjacency
 from neutronstarlite_tpu.parallel.dist_graph import DistGraph
-from neutronstarlite_tpu.parallel.mesh import PARTITION_AXIS
+from neutronstarlite_tpu.parallel.mesh import PARTITION_AXIS, shard_map
 from neutronstarlite_tpu.utils.logging import get_logger
 
 log = get_logger("dist_blocked")
@@ -192,7 +192,7 @@ def _dist_blocked_apply(mesh: Mesh, dbl: DistBlockedEll, x: jax.Array) -> jax.Ar
         PS(PARTITION_AXIS, *([None] * (a.ndim - 1)))
         for a in (*dbl.nbr, *dbl.wgt, *dbl.dst_row)
     )
-    fn = jax.shard_map(
+    fn = shard_map(
         body,
         mesh=mesh,
         in_specs=specs + (PS(PARTITION_AXIS, None),),

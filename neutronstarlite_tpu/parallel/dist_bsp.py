@@ -48,7 +48,7 @@ from neutronstarlite_tpu.ops.bsp_ell import (
 from neutronstarlite_tpu.ops.pallas_kernels import pallas_interpret_default
 from neutronstarlite_tpu.parallel.dist_ell import per_device_adjacency
 from neutronstarlite_tpu.parallel.dist_graph import DistGraph
-from neutronstarlite_tpu.parallel.mesh import PARTITION_AXIS
+from neutronstarlite_tpu.parallel.mesh import PARTITION_AXIS, shard_map
 from neutronstarlite_tpu.utils.logging import get_logger
 
 log = get_logger("dist_bsp")
@@ -393,7 +393,7 @@ def _dist_bsp_apply(mesh: Mesh, dbsp: DistBsp, x: jax.Array) -> jax.Array:
             (nbr[0], wgt[0], ldst[0], key[0], first[0]), xg
         )
 
-    fn = jax.shard_map(
+    fn = shard_map(
         body,
         mesh=mesh,
         in_specs=(

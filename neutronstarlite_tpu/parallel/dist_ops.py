@@ -34,7 +34,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as PS
 
 from neutronstarlite_tpu.ops.aggregate import _scatter_accumulate
-from neutronstarlite_tpu.parallel.mesh import PARTITION_AXIS
+from neutronstarlite_tpu.parallel.mesh import PARTITION_AXIS, shard_map
 
 
 def _ring_aggregate_local(block_src, block_dst, block_weight, x_local, *,
@@ -115,7 +115,7 @@ def dist_gather_dst_from_src(
                 edge_chunk=edge_chunk,
             )
 
-        fn = jax.shard_map(
+        fn = shard_map(
             local_steps,
             mesh=mesh,
             in_specs=tuple(PS(PARTITION_AXIS, None) for _ in range(3 * n_steps))
@@ -137,7 +137,7 @@ def dist_gather_dst_from_src(
         # shard_map passes [1, P, Eb] / [vp, f] blocks; squeeze the dst axis
         return body(bs[0], bd[0], bw[0], xs)
 
-    fn = jax.shard_map(
+    fn = shard_map(
         local,
         mesh=mesh,
         in_specs=(

@@ -49,7 +49,7 @@ from jax.sharding import Mesh, PartitionSpec as PS
 
 from neutronstarlite_tpu.graph.storage import CSCGraph, partition_offsets
 from neutronstarlite_tpu.parallel.dist_edge_ops import _gather_rows
-from neutronstarlite_tpu.parallel.mesh import PARTITION_AXIS
+from neutronstarlite_tpu.parallel.mesh import PARTITION_AXIS, shard_map
 from neutronstarlite_tpu.parallel.mirror import MirrorGraph, build_local_edge_lists
 from neutronstarlite_tpu.parallel.vertex_space import round_up
 from neutronstarlite_tpu.utils.logging import get_logger
@@ -343,7 +343,7 @@ def dist_get_dep_nbr_partial(
         m = jnp.concatenate([cached, got], axis=1)  # [P, mc+mf, f]
         return m.reshape(1, P * (mc + mf), f)
 
-    fn = jax.shard_map(
+    fn = shard_map(
         body,
         mesh=mesh,
         in_specs=(
@@ -371,7 +371,7 @@ def dist_fetch_cached_rows(
         got = lax.all_to_all(rows, PARTITION_AXIS, 0, 0, tiled=True)
         return got.reshape(1, P * mc, xs.shape[1])
 
-    fn = jax.shard_map(
+    fn = shard_map(
         body,
         mesh=mesh,
         in_specs=(PS(PARTITION_AXIS, None, None), PS(PARTITION_AXIS, None)),

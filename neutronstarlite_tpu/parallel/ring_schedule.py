@@ -1,0 +1,70 @@
+"""Shared ring-rotation scaffolding for the pipelined dist exchanges.
+
+One module owns the three facts every ring participant must agree on —
+who sends to whom (``ring_perm``), which source partition a device holds
+at each step (``ring_source``), and what dtype rides the wire
+(``resolve_wire_dtype``) — so the stacked table builder
+(parallel/dist_ring_blocked.py), the shard_map ring body, the
+collective-free sim twin, and the wire accounting can never drift on the
+schedule. Reference: the ``(pid +- step) % partitions`` master/mirror
+rotation (core/graph.hpp:2644, comm/network.cpp:612-633); the backward
+pass runs the REVERSE ring (direction -1), the reference's gradient-push
+``compute_sync_decoupled`` order (graph.hpp:3456).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Tuple
+
+import jax.numpy as jnp
+
+# cfg WIRE_DTYPE / env NTS_WIRE_DTYPE spellings -> canonical names
+_WIRE_DTYPES = {
+    "": None,
+    "f32": None,
+    "float32": None,
+    "bf16": "bfloat16",
+    "bfloat16": "bfloat16",
+}
+
+
+def ring_perm(partitions: int, direction: int = 1) -> List[Tuple[int, int]]:
+    """ppermute pairs for one rotation hop. ``direction=+1`` is the forward
+    ring (device i sends its resident shard to i-1, so each device's held
+    source partition advances +1 per step); ``-1`` is the reverse ring the
+    backward pass rides."""
+    if direction not in (1, -1):
+        raise ValueError(f"ring direction must be +1 or -1, got {direction}")
+    return [(i, (i - direction) % partitions) for i in range(partitions)]
+
+
+def ring_source(p: int, step: int, partitions: int, direction: int = 1) -> int:
+    """The source partition whose shard device ``p`` holds at ring step
+    ``step`` under ``direction`` (step 0 = its own shard)."""
+    return (p + direction * step) % partitions
+
+
+def resolve_wire_dtype(cfg_value: str = "") -> Optional[jnp.dtype]:
+    """The dtype feature shards ride the ICI in, or None for "ship the
+    compute dtype unchanged". ``NTS_WIRE_DTYPE`` (launcher parity)
+    overrides the cfg ``WIRE_DTYPE`` key; bf16 halves wire bytes while the
+    per-step accumulation stays f32 (the ring body's explicit wide carry).
+    """
+    value = os.environ.get("NTS_WIRE_DTYPE", "") or (cfg_value or "")
+    value = value.strip().lower()
+    if value not in _WIRE_DTYPES:
+        raise ValueError(
+            f"WIRE_DTYPE must be one of {sorted(k for k in _WIRE_DTYPES if k)}"
+            f" (or empty), got {value!r}"
+        )
+    name = _WIRE_DTYPES[value]
+    return jnp.dtype(name) if name else None
+
+
+def trim_transfers(work_steps: List[int]) -> int:
+    """Rotation hops actually needed: shards only travel far enough to
+    reach the LAST step with compute — a skipped suffix (empty partition
+    pairs) drops its transfers from the schedule entirely. Returns the
+    number of ppermute hops (0 when only step 0 works or nothing works)."""
+    return max(work_steps) if work_steps else 0

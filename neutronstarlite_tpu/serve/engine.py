@@ -183,19 +183,49 @@ class InferenceEngine:
         self.ckpt_step = step
         log.info("serving checkpoint step %d from %s", step, ckpt_dir)
 
+    # the one parameter family the AOT bucket forward can rebuild today;
+    # grows as the engine learns more model forwards
+    SERVABLE_FAMILIES = (
+        "sampled-GCN (params = [{'W': ...}, ...]; ALGORITHM:GCNSAMPLESINGLE)",
+    )
+
+    @staticmethod
+    def _param_family(p) -> str:
+        """Best-effort name for a parameter tree's model family, so the
+        refusal names what the checkpoint IS, not just what it isn't."""
+        if not isinstance(p, (list, tuple)) or not p:
+            return f"non-layer-list params ({type(p).__name__})"
+        keys = set()
+        for layer in p:
+            if not isinstance(layer, dict):
+                return f"layer list with non-dict entries ({type(layer).__name__})"
+            keys |= set(layer)
+        if "a" in keys:
+            return "GAT family (attention vector 'a' present)"
+        if "Ws" in keys or "Wd" in keys:
+            return "GGCN family (gated edge-NN weights Ws/Wd)"
+        if "W1" in keys or "W2" in keys:
+            return "GIN family (two-layer MLP W1/W2)"
+        if "C" in keys or "H" in keys:
+            return "CommNet family (C/H projections)"
+        if "bn" in keys:
+            return "full-batch GCN family (batch-norm stats present)"
+        return f"unrecognized family (layer keys: {sorted(keys)})"
+
     def _check_servable(self, p) -> None:
         """The engine serves the sampled-GCN parameter family: a list of
         layers each holding exactly one dense ``W``. Anything else (bn
-        stats, attention params) would silently skip math — refuse."""
+        stats, attention params) would silently skip math — refuse,
+        naming the DETECTED family and the supported list."""
         ok = isinstance(p, (list, tuple)) and len(p) > 0 and all(
             isinstance(layer, dict) and set(layer) == {"W"} for layer in p
         )
         if not ok:
+            supported = "; ".join(self.SERVABLE_FAMILIES)
             raise ServeSetupError(
                 f"ALGORITHM {self.cfg.algorithm!r} checkpoints are not "
-                "servable: the engine supports the sampled-GCN family "
-                "(params = [{'W': ...}, ...]); train with "
-                "ALGORITHM:GCNSAMPLESINGLE"
+                f"servable: detected {self._param_family(p)}; the engine "
+                f"supports: {supported}"
             )
 
     # ---- AOT bucket executables ------------------------------------------

@@ -103,8 +103,32 @@ def _dist_gcn_case(cfg, base_dir, mesh, edges=None):
     host_graph = build_graph(src, dst, cfg.vertices, weight="gcn_norm")
     sizes = cfg.layer_sizes()
 
-    layer_kind = DistGCNTrainer.resolve_comm_layer(cfg, host_graph, P)
-    if layer_kind == "mirror":
+    # same DIST_PATH resolution as DistGCNTrainer.build_model — the tool
+    # must compile the exchange the trainer ships, not a different one
+    dist_path = getattr(cfg, "dist_path", "")
+    wire_dtype = None
+    if dist_path in ("ring_blocked", "ring_blocked_sim"):
+        layer_kind = "ring_blocked"
+    elif dist_path == "all_gather":
+        layer_kind = "ell"
+    else:
+        layer_kind = DistGCNTrainer.resolve_comm_layer(cfg, host_graph, P)
+    if layer_kind == "ring_blocked":
+        from neutronstarlite_tpu.parallel.dist_graph import DistGraph
+        from neutronstarlite_tpu.parallel.dist_ring_blocked import (
+            RingBlockedPair,
+            default_ring_vt,
+        )
+        from neutronstarlite_tpu.parallel.ring_schedule import (
+            resolve_wire_dtype,
+        )
+
+        dist = DistGraph.build(host_graph, P, edge_chunk=cfg.edge_chunk or None)
+        host_blocks = RingBlockedPair.build(
+            dist, vt=default_ring_vt(dist.vp, cfg.kernel_tile)
+        )
+        wire_dtype = resolve_wire_dtype(getattr(cfg, "wire_dtype", ""))
+    elif layer_kind == "mirror":
         # the GCN fused path ships the SPLIT layout since round 5
         from neutronstarlite_tpu.parallel.mirror import SplitMirror
 
@@ -177,7 +201,7 @@ def _dist_gcn_case(cfg, base_dir, mesh, edges=None):
         def loss_fn(p):
             logits = dist_gcn_forward(
                 mesh, dist, blocks, p, feature, valid, key, drop_rate, True,
-                compute_dtype=compute_dtype,
+                compute_dtype=compute_dtype, wire_dtype=wire_dtype,
             )
             return masked_nll(logits, label, train01), logits
 

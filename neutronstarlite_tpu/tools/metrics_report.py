@@ -192,6 +192,49 @@ def render_serve(path: str, rec: Dict[str, Any],
     return "\n".join(lines)
 
 
+def render_ring(events: List[Dict[str, Any]],
+                rec: Dict[str, Any]) -> List[str]:
+    """The ring-pipelined exchange block: overlap/memory facts from the
+    per-hop ``ring_step`` records (parallel/dist_ring_blocked.py) plus the
+    residency gauges the trainer pins. Empty when the stream has none."""
+    hops = [e for e in events if e["event"] == "ring_step"]
+    if not hops:
+        return []
+    gauges = rec.get("gauges") or {}
+    total = sum(e["bytes"] for e in hops)
+    by_step: Dict[int, int] = {}
+    for e in hops:
+        by_step[e["step"]] = by_step.get(e["step"], 0) + e["bytes"]
+    epochs = len({e.get("epoch") for e in hops})
+    # skip count from the trainer's trace-time gauge when present — a
+    # trimmed SUFFIX ships no hops at all, so its skipped steps never
+    # appear in the per-hop records; fall back to the records otherwise
+    skipped = gauges.get("ring.skipped_steps")
+    if skipped is None:
+        skipped = sum(1 for e in hops if e.get("skipped")) // max(epochs, 1)
+    lines = [
+        "ring-pipelined exchange:",
+        f"#ring_hops_per_epoch={len(by_step)} "
+        f"(skipped_compute_steps={int(skipped)})",
+        f"#ring_wire_bytes={total} ({total / 2**20:.2f} MiB over "
+        f"{epochs} epoch(s))",
+    ]
+    peak = gauges.get("wire.peak_resident_rows")
+    if peak is not None:
+        lines.append(
+            f"#ring_peak_resident_rows={int(peak)} (double buffer: "
+            "resident shard + one in flight; the all_gather family holds "
+            "P*vp)"
+        )
+    timed = [e["seconds"] for e in hops if e.get("seconds") is not None]
+    if timed:
+        lines.append(
+            f"#ring_hop_time_total={sum(timed) * 1000:.3f}(ms) over "
+            f"{len(timed)} measured hops"
+        )
+    return lines
+
+
 _TIMELINE_SKIP = ("event", "run_id", "schema", "ts", "seq", "error")
 
 
@@ -248,6 +291,7 @@ def render_run(path: str, rec: Dict[str, Any]) -> str:
     loss = (rec.get("result") or {}).get("loss")
     if loss is not None:
         lines.append(f"#final_loss={loss}")
+    lines.extend(rec.get("_ring") or [])
     timeline = rec.get("_timeline") or []
     if timeline:
         lines.append("recovery timeline:")
@@ -323,6 +367,7 @@ def main(argv=None) -> int:
         if rec is not None:
             rec["_path"] = p
             rec["_timeline"] = recovery_timeline(events)
+            rec["_ring"] = render_ring(events, rec)
         if srec is not None:
             srec["_path"] = p
             srec["_events"] = events

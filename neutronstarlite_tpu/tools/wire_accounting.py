@@ -48,9 +48,12 @@ def exchange_rows_per_device(kind: str, P: int, vp: int, mb: int = 0) -> int:
     The single formula bridged into the live ``obs`` wire counters (dist
     trainers) AND used by :func:`accounting` below, so the offline report
     and the run-time telemetry can never disagree. Dense exchanges (ring
-    ppermute rotation, ell/blocked all_gather) deliver P-1 remote shard
-    chunks of ``vp`` rows; the mirror all_to_all delivers P-1 compacted
-    chunks of ``mb`` rows (the reference's active-only message
+    ppermute rotation, ell/blocked all_gather, AND the ring-pipelined
+    ``ring_blocked`` path) deliver P-1 remote shard chunks of ``vp`` rows
+    — ring_blocked ships the SAME total volume as all_gather, chunked
+    over P-1 overlapped hops so at most one chunk is in flight (see
+    :func:`peak_resident_rows`); the mirror all_to_all delivers P-1
+    compacted chunks of ``mb`` rows (the reference's active-only message
     optimization, comm/network.cpp:505-518, as a layout property).
     """
     if P <= 1:
@@ -58,6 +61,23 @@ def exchange_rows_per_device(kind: str, P: int, vp: int, mb: int = 0) -> int:
     if kind in ("mirror", "mirror_uniform"):
         return (P - 1) * mb
     return (P - 1) * vp
+
+
+def peak_resident_rows(kind: str, P: int, vp: int, mb: int = 0) -> int:
+    """Peak EXCHANGE-BUFFER rows live at once per device (the memory half
+    of the comm-layer decision; the row count the obs gauge
+    ``wire.peak_resident_rows`` carries). The all_gather family
+    materializes every shard before compute starts (P*vp); the ring
+    families are double-buffered — resident shard + the one in flight
+    (2*vp, independent of P); the mirror all_to_all lands all P-1 remote
+    compacted chunks plus the resident diagonal (P*mb)."""
+    if P <= 1:
+        return vp
+    if kind in ("mirror", "mirror_uniform"):
+        return P * mb
+    if kind in ("ring", "ring_blocked"):
+        return min(2, P) * vp
+    return P * vp
 
 
 def accounting(g, P: int, f: int, refresh: int, budget_bytes: int,
@@ -76,20 +96,30 @@ def accounting(g, P: int, f: int, refresh: int, budget_bytes: int,
     dense_rows = exchange_rows_per_device("ring", P, vp)
     mirror_rows = exchange_rows_per_device("mirror", P, vp, mb)
     mirror_uni_rows = exchange_rows_per_device("mirror", P, vp, mb_uni)
+    layer_rows = {
+        "ring": dense_rows, "ell": dense_rows, "blocked": dense_rows,
+        "ring_blocked": dense_rows,
+        "mirror": mirror_rows, "mirror_uniform": mirror_uni_rows,
+    }
     out = {
         "P": P, "f": f, "vp": vp, "mb": mb, "mb_uniform": mb_uni,
-        "layers": {
-            "ring": dense_rows, "ell": dense_rows, "blocked": dense_rows,
-            "mirror": mirror_rows, "mirror_uniform": mirror_uni_rows,
-        },
-        "bytes_per_layer": {
-            k: v * f * 4
-            for k, v in (
-                ("ring", dense_rows), ("ell", dense_rows),
-                ("blocked", dense_rows), ("mirror", mirror_rows),
-                ("mirror_uniform", mirror_uni_rows),
+        "layers": layer_rows,
+        "bytes_per_layer": {k: v * f * 4 for k, v in layer_rows.items()},
+        # wire volume is only half the decision: the ring ships the SAME
+        # (P-1)*vp rows as all_gather but holds 2 shard buffers live
+        # instead of P — the dist memory envelope argument. Each mirror
+        # flavor is priced at ITS OWN slot count (the uniform layout's
+        # mb_uni, not the split layout's compacted mb).
+        "peak_resident_rows": {
+            k: peak_resident_rows(
+                k, P, vp,
+                {"mirror": mb, "mirror_uniform": mb_uni}.get(k, 0),
             )
+            for k in layer_rows
         },
+    }
+    out["peak_resident_bytes"] = {
+        k: v * f * 4 for k, v in out["peak_resident_rows"].items()
     }
 
     # threshold ladder: degree percentiles of the mirror sources
@@ -188,8 +218,10 @@ def main(argv=None) -> int:
         "\n".join(
             [f"wire accounting: {name} P={out['P']} f={out['f']} "
              f"vp={out['vp']} mb={out['mb']}"]
-            + [f"  {k:8s} {v:>12d} rows/dev/layer "
-               f"({out['bytes_per_layer'][k] / 2**20:.1f} MiB)"
+            + [f"  {k:14s} {v:>12d} rows/dev/layer "
+               f"({out['bytes_per_layer'][k] / 2**20:.1f} MiB wire, "
+               f"{out['peak_resident_rows'][k]:>8d} rows "
+               f"{out['peak_resident_bytes'][k] / 2**20:.1f} MiB resident)"
                for k, v in out["layers"].items()]
             + [f"  depcache t={e['threshold']:>6d}: mc={e['mc']:>6d} "
                f"mf={e['mf']:>6d} hot={e['hot_fraction']:.3f} "

@@ -127,6 +127,16 @@ class InputInfo:
     # path), mirror (compacted active-mirror all_to_all — the analog of the
     # reference's active-only messages, comm/network.cpp:505-518), or auto
     # (pick mirror vs ring by estimated wire rows; OPTIM_KERNEL:1 -> ell)
+    dist_path: str = ""  # dist aggregation path override, one level above
+    # COMM_LAYER: "" / auto (keep the COMM_LAYER selection), all_gather
+    # (force the gather-only OPTIM_KERNEL family), ring_blocked (the
+    # ring-pipelined blocked exchange, parallel/dist_ring_blocked.py —
+    # O(2*vp) exchange memory, comm/compute overlap), ring_blocked_sim
+    # (its collective-free twin, single-core CI parity)
+    wire_dtype: str = ""  # ICI exchange dtype for the ring-pipelined path:
+    # "" / f32 / float32 (ship the compute dtype) or bf16 / bfloat16
+    # (halve wire bytes; the per-step accumulator stays f32). Env override
+    # NTS_WIRE_DTYPE (parallel/ring_schedule.resolve_wire_dtype).
     kernel_tile: int = 0  # OPTIM_KERNEL source-tile width (vertices): 0 =
     # plain ELL; >0 = blocked ELL (ops/blocked_ell.py) whose per-tile gather
     # table [vt, f] is sized to stay in the fast on-chip regime at any V
@@ -262,6 +272,25 @@ class InputInfo:
             self.edge_chunk = int(value)
         elif key == "COMM_LAYER":
             self.comm_layer = value.strip().lower()
+        elif key == "DIST_PATH":
+            v = value.strip().lower()
+            # validated like PRECISION: a typo'd value would silently run
+            # the all_gather path while the user benchmarks it as the ring
+            if v not in ("", "auto", "all_gather", "ring_blocked",
+                         "ring_blocked_sim"):
+                raise ValueError(
+                    "DIST_PATH must be auto, all_gather, ring_blocked or "
+                    f"ring_blocked_sim, got {value!r}"
+                )
+            self.dist_path = v
+        elif key == "WIRE_DTYPE":
+            v = value.strip().lower()
+            if v not in ("", "f32", "float32", "bf16", "bfloat16"):
+                raise ValueError(
+                    f"WIRE_DTYPE must be f32/float32 or bf16/bfloat16, "
+                    f"got {value!r}"
+                )
+            self.wire_dtype = v
         elif key == "UNDIRECTED":
             self.undirected = bool(int(value))
         elif key == "DATA_FORMAT":

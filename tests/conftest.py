@@ -10,6 +10,12 @@ Must run before the first jax import in the test process.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# A container with libtpu installed but no reachable TPU hangs PJRT init
+# FOREVER; the test_tpu probe subprocess then burns its whole timeout in
+# every CPU-rig run. 60 s is ~4x a healthy-tunnel probe (bench logs
+# init_s in single digits); on-chip rigs with slow tunnels override via
+# the env (setdefault — an explicit value always wins).
+os.environ.setdefault("NTS_TPU_PROBE_TIMEOUT_S", "60")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -90,6 +90,9 @@ def test_pad_unpad_roundtrip(rng):
 
 @multidevice
 @pytest.mark.parametrize("partitions", [2, 4])
+@pytest.mark.slow  # real-collective integration on the 2-core CPU
+# rig: compile+execute of the shard_map program dominates tier-1
+# wall time; the sim-twin parity tests in this module stay tier-1
 def test_dist_gather_matches_single_device(rng, partitions):
     g, dense = tiny_graph(rng, v_num=97, e_num=800)
     mesh = make_mesh(partitions)
@@ -106,6 +109,9 @@ def test_dist_gather_matches_single_device(rng, partitions):
 
 
 @multidevice
+@pytest.mark.slow  # real-collective integration on the 2-core CPU
+# rig: compile+execute of the shard_map program dominates tier-1
+# wall time; the sim-twin parity tests in this module stay tier-1
 def test_dist_gather_gradient_is_reverse_ring(rng):
     partitions = 4
     g, dense = tiny_graph(rng, v_num=50, e_num=400)
@@ -176,6 +182,9 @@ def test_resolve_comm_layer_rules(rng):
 
 
 @multidevice
+@pytest.mark.slow  # real-collective integration on the 2-core CPU
+# rig: compile+execute of the shard_map program dominates tier-1
+# wall time; the sim-twin parity tests in this module stay tier-1
 def test_dist_gin_trainer_matches_single_chip(rng):
     """GINDIST (the reference's GIN under mpiexec) on a real 4-device mesh:
     must converge and track the single-chip GIN trainer's loss (same math;
@@ -215,6 +224,9 @@ def test_dist_gin_trainer_matches_single_chip(rng):
 
 
 @multidevice
+@pytest.mark.slow  # real-collective integration on the 2-core CPU
+# rig: compile+execute of the shard_map program dominates tier-1
+# wall time; the sim-twin parity tests in this module stay tier-1
 def test_dist_commnet_trainer_matches_single_chip(rng):
     """COMMNETDIST on a real 4-device mesh: converge + track the single-chip
     CommNet trainer (same communication-step math)."""
@@ -253,6 +265,9 @@ def test_dist_commnet_trainer_matches_single_chip(rng):
 
 @multidevice
 @pytest.mark.parametrize("comm_layer", ["ring", "ell", "mirror"])
+@pytest.mark.slow  # real-collective integration on the 2-core CPU
+# rig: compile+execute of the shard_map program dominates tier-1
+# wall time; the sim-twin parity tests in this module stay tier-1
 def test_dist_eager_gcn_matches_single_chip(rng, comm_layer):
     """GCNEAGERDIST (the reference's GCN_EAGER dist toolkit): NN-then-
     exchange order on a real 4-device mesh must track the single-chip eager
@@ -295,6 +310,9 @@ def test_dist_eager_gcn_matches_single_chip(rng, comm_layer):
 
 
 @multidevice
+@pytest.mark.slow  # real-collective integration on the 2-core CPU
+# rig: compile+execute of the shard_map program dominates tier-1
+# wall time; the sim-twin parity tests in this module stay tier-1
 def test_dist_debuginfo_report(rng):
     """Dist DEBUGINFO (models/debuginfo.py): the exchange-vs-compute split
     must produce the reference-shaped report (#nn_time/#graph_time/...,
@@ -333,6 +351,9 @@ def test_dist_debuginfo_report(rng):
 
 @multidevice
 @pytest.mark.parametrize("comm_layer", ["ring", "ell", "mirror"])
+@pytest.mark.slow  # real-collective integration on the 2-core CPU
+# rig: compile+execute of the shard_map program dominates tier-1
+# wall time; the sim-twin parity tests in this module stay tier-1
 def test_dist_gcn_bf16_tracks_f32(rng, comm_layer):
     """PRECISION:bfloat16 on the dist GCN engine (round 5): the exchange
     ships bf16 activations (half the wire) on every comm layer while

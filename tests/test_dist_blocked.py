@@ -76,6 +76,9 @@ def test_dist_blocked_matches_dist_ell(rng):
 
 
 @multidevice
+@pytest.mark.slow  # real-collective integration on the 2-core CPU
+# rig: compile+execute of the shard_map program dominates tier-1
+# wall time; the sim-twin parity tests in this module stay tier-1
 def test_dist_blocked_real_collective_matches_sim(rng):
     """The shard_map path (all_gather + per-device blocked scan with the
     peeled varying carry) on the real virtual mesh, value and gradient."""
@@ -112,6 +115,9 @@ def test_dist_blocked_real_collective_matches_sim(rng):
 
 
 @multidevice
+@pytest.mark.slow  # compile-heavy regime (interpret-mode / forced
+# chunking) on the CPU rig; each layer family's primary real-collective
+# parity test stays tier-1
 def test_dist_blocked_multi_chunk_regime(rng, monkeypatch):
     """Force the inner row-chunk scan (tiny byte budget) under the REAL
     shard_map — both peeled scans must be varying-legal together."""
@@ -138,6 +144,9 @@ def test_dist_blocked_multi_chunk_regime(rng, monkeypatch):
 
 
 @multidevice
+@pytest.mark.slow  # real-collective integration on the 2-core CPU
+# rig: compile+execute of the shard_map program dominates tier-1
+# wall time; the sim-twin parity tests in this module stay tier-1
 def test_dist_gcn_trainer_kernel_tile(rng):
     """DistGCNTrainer with OPTIM_KERNEL:1 + KERNEL_TILE accepts the cfg
     (no warning path) and matches the plain dist-ELL trainer's losses."""

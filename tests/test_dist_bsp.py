@@ -100,6 +100,9 @@ def test_dist_bsp_segmented_matches_dense(rng, P, monkeypatch):
 
 
 @multidevice
+@pytest.mark.slow  # compile-heavy regime (interpret-mode / forced
+# chunking) on the CPU rig; each layer family's primary real-collective
+# parity test stays tier-1
 def test_dist_bsp_segmented_real_collective(rng, monkeypatch):
     """The segmented stacked layout under the REAL shard_map + all_gather
     path (8-dev CPU mesh): forward parity vs the collective-free twin and
@@ -140,6 +143,9 @@ def test_dist_bsp_segmented_real_collective(rng, monkeypatch):
 
 
 @multidevice
+@pytest.mark.slow  # real-collective integration on the 2-core CPU
+# rig: compile+execute of the shard_map program dominates tier-1
+# wall time; the sim-twin parity tests in this module stay tier-1
 def test_dist_bsp_real_collective_matches_sim(rng):
     from neutronstarlite_tpu.parallel.dist_ops import vertex_sharded
     from neutronstarlite_tpu.parallel.mesh import make_mesh
@@ -174,6 +180,9 @@ def test_dist_bsp_real_collective_matches_sim(rng):
 
 
 @multidevice
+@pytest.mark.slow  # real-collective integration on the 2-core CPU
+# rig: compile+execute of the shard_map program dominates tier-1
+# wall time; the sim-twin parity tests in this module stay tier-1
 def test_dist_bsp_trainer_matches_ell_trainer(rng):
     """End-to-end DistGCN: PALLAS:1 (dist-bsp exchange) must track the XLA
     dist-ELL trainer's losses (same math, different kernel + summation
@@ -208,6 +217,9 @@ def test_dist_bsp_trainer_matches_ell_trainer(rng):
 
 
 @multidevice
+@pytest.mark.slow  # real-collective integration on the 2-core CPU
+# rig: compile+execute of the shard_map program dominates tier-1
+# wall time; the sim-twin parity tests in this module stay tier-1
 def test_dist_bsp_serves_inherited_trainers(rng):
     """GIN-dist inherits DistGCNTrainer's exchange machinery, so PALLAS:1
     must flow through to the bsp exchange there too (engine decoupling,

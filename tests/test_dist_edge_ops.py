@@ -259,6 +259,9 @@ def test_dist_gat_trainer_converges_simulated(rng):
 
 
 @multidevice
+@pytest.mark.slow  # real-collective integration on the 2-core CPU
+# rig: compile+execute of the shard_map program dominates tier-1
+# wall time; the sim-twin parity tests in this module stay tier-1
 def test_dist_gat_trainer_real_mesh_matches_sim(rng):
     """The FULL GAT dist trainer on a real 4-device mesh (shard_map edge-op
     chain: dep_nbr -> scatter -> edge softmax -> aggregate under real
@@ -303,6 +306,9 @@ def test_dist_gat_trainer_real_mesh_matches_sim(rng):
 
 
 @multidevice
+@pytest.mark.slow  # real-collective integration on the 2-core CPU
+# rig: compile+execute of the shard_map program dominates tier-1
+# wall time; the sim-twin parity tests in this module stay tier-1
 def test_dep_nbr_real_collective_matches_sim(rng):
     P = 4
     g, _, mg = _mirror_rig(rng, P=P)
@@ -318,6 +324,9 @@ def test_dep_nbr_real_collective_matches_sim(rng):
 
 
 @multidevice
+@pytest.mark.slow  # real-collective integration on the 2-core CPU
+# rig: compile+execute of the shard_map program dominates tier-1
+# wall time; the sim-twin parity tests in this module stay tier-1
 def test_fused_mirror_aggregation_real_matches_dense(rng):
     P = 4
     g, dense, mg = _mirror_rig(rng, P=P)
@@ -334,6 +343,9 @@ def test_fused_mirror_aggregation_real_matches_dense(rng):
 
 
 @multidevice
+@pytest.mark.slow  # real-collective integration on the 2-core CPU
+# rig: compile+execute of the shard_map program dominates tier-1
+# wall time; the sim-twin parity tests in this module stay tier-1
 def test_dist_ggcn_trainer_real_mesh_matches_single_chip(rng):
     """GGCNDIST (gated multi-channel edge chain over mirror slots) on a real
     4-device mesh: must converge and track the single-chip GGCN trainer."""
@@ -373,6 +385,9 @@ def test_dist_ggcn_trainer_real_mesh_matches_single_chip(rng):
 
 
 @multidevice
+@pytest.mark.slow  # real-collective integration on the 2-core CPU
+# rig: compile+execute of the shard_map program dominates tier-1
+# wall time; the sim-twin parity tests in this module stay tier-1
 def test_dist_ggcn_chunked_chain_invariant_to_chunking(rng, monkeypatch):
     """Round 5: the GGCN edge chain runs chunk-at-a-time (dst-aligned cuts
     + per-chunk remat — the full-Reddit HBM fit, 76.9 -> ~2 GiB). Chunking
@@ -507,6 +522,9 @@ def test_bsp_call_width_matches_runtime_semantics():
 
 
 @multidevice
+@pytest.mark.slow  # real-collective integration on the 2-core CPU
+# rig: compile+execute of the shard_map program dominates tier-1
+# wall time; the sim-twin parity tests in this module stay tier-1
 def test_dist_gat_bf16_tracks_f32(rng):
     """PRECISION:bfloat16 on the dist edge-chain models (round 5): bf16
     matmuls + exchange + chain with f32 params and wide accumulation must

@@ -64,6 +64,9 @@ def test_dist_ell_matches_ring_schedule(rng):
 
 
 @multidevice
+@pytest.mark.slow  # real-collective integration on the 2-core CPU
+# rig: compile+execute of the shard_map program dominates tier-1
+# wall time; the sim-twin parity tests in this module stay tier-1
 def test_dist_ell_real_collective_matches_sim(rng):
     from neutronstarlite_tpu.parallel.dist_ell import dist_ell_gather_dst_from_src
     from neutronstarlite_tpu.parallel.dist_ops import vertex_sharded
@@ -97,6 +100,9 @@ def test_dist_ell_real_collective_matches_sim(rng):
 
 
 @multidevice
+@pytest.mark.slow  # compile-heavy regime (interpret-mode / forced
+# chunking) on the CPU rig; each layer family's primary real-collective
+# parity test stays tier-1
 def test_dist_ell_k_chunked_hub_under_shard_map(rng, monkeypatch):
     """The K-chunked hub reduction (ops/ell.k_chunked_sum) running INSIDE
     the shard_map local aggregation: its zeros-free peeled scan carry must
@@ -170,6 +176,9 @@ def test_padding_waste_bounded_on_power_law(rng):
 
 
 @multidevice
+@pytest.mark.slow  # compile-heavy regime (interpret-mode / forced
+# chunking) on the CPU rig; each layer family's primary real-collective
+# parity test stays tier-1
 def test_dist_ell_pallas_kernel_matches_xla(rng):
     """PALLAS under shard_map (round-3): the per-shard fused-kernel
     executor over the merged stacked tables must match the XLA executor's
@@ -206,6 +215,9 @@ def test_dist_ell_pallas_kernel_matches_xla(rng):
 
 
 @multidevice
+@pytest.mark.slow  # compile-heavy regime (interpret-mode / forced
+# chunking) on the CPU rig; each layer family's primary real-collective
+# parity test stays tier-1
 def test_dist_ell_pallas_trainer_matches_xla_trainer(rng, monkeypatch):
     """End-to-end DistGCN with the INTERPRET-only resident per-shard
     executor (NTS_PALLAS_RESIDENT=1 + PALLAS:1 -> DistEll kernel='pallas'):
