@@ -27,7 +27,7 @@ from neutronstarlite_tpu.graph.storage import CSCGraph, build_graph, load_edges
 from neutronstarlite_tpu.ops.device_graph import DeviceGraph
 from neutronstarlite_tpu.utils.config import InputInfo
 from neutronstarlite_tpu.utils.logging import get_logger
-from neutronstarlite_tpu.utils.timing import PhaseTimers
+from neutronstarlite_tpu.utils.timing import PhaseTimers, get_time
 
 log = get_logger("models")
 
@@ -97,6 +97,18 @@ class ToolkitBase:
         self.metrics = obs.open_run(
             cfg.algorithm or type(self).__name__, cfg=cfg, seed=seed
         )
+        # span tracing (obs/trace): one trace per run. The root "run" span
+        # opens here and closes in finalize_metrics; PhaseTimers buckets
+        # and per-epoch spans parent under it, so the whole lifecycle
+        # funnel (init_graph -> init_nn -> epochs -> finalize) reads as
+        # one causal tree in tools/trace_timeline.
+        self.tracer = obs.Tracer(self.metrics)
+        self.timers.tracer = self.tracer
+        self._run_span = self.tracer.begin(
+            "run", cat="lifecycle",
+            algorithm=cfg.algorithm or type(self).__name__,
+        )
+        self._last_epoch_span = None
         self.run_summary_record: Optional[dict] = None
         # fault/recovery records from any layer (fault injection, guard
         # trips, checkpoint quarantine) land in this trainer's stream
@@ -528,22 +540,53 @@ class ToolkitBase:
         return acc
 
     # ---- run metrics -----------------------------------------------------
-    def emit_epoch(self, epoch: int, seconds: float, loss=None, **extra):
+    def emit_epoch(self, epoch: int, seconds: float, loss=None,
+                   stages: Optional[dict] = None, **extra):
         """Record one trained epoch in the metrics stream (run loops call
         this right after appending to epoch_times/loss_history), then run
         the per-epoch health guards (resilience/guards) — every run loop
         funnels through here, so a guard trip always happens AFTER the
         faulty epoch is visible in the stream and BEFORE ckpt_epoch_end
         could persist a poisoned checkpoint. Guards only raise when armed
-        (supervised_run / NTS_GUARDS=1)."""
+        (supervised_run / NTS_GUARDS=1).
+
+        ``stages``: ordered {name: seconds} sub-intervals of this epoch
+        (e.g. ``step_dispatch``/``step_device``, or the NTS_TRACE_STEP
+        split's ``forward_backward``/``optim``) — emitted as child spans
+        laid back-to-back from the epoch's start, and attached to the
+        epoch event for flat consumers."""
         if getattr(self, "_first_epoch_trained", None) is None:
             # anchor for mapping epoch numbers onto epoch_times indices
             # (a crash-resumed trainer's first trained epoch is not 0)
             self._first_epoch_trained = epoch
+        if stages:
+            extra = dict(extra, stages={
+                k: float(v) for k, v in stages.items()
+            })
         rec = self.metrics.epoch_event(
             epoch, seconds,
             loss=float(loss) if loss is not None else None, **extra,
         )
+        # the epoch (and its stages) as spans on the causal timeline —
+        # retroactive: the epoch just ended, so end ~= now and the stream's
+        # mono->wall recovery (trace.py docstring) holds
+        end = get_time()
+        span = self.tracer.complete(
+            "epoch", dur_s=seconds, end=end, cat="epoch",
+            parent=self._run_span, epoch=int(epoch),
+        )
+        # NTS_TRACE=0 still returns a handle (ids allocate, nothing is
+        # emitted) — a disabled tracer must not leak phantom span ids
+        # into ring_step records' epoch_span join field
+        self._last_epoch_span = span if self.tracer.enabled else None
+        if stages:
+            t = end - seconds
+            for name, dur in stages.items():
+                self.tracer.complete(
+                    name, dur_s=float(dur), t0=t, cat="stage",
+                    parent=span, epoch=int(epoch),
+                )
+                t += float(dur)
         res_guards.epoch_check(self, epoch, seconds, loss)
         return rec
 
@@ -566,6 +609,13 @@ class ToolkitBase:
         """
         if self.run_summary_record is not None:
             return self.run_summary_record
+        # close the root lifecycle span BEFORE the summary so the span is
+        # part of the stream the summary consolidates
+        if self._run_span is not None:
+            self.tracer.end(
+                self._run_span, epochs=len(self.epoch_times),
+            )
+            self._run_span = None
         from neutronstarlite_tpu.obs import collectors
 
         fields: dict = {

@@ -186,6 +186,17 @@ class FullBatchTrainer(ToolkitBase):
         self._fwd_only = fwd_only
         self._fwd_bwd = fwd_bwd
 
+        # NTS_TRACE_STEP=1 runs the epoch as two device programs
+        # (forward+backward, then optimizer) so the span timeline gets
+        # real per-epoch forward_backward/optim attribution instead of
+        # one opaque fused step; jit tracing is lazy, so defining the
+        # update program costs nothing unless that mode is on
+        @jax.jit
+        def optim_step(params, grads, opt_state):
+            return adam_update(params, grads, opt_state, adam_cfg)
+
+        self._optim_step = optim_step
+
     def debug_info(self, key, n: int = 3) -> str:
         """Per-phase epoch breakdown, DEBUGINFO's role (GCN.hpp:308-353).
 
@@ -247,25 +258,56 @@ class FullBatchTrainer(ToolkitBase):
 
         trace_from = start_epoch + 1
         trace_cm = None
+        # NTS_TRACE_STEP=1: two-program epochs (forward+backward, optim)
+        # for real per-epoch stage spans; adds one host sync per epoch, so
+        # it is opt-in. The fused path still attributes dispatch vs device
+        # wait (the host-observable split of an async XLA step).
+        split_step = os.environ.get("NTS_TRACE_STEP", "0") == "1"
         for epoch in range(start_epoch, cfg.epochs):
             if epoch == trace_from and epoch < cfg.epochs:
                 trace_cm = maybe_trace(type(self).__name__)
                 trace_cm.__enter__()
             ekey = jax.random.fold_in(key, epoch)
             t0 = get_time()
-            self.params, self.opt_state, loss, logits = self._train_step(
-                self.params, self.opt_state, self.compute_graph, self.feature,
-                self.label, self._train_mask01, ekey,
-            )
-            jax.block_until_ready(loss)
+            if split_step:
+                loss, grads = self._fwd_bwd(
+                    self.params, self.compute_graph, self.feature,
+                    self.label, self._train_mask01, ekey,
+                )
+                jax.block_until_ready(loss)
+                t_fb = get_time()
+                self.params, self.opt_state = self._optim_step(
+                    self.params, grads, self.opt_state
+                )
+                jax.block_until_ready(self.params)
+                logits = None  # cadence accuracies are skipped this mode
+                stages = {
+                    "forward_backward": t_fb - t0,
+                    "optim": get_time() - t_fb,
+                }
+            else:
+                self.params, self.opt_state, loss, logits = self._train_step(
+                    self.params, self.opt_state, self.compute_graph,
+                    self.feature, self.label, self._train_mask01, ekey,
+                )
+                t_disp = get_time()
+                jax.block_until_ready(loss)
+                stages = {
+                    "step_dispatch": t_disp - t0,
+                    "step_device": get_time() - t_disp,
+                }
             # chaos hook (NTS_FAULT_SPEC): nan_loss/stall/crash fire here,
             # before the loss reaches history, guards, or a checkpoint
             loss = fault_point("epoch_loss", epoch=epoch, value=loss)
             dt = get_time() - t0
             self.epoch_times.append(dt)
             self.loss_history.append(float(loss))
-            self.emit_epoch(epoch, dt, loss)
-            if epoch % max(1, cfg.epochs // 20) == 0 or epoch == cfg.epochs - 1:
+            self.emit_epoch(epoch, dt, loss, stages=stages)
+            cadence = (
+                epoch % max(1, cfg.epochs // 20) == 0
+                or epoch == cfg.epochs - 1
+            )
+            if cadence and logits is not None:
                 # per-epoch Train/Eval/Test accuracy from the training
                 # forward's logits, the reference's oracle cadence
                 # (Test(0/1/2) each epoch on X[last], GCN_CPU.hpp:241-248).
@@ -277,6 +319,9 @@ class FullBatchTrainer(ToolkitBase):
                 self.test(h, 0)
                 self.test(h, 1)
                 self.test(h, 2)
+            if cadence:
+                # the loss line must not depend on logits: NTS_TRACE_STEP=1
+                # skips cadence accuracies but still has loss every epoch
                 log.info("Epoch %d loss %f", epoch, float(loss))
             self.ckpt_epoch_end(epoch)
         if trace_cm is not None:

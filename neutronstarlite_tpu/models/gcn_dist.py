@@ -604,6 +604,50 @@ class DistGCNTrainer(ToolkitBase):
             jax.random.PRNGKey(self.seed + 1),
         )
 
+    def _run_overlap_probe(self) -> None:
+        """NTS_OVERLAP_PROBE=1 on a ring path: measure how much of the hop
+        time the double-buffered schedule hides under the blocked compute
+        (parallel/dist_ring_blocked.measure_overlap over the first-layer
+        exchange), then pin the verdict as gauges + one probe span so
+        tools/trace_timeline and metrics_report report a MEASURED overlap
+        efficiency instead of an asserted one. Costs three small compiles;
+        off by default."""
+        from neutronstarlite_tpu.parallel.dist_ring_blocked import (
+            measure_overlap,
+        )
+
+        h = self.tracer.begin("ring_overlap_probe", cat="probe")
+        try:
+            probe = measure_overlap(
+                self.blocks.fwd, self.feature_p, mesh=self.mesh,
+                wire_dtype=self.wire_dtype,
+            )
+        except BaseException as e:
+            # run() swallows probe failures; the span must still emit (and
+            # pop off the stack) or later spans parent under a ghost
+            self.tracer.end(h, error=type(e).__name__)
+            raise
+        self.tracer.end(h, **probe)
+        if probe["efficiency"] is not None:
+            self.metrics.gauge_set(
+                "ring.overlap_efficiency", probe["efficiency"]
+            )
+        self.metrics.gauge_set("ring.probe_overlap_s", probe["overlap_s"])
+        self.metrics.gauge_set("ring.probe_compute_s", probe["compute_s"])
+        self.metrics.gauge_set("ring.probe_exchange_s", probe["exchange_s"])
+        self.metrics.gauge_set(
+            "ring.probe_simulated", bool(probe["simulated"])
+        )
+        log.info(
+            "ring overlap probe%s: overlapped %.3f ms, compute-only %.3f "
+            "ms, exchange-only %.3f ms -> efficiency %s",
+            " (sim)" if probe["simulated"] else "",
+            probe["overlap_s"] * 1e3, probe["compute_s"] * 1e3,
+            probe["exchange_s"] * 1e3,
+            f"{probe['efficiency']:.2f}" if probe["efficiency"] is not None
+            else "n/a",
+        )
+
     def run(self) -> Dict[str, Any]:
         cfg = self.cfg
         key = jax.random.PRNGKey(self.seed + 1)
@@ -614,6 +658,16 @@ class DistGCNTrainer(ToolkitBase):
         )
         start_epoch = self.ckpt_begin()
         loss = None
+        if self._ring_plan is not None and os.environ.get(
+            "NTS_OVERLAP_PROBE", "0"
+        ) == "1":
+            try:
+                self._run_overlap_probe()
+            except Exception as e:
+                # telemetry must never kill a run: the probe's three extra
+                # compiles can fail (OOM, XLA) where training would not
+                log.warning("overlap probe failed (%s); continuing "
+                            "without ring.probe_* gauges", e)
         # steady-state trace window (see FullBatchTrainer.run)
         from neutronstarlite_tpu.utils.profiling import maybe_trace
 
@@ -635,7 +689,9 @@ class DistGCNTrainer(ToolkitBase):
                 self.valid_p,
                 ekey,
             )
+            t_disp = get_time()
             jax.block_until_ready(loss)
+            t_wait = get_time()
             # chaos hook (NTS_FAULT_SPEC): nan_loss/stall/crash fire here,
             # before the loss reaches history, guards, or a checkpoint
             loss = fault_point("epoch_loss", epoch=epoch, value=loss)
@@ -645,18 +701,27 @@ class DistGCNTrainer(ToolkitBase):
             self.record_epoch_wire(
                 epoch, dt, loss, self._wire_bytes_fwd_per_epoch,
                 self._wire_exchanges_per_epoch,
+                stages={
+                    "step_dispatch": t_disp - t0,
+                    "step_device": t_wait - t_disp,
+                },
             )
             if self._ring_plan is not None:
                 # typed per-rotation-hop records: bytes shipped per device
                 # this epoch (all layer exchanges, forward direction) and
                 # the static skip verdict. Per-hop wall time is not
                 # separable inside one XLA program — ``seconds`` is null
-                # here; parallel/comm_bench.py measures it standalone.
+                # here; parallel/comm_bench.py measures it standalone and
+                # the NTS_OVERLAP_PROBE run attributes hidden-vs-exposed
+                # hop time. ``epoch_span`` joins each hop to its epoch's
+                # span on the causal timeline.
+                espan = self._last_epoch_span
                 for hop in self._ring_plan["steps"]:
                     self.metrics.event(
                         "ring_step", epoch=epoch, step=hop["step"],
                         bytes=int(hop["bytes"]), skipped=hop["skipped"],
                         seconds=None,
+                        epoch_span=espan.span_id if espan else None,
                     )
             self.ckpt_epoch_end(epoch)
             if epoch % max(1, cfg.epochs // 20) == 0 or epoch == cfg.epochs - 1:

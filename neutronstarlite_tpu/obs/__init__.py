@@ -12,7 +12,10 @@ volume) and ad-hoc bench prints:
 - :mod:`collectors` — device memory, compile-vs-steady-state step
   attribution, phase-timer snapshots;
 - :mod:`schema` — the JSONL event schema and its validator (tests and
-  tools/metrics_report consume it).
+  tools/metrics_report consume it);
+- :mod:`trace` — hierarchical span tracing (trace_id / span_id /
+  parent_id) over the same JSONL stream; tools/trace_timeline merges the
+  per-rank span files into one causal timeline and a Chrome trace.
 
 Every trainer run emits one ``run_summary`` record; ``tools/metrics_report``
 renders one or more streams into the reference-shaped ``#key=value(ms)``
@@ -26,10 +29,12 @@ from neutronstarlite_tpu.obs.registry import (
     open_run,
 )
 from neutronstarlite_tpu.obs.schema import SCHEMA_VERSION, validate_event
+from neutronstarlite_tpu.obs.trace import Tracer
 
 __all__ = [
     "MetricsRegistry",
     "SCHEMA_VERSION",
+    "Tracer",
     "config_fingerprint",
     "metrics_dir",
     "open_run",

@@ -30,6 +30,22 @@ def metrics_dir() -> Optional[str]:
     return os.environ.get("NTS_METRICS_DIR") or None
 
 
+def max_stream_bytes() -> int:
+    """The per-stream size cap (``NTS_METRICS_MAX_MB``, fractional MB
+    allowed) in bytes; 0 = unbounded. A long supervised run with per-hop
+    ring records and per-request serve records can otherwise grow its
+    JSONL file without limit."""
+    raw = os.environ.get("NTS_METRICS_MAX_MB", "")
+    if not raw:
+        return 0
+    try:
+        mb = float(raw)
+    except ValueError:
+        log.warning("NTS_METRICS_MAX_MB=%r is not a number; ignoring", raw)
+        return 0
+    return int(mb * 2**20) if mb > 0 else 0
+
+
 def config_fingerprint(cfg: Any) -> str:
     """Stable 12-hex-digit digest of a run configuration (InputInfo, dict,
     or any attribute bag) — the cross-run join key in metrics_report."""
@@ -98,6 +114,11 @@ class MetricsRegistry:
         # buffered and flushed with the first real write.
         self._fh = None
         self._pending: list = []
+        # NTS_METRICS_MAX_MB stream size guard (rotate-once-with-warning,
+        # see _maybe_rotate); resolved at construction so tests can vary it
+        self._max_bytes = max_stream_bytes()
+        self._bytes_written = 0
+        self.rotations = 0
         self.summary: Optional[Dict[str, Any]] = None
 
     # ---- metric primitives ----------------------------------------------
@@ -158,10 +179,13 @@ class MetricsRegistry:
                             self._fh = open(self.path, "a", encoding="utf-8")
                             for p in self._pending:
                                 self._fh.write(p)
+                                self._bytes_written += len(p)
                             self._pending.clear()
                             log.info("metrics stream: %s", self.path)
                         self._fh.write(line)
                         self._fh.flush()
+                        self._bytes_written += len(line)
+                        self._maybe_rotate_locked()
                     except OSError as e:  # telemetry must never kill a run
                         log.warning(
                             "metrics write failed (%s); disabling sink", e
@@ -169,6 +193,51 @@ class MetricsRegistry:
                         self._fh = None
                         self.path = None
         return rec
+
+    def _maybe_rotate_locked(self) -> None:
+        """NTS_METRICS_MAX_MB guard — called with ``self._lock`` held right
+        after a write. When the stream crosses the cap, the current file is
+        rotated aside to ``<path>.1`` (one previous chunk retained; an older
+        ``.1`` is overwritten — bounded disk, not unbounded history) and a
+        LOUD ``stream_rotated`` record opens the fresh file, so a consumer
+        that sees a truncated history knows it was truncated and why."""
+        if not self._max_bytes or self._bytes_written < self._max_bytes:
+            return
+        rotated_to = self.path + ".1"
+        try:
+            self._fh.close()
+            os.replace(self.path, rotated_to)
+            self._fh = open(self.path, "a", encoding="utf-8")
+        except OSError as e:
+            log.warning("metrics rotation failed (%s); disabling sink", e)
+            self._fh = None
+            self.path = None
+            return
+        seq = self._seq
+        self._seq += 1
+        marker = {
+            "event": "stream_rotated",
+            "run_id": self.run_id,
+            "schema": SCHEMA_VERSION,
+            "ts": time.time(),
+            "seq": seq,
+            "reason": (
+                f"NTS_METRICS_MAX_MB: stream exceeded "
+                f"{self._max_bytes / 2**20:g} MB"
+            ),
+            "rotated_to": rotated_to,
+            "bytes_written": self._bytes_written,
+        }
+        line = json.dumps(marker, default=str) + "\n"
+        self._fh.write(line)
+        self._fh.flush()
+        self.rotations += 1
+        self._bytes_written = len(line)
+        log.warning(
+            "metrics stream %s exceeded NTS_METRICS_MAX_MB; rotated the "
+            "first %d bytes to %s (older rotations are overwritten)",
+            self.path, marker["bytes_written"], rotated_to,
+        )
 
     def epoch_event(
         self, epoch: int, seconds: float, loss: Optional[float] = None,

@@ -9,7 +9,7 @@ consumers rely on and allows extra keys (forward compatibility).
 Envelope (all events):
   event: str       one of run_start | epoch | ring_step | run_summary |
                    fault | recovery | serve_request | batch_flush | shed |
-                   serve_summary (open set)
+                   serve_summary | span | stream_rotated (open set)
   run_id: str      "<algo>-<fingerprint>-<pid>"
   schema: int      SCHEMA_VERSION
   ts: float        wall-clock seconds (time.time())
@@ -58,6 +58,21 @@ serve_summary (serve/): consolidated end-of-serving record (the serving
   counters: object (the registry snapshot: serve.* counters incl.
   per-bucket compile counts)
 
+span (obs/trace.py): one completed interval on the causal timeline
+  name: str (non-empty), cat: str (phase | lifecycle | epoch | stage |
+  serve | ring | resilience | probe, open set),
+  span_id: str (non-empty, unique within the stream),
+  trace_id: str (non-empty; defaults to the run_id),
+  parent_id: str | null (the enclosing span),
+  t0: number (time.perf_counter seconds at begin — monotonic,
+  process-local; tools/trace_timeline maps it to wall clock via the
+  envelope ts and aligns ranks on epoch spans),
+  dur_s: number >= 0,
+  rank: int | absent, thread: str | absent, plus open attribute fields
+
+stream_rotated (obs/registry.py): the NTS_METRICS_MAX_MB size guard fired
+  reason: str, rotated_to: str | null, bytes_written: int
+
 run_summary:
   algorithm: str, fingerprint: str,
   counters/gauges/timings: objects (the registry snapshot),
@@ -74,6 +89,25 @@ from __future__ import annotations
 from typing import Any, Dict
 
 SCHEMA_VERSION = 1
+
+# every typed record kind this schema pins fields for. The round-trip test
+# (tests/test_schema_roundtrip.py) constructs + validates + report-renders
+# one instance of each, so adding a kind here without renderer/test support
+# fails tier-1 — the "no silently unrenderable records" contract.
+KNOWN_KINDS = (
+    "run_start",
+    "epoch",
+    "ring_step",
+    "fault",
+    "recovery",
+    "serve_request",
+    "batch_flush",
+    "shed",
+    "serve_summary",
+    "span",
+    "stream_rotated",
+    "run_summary",
+)
 
 _ENVELOPE = ("event", "run_id", "schema", "ts", "seq")
 
@@ -195,6 +229,26 @@ def validate_event(obj: Any) -> None:
             _fail("shed.reason must be a non-empty string")
         if "queue_depth" in obj and not isinstance(obj["queue_depth"], int):
             _fail("shed.queue_depth must be an int when present")
+    elif kind == "span":
+        for key in ("name", "cat", "span_id", "trace_id"):
+            if not isinstance(obj.get(key), str) or not obj[key]:
+                _fail(f"span.{key} must be a non-empty string, got "
+                      f"{obj.get(key)!r}")
+        pid_ = obj.get("parent_id")
+        if pid_ is not None and (not isinstance(pid_, str) or not pid_):
+            _fail(f"span.parent_id must be a non-empty string or null, "
+                  f"got {pid_!r}")
+        _require_number(obj, "t0")
+        _require_number(obj, "dur_s")
+        if obj["dur_s"] < 0:
+            _fail(f"span.dur_s must be >= 0, got {obj['dur_s']!r}")
+        if "rank" in obj and not isinstance(obj["rank"], int):
+            _fail("span.rank must be an int when present")
+    elif kind == "stream_rotated":
+        if not isinstance(obj.get("reason"), str) or not obj["reason"]:
+            _fail("stream_rotated.reason must be a non-empty string")
+        if not isinstance(obj.get("bytes_written"), int):
+            _fail("stream_rotated.bytes_written must be an int")
     elif kind == "serve_summary":
         for key in ("requests", "shed"):
             if not isinstance(obj.get(key), int) or obj[key] < 0:

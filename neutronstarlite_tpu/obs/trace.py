@@ -1,0 +1,241 @@
+"""Hierarchical span tracing over the MetricsRegistry JSONL stream.
+
+PR 1's flat counters/records can say *how much* (bytes shipped, epochs
+timed) but not *when relative to what*: did the ring's hop wait hide under
+the blocked-kernel compute, where inside a serve request's p99 did the
+time go, what did a resilience retry cost end-to-end. This module adds the
+missing causal dimension: every interesting interval becomes one typed
+``span`` record (``trace_id`` / ``span_id`` / ``parent_id``, monotonic
+begin + duration) written through the SAME per-rank JSONL sink the rest of
+obs/ uses — no second telemetry pipe, no new file format, and the existing
+``NTS_METRICS_MAX_MB`` / multi-host rank-file conventions apply unchanged.
+
+Clock model (documented in docs/OBSERVABILITY.md):
+
+- ``t0`` is ``time.perf_counter()`` seconds — monotonic, process-local,
+  immune to NTP steps mid-run;
+- the envelope ``ts`` (wall clock) is stamped when the record is WRITTEN,
+  which for spans is immediately after the span ends — so per process the
+  mono->wall offset is recoverable as ``median(ts - (t0 + dur_s))`` over
+  its spans (tools/trace_timeline does exactly this);
+- cross-rank skew is corrected AFTER that mapping by matching per-epoch
+  spans (every rank ends epoch e at the same collective barrier), again
+  in tools/trace_timeline — the tracer itself never talks to other ranks.
+
+When ``NTS_PROFILE_DIR`` is set, LIVE spans (context-manager or
+``begin()``/``end()``) additionally open a ``jax.profiler.TraceAnnotation``
+so the same names appear inside the device trace — host causality and
+device ops land in one Perfetto view. Spans emitted retroactively via
+``complete()`` (epoch/stage/request/queue) already happened and cannot
+annotate; device-side epoch attribution comes from the profiler's own
+kernel events.
+
+Usage::
+
+    tracer = Tracer(registry)
+    with tracer.span("graph_load", cat="phase"):
+        ...                        # parent = innermost open span (thread-local)
+    h = tracer.begin("run", cat="lifecycle")   # long-lived root
+    ...
+    tracer.end(h, outcome="ok")
+    tracer.complete("epoch", dur_s=dt, epoch=3)  # retroactive: ended just now
+
+Tracing is on whenever the registry exists (spans are ordinary events; a
+sink-less registry keeps them in memory only); ``NTS_TRACE=0`` disables
+emission entirely for overhead-sensitive sweeps.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+from typing import Any, Optional
+
+from neutronstarlite_tpu.utils.logging import get_logger, process_index
+
+log = get_logger("obs")
+
+
+def _now() -> float:
+    return time.perf_counter()
+
+
+# One process-wide id source: several tracers can share one registry (the
+# trainer funnel's tracer + the serve server's on a train-then-serve run
+# write the SAME per-rank stream), and schema.py documents span_id as
+# unique within the stream — per-tracer counters would collide at "s0".
+_SPAN_IDS = itertools.count()
+
+
+class SpanHandle:
+    """One open (or retroactively completed) span."""
+
+    __slots__ = ("name", "cat", "span_id", "parent_id", "t0", "attrs",
+                 "_ann", "_ann_tid")
+
+    def __init__(self, name: str, cat: str, span_id: str,
+                 parent_id: Optional[str], t0: float, attrs: dict):
+        self.name = name
+        self.cat = cat
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.t0 = t0
+        self.attrs = attrs
+        self._ann = None  # the open jax.profiler annotation, if any
+        self._ann_tid = None  # thread that opened it (scopes are TLS)
+
+
+class Tracer:
+    """Span emitter bound to one MetricsRegistry (one trace per run).
+
+    Thread-safe: each thread keeps its own open-span stack, so the serve
+    batcher's flusher thread and shedding client threads nest their spans
+    independently. Parenting across threads is explicit (``parent=``)."""
+
+    def __init__(self, registry, trace_id: Optional[str] = None):
+        self.registry = registry
+        self.trace_id = trace_id or (
+            registry.run_id if registry is not None else "trace"
+        )
+        self._tls = threading.local()
+        self._rank = process_index()
+        self.enabled = (
+            registry is not None
+            and os.environ.get("NTS_TRACE", "1") != "0"
+        )
+
+    # ---- internals -------------------------------------------------------
+    def _stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def _next_id(self) -> str:
+        return f"s{next(_SPAN_IDS):x}"
+
+    def _resolve_parent(self, parent) -> Optional[str]:
+        if parent is not None:
+            return parent.span_id if isinstance(parent, SpanHandle) else str(parent)
+        st = self._stack()
+        return st[-1].span_id if st else None
+
+    def _emit(self, h: SpanHandle, dur_s: float, extra: dict) -> None:
+        if not self.enabled:
+            return
+        attrs = dict(h.attrs)
+        attrs.update(extra)
+        try:
+            self.registry.event(
+                "span",
+                name=h.name,
+                cat=h.cat,
+                span_id=h.span_id,
+                trace_id=self.trace_id,
+                parent_id=h.parent_id,
+                t0=float(h.t0),
+                dur_s=max(float(dur_s), 0.0),
+                rank=self._rank,
+                thread=threading.current_thread().name,
+                **attrs,
+            )
+        except Exception as e:  # telemetry must never kill the run
+            log.warning("span emit failed (%s); continuing", e)
+
+    # ---- explicit begin/end (long-lived roots) ---------------------------
+    def begin(self, name: str, cat: str = "host", parent=None,
+              **attrs: Any) -> SpanHandle:
+        """Open a span and push it on this thread's stack (it becomes the
+        default parent for spans opened on the same thread until ended)."""
+        h = SpanHandle(
+            name, cat, self._next_id(), self._resolve_parent(parent),
+            _now(), attrs,
+        )
+        if self.enabled:
+            self._stack().append(h)
+            if os.environ.get("NTS_PROFILE_DIR"):
+                # live spans also open a jax.profiler TraceAnnotation so
+                # the same name lands inside the device trace (spans
+                # emitted retroactively via complete() cannot — they
+                # already happened)
+                try:
+                    from neutronstarlite_tpu.utils.profiling import annotate
+
+                    h._ann = annotate(name)
+                    h._ann.__enter__()
+                    h._ann_tid = threading.get_ident()
+                except Exception:
+                    h._ann = None
+        return h
+
+    def end(self, h: SpanHandle, **attrs: Any) -> None:
+        """Close ``h`` (idempotence is the caller's job) and emit it. Pops
+        the handle from this thread's stack if it is there — ends from a
+        different thread than the begin simply skip the pop."""
+        if h._ann is not None:
+            # TraceAnnotation scopes are thread-local: only the opening
+            # thread may close one (cross-thread ends just drop it)
+            if h._ann_tid == threading.get_ident():
+                try:
+                    h._ann.__exit__(None, None, None)
+                except Exception:
+                    pass
+            h._ann = None
+        st = self._stack()
+        if h in st:
+            # close any dangling children too (crash paths)
+            while st and st[-1] is not h:
+                st.pop()
+            if st:
+                st.pop()
+        self._emit(h, _now() - h.t0, attrs)
+
+    # ---- context-manager form -------------------------------------------
+    def span(self, name: str, cat: str = "host", parent=None, **attrs: Any):
+        """``with tracer.span("sample", cat="serve") as h:`` — nests via the
+        thread-local stack, annotates the device trace when profiling."""
+        return _SpanCtx(self, name, cat, parent, attrs)
+
+    # ---- retroactive completion -----------------------------------------
+    def complete(self, name: str, dur_s: float, end: Optional[float] = None,
+                 t0: Optional[float] = None, cat: str = "host", parent=None,
+                 **attrs: Any) -> SpanHandle:
+        """Emit a span that ALREADY happened: callers that timed an interval
+        themselves (the epoch loop's ``get_time()`` bracketing) hand over
+        the duration; ``end`` defaults to now, ``t0`` to ``end - dur_s``."""
+        if t0 is None:
+            t0 = (end if end is not None else _now()) - max(dur_s, 0.0)
+        h = SpanHandle(
+            name, cat, self._next_id(), self._resolve_parent(parent),
+            float(t0), attrs,
+        )
+        self._emit(h, dur_s, {})
+        return h
+
+
+class _SpanCtx:
+    __slots__ = ("tracer", "name", "cat", "parent", "attrs", "handle")
+
+    def __init__(self, tracer: Tracer, name: str, cat: str, parent, attrs):
+        self.tracer = tracer
+        self.name = name
+        self.cat = cat
+        self.parent = parent
+        self.attrs = attrs
+        self.handle: Optional[SpanHandle] = None
+
+    def __enter__(self) -> SpanHandle:
+        self.handle = self.tracer.begin(
+            self.name, cat=self.cat, parent=self.parent, **self.attrs
+        )
+        return self.handle
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if self.handle is None:
+            return
+        self.tracer.end(
+            self.handle,
+            **({"error": type(exc).__name__} if exc_type is not None else {}),
+        )

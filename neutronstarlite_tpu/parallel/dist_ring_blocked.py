@@ -280,9 +280,16 @@ def _flatten_tables(rbe: RingBlockedEll):
 
 def _ring_blocked_apply(
     mesh: Mesh, rbe: RingBlockedEll, x: jax.Array,
-    wire_dtype: Optional[jnp.dtype] = None,
+    wire_dtype: Optional[jnp.dtype] = None, mode: str = "full",
 ) -> jax.Array:
-    """The double-buffered shard_map ring (one direction)."""
+    """The double-buffered shard_map ring (one direction).
+
+    ``mode`` isolates the two halves of the overlapped schedule for the
+    overlap-efficiency probe (``measure_overlap``): ``compute_only`` runs
+    every step's blocked tables against the resident shard (identical
+    table work, zero hops), ``exchange_only`` runs the bare ppermute hop
+    chain (returning the final in-flight buffer so XLA cannot drop the
+    dependent chain). ``full`` is the production overlapped body."""
     P = rbe.partitions
     perm = ring_perm(P, rbe.direction)
     n_hops = rbe.n_transfers()
@@ -307,7 +314,7 @@ def _ring_blocked_apply(
         acc = jnp.zeros((rbe.vp, xs.shape[1]), jnp.float32)
         cur = xs
         for s in range(P):
-            send = s < n_hops
+            send = s < n_hops and mode != "compute_only"
             # issue the hop FIRST: the async collective-permute can fly
             # over ICI while this step's blocked aggregation consumes the
             # same resident buffer (double buffering — cur stays live
@@ -318,11 +325,22 @@ def _ring_blocked_apply(
             if send:
                 sent = cur if wire_dtype is None else cur.astype(wire_dtype)
                 nxt = lax.ppermute(sent, PARTITION_AXIS, perm)
-            if s in per_step:
+            if mode != "exchange_only" and s in per_step:
                 view = rbe._device_step_view(*per_step[s])
-                acc = view.aggregate_into(acc, cur)
+                # s>0 table work always consumes a wire-dtype buffer: in
+                # full mode cur already rounded when first shipped, and
+                # compute_only must mirror that (no-op cast there being
+                # the resident shard) or the probe's compute_s is biased
+                # against a different input dtype than production
+                inp = (
+                    cur if wire_dtype is None or s == 0
+                    else cur.astype(wire_dtype)
+                )
+                acc = view.aggregate_into(acc, inp)
             if send:
                 cur = nxt
+        if mode == "exchange_only":
+            return cur.astype(xs.dtype)
         return acc.astype(xs.dtype)
 
     fn = shard_map(
@@ -358,33 +376,49 @@ def dist_ring_blocked_gather_dst_from_src(
 
 def ring_blocked_apply_simulated(
     rbe: RingBlockedEll, x: jax.Array,
-    wire_dtype: Optional[jnp.dtype] = None,
+    wire_dtype: Optional[jnp.dtype] = None, mode: str = "full",
 ) -> jax.Array:
     """Collective-free twin: the EXACT step order and f32 carry of the
     shard_map body, with ppermute replaced by explicit shard slicing —
     single-core CI parity (NTS_DIST_SIMULATE / DIST_PATH:ring_blocked_sim).
+    ``mode`` mirrors `_ring_blocked_apply` for the overlap probe (here
+    the "exchange" is a host-free slice, so probe numbers on the sim rig
+    quantify schedule overhead, not real ICI time).
     """
     P, vp = rbe.partitions, rbe.vp
     work = set(rbe.work_steps())
     outs = []
     for p in range(P):
         acc = jnp.zeros((vp, x.shape[1]), jnp.float32)
+        last = x[p * vp : (p + 1) * vp]
         for s in range(P):
             if s not in work:
                 continue
-            q = ring_source(p, s, P, rbe.direction)
+            q = (
+                p if mode == "compute_only"
+                else ring_source(p, s, P, rbe.direction)
+            )
             shard = x[q * vp : (q + 1) * vp]
             if wire_dtype is not None and s > 0:
                 # mirror the collective body exactly: only SHIPPED shards
-                # round to the wire dtype; step 0 is the device's own
+                # round to the wire dtype; step 0 is the device's own.
+                # compute_only keeps the cast too (its "shard" is the
+                # resident one, but the probe must measure s>0 table work
+                # at the same dtype production runs it)
                 shard = shard.astype(wire_dtype)
+            last = shard
+            if mode == "exchange_only":
+                continue
             view = rbe._device_step_view(
                 [n[p] for n in rbe.nbr[s]],
                 [w[p] for w in rbe.wgt[s]],
                 [d[p] for d in rbe.dst_row[s]],
             )
             acc = view.aggregate_into(acc, shard)
-        outs.append(acc.astype(x.dtype))
+        outs.append(
+            last.astype(x.dtype) if mode == "exchange_only"
+            else acc.astype(x.dtype)
+        )
     return jnp.concatenate(outs, axis=0)
 
 
@@ -408,6 +442,73 @@ def dist_ring_blocked_gather_simulated(
 
     apply.defvjp(apply_fwd, apply_bwd)
     return apply(x)
+
+
+def measure_overlap(
+    rbe: RingBlockedEll,
+    x: jax.Array,
+    mesh: Optional[Mesh] = None,
+    wire_dtype: Optional[jnp.dtype] = None,
+    repeats: int = 3,
+) -> dict:
+    """Measured ring overlap efficiency: how much of the hop (exchange)
+    time hides under the blocked-kernel compute.
+
+    Times three warm programs over the same input — the production
+    overlapped body, its compute-only half (identical table work, no
+    hops), and its exchange-only half (the bare dependent hop chain) —
+    and reports::
+
+        hidden     = max(compute + exchange - overlapped, 0)
+        efficiency = hidden / exchange          (clamped to [0, 1])
+
+    efficiency 1.0 means the ICI transfer is fully hidden (the paper's
+    decoupled-overlap ideal, graph.hpp:2644); 0.0 means the schedule
+    serializes. On the collective-free sim rig (``mesh=None``) the
+    "exchange" is shard slicing, so the number quantifies schedule
+    overhead rather than real wire time — still useful as a structural
+    regression canary, and the probe record says which rig produced it.
+
+    Three small extra compiles (one per mode) — callers gate it
+    (``NTS_OVERLAP_PROBE=1``) rather than paying it on every run.
+    """
+    import time as _time
+
+    def run_mode(mode: str) -> float:
+        if mesh is not None:
+            fn = jax.jit(
+                lambda a: _ring_blocked_apply(mesh, rbe, a, wire_dtype,
+                                              mode=mode)
+            )
+        else:
+            fn = jax.jit(
+                lambda a: ring_blocked_apply_simulated(rbe, a, wire_dtype,
+                                                       mode=mode)
+            )
+        jax.block_until_ready(fn(x))  # compile + warm
+        ts = []
+        for _ in range(max(repeats, 1)):
+            t0 = _time.perf_counter()
+            jax.block_until_ready(fn(x))
+            ts.append(_time.perf_counter() - t0)
+        return float(np.median(ts))
+
+    overlap_s = run_mode("full")
+    compute_s = run_mode("compute_only")
+    exchange_s = run_mode("exchange_only")
+    hidden_s = max(compute_s + exchange_s - overlap_s, 0.0)
+    efficiency = (
+        min(hidden_s / exchange_s, 1.0) if exchange_s > 0 else None
+    )
+    return {
+        "overlap_s": overlap_s,
+        "compute_s": compute_s,
+        "exchange_s": exchange_s,
+        "hidden_s": hidden_s,
+        "efficiency": efficiency,
+        "simulated": mesh is None,
+        "repeats": int(max(repeats, 1)),
+    }
 
 
 def ring_wire_plan(rbe: RingBlockedEll, widths, itemsize: int) -> dict:

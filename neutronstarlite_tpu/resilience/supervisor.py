@@ -96,6 +96,14 @@ def supervised_run(
     metrics = getattr(toolkit, "metrics", None)
     if metrics is not None:
         events.set_sink(metrics)
+    # retry/rollback episodes as spans (obs/trace): each attempt is one
+    # span; backoff sleeps and model rebuilds get their own, so a retry's
+    # end-to-end cost reads directly off the causal timeline
+    # (tools/trace_timeline's retry-cost block derives from these plus the
+    # fault/recovery records)
+    from neutronstarlite_tpu.obs.trace import Tracer
+
+    tracer = getattr(toolkit, "tracer", None) or Tracer(metrics)
 
     attempt = 0
     divergence_streak = 0
@@ -108,9 +116,14 @@ def supervised_run(
                     watchdog_s,
                     first_beat_grace_s=grace if grace > 0 else None,
                 ).start()
+            attempt_span = tracer.begin(
+                "attempt", cat="resilience", attempt=attempt + 1
+            )
             try:
                 try:
-                    return toolkit.run()
+                    result = toolkit.run()
+                    tracer.end(attempt_span, outcome="ok")
+                    return result
                 except KeyboardInterrupt:
                     # only a watchdog-initiated interrupt is a fault; a
                     # real Ctrl-C must keep killing the run
@@ -128,6 +141,7 @@ def supervised_run(
                         watchdog.stop()
                         watchdog = None
             except guards.HealthError as err:
+                tracer.end(attempt_span, outcome=err.code)
                 attempt += 1
                 if metrics is not None:
                     metrics.counter_add("resilience.faults")
@@ -156,7 +170,9 @@ def supervised_run(
                 if backoff_base_s > 0:
                     delay = backoff_base_s * (2.0 ** (attempt - 1))
                     log.info("backing off %.2fs before restart", delay)
-                    time.sleep(delay)
+                    with tracer.span("backoff", cat="resilience",
+                                     attempt=attempt, delay_s=delay):
+                        time.sleep(delay)
 
                 scale_lr = (
                     divergence_streak >= 2 and lr_backoff > 0
@@ -174,7 +190,9 @@ def supervised_run(
                     # fresh params + re-jitted step (the new LR lives in
                     # the closed-over AdamConfig); with a checkpoint, the
                     # retry's ckpt_begin restores over the rebuilt params
-                    toolkit.build_model()
+                    with tracer.span("rebuild", cat="resilience",
+                                     attempt=attempt):
+                        toolkit.build_model()
                 if not rollback:
                     # restart-from-scratch: the failed attempt's epoch
                     # telemetry must not pollute run_summary aggregates
@@ -199,3 +217,12 @@ def supervised_run(
                     **({"lr_scaled_to": toolkit.cfg.learn_rate}
                        if scale_lr else {}),
                 )
+            except BaseException as e:
+                # not a health fault: a real Ctrl-C, XLA runtime error,
+                # OOM. It propagates, but the failed attempt — the span
+                # the retry-cost timeline most needs — must still land
+                # (and pop off the thread stack, or an embedder that
+                # catches this and keeps the toolkit would parent later
+                # spans under a handle that never reaches the stream).
+                tracer.end(attempt_span, outcome=type(e).__name__)
+                raise

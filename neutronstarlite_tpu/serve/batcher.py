@@ -21,6 +21,7 @@ All knobs live on :class:`ServeOptions`; each has a cfg key (SERVE_*) and an
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import os
 import threading
 import time
@@ -145,14 +146,21 @@ class ServeOptions:
         return out
 
 
+# process-wide request id sequence: the join key between a request's
+# ``serve_request`` record and its lifecycle spans (obs/trace) — unique
+# within one stream (ids are per-process, streams are per-process files)
+_REQ_IDS = itertools.count()
+
+
 class ServeRequest:
     """One in-flight request: seed ids + a completion future."""
 
-    __slots__ = ("node_ids", "t_submit", "t_flush", "t_done", "status",
-                 "logits", "error", "_done")
+    __slots__ = ("node_ids", "req_id", "t_submit", "t_flush", "t_done",
+                 "status", "logits", "error", "_done")
 
     def __init__(self, node_ids: np.ndarray):
         self.node_ids = node_ids
+        self.req_id = f"q{next(_REQ_IDS):x}"
         self.t_submit = time.perf_counter()
         self.t_flush: Optional[float] = None
         self.t_done: Optional[float] = None
@@ -257,11 +265,12 @@ class MicroBatcher:
         if self.metrics is not None:
             self.metrics.counter_add("serve.shed")
             self.metrics.event(
-                "shed", reason=reason, queue_depth=len(self._pending)
+                "shed", reason=reason, queue_depth=len(self._pending),
+                req_id=req.req_id,
             )
             self.metrics.event(
                 "serve_request", n_seeds=max(len(req.node_ids), 1),
-                status="shed", total_ms=req.total_ms,
+                status="shed", total_ms=req.total_ms, req_id=req.req_id,
             )
 
     # ---- flusher thread --------------------------------------------------

@@ -20,6 +20,7 @@ batch of random requests, and prints the latency summary (docs/SERVING.md).
 from __future__ import annotations
 
 import argparse
+import itertools
 import os
 import sys
 import threading
@@ -44,6 +45,11 @@ from neutronstarlite_tpu.utils.logging import get_logger  # noqa: E402
 
 log = get_logger("serve")
 
+# process-wide like batcher._REQ_IDS: two servers (or a restarted one)
+# sharing one registry stream must not collide flush ids — trace_timeline
+# joins stage spans to serve_request records by (run_id, flush_id)
+_FLUSH_IDS = itertools.count()
+
 
 class InferenceServer:
     """Micro-batched, cache-fronted serving over one InferenceEngine."""
@@ -59,6 +65,14 @@ class InferenceServer:
             self.opts.cache_max_age_s,
             self.opts.hot_threshold,
         )
+        # span tracing over the same obs stream: each flush becomes one
+        # batch_flush span with cache/sample/execute/reply stage children,
+        # each request one request/queue span pair — joined to the typed
+        # serve_request records by req_id (tools/trace_timeline computes
+        # the per-request critical-path breakdown from exactly this)
+        from neutronstarlite_tpu.obs.trace import Tracer
+
+        self.tracer = Tracer(self.metrics)
         self.batcher = MicroBatcher(self._flush, self.opts, self.metrics)
         self._stats_lock = threading.Lock()
         self._latencies_ms: List[float] = []
@@ -80,6 +94,27 @@ class InferenceServer:
     # ---- the flush path (batcher thread) ---------------------------------
     def _flush(self, requests: List[ServeRequest], reason: str) -> None:
         t0 = time.perf_counter()
+        flush_id = next(_FLUSH_IDS)
+        batch_span = self.tracer.begin(
+            "batch_flush", cat="serve", flush_id=flush_id, reason=reason,
+            n_requests=len(requests),
+        )
+        try:
+            bucket, n_seeds, exec_ms = self._flush_body(
+                requests, t0, flush_id, batch_span
+            )
+        except BaseException as e:
+            # the batcher deliberately survives a bad flush (_loop catches
+            # everything); the span must still land — and pop off the
+            # flusher thread's stack — or every later flush parents under
+            # a handle that never reaches the stream
+            self.tracer.end(batch_span, error=type(e).__name__)
+            raise
+        self.tracer.end(batch_span, bucket=bucket, n_seeds=n_seeds)
+        self._record(requests, reason, bucket, n_seeds, exec_ms, flush_id)
+
+    def _flush_body(self, requests: List[ServeRequest], t0: float,
+                    flush_id: int, batch_span):
         # cache pass: per requested id, a fresh cached row or a compute slot
         all_ids: List[int] = []
         seen = set()
@@ -94,17 +129,21 @@ class InferenceServer:
                     cached_rows[vid] = row
                 else:
                     all_ids.append(vid)
+        t_cache = time.perf_counter()
         bucket = None
         rows: Dict[int, np.ndarray] = dict(cached_rows)
+        t_sample = t_cache
         if all_ids:
             uniq = np.asarray(all_ids, dtype=np.int64)
             bucket = self.engine.sampler.bucket_for(len(uniq))
             batch = self.engine.sampler.sample(bucket, uniq)
+            t_sample = time.perf_counter()
             logits = self.engine.forward_batch(batch, bucket)
             for i, vid in enumerate(uniq.tolist()):
                 rows[vid] = logits[i]
             self.cache.insert(uniq, logits[: len(uniq)])
-        exec_ms = (time.perf_counter() - t0) * 1000.0
+        t_exec = time.perf_counter()
+        exec_ms = (t_exec - t0) * 1000.0
 
         for r in requests:
             out = np.stack([rows[v] for v in r.node_ids.tolist()])
@@ -112,10 +151,25 @@ class InferenceServer:
                 v in cached_rows for v in r.node_ids.tolist()
             ) else "ok"
             r._complete(out, status)
-        self._record(requests, reason, bucket, len(all_ids), exec_ms)
+        t_reply = time.perf_counter()
+        # stage children, back-to-back over the flush body — the sum of a
+        # request's queue span + these four IS its end-to-end latency (the
+        # critical-path contract tests pin within tolerance)
+        for name, a, b in (
+            ("cache_lookup", t0, t_cache),
+            ("sample", t_cache, t_sample),
+            ("execute", t_sample, t_exec),
+            ("reply", t_exec, t_reply),
+        ):
+            self.tracer.complete(
+                name, dur_s=b - a, t0=a, cat="serve", parent=batch_span,
+                flush_id=flush_id,
+            )
+        return bucket, len(all_ids), exec_ms
 
     def _record(self, requests: List[ServeRequest], reason: str,
-                bucket: Optional[int], n_seeds: int, exec_ms: float) -> None:
+                bucket: Optional[int], n_seeds: int, exec_ms: float,
+                flush_id: Optional[int] = None) -> None:
         now = time.perf_counter()
         with self._stats_lock:
             if self._t_first is None:
@@ -138,6 +192,7 @@ class InferenceServer:
         self.metrics.event(
             "batch_flush", n_requests=len(requests), n_seeds=n_seeds,
             reason=reason, bucket=bucket, exec_ms=exec_ms,
+            flush_id=flush_id,
         )
         for r in requests:
             if r.status == "cached":
@@ -145,6 +200,20 @@ class InferenceServer:
             self.metrics.event(
                 "serve_request", n_seeds=len(r.node_ids), status=r.status,
                 total_ms=r.total_ms, queue_ms=r.queue_ms,
+                req_id=r.req_id, flush_id=flush_id,
+            )
+            if r.t_done is None or r.t_flush is None:
+                continue
+            # request lifecycle spans, retroactive from the recorded
+            # perf_counter marks (same clock domain as the tracer)
+            span = self.tracer.complete(
+                "request", dur_s=r.t_done - r.t_submit, t0=r.t_submit,
+                cat="serve", req_id=r.req_id, status=r.status,
+                n_seeds=len(r.node_ids), flush_id=flush_id,
+            )
+            self.tracer.complete(
+                "queue", dur_s=r.t_flush - r.t_submit, t0=r.t_submit,
+                cat="serve", parent=span, req_id=r.req_id,
             )
 
     # ---- SLO telemetry ---------------------------------------------------

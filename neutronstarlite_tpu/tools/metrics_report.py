@@ -11,16 +11,27 @@ each record against the obs schema, and renders:
 - per run: the recovery timeline — every ``fault`` / ``recovery`` record
   (resilience/) with its offset from the stream's first event, so a
   run's failure-and-recovery history reads at a glance;
+- per run: the span timeline block (tools/trace_timeline derived
+  metrics) — span inventory, measured ring overlap efficiency, serve
+  critical-path breakdown, retry cost — when the stream carries ``span``
+  records;
 - across runs: a comparison table keyed by run_id/algorithm/fingerprint.
 
 A file with epoch events but no run_summary (killed run) still renders:
 the summary is synthesized from the epoch events, marked ``(synthesized)``.
 
+``--diff A B`` compares two runs' summaries metric by metric (warm epoch
+time, wire bytes, shed rate, serve p99) with a per-metric % delta and
+exits 2 when any metric regressed beyond ``--tol`` — the BENCH trajectory
+check as a gate instead of an eyeball.
+
 Usage:
   python -m neutronstarlite_tpu.tools.metrics_report <file-or-dir> [...]
       [--json]
+  python -m neutronstarlite_tpu.tools.metrics_report --diff A B
+      [--tol 0.05]
 Exit code 0 when every input yielded a report; 1 when nothing usable was
-found (or any input was unreadable).
+found (or any input was unreadable); 2 when --diff found a regression.
 """
 
 from __future__ import annotations
@@ -51,22 +62,28 @@ def expand_paths(args: List[str]) -> List[str]:
 
 
 def load_events(path: str) -> List[Dict[str, Any]]:
-    """Parse + validate one JSONL file; bad lines are reported to stderr
-    and skipped (a crashed writer may leave a torn final line)."""
+    """Parse + validate one JSONL stream; bad lines are reported to stderr
+    and skipped (a crashed writer may leave a torn final line). A rotated
+    ``<path>.1`` chunk (NTS_METRICS_MAX_MB) holds the stream's OLDEST
+    records — it is read first, so run_start/run_summary survive a
+    rotation that fired right after they were written."""
+    rotated = path + ".1"
+    chunks = [rotated, path] if os.path.exists(rotated) else [path]
     events: List[Dict[str, Any]] = []
-    with open(path, "r", encoding="utf-8") as fh:
-        for ln, raw in enumerate(fh, 1):
-            raw = raw.strip()
-            if not raw:
-                continue
-            try:
-                obj = json.loads(raw)
-                schema.validate_event(obj)
-            except (json.JSONDecodeError, ValueError) as e:
-                print(f"{path}:{ln}: skipping bad record: {e}",
-                      file=sys.stderr)
-                continue
-            events.append(obj)
+    for chunk in chunks:
+        with open(chunk, "r", encoding="utf-8") as fh:
+            for ln, raw in enumerate(fh, 1):
+                raw = raw.strip()
+                if not raw:
+                    continue
+                try:
+                    obj = json.loads(raw)
+                    schema.validate_event(obj)
+                except (json.JSONDecodeError, ValueError) as e:
+                    print(f"{chunk}:{ln}: skipping bad record: {e}",
+                          file=sys.stderr)
+                    continue
+                events.append(obj)
     return events
 
 
@@ -189,6 +206,7 @@ def render_serve(path: str, rec: Dict[str, Any],
             "#cache_hits={hits} misses={misses} entries={entries} "
             "expired={expired}".format(**cache)
         )
+    lines.extend(rec.get("_trace") or [])
     return "\n".join(lines)
 
 
@@ -239,11 +257,13 @@ _TIMELINE_SKIP = ("event", "run_id", "schema", "ts", "seq", "error")
 
 
 def recovery_timeline(events: List[Dict[str, Any]]) -> List[str]:
-    """``fault``/``recovery`` records as offset-stamped one-liners."""
+    """``fault``/``recovery`` records as offset-stamped one-liners;
+    ``stream_rotated`` markers (the NTS_METRICS_MAX_MB guard) ride the
+    same timeline — a truncated history must say so in the report."""
     t0 = events[0]["ts"] if events else 0.0
     lines: List[str] = []
     for e in events:
-        if e["event"] not in ("fault", "recovery"):
+        if e["event"] not in ("fault", "recovery", "stream_rotated"):
             continue
         detail = " ".join(
             f"{k}={e[k]}" for k in sorted(e)
@@ -292,6 +312,7 @@ def render_run(path: str, rec: Dict[str, Any]) -> str:
     if loss is not None:
         lines.append(f"#final_loss={loss}")
     lines.extend(rec.get("_ring") or [])
+    lines.extend(rec.get("_trace") or [])
     timeline = rec.get("_timeline") or []
     if timeline:
         lines.append("recovery timeline:")
@@ -331,16 +352,136 @@ def render_table(rows: List[Dict[str, Any]]) -> str:
     )
 
 
+# ---- --diff: two-run regression gate ---------------------------------------
+
+
+def _load_side(path: str):
+    """(train summary, serve summary) for one --diff side: the first
+    stream under ``path`` carrying each (a side is one run's
+    NTS_METRICS_DIR, or a single file)."""
+    rec = srec = None
+    for p in expand_paths([path]):
+        try:
+            events = load_events(p)
+        except OSError as e:
+            print(f"{p}: {e}", file=sys.stderr)
+            continue
+        if rec is None:
+            rec = summarize(p, events)
+        if srec is None:
+            srec = summarize_serve(events)
+    return rec, srec
+
+
+def _diff_metrics(rec, srec):
+    """{metric: value} — every entry is lower-is-better so the regression
+    rule is uniform; None/absent entries are skipped in the comparison."""
+    out = {}
+    if rec is not None:
+        et = rec.get("epoch_time") or {}
+        out["warm_median_epoch_s"] = et.get("warm_median_s")
+        out["avg_epoch_s"] = rec.get("avg_epoch_s")
+        counters = rec.get("counters") or {}
+        # wire.bytes_fwd is a run-total counter; normalize per epoch so a
+        # longer run doesn't read as a wire regression (every other diff
+        # metric is already per-epoch or a rate)
+        wire = counters.get("wire.bytes_fwd")
+        n_epochs = rec.get("epochs") or 0
+        out["wire_bytes_fwd_per_epoch"] = (
+            wire / n_epochs if wire is not None and n_epochs > 0 else None
+        )
+    if srec is not None:
+        answered = srec.get("requests", 0)
+        shed = srec.get("shed", 0)
+        out["shed_rate"] = (
+            shed / (answered + shed) if (answered + shed) > 0 else None
+        )
+        out["serve_p99_ms"] = (srec.get("latency_ms") or {}).get("p99")
+    return out
+
+
+def run_diff(a_path: str, b_path: str, tol: float,
+             as_json: bool = False) -> int:
+    """Compare run B against baseline A; exit 2 when any shared metric
+    regressed (grew) by more than ``tol`` (fractional, e.g. 0.05 = 5%;
+    against a 0.0 baseline ``tol`` is the absolute threshold instead).
+    ``as_json`` emits one machine-readable object instead of the table."""
+    a = _diff_metrics(*_load_side(a_path))
+    b = _diff_metrics(*_load_side(b_path))
+    shared = [
+        k for k in a
+        if a.get(k) is not None and b.get(k) is not None
+    ]
+    if not shared:
+        print("diff: no comparable metrics between the two sides",
+              file=sys.stderr)
+        return 1
+    header = ("metric", "A", "B", "delta")
+    table = [header]
+    regressions = []
+    detail: Dict[str, Dict[str, Any]] = {}
+    for k in shared:
+        va, vb = float(a[k]), float(b[k])
+        if va > 0:
+            delta = (vb - va) / va
+            dstr = f"{delta * 100:+.1f}%"
+        else:
+            delta = 1.0 if vb > 0 else 0.0
+            dstr = "n/a" if vb == va else f"+{vb:g} (A was 0)"
+        # zero baseline: no relative delta exists, so --tol acts as an
+        # absolute floor (shed_rate 0 -> 0.0001 passes at --tol 0.05
+        # instead of failing on ANY nonzero value)
+        regressed = vb > va * (1.0 + tol) if va > 0 else vb > tol
+        if regressed:
+            regressions.append(f"{k}: {va:g} -> {vb:g} ({dstr})")
+        detail[k] = {"a": va, "b": vb, "delta": delta,
+                     "regressed": regressed}
+        table.append(
+            (k, f"{va:g}", f"{vb:g}", dstr + (" REGRESSED" if regressed else ""))
+        )
+    if as_json:
+        print(json.dumps({
+            "tol": tol,
+            "metrics": detail,
+            "regressed": sorted(k for k in detail
+                                if detail[k]["regressed"]),
+        }))
+        return 2 if regressions else 0
+    widths = [max(len(r[i]) for r in table) for i in range(len(header))]
+    for row in table:
+        print("  ".join(c.ljust(w) for c, w in zip(row, widths)).rstrip())
+    if regressions:
+        print(
+            f"REGRESSION beyond --tol {tol:g}: " + "; ".join(regressions),
+            file=sys.stderr,
+        )
+        return 2
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="render obs JSONL metric streams into the "
         "reference-shaped #key=value(ms) report"
     )
-    ap.add_argument("paths", nargs="+",
+    ap.add_argument("paths", nargs="*",
                     help="JSONL file(s) or NTS_METRICS_DIR-style directories")
     ap.add_argument("--json", action="store_true",
                     help="emit one JSON line (the summaries) instead of text")
+    ap.add_argument("--diff", nargs=2, metavar=("A", "B"), default=None,
+                    help="compare run B against baseline A (each a file or "
+                    "metrics dir); exit 2 on regression beyond --tol")
+    ap.add_argument("--tol", type=float, default=0.05,
+                    help="--diff regression tolerance as a fraction "
+                    "(default 0.05 = 5%%); absolute threshold when the "
+                    "baseline value is 0")
     args = ap.parse_args(argv)
+
+    if args.diff is not None:
+        return run_diff(args.diff[0], args.diff[1], args.tol,
+                        as_json=args.json)
+    if not args.paths:
+        ap.error("paths required (or use --diff A B)")
 
     paths = expand_paths(args.paths)
     if not paths:
@@ -364,14 +505,22 @@ def main(argv=None) -> int:
             print(f"{p}: no run_summary, epoch, or serving events; skipping",
                   file=sys.stderr)
             continue
+        # the span-timeline block (derived metrics) rides whichever record
+        # renders this stream — the training one when present, else the
+        # serving one — so it prints exactly once per stream
+        from neutronstarlite_tpu.tools.trace_timeline import timeline_block
+
+        trace_lines = timeline_block(events)
         if rec is not None:
             rec["_path"] = p
             rec["_timeline"] = recovery_timeline(events)
             rec["_ring"] = render_ring(events, rec)
+            rec["_trace"] = trace_lines
         if srec is not None:
             srec["_path"] = p
             srec["_events"] = events
             srec["_serve"] = True
+            srec["_trace"] = trace_lines if rec is None else []
         rows.extend(r for r in (rec, srec) if r is not None)
     if not rows:
         return 1

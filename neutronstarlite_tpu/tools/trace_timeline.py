@@ -1,0 +1,575 @@
+"""Merge per-rank span streams into one causal timeline.
+
+The span records (obs/trace.py) land in per-rank JSONL files with
+process-local monotonic clocks. This CLI reconstructs one coherent view:
+
+1. **mono->wall recovery** per stream: a span record is written
+   immediately after its span ends, so the envelope wall-clock ``ts``
+   sits just past ``t0 + dur_s`` — ``median(ts - (t0 + dur_s))`` over a
+   stream's spans is that process's monotonic->wall offset (robust to a
+   few delayed writes; see docs/OBSERVABILITY.md for the caveats).
+2. **epoch-marker rank alignment**: every rank ends epoch *e* at the same
+   collective barrier, so per-epoch spans are cross-rank fence posts —
+   each rank is shifted by the median difference of its epoch-end times
+   against the reference (lowest) rank. Wall clocks that agree within the
+   epoch time are left essentially untouched; skewed hosts snap into
+   place.
+3. **Chrome trace-event export** (``--chrome out.json``): complete ("X")
+   events per span (pid = rank, tid = host thread), instant events for
+   fault / recovery / shed records — loadable in Perfetto or
+   chrome://tracing. When the run also wrote a ``jax.profiler`` trace
+   (``NTS_PROFILE_DIR``), the host spans were emitted as
+   ``TraceAnnotation``s inside it too, so the device-op view carries the
+   same names — open both in one Perfetto window to line host causality
+   up with kernel truth.
+4. **Derived metrics** printed as the timeline report (and rendered by
+   tools/metrics_report as its "span timeline" block):
+   - ring overlap efficiency — the NTS_OVERLAP_PROBE verdict (hop time
+     hidden under blocked-kernel compute / total hop time);
+   - serve critical path — per-request stage breakdown
+     (queue -> cache_lookup -> sample -> execute -> reply), joined to the
+     ``serve_request`` records by ``req_id``; the stage sum must match
+     the recorded end-to-end latency (the tests pin the tolerance);
+   - retry cost — per fault episode, time from the fault record to the
+     first epoch completed after recovery, plus replayed-epoch counts.
+
+Usage:
+  python -m neutronstarlite_tpu.tools.trace_timeline <file-or-dir> [...]
+      [--chrome OUT.json] [--json]
+Exit 0 when at least one stream yielded a timeline; 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+from typing import Any, Dict, List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from neutronstarlite_tpu.tools.metrics_report import (  # noqa: E402
+    expand_paths,
+    load_events,
+)
+
+
+def _median(vals: List[float]) -> Optional[float]:
+    return statistics.median(vals) if vals else None
+
+
+def spans_of(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    return [e for e in events if e["event"] == "span"]
+
+
+def stream_rank(events: List[Dict[str, Any]], path: str) -> int:
+    """Rank of one stream: run_start.process_index, span.rank, or the
+    ``-pN.jsonl`` filename convention; 0 when nothing says otherwise."""
+    for e in events:
+        if e["event"] == "run_start" and isinstance(
+            e.get("process_index"), int
+        ):
+            return e["process_index"]
+    for e in events:
+        if e["event"] == "span" and isinstance(e.get("rank"), int):
+            return e["rank"]
+    stem = os.path.basename(path)
+    if "-p" in stem:
+        tail = stem.rsplit("-p", 1)[1].split(".", 1)[0]
+        if tail.isdigit():
+            return int(tail)
+    return 0
+
+
+def mono_wall_offset(events: List[Dict[str, Any]]) -> Optional[float]:
+    """Monotonic->wall offset for one stream (docstring step 1)."""
+    return _median([
+        e["ts"] - (e["t0"] + e["dur_s"]) for e in spans_of(events)
+    ])
+
+
+class Stream:
+    """One per-rank JSONL file with its clock corrections resolved."""
+
+    def __init__(self, path: str, events: List[Dict[str, Any]]):
+        self.path = path
+        self.events = events
+        self.rank = stream_rank(events, path)
+        self.offset = mono_wall_offset(events)  # mono -> wall (step 1)
+        self.align = 0.0  # cross-rank shift (step 2)
+        self.run_id = next(
+            (e["run_id"] for e in events if e.get("run_id")), "?"
+        )
+
+    def span_wall(self, span: Dict[str, Any]) -> Optional[float]:
+        """Aligned wall-clock start of ``span`` (None without an offset —
+        a stream with no spans has nothing to place on the timeline)."""
+        if self.offset is None:
+            return None
+        return span["t0"] + self.offset + self.align
+
+    def epoch_ends(self) -> Dict[int, float]:
+        """{epoch: aligned wall end} from this stream's epoch spans."""
+        out: Dict[int, float] = {}
+        if self.offset is None:
+            return out
+        for s in spans_of(self.events):
+            if s["name"] == "epoch" and isinstance(s.get("epoch"), int):
+                out[s["epoch"]] = (
+                    s["t0"] + s["dur_s"] + self.offset + self.align
+                )
+        return out
+
+
+def align_streams(streams: List["Stream"]) -> None:
+    """Epoch-marker alignment (docstring step 2), in place: the lowest
+    rank with epoch spans anchors; every other stream shifts by the median
+    epoch-end difference over shared epochs. Streams sharing no epochs
+    (e.g. a serve-only stream next to a training stream) keep wall time."""
+    anchored = sorted(
+        (s for s in streams if s.epoch_ends()), key=lambda s: s.rank
+    )
+    if not anchored:
+        return
+    ref = anchored[0].epoch_ends()
+    for s in anchored[1:]:
+        own = s.epoch_ends()
+        deltas = [ref[e] - own[e] for e in ref.keys() & own.keys()]
+        d = _median(deltas)
+        if d is not None:
+            s.align = d
+
+
+def load_streams(paths: List[str]) -> List[Stream]:
+    streams = []
+    for p in paths:
+        try:
+            events = load_events(p)
+        except OSError as e:
+            print(f"{p}: {e}", file=sys.stderr)
+            continue
+        if events:
+            streams.append(Stream(p, events))
+    align_streams(streams)
+    return streams
+
+
+# ---------------------------------------------------------------------------
+# Chrome trace export
+# ---------------------------------------------------------------------------
+
+_INSTANT_KINDS = ("fault", "recovery", "shed")
+_ENVELOPE_OR_SPAN = (
+    "event", "run_id", "schema", "ts", "seq", "name", "cat", "span_id",
+    "trace_id", "parent_id", "t0", "dur_s", "rank", "thread",
+)
+
+
+def chrome_trace(streams: List[Stream]) -> Dict[str, Any]:
+    """Chrome trace-event JSON (the ``traceEvents`` container form).
+
+    pid = rank, tid = one int per (rank, host thread); metadata records
+    name both. Spans become complete ("X") events; fault/recovery/shed
+    records become process-scoped instants ("i")."""
+    events: List[Dict[str, Any]] = []
+    starts: List[float] = []
+    for st in streams:
+        for s in spans_of(st.events):
+            w = st.span_wall(s)
+            if w is not None:
+                starts.append(w)
+        if st.offset is not None:
+            for e in st.events:
+                if e["event"] in _INSTANT_KINDS:
+                    starts.append(e["ts"] + st.align)
+    t0 = min(starts) if starts else 0.0
+
+    tids: Dict[tuple, int] = {}
+    for st in streams:
+        events.append({
+            "ph": "M", "name": "process_name", "pid": st.rank, "tid": 0,
+            "ts": 0,
+            "args": {"name": f"rank {st.rank} · {st.run_id}"},
+        })
+        for s in spans_of(st.events):
+            w = st.span_wall(s)
+            if w is None:
+                continue
+            key = (st.rank, s.get("thread") or "main")
+            tid = tids.get(key)
+            if tid is None:
+                tid = tids[key] = len(tids) + 1
+                events.append({
+                    "ph": "M", "name": "thread_name", "pid": st.rank,
+                    "tid": tid, "ts": 0, "args": {"name": key[1]},
+                })
+            args = {
+                k: v for k, v in s.items()
+                if k not in _ENVELOPE_OR_SPAN and v is not None
+            }
+            args["span_id"] = s["span_id"]
+            if s.get("parent_id"):
+                args["parent_id"] = s["parent_id"]
+            events.append({
+                "ph": "X",
+                "name": s["name"],
+                "cat": s.get("cat") or "host",
+                "pid": st.rank,
+                "tid": tid,
+                "ts": (w - t0) * 1e6,
+                "dur": s["dur_s"] * 1e6,
+                "args": args,
+            })
+        if st.offset is None:
+            continue
+        for e in st.events:
+            if e["event"] not in _INSTANT_KINDS:
+                continue
+            label = (
+                e.get("kind") or e.get("action") or e.get("reason") or ""
+            )
+            events.append({
+                "ph": "i",
+                "name": f"{e['event']}:{label}",
+                "cat": "marker",
+                "pid": st.rank,
+                "tid": 0,
+                "ts": (e["ts"] + st.align - t0) * 1e6,
+                "s": "p",
+            })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def validate_chrome_trace(trace: Any) -> int:
+    """Structural check of a Chrome trace-event JSON object; returns the
+    event count, raises ValueError on the first violation. This is the
+    schema the tests (and any CI consumer) pin."""
+    def fail(msg):
+        raise ValueError(f"chrome trace: {msg}")
+
+    if not isinstance(trace, dict) or not isinstance(
+        trace.get("traceEvents"), list
+    ):
+        fail("top level must be an object with a traceEvents array")
+    for i, e in enumerate(trace["traceEvents"]):
+        if not isinstance(e, dict):
+            fail(f"traceEvents[{i}] is not an object")
+        if e.get("ph") not in ("X", "i", "M"):
+            fail(f"traceEvents[{i}].ph {e.get('ph')!r} not in X/i/M")
+        if not isinstance(e.get("name"), str) or not e["name"]:
+            fail(f"traceEvents[{i}].name must be a non-empty string")
+        for key in ("pid", "tid"):
+            if not isinstance(e.get(key), int):
+                fail(f"traceEvents[{i}].{key} must be an int")
+        if not isinstance(e.get("ts"), (int, float)):
+            fail(f"traceEvents[{i}].ts must be a number")
+        if e["ph"] == "X":
+            if not isinstance(e.get("dur"), (int, float)) or e["dur"] < 0:
+                fail(f"traceEvents[{i}].dur must be a number >= 0")
+            if e["ts"] < 0:
+                fail(f"traceEvents[{i}].ts must be >= 0")
+    return len(trace["traceEvents"])
+
+
+# ---------------------------------------------------------------------------
+# Derived metrics
+# ---------------------------------------------------------------------------
+
+
+def ring_overlap_report(events: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """The NTS_OVERLAP_PROBE verdict: prefers the run_summary gauges, falls
+    back to the probe span's attributes (killed run)."""
+    for e in reversed(events):
+        if e["event"] == "run_summary":
+            g = e.get("gauges") or {}
+            if "ring.probe_overlap_s" in g:
+                return {
+                    "efficiency": g.get("ring.overlap_efficiency"),
+                    "overlap_s": g.get("ring.probe_overlap_s"),
+                    "compute_s": g.get("ring.probe_compute_s"),
+                    "exchange_s": g.get("ring.probe_exchange_s"),
+                    "simulated": bool(g.get("ring.probe_simulated")),
+                }
+    for e in reversed(events):
+        if e["event"] == "span" and e["name"] == "ring_overlap_probe":
+            return {
+                "efficiency": e.get("efficiency"),
+                "overlap_s": e.get("overlap_s"),
+                "compute_s": e.get("compute_s"),
+                "exchange_s": e.get("exchange_s"),
+                "simulated": bool(e.get("simulated")),
+            }
+    return None
+
+
+SERVE_STAGES = ("queue", "cache_lookup", "sample", "execute", "reply")
+
+
+def serve_critical_path(events: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """Per-request stage breakdown from the serve lifecycle spans, joined
+    to the ``serve_request`` records by ``req_id``. For each answered
+    request: queue (its own span) + the four flush stages of the batch
+    that served it (``flush_id`` join). The stage sum reproduces the
+    recorded end-to-end latency — ``max_abs_mismatch_ms`` quantifies how
+    tightly (tests pin it)."""
+    spans = spans_of(events)
+    # join keys carry run_id: req_id/flush_id counters restart at 0 in
+    # every serving process, and a merged multi-run dir must not cross-join
+    # run A's requests to run B's queue/stage spans
+    queue_by_req = {
+        (s.get("run_id"), s["req_id"]): s for s in spans
+        if s["name"] == "queue" and s.get("req_id")
+    }
+    stages_by_flush: Dict[Any, Dict[str, float]] = {}
+    for s in spans:
+        if s["name"] in SERVE_STAGES[1:] and s.get("flush_id") is not None:
+            stages_by_flush.setdefault(
+                (s.get("run_id"), s["flush_id"]), {}
+            )[s["name"]] = s["dur_s"] * 1000.0
+    recs = [
+        e for e in events
+        if e["event"] == "serve_request" and e.get("status") != "shed"
+        and e.get("req_id") and e.get("total_ms") is not None
+    ]
+    requests = []
+    for r in recs:
+        q = queue_by_req.get((r.get("run_id"), r["req_id"]))
+        flush = stages_by_flush.get((r.get("run_id"), r.get("flush_id")))
+        if q is None or not flush:
+            continue
+        stages = {"queue": q["dur_s"] * 1000.0}
+        stages.update(
+            {name: flush.get(name, 0.0) for name in SERVE_STAGES[1:]}
+        )
+        total = float(r["total_ms"])
+        s_sum = sum(stages.values())
+        requests.append({
+            "req_id": r["req_id"],
+            "flush_id": r.get("flush_id"),
+            "status": r["status"],
+            "total_ms": total,
+            "stage_sum_ms": s_sum,
+            "mismatch_ms": s_sum - total,
+            "stages_ms": stages,
+        })
+    if not requests:
+        return None
+    p50 = {
+        name: _median([r["stages_ms"][name] for r in requests])
+        for name in SERVE_STAGES
+    }
+    return {
+        "requests": requests,
+        "n": len(requests),
+        "stage_p50_ms": p50,
+        "critical_stage": max(p50, key=lambda k: p50[k] or 0.0),
+        "max_abs_mismatch_ms": max(
+            abs(r["mismatch_ms"]) for r in requests
+        ),
+    }
+
+
+def retry_report(events: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """Per fault episode: the recovery action taken and the time from the
+    fault record to the first epoch completed afterwards (end-to-end
+    retry cost, backoff + restore + replay included)."""
+    faults = [e for e in events if e["event"] == "fault"]
+    if not faults:
+        return None
+    recoveries = [e for e in events if e["event"] == "recovery"]
+    epochs = [e for e in events if e["event"] == "epoch"]
+    episodes = []
+    for f in faults:
+        # same-run pairing only: a merged multi-run dir must not heal one
+        # run's fault with the first epoch another run happens to finish
+        rid = f.get("run_id")
+        action = next(
+            (r for r in recoveries
+             if r.get("run_id") == rid and r["ts"] >= f["ts"]), None
+        )
+        healed = next(
+            (e for e in epochs
+             if e.get("run_id") == rid and e["ts"] > f["ts"]), None
+        )
+        episodes.append({
+            "kind": f.get("kind"),
+            "epoch": f.get("epoch"),
+            "attempt": f.get("attempt"),
+            "action": action.get("action") if action else None,
+            "recover_s": (healed["ts"] - f["ts"]) if healed else None,
+        })
+    replayed = 0
+    for e in reversed(events):
+        if e["event"] == "run_summary":
+            replayed = int(
+                (e.get("counters") or {}).get(
+                    "resilience.replayed_epochs", 0
+                )
+            )
+            break
+    recovered = [p["recover_s"] for p in episodes if p["recover_s"]]
+    return {
+        "episodes": episodes,
+        "n": len(episodes),
+        "replayed_epochs": replayed,
+        "mean_recover_s": (
+            sum(recovered) / len(recovered) if recovered else None
+        ),
+    }
+
+
+def span_inventory(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    by_name: Dict[str, Dict[str, float]] = {}
+    for s in spans_of(events):
+        b = by_name.setdefault(s["name"], {"count": 0, "total_s": 0.0})
+        b["count"] += 1
+        b["total_s"] += s["dur_s"]
+    return by_name
+
+
+def timeline_block(events: List[Dict[str, Any]]) -> List[str]:
+    """The "span timeline" lines tools/metrics_report embeds under each
+    run's #key=value block (one stream's events; empty without spans)."""
+    inv = span_inventory(events)
+    if not inv:
+        return []
+    lines = ["span timeline:"]
+    lines.append(
+        "#spans="
+        + " ".join(
+            f"{name}:{int(b['count'])}({b['total_s'] * 1000:.1f}ms)"
+            for name, b in sorted(inv.items())
+        )
+    )
+    ring = ring_overlap_report(events)
+    if ring is not None and ring.get("overlap_s") is not None:
+        eff = ring.get("efficiency")
+        lines.append(
+            f"#ring_overlap_efficiency="
+            f"{f'{eff:.2f}' if eff is not None else 'n/a'} "
+            f"(overlapped={ring['overlap_s'] * 1000:.3f}ms "
+            f"compute_only={ring['compute_s'] * 1000:.3f}ms "
+            f"exchange_only={ring['exchange_s'] * 1000:.3f}ms"
+            f"{', sim rig' if ring.get('simulated') else ''})"
+        )
+    serve = serve_critical_path(events)
+    if serve is not None:
+        p50 = serve["stage_p50_ms"]
+        lines.append(
+            "#serve_critical_path_p50="
+            + " ".join(
+                f"{name}:{p50[name]:.3f}ms" for name in SERVE_STAGES
+                if p50.get(name) is not None
+            )
+            + f" (critical={serve['critical_stage']}, n={serve['n']}, "
+            f"max|stage_sum-latency|={serve['max_abs_mismatch_ms']:.3f}ms)"
+        )
+    retry = retry_report(events)
+    if retry is not None:
+        mean = retry["mean_recover_s"]
+        lines.append(
+            f"#retry_cost={retry['n']} episode(s), "
+            f"mean_time_to_recover="
+            f"{f'{mean:.2f}s' if mean is not None else 'n/a'}, "
+            f"replayed_epochs={retry['replayed_epochs']}"
+        )
+    return lines
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="merge per-rank obs span streams into one causal "
+        "timeline: Chrome trace export + overlap/critical-path/retry "
+        "derived metrics"
+    )
+    ap.add_argument("paths", nargs="+",
+                    help="JSONL file(s) or NTS_METRICS_DIR directories")
+    ap.add_argument("--chrome", metavar="OUT.json", default="",
+                    help="write Chrome trace-event JSON here "
+                    "(Perfetto / chrome://tracing)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the derived metrics as one JSON object")
+    args = ap.parse_args(argv)
+
+    streams = load_streams(expand_paths(args.paths))
+    streams = [s for s in streams if spans_of(s.events)]
+    if not streams:
+        print("no span records found in the given streams",
+              file=sys.stderr)
+        return 1
+
+    merged: List[Dict[str, Any]] = []
+    for s in streams:
+        merged.extend(s.events)
+    merged.sort(key=lambda e: e["ts"])
+
+    out: Dict[str, Any] = {
+        "streams": [
+            {
+                "path": s.path,
+                "rank": s.rank,
+                "run_id": s.run_id,
+                "spans": len(spans_of(s.events)),
+                "mono_wall_offset_s": s.offset,
+                "align_shift_s": s.align,
+            }
+            for s in streams
+        ],
+        "ring_overlap": ring_overlap_report(merged),
+        "serve_critical_path": serve_critical_path(merged),
+        "retries": retry_report(merged),
+        "span_inventory": span_inventory(merged),
+    }
+    if args.chrome:
+        trace = chrome_trace(streams)
+        validate_chrome_trace(trace)
+        with open(args.chrome, "w", encoding="utf-8") as fh:
+            json.dump(trace, fh)
+        out["chrome"] = {
+            "path": args.chrome, "events": len(trace["traceEvents"]),
+        }
+
+    if args.json:
+        print(json.dumps(out, default=str))
+        return 0
+
+    for s in out["streams"]:
+        off = s["mono_wall_offset_s"]
+        print(
+            f"== stream rank {s['rank']} · {s['run_id']} — {s['path']}\n"
+            f"   {s['spans']} spans, mono->wall offset "
+            f"{off:.3f}s, align shift {s['align_shift_s'] * 1000:+.3f}ms"
+        )
+    for line in timeline_block(merged):
+        print(line)
+    serve = out["serve_critical_path"]
+    if serve is not None:
+        worst = max(serve["requests"], key=lambda r: r["total_ms"])
+        print(
+            f"slowest request {worst['req_id']}: "
+            f"{worst['total_ms']:.3f}ms total = "
+            + " + ".join(
+                f"{worst['stages_ms'][n]:.3f} {n}" for n in SERVE_STAGES
+            )
+        )
+    if "chrome" in out:
+        print(
+            f"chrome trace: {out['chrome']['events']} events -> "
+            f"{out['chrome']['path']} (open in Perfetto; with "
+            f"NTS_PROFILE_DIR the same span names appear inside the "
+            f"jax.profiler device trace)"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -52,17 +52,27 @@ class Timer:
 
 
 class PhaseTimers:
-    """Named phase accumulators + DEBUGINFO-style report (GCN.hpp:308-353)."""
+    """Named phase accumulators + DEBUGINFO-style report (GCN.hpp:308-353).
 
-    def __init__(self) -> None:
+    When a span tracer (obs/trace.Tracer) is attached, every ``phase()``
+    interval is ALSO emitted as one ``span`` record — the aggregate report
+    and the causal timeline stay two views of the same measurement instead
+    of two instrumentation sites that can drift."""
+
+    def __init__(self, tracer=None) -> None:
         self._timers: Dict[str, Timer] = defaultdict(Timer)
+        self.tracer = tracer
 
     @contextmanager
     def phase(self, name: str) -> Iterator[None]:
         t = self._timers[name]
         t.start()
         try:
-            yield
+            if self.tracer is not None:
+                with self.tracer.span(name, cat="phase"):
+                    yield
+            else:
+                yield
         finally:
             t.stop()
 

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Tier-1 verify gate — the ROADMAP.md "Tier-1 verify" command, verbatim,
+# so builders and any future CI run the IDENTICAL gate (same timeout, same
+# marker filter, same DOTS_PASSED count). Run from the repo root:
+#
+#   bash scripts/ci_tier1.sh
+#
+# Exit code is pytest's (pipefail-preserved through the tee); the final
+# DOTS_PASSED=N line is the per-run passed-test count the PROGRESS
+# trajectory tracks. Change this file ONLY together with ROADMAP.md.
+cd "$(dirname "$0")/.." || exit 1
+set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

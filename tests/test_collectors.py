@@ -1,0 +1,121 @@
+"""obs/collectors direct coverage on the CPU-only rig.
+
+The collectors were previously exercised only incidentally through trainer
+smokes; these tests pin their contracts standalone: graceful degradation
+(CPU backends expose no memory_stats -> explicit nulls, 0/1-epoch runs ->
+null warm statistics), the compile-attribution arithmetic, and the
+persistent-cache probe — so a collector regression fails HERE with a
+named cause instead of somewhere inside a 40-second smoke.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from neutronstarlite_tpu.obs import collectors
+from neutronstarlite_tpu.utils.timing import PhaseTimers
+
+
+# ---- device_memory_stats ----------------------------------------------------
+
+
+def test_device_memory_stats_shape_is_backend_independent():
+    """One schema either way: 'available' bool + the three aggregate keys;
+    on the CPU rig (no memory_stats) the values are explicit nulls."""
+    mem = collectors.device_memory_stats()
+    assert isinstance(mem["available"], bool)
+    assert set(mem) >= {"available", "bytes_in_use", "peak_bytes_in_use",
+                        "devices"}
+    assert isinstance(mem["devices"], list)
+    if not mem["available"]:
+        assert mem["bytes_in_use"] is None
+        assert mem["peak_bytes_in_use"] is None
+        assert mem["devices"] == []
+    else:  # a rig that DOES expose stats must aggregate them as ints
+        assert isinstance(mem["bytes_in_use"], int)
+        assert isinstance(mem["peak_bytes_in_use"], int)
+        for d in mem["devices"]:
+            assert "device" in d and "bytes_in_use" in d
+
+
+def test_device_memory_stats_survives_broken_jax(monkeypatch):
+    """Telemetry must never fail a run: a jax whose local_devices() raises
+    degrades to the explicit-null shape instead of propagating."""
+    import jax
+
+    monkeypatch.setattr(
+        jax, "local_devices",
+        lambda *a, **k: (_ for _ in ()).throw(RuntimeError("boom")),
+    )
+    mem = collectors.device_memory_stats()
+    assert mem["available"] is False and mem["devices"] == []
+
+
+# ---- steady_state_stats -----------------------------------------------------
+
+
+def test_steady_state_stats_empty_and_single():
+    z = collectors.steady_state_stats([])
+    assert z["epochs"] == 0 and z["first_s"] is None
+    assert z["warm_median_s"] is None and z["compile_overhead_s"] is None
+
+    one = collectors.steady_state_stats([2.5])
+    assert one["epochs"] == 1 and one["first_s"] == 2.5
+    # a 1-epoch run has no warm window: nulls, not fictitious zeros
+    assert one["warm_median_s"] is None
+    assert one["first_to_warm_ratio"] is None
+
+
+def test_steady_state_stats_attribution_math():
+    s = collectors.steady_state_stats([5.0, 1.0, 2.0, 3.0])
+    assert s["epochs"] == 4 and s["first_s"] == 5.0
+    assert s["warm_median_s"] == 2.0  # median of [1, 2, 3]
+    assert s["warm_mean_s"] == pytest.approx(2.0)
+    assert s["compile_overhead_s"] == pytest.approx(3.0)  # 5 - 2
+    assert s["first_to_warm_ratio"] == pytest.approx(2.5)
+    # even warm count: midpoint interpolation
+    s = collectors.steady_state_stats([4.0, 1.0, 3.0])
+    assert s["warm_median_s"] == pytest.approx(2.0)
+
+
+def test_steady_state_stats_clamps_negative_overhead():
+    """A first epoch FASTER than warm (AOT/persistent-cache hit) must not
+    report negative compile overhead."""
+    s = collectors.steady_state_stats([1.0, 2.0, 2.0])
+    assert s["compile_overhead_s"] == 0.0
+    assert s["first_to_warm_ratio"] == pytest.approx(0.5)
+
+
+# ---- compile_cache_info -----------------------------------------------------
+
+
+def test_compile_cache_info_reports_the_configured_dir(tmp_path):
+    import jax
+
+    info = collectors.compile_cache_info()
+    assert set(info) == {"persistent_cache_dir", "enabled"}
+    assert isinstance(info["enabled"], bool)
+
+    before = jax.config.jax_compilation_cache_dir
+    try:
+        jax.config.update("jax_compilation_cache_dir", str(tmp_path))
+        on = collectors.compile_cache_info()
+        assert on["enabled"] is True
+        assert on["persistent_cache_dir"] == str(tmp_path)
+    finally:
+        jax.config.update("jax_compilation_cache_dir", before)
+
+
+# ---- phase_snapshot ---------------------------------------------------------
+
+
+def test_phase_snapshot_none_and_live_timers():
+    assert collectors.phase_snapshot(None) == {}
+    timers = PhaseTimers()
+    with timers.phase("graph_load"):
+        pass
+    with timers.phase("graph_load"):
+        pass
+    snap = collectors.phase_snapshot(timers)
+    assert snap["graph_load"]["count"] == 2
+    assert snap["graph_load"]["total_s"] >= 0.0

@@ -57,6 +57,49 @@ def test_registry_writes_validated_jsonl(tmp_path, monkeypatch):
     assert events[2]["wire_bytes_fwd"] == 4096
 
 
+def test_stream_size_guard_rotates_with_loud_marker(tmp_path, monkeypatch):
+    """NTS_METRICS_MAX_MB: the stream rotates instead of growing without
+    bound; the fresh file opens with a schema-valid stream_rotated marker,
+    seq stays monotonic across the rotation, and only ONE previous chunk
+    is retained (bounded disk)."""
+    monkeypatch.setenv("NTS_METRICS_MAX_MB", "0.002")  # ~2 KB
+    reg = registry.MetricsRegistry(
+        "run-rot", algorithm="GCN", fingerprint="f",
+        path=str(tmp_path / "rot.jsonl"),
+    )
+    for i in range(60):
+        reg.epoch_event(i, 0.1, loss=1.0)
+    reg.close()
+    assert reg.rotations >= 1
+    assert (tmp_path / "rot.jsonl.1").exists()
+    assert not (tmp_path / "rot.jsonl.2").exists()
+    # both the live file and the retained chunk stay schema-valid; the
+    # live file leads with the loud marker
+    live = [json.loads(l) for l in open(tmp_path / "rot.jsonl")]
+    old = [json.loads(l) for l in open(tmp_path / "rot.jsonl.1")]
+    assert schema.validate_stream(live) == len(live)
+    assert schema.validate_stream(old) == len(old)
+    assert live[0]["event"] == "stream_rotated"
+    assert "NTS_METRICS_MAX_MB" in live[0]["reason"]
+    seqs = [e["seq"] for e in old + live]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+    # the cap actually bounds the live file (marker + tail, not 60 epochs)
+    assert os.path.getsize(tmp_path / "rot.jsonl") <= 4096
+
+
+def test_stream_size_guard_off_by_default(tmp_path, monkeypatch):
+    monkeypatch.delenv("NTS_METRICS_MAX_MB", raising=False)
+    reg = registry.MetricsRegistry(
+        "run-nr", algorithm="GCN", fingerprint="f",
+        path=str(tmp_path / "nr.jsonl"),
+    )
+    for i in range(200):
+        reg.epoch_event(i, 0.1)
+    reg.close()
+    assert reg.rotations == 0
+    assert not (tmp_path / "nr.jsonl.1").exists()
+
+
 def test_config_fingerprint_stable_and_sensitive():
     from neutronstarlite_tpu.utils.config import InputInfo
 

@@ -1,0 +1,147 @@
+"""Schema round-trip: every typed record kind constructs, validates, and
+report-renders.
+
+The contract this file enforces: ``obs/schema.KNOWN_KINDS`` is the closed
+list of typed records, and EVERY kind must have (a) a factory here that
+builds a valid instance, (b) an entry in RENDER_MARKERS naming the string
+its renderer leaves in the metrics_report output (None only for records
+whose rendering story is explicitly "envelope-only"). Adding a record kind
+to the schema without extending this file — or without renderer support —
+fails tier-1 instead of shipping silently unrenderable telemetry.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from neutronstarlite_tpu.obs import registry, schema
+
+# ---- one factory per typed kind (emitted through a real registry so the
+# envelope is the production one) --------------------------------------------
+
+
+def _emit_all(reg: registry.MetricsRegistry) -> None:
+    reg.event("run_start", algorithm="GCNDIST", fingerprint="cafecafecafe",
+              seed=0, process_index=0, pid=1234)
+    reg.event("epoch", epoch=0, seconds=0.5, loss=1.25)
+    reg.event("ring_step", epoch=0, step=1, bytes=4096, skipped=False,
+              seconds=None, epoch_span="s1")
+    reg.event("fault", kind="nonfinite_loss", epoch=1, attempt=1,
+              injected=True)
+    reg.event("recovery", action="rollback", epoch=1, attempt=1)
+    reg.event("serve_request", n_seeds=2, status="ok", total_ms=3.5,
+              queue_ms=1.0, req_id="q1", flush_id=0)
+    reg.event("batch_flush", n_requests=1, n_seeds=2, reason="deadline",
+              bucket=4, exec_ms=2.0, flush_id=0)
+    reg.event("shed", reason="queue_full (depth 8)", queue_depth=8,
+              req_id="q2")
+    reg.event(
+        "serve_summary", requests=1, shed=1,
+        latency_ms={"p50": 3.5, "p95": 3.5, "p99": None},
+        throughput_rps=10.0, counters={"serve.requests": 1},
+    )
+    reg.event(
+        "span", name="epoch", cat="epoch", span_id="s1",
+        trace_id=reg.run_id, parent_id=None, t0=10.0, dur_s=0.5,
+        rank=0, thread="MainThread", epoch=0,
+    )
+    reg.event("stream_rotated",
+              reason="NTS_METRICS_MAX_MB: stream exceeded 1 MB",
+              rotated_to="x.jsonl.1", bytes_written=1048600)
+    reg.event(
+        "run_summary", algorithm="GCNDIST", fingerprint="cafecafecafe",
+        counters={"wire.bytes_fwd": 4096}, gauges={}, timings={},
+        epochs=1,
+        epoch_time={"first_s": 0.5, "warm_median_s": None,
+                    "compile_overhead_s": None},
+        avg_epoch_s=0.5, epoch_times_s=[0.5], loss_history=[1.25],
+        phases={}, memory={"available": False, "bytes_in_use": None,
+                           "peak_bytes_in_use": None, "devices": []},
+    )
+
+
+# the string each kind's renderer leaves in the metrics_report text output.
+# None is an EXPLICIT decision that the kind is envelope-only context
+# (run_start parameterizes the header; it has no line of its own).
+RENDER_MARKERS = {
+    "run_start": None,
+    "epoch": "#epochs=",
+    "ring_step": "ring-pipelined exchange:",
+    "fault": "kind=nonfinite_loss",
+    "recovery": "action=rollback",
+    "serve_request": "finish serving !",
+    "batch_flush": "#batches=",
+    "shed": "#shed=",
+    "serve_summary": "#p99_latency=",
+    "span": "span timeline:",
+    "stream_rotated": "stream_rotated",
+    "run_summary": "finish algorithm !",
+}
+
+
+def test_every_known_kind_has_a_factory_and_a_render_decision():
+    """The enforcement hook: extend KNOWN_KINDS -> extend this file."""
+    assert set(RENDER_MARKERS) == set(schema.KNOWN_KINDS)
+
+
+def test_roundtrip_construct_validate_render(tmp_path, capsys):
+    path = tmp_path / "all_kinds.jsonl"
+    reg = registry.MetricsRegistry(
+        "gcndist-cafecafecafe-1234", algorithm="GCNDIST",
+        fingerprint="cafecafecafe", path=str(path),
+    )
+    _emit_all(reg)
+    reg.close()
+
+    events = [json.loads(line) for line in open(path) if line.strip()]
+    # construct -> validate: every KNOWN kind present and schema-valid
+    assert schema.validate_stream(events) == len(events)
+    assert {e["event"] for e in events} == set(schema.KNOWN_KINDS)
+
+    # -> render: the report CLI accepts the stream and every kind's
+    # renderer left its marker
+    from neutronstarlite_tpu.tools.metrics_report import main as report_main
+
+    rc = report_main([str(path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    for kind, marker in RENDER_MARKERS.items():
+        if marker is not None:
+            assert marker in out, (
+                f"record kind {kind!r} left no {marker!r} in the report — "
+                "renderer support missing"
+            )
+
+
+def test_validator_rejects_mutations_per_kind(tmp_path):
+    """Each typed kind's validator actually bites: one representative
+    field violation per kind must raise."""
+    path = tmp_path / "k.jsonl"
+    reg = registry.MetricsRegistry("r", algorithm="A", fingerprint="f",
+                                   path=str(path))
+    _emit_all(reg)
+    reg.close()
+    events = {e["event"]: e for e in
+              (json.loads(line) for line in open(path) if line.strip())}
+
+    mutations = {
+        "run_start": {"algorithm": 7},
+        "epoch": {"seconds": 0},
+        "ring_step": {"step": 0},
+        "fault": {"kind": ""},
+        "recovery": {"action": ""},
+        "serve_request": {"n_seeds": 0},
+        "batch_flush": {"reason": ""},
+        "shed": {"reason": ""},
+        "serve_summary": {"latency_ms": "fast"},
+        "span": {"dur_s": -1.0},
+        "stream_rotated": {"bytes_written": "lots"},
+        "run_summary": {"epoch_time": None},
+    }
+    assert set(mutations) == set(schema.KNOWN_KINDS)
+    for kind, mut in mutations.items():
+        bad = dict(events[kind], **mut)
+        with pytest.raises(ValueError):
+            schema.validate_event(bad)
