@@ -118,6 +118,11 @@ class ToolkitBase:
     # DeviceGraph upload would be O(E) wasted HBM for them
     needs_device_graph = True
 
+    # trainers whose build_model honors KERNEL:fused_edge (the attention/
+    # edge-op families: GAT / GGCN and their dist twins) set this True;
+    # everywhere else the key refuses loudly (see _check_kernel)
+    supports_fused_edge = False
+
     # ---- init_graph ------------------------------------------------------
     def _wants_ell(self) -> bool:
         """True when build_model will replace the DeviceGraph with ELL tables
@@ -126,8 +131,21 @@ class ToolkitBase:
             self.cfg.optim_kernel and getattr(type(self), "supports_optim_kernel", False)
         )
 
+    def _wants_fused_edge(self) -> bool:
+        """True when build_model will route the edge chain through the
+        fused blocked kernel (KERNEL:fused_edge, ops/fused_edge.py) —
+        the DeviceGraph edge arrays are dead weight on that path too."""
+        return bool(
+            self.cfg.kernel == "fused_edge"
+            and getattr(type(self), "supports_fused_edge", False)
+        )
+
     def _build_device_graph(self) -> bool:
-        return type(self).needs_device_graph and not self._wants_ell()
+        return (
+            type(self).needs_device_graph
+            and not self._wants_ell()
+            and not self._wants_fused_edge()
+        )
 
     def init_graph(self) -> None:
         cfg = self.cfg
@@ -208,7 +226,38 @@ class ToolkitBase:
                 "ALGORITHM %s ignores it", cfg.algorithm,
             )
 
+    def _check_kernel(self) -> None:
+        """Kernel-selection loudness at the lifecycle funnel (the PR 4
+        DIST_PATH refusal pattern): a knob that would otherwise be
+        silently ignored must refuse, not run a different kernel than the
+        user is benchmarking."""
+        cfg = self.cfg
+        if cfg.pallas_kernel and not cfg.optim_kernel:
+            raise ValueError(
+                "PALLAS:1 requires OPTIM_KERNEL:1 — the Pallas block-sparse "
+                "kernel is a layout of the OPTIM_KERNEL aggregation path "
+                "and would be silently ignored without it; set "
+                "OPTIM_KERNEL:1 (or drop PALLAS:1)"
+            )
+        if cfg.kernel == "fused_edge":
+            if not getattr(type(self), "supports_fused_edge", False):
+                raise ValueError(
+                    f"KERNEL:fused_edge is not available for ALGORITHM "
+                    f"{cfg.algorithm!r}: the fused SDDMM+softmax+SpMM kernel "
+                    "serves the attention/edge-op families (GATCPU / GGCNCPU "
+                    "and their dist twins GATDIST / GGCNDIST); other "
+                    "families aggregate through OPTIM_KERNEL/PALLAS instead"
+                )
+            if cfg.optim_kernel or cfg.pallas_kernel:
+                raise ValueError(
+                    "KERNEL:fused_edge and OPTIM_KERNEL/PALLAS select "
+                    "different kernel stacks for the same chain — choose "
+                    "one (the fused kernel already subsumes the scatter-"
+                    "free attention path)"
+                )
+
     def _finalize_datum(self) -> None:
+        self._check_kernel()
         self._check_dist_path()
         self.feature = jnp.asarray(self.datum.feature)
         self.label = jnp.asarray(self.datum.label.astype(np.int32))

@@ -68,7 +68,26 @@ class FullBatchTrainer(ToolkitBase):
                 cfg.algorithm,
             )
         self.compute_graph = self.graph
-        if self._wants_ell():
+        if self._wants_fused_edge():
+            # KERNEL:fused_edge — the blocked streaming fused edge kernel
+            # (ops/fused_edge.py). Like the ELL paths, the DeviceGraph
+            # edge arrays are dead weight here (base.init_graph already
+            # skipped the upload when it saw this path coming).
+            self.graph = None
+            from neutronstarlite_tpu.ops.fused_edge import FusedEdgePair
+
+            self.compute_graph = FusedEdgePair.from_host(
+                self.host_graph, vt=cfg.kernel_tile
+            )
+            log.info(
+                "KERNEL:fused_edge: blocked streaming SDDMM+softmax+SpMM "
+                "(%d src tiles of %d, %d fwd levels, %d table slots)",
+                self.compute_graph.fwd.n_tiles,
+                self.compute_graph.fwd.vt,
+                len(self.compute_graph.fwd.nbr),
+                self.compute_graph.slot_count(),
+            )
+        elif self._wants_ell():
             # drop the (unused on this path) DeviceGraph edge arrays BEFORE
             # shipping the ELL tables so peak HBM never holds both O(E)
             # structures (base.init_graph also skips the device upload when
@@ -134,6 +153,8 @@ class FullBatchTrainer(ToolkitBase):
             # trainer-specific table adaptation (e.g. GAT wraps the plain
             # EllPair with the attention slot maps); default is identity
             self.compute_graph = self.adapt_ell_graph(self.compute_graph)
+        if getattr(type(self), "edge_family", False):
+            self._emit_edge_kernel_gauges()
         key = jax.random.PRNGKey(self.seed)
         self.params = self.init_params(key)
         self.adam_cfg = AdamConfig(
@@ -196,6 +217,47 @@ class FullBatchTrainer(ToolkitBase):
             return adam_update(params, grads, opt_state, adam_cfg)
 
         self._optim_step = optim_step
+
+    # score-channel width per output width: GAT's decomposed attention is
+    # scalar (C=1); GGCN's per-channel gate overrides with C=f'
+    @staticmethod
+    def edge_score_channels(f_out: int) -> int:
+        return 1
+
+    def _emit_edge_kernel_gauges(self) -> None:
+        """``kernel.*`` gauges for the attention/edge families: which
+        kernel the chain runs and the estimated per-epoch HBM bytes of
+        [Ep, .]-shaped edge tensors it materializes — the traffic the
+        fused path eliminates (exactly 0 there; the diff gate in
+        scripts/ci_tier1.sh pins that structurally). The eager estimate
+        per layer is 2 feature-wide edge passes (the aggregation's gather
+        + its backward scatter) plus 3 score-width passes (score,
+        softmax, softmax backward), f32."""
+        from neutronstarlite_tpu.ops.fused_edge import FusedEdgePair
+
+        cg = self.compute_graph
+        sizes = self.cfg.layer_sizes()
+        if isinstance(cg, FusedEdgePair):
+            path, edge_bytes = "fused_edge", 0
+            self.metrics.gauge_set(
+                "kernel.fused_levels", len(cg.fwd.nbr)
+            )
+            self.metrics.gauge_set("kernel.fused_slots", cg.slot_count())
+            self.metrics.gauge_set("kernel.fused_vt", cg.fwd.vt)
+        else:
+            from neutronstarlite_tpu.ops.device_graph import DeviceGraph
+
+            path = "eager_edge" if isinstance(cg, DeviceGraph) else "ell_gat"
+            if isinstance(cg, DeviceGraph):
+                ep = cg.e_pad
+                edge_bytes = sum(
+                    ep * (2 * f + 3 * type(self).edge_score_channels(f)) * 4
+                    for f in sizes[1:]
+                )
+            else:
+                edge_bytes = 0  # the ELL attention path is edge-tensor-free
+        self.metrics.gauge_set("kernel.path", path)
+        self.metrics.gauge_set("kernel.edge_hbm_bytes_per_epoch", edge_bytes)
 
     def debug_info(self, key, n: int = 3) -> str:
         """Per-phase epoch breakdown, DEBUGINFO's role (GCN.hpp:308-353).

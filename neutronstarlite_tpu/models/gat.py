@@ -78,10 +78,34 @@ def gat_layer_ell(gep, W, a, x, last: bool):
     return out if last else jax.nn.relu(out)
 
 
+def gat_layer_fused(fep, W, a, x, last: bool):
+    """The same layer over the blocked streaming fused kernel
+    (KERNEL:fused_edge, ops/fused_edge.py): SDDMM + online per-dst softmax
+    + SpMM in one streamed pass, no [Ep, f] edge tensors. The decomposed
+    score halves al/ar are MXU matmuls, so the attention-vector gradient
+    flows through them from the kernel's grad_asrc/grad_adst."""
+    from neutronstarlite_tpu.ops.fused_edge import (
+        fused_edge_attention_aggregate,
+    )
+
+    h = x @ W
+    f = h.shape[1]
+    al = h @ a[:f]  # [V, 1] source half of the decomposed attention
+    ar = h @ a[f:]
+    out = fused_edge_attention_aggregate(fep, h, al, ar, LEAKY_SLOPE)
+    return out if last else jax.nn.relu(out)
+
+
 def gat_forward(graph, params, x, key, drop_rate: float, train: bool):
     from neutronstarlite_tpu.ops.ell_gat import GatEllPair
+    from neutronstarlite_tpu.ops.fused_edge import FusedEdgePair
 
-    layer_fn = gat_layer_ell if isinstance(graph, GatEllPair) else gat_layer
+    if isinstance(graph, FusedEdgePair):
+        layer_fn = gat_layer_fused
+    elif isinstance(graph, GatEllPair):
+        layer_fn = gat_layer_ell
+    else:
+        layer_fn = gat_layer
     n = len(params)
     for i, layer in enumerate(params):
         x = layer_fn(graph, layer["W"], layer["a"], x, i == n - 1)
@@ -96,6 +120,9 @@ class GATTrainer(FullBatchTrainer):
     weight_mode = "ones"
     # OPTIM_KERNEL:1 -> the fused ELL attention path (scatter-free)
     supports_optim_kernel = True
+    # KERNEL:fused_edge -> the blocked streaming fused kernel
+    supports_fused_edge = True
+    edge_family = True  # emits the kernel.* edge-traffic gauges
 
     def init_params(self, key):
         return init_gat_params(key, self.cfg.layer_sizes())

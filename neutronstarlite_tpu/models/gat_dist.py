@@ -106,6 +106,42 @@ def dist_gat_forward(mesh, mg, tables, params, x, key, drop_rate: float,
     return x
 
 
+def dist_gat_fused_forward(mesh, mg, pair, params, x, key, drop_rate: float,
+                           train: bool, nn_only: bool = False,
+                           compute_dtype=None):
+    """KERNEL:fused_edge — the whole edge chain per layer is ONE ring-
+    pipelined fused kernel application (parallel/dist_fused_edge.py): the
+    [vp, f'+1] payload circulates hop by hop while the online-softmax
+    state stays local, so no [El, f]-shaped edge tensors exist anywhere.
+    ``mg`` is unused (no mirror tables on this path); ``pair`` is the
+    RingFusedEdgePair riding the jit boundary as the tables argument.
+    ``compute_dtype=jnp.bfloat16`` ships a bf16 ring payload (half the
+    ICI bytes) while the kernel's state stays f32."""
+    from neutronstarlite_tpu.parallel.dist_fused_edge import (
+        dist_fused_edge_aggregate,
+    )
+
+    cast = compute_cast(compute_dtype)
+    x = cast(x)
+    n = len(params)
+    for i, layer in enumerate(params):
+        h = x @ cast(layer["W"])  # [P*vp, f'], params replicated
+        f = h.shape[1]
+        al = h @ cast(layer["a"][:f])  # decomposed attention halves
+        ar = h @ cast(layer["a"][f:])
+        if nn_only:
+            out = jnp.zeros_like(h, dtype=jnp.float32)
+        else:
+            out = dist_fused_edge_aggregate(
+                mesh, pair, h, al, ar, LEAKY_SLOPE
+            )
+        out = out.astype(jnp.float32)  # activations between layers stay f32
+        x = out if i == n - 1 else jax.nn.relu(out)
+        if train and i < n - 1:
+            x = dropout(jax.random.fold_in(key, i), x, drop_rate, train)
+    return x
+
+
 @register_algorithm("GATCPUDIST", "GATGPUDIST", "GATDIST", "GATCPUDISTOPTM")
 class DistGATTrainer(ToolkitBase):
     """Vertex-sharded full-batch GAT (PARTITIONS cfg key picks the mesh)."""
@@ -116,6 +152,10 @@ class DistGATTrainer(ToolkitBase):
     # drop_rate, train) — DistGGCNTrainer overrides this and
     # init_model_params only (decoupled graph-op/NN-op split)
     model_forward_fn = staticmethod(dist_gat_forward)
+    # KERNEL:fused_edge — the ring-pipelined fused edge kernel
+    # (parallel/dist_fused_edge.py); same signature, pair as tables
+    fused_forward_fn = staticmethod(dist_gat_fused_forward)
+    supports_fused_edge = True
 
     def init_model_params(self, key):
         return init_gat_params(key, self.cfg.layer_sizes())
@@ -126,28 +166,100 @@ class DistGATTrainer(ToolkitBase):
         GAT's payload is [h || h.a_src] (f'+1); GGCN overrides (2f')."""
         return f_out + 1
 
+    @staticmethod
+    def edge_score_channels(f_out: int) -> int:
+        """Score-channel width C of the decomposed attention halves (the
+        fused kernel's payload/pricing knob): GAT is scalar."""
+        return 1
+
     @classmethod
     def bind_forward(cls, cfg):
-        """The forward fn with the cfg's precision policy bound — ONE
-        definition shared by build_model and tools/aot_check, so the AOT
-        capacity numbers always measure the program the trainer ships."""
-        forward = cls.model_forward_fn
+        """The forward fn with the cfg's kernel + precision policy bound —
+        ONE definition shared by build_model and tools/aot_check, so the
+        AOT capacity numbers always measure the program the trainer
+        ships."""
+        forward = (
+            cls.fused_forward_fn
+            if cfg.kernel == "fused_edge"
+            else cls.model_forward_fn
+        )
         if cfg.precision == "bfloat16":
             # PRECISION:bfloat16 — same compute policy as the GCN family:
-            # bf16 matmuls + exchange (the all_to_all ships half the
-            # bytes), f32 params/activations, wide accumulation
+            # bf16 matmuls + exchange (the all_to_all / ring payload ships
+            # half the bytes), f32 params/activations, wide accumulation
             from functools import partial
 
             forward = partial(forward, compute_dtype=jnp.bfloat16)
         return forward
 
-    # DIST_PATH/WIRE_DTYPE refusal lives in ToolkitBase._check_dist_path
-    # (supports_dist_path stays False: the attention exchange is
-    # mirror-based, not a dense-feature DIST_PATH)
+    def _check_dist_path(self) -> None:
+        """KERNEL:fused_edge runs a ring exchange, so DIST_PATH may name
+        the ring family (ring_blocked = real collectives, ring_blocked_sim
+        = the collective-free CI twin); anything else keeps the base
+        refusal (the mirror chain is not a dense-feature DIST_PATH)."""
+        cfg = self.cfg
+        if cfg.kernel == "fused_edge":
+            if cfg.dist_path not in (
+                "", "auto", "ring_blocked", "ring_blocked_sim"
+            ):
+                raise ValueError(
+                    f"DIST_PATH:{cfg.dist_path} is not available with "
+                    "KERNEL:fused_edge — the fused edge kernel runs the "
+                    "ring schedule (ring_blocked / ring_blocked_sim)"
+                )
+            if getattr(cfg, "wire_dtype", "") or os.environ.get(
+                "NTS_WIRE_DTYPE"
+            ):
+                log.warning(
+                    "WIRE_DTYPE/NTS_WIRE_DTYPE is ignored on the fused "
+                    "edge ring: the payload ships the compute dtype "
+                    "(PRECISION:bfloat16 halves it)"
+                )
+            return
+        super()._check_dist_path()
+
+    def _build_fused_graph(self, P: int):
+        """DistGraph partition blocks + the ring fused tables; returns the
+        padded-vertex-space provider (the mirror path's MirrorGraph role)."""
+        from neutronstarlite_tpu.parallel.dist_fused_edge import (
+            RingFusedEdgePair,
+        )
+        from neutronstarlite_tpu.parallel.dist_graph import DistGraph
+        from neutronstarlite_tpu.parallel.dist_ring_blocked import (
+            default_ring_vt,
+        )
+
+        self.dist = DistGraph.build(self.host_graph, P)
+        vt = default_ring_vt(self.dist.vp, self.cfg.kernel_tile)
+        pair = RingFusedEdgePair.build(self.dist, vt)
+        self.tables = pair.shard(self.mesh) if self.mesh is not None else pair
+        self.metrics.gauge_set("kernel.path", "fused_edge")
+        self.metrics.gauge_set("kernel.fused_vt", vt)
+        # same geometry gauges as the single-chip fused path (fullbatch's
+        # _emit_edge_kernel_gauges): levels = stacked level tables across
+        # all ring steps, slots = fwd + transposed table capacity
+        self.metrics.gauge_set(
+            "kernel.fused_levels", sum(len(ls) for ls in pair.fwd.nbr)
+        )
+        self.metrics.gauge_set(
+            "kernel.fused_slots",
+            pair.fwd.slot_count() + pair.bwd.slot_count(),
+        )
+        self.metrics.gauge_set("kernel.edge_hbm_bytes_per_epoch", 0)
+        return self.dist
 
     def build_model(self) -> None:
         cfg = self.cfg
+        if cfg.kernel == "fused_edge" and cfg.dist_path == "ring_blocked_sim":
+            # the explicit sim spelling forces the collective-free twin
+            # (NTS_DIST_SIMULATE=1 parity)
+            self.simulate = True
         self.mesh, P = self.resolve_mesh()
+        if cfg.kernel == "fused_edge":
+            self.mg = None
+            space = self._build_fused_graph(P)
+            self._finish_build(space)
+            return
         self.mg = MirrorGraph.build(self.host_graph, P)
         # the *_sim ops re-derive the tables from mg; only the sharded path
         # consumes device-put tables
@@ -180,8 +292,15 @@ class DistGATTrainer(ToolkitBase):
                 "remat'd per chunk",
                 ch.slot.shape[1], ch.slot.shape[2], ch.dp,
             )
+        self._finish_build(self.mg)
 
-        pad = self.mg.pad_vertex_array
+    def _finish_build(self, space) -> None:
+        """The kernel-independent tail of build_model: padded vertex
+        arrays, params, wire counters, and the jitted programs. ``space``
+        provides the padded vertex space (MirrorGraph on the mirror chain,
+        DistGraph on the fused ring)."""
+        cfg = self.cfg
+        pad = space.pad_vertex_array
         if self.mesh is not None:
             vsh = NamedSharding(self.mesh, PS(PARTITION_AXIS, None))
             vsh1 = NamedSharding(self.mesh, PS(PARTITION_AXIS))
@@ -196,7 +315,7 @@ class DistGATTrainer(ToolkitBase):
         self.train01_p = put(pad(train01), vsh1)
         # pad fill -1 so padding rows match no mask split in the eval counters
         self.mask_p = put(pad(self.datum.mask, fill=-1), vsh1)
-        self.valid_p = put(self.mg.valid_mask(), vsh1)
+        self.valid_p = put(space.valid_mask(), vsh1)
 
         key = jax.random.PRNGKey(self.seed)
         params = self.init_model_params(key)
@@ -210,28 +329,57 @@ class DistGATTrainer(ToolkitBase):
         self.opt_state = jax.tree.map(lambda a: put(a, rsh), adam_init(params))
 
         # live wire counters (obs): the mirror all_to_all ships the
-        # compacted payload rows at each layer's payload width; priced by
-        # the same row formula tools/wire_accounting reports offline.
-        # ``wire.simulated=1`` marks the collective-free sim rig, where
-        # the volume is what WOULD cross a real interconnect.
+        # compacted payload rows at each layer's payload width; the fused
+        # ring ships (P-1)*vp shard rows of [h || asrc] per layer. Both
+        # priced by the row formulas tools/wire_accounting reports
+        # offline. ``wire.simulated=1`` marks the collective-free sim
+        # rig, where the volume is what WOULD cross a real interconnect.
         from neutronstarlite_tpu.tools.wire_accounting import (
             exchange_rows_per_device,
         )
 
         sizes = cfg.layer_sizes()
-        rows = exchange_rows_per_device(
-            "mirror", self.mg.partitions, self.mg.vp, self.mg.mb
-        )
-        cols = sum(type(self).mirror_payload_width(f) for f in sizes[1:])
+        fused = cfg.kernel == "fused_edge"
+        if fused:
+            from neutronstarlite_tpu.parallel.dist_fused_edge import (
+                fused_wire_cols,
+            )
+
+            rows = exchange_rows_per_device("ring", space.partitions, space.vp)
+            cols = sum(
+                fused_wire_cols(f, type(self).edge_score_channels(f))["fwd"]
+                for f in sizes[1:]
+            )
+        else:
+            rows = exchange_rows_per_device(
+                "mirror", space.partitions, space.vp, space.mb
+            )
+            cols = sum(type(self).mirror_payload_width(f) for f in sizes[1:])
         itemsize = 2 if cfg.precision == "bfloat16" else 4
         self._wire_exchanges_per_epoch = len(sizes) - 1
         self._wire_bytes_fwd_per_epoch = rows * cols * itemsize
-        self.metrics.gauge_set("wire.comm_layer", "mirror")
+        self.metrics.gauge_set(
+            "wire.comm_layer", "ring_fused" if fused else "mirror"
+        )
         self.metrics.gauge_set("wire.rows_per_layer", rows)
         self.metrics.gauge_set(
             "wire.bytes_per_epoch_fwd", self._wire_bytes_fwd_per_epoch
         )
         self.metrics.gauge_set("wire.simulated", int(self.mesh is None))
+        if not fused:
+            # the eager mirror chain materializes [El, .]-shaped edge
+            # tensors per device per layer — the traffic class the fused
+            # kernel eliminates (same estimate family as the single-chip
+            # gauge: 2 feature-wide passes + 3 score-width passes, f32)
+            self.metrics.gauge_set("kernel.path", "eager_edge")
+            self.metrics.gauge_set(
+                "kernel.edge_hbm_bytes_per_epoch",
+                sum(
+                    space.el
+                    * (2 * f + 3 * type(self).edge_score_channels(f)) * 4
+                    for f in sizes[1:]
+                ),
+            )
 
         mesh, mg, tables = self.mesh, self.mg, self.tables
         drop_rate = cfg.drop_rate
@@ -315,13 +463,20 @@ class DistGATTrainer(ToolkitBase):
     def run(self) -> Dict[str, Any]:
         cfg = self.cfg
         key = jax.random.PRNGKey(self.seed + 1)
-        log.info(
-            "GNNmini::Engine[Dist.TPU.GATimpl] %d partitions (Mb=%d El=%d), [%d] Epochs",
-            self.mg.partitions,
-            self.mg.mb,
-            self.mg.el,
-            cfg.epochs,
-        )
+        if self.mg is not None:
+            log.info(
+                "GNNmini::Engine[Dist.TPU.GATimpl] %d partitions (Mb=%d El=%d), [%d] Epochs",
+                self.mg.partitions,
+                self.mg.mb,
+                self.mg.el,
+                cfg.epochs,
+            )
+        else:  # KERNEL:fused_edge — the ring fused tables replace the mirrors
+            log.info(
+                "GNNmini::Engine[Dist.TPU.GATimpl] %d partitions "
+                "(fused_edge ring, vp=%d), [%d] Epochs",
+                self.dist.partitions, self.dist.vp, cfg.epochs,
+            )
         start_epoch = self.ckpt_begin()
         loss = None
         for epoch in range(start_epoch, cfg.epochs):

@@ -76,10 +76,33 @@ def ggcn_layer(graph: DeviceGraph, layer, x, last: bool):
     return out if last else jax.nn.relu(out)
 
 
+def ggcn_layer_fused(fep, layer, x, last: bool):
+    """The same layer over the blocked streaming fused kernel
+    (KERNEL:fused_edge, ops/fused_edge.py) with C = f' CHANNELS: the
+    per-channel gate score/softmax runs as the fused online softmax with
+    f'-wide running statistics; the edge-NN weight gradients (Ws/Wd) flow
+    through the hs/hd matmuls from grad_asrc/grad_adst."""
+    from neutronstarlite_tpu.ops.fused_edge import (
+        fused_edge_attention_aggregate,
+    )
+
+    h = x @ layer["W"]
+    hs = h @ layer["Ws"]  # [V, f'] source half of the decomposed edge NN
+    hd = h @ layer["Wd"]  # dst half
+    out = fused_edge_attention_aggregate(fep, h, hs, hd, GGCN_LEAKY_SLOPE)
+    return out if last else jax.nn.relu(out)
+
+
 def ggcn_forward(graph, params, x, key, drop_rate: float, train: bool):
+    from neutronstarlite_tpu.ops.fused_edge import FusedEdgePair
+
+    fused = isinstance(graph, FusedEdgePair)
     n = len(params)
     for i, layer in enumerate(params):
-        x = ggcn_layer(graph, layer, x, i == n - 1)
+        if fused:
+            x = ggcn_layer_fused(graph, layer, x, i == n - 1)
+        else:
+            x = ggcn_layer(graph, layer, x, i == n - 1)
         if train and i < n - 1:
             x = dropout(jax.random.fold_in(key, i), x, drop_rate, train)
     return x
@@ -88,6 +111,16 @@ def ggcn_forward(graph, params, x, key, drop_rate: float, train: bool):
 @register_algorithm("GGCNCPU", "GGCN", "GGNN")
 class GGCNTrainer(FullBatchTrainer):
     weight_mode = "ones"  # the learned gate supplies edge weights
+    # KERNEL:fused_edge -> the blocked streaming fused kernel (the chain's
+    # multi-channel softmax runs as the C=f' online softmax)
+    supports_fused_edge = True
+    edge_family = True  # emits the kernel.* edge-traffic gauges
+
+    @staticmethod
+    def edge_score_channels(f_out: int) -> int:
+        """GGCN's gate is per-channel: the edge score/softmax tensors are
+        f'-wide (the kernel gauge pricing; GAT's scalar C=1 is the base)."""
+        return f_out
 
     def init_params(self, key):
         return init_ggcn_params(key, self.cfg.layer_sizes())

@@ -80,11 +80,45 @@ def dist_ggcn_forward(mesh, mg, tables, params, x, key, drop_rate: float,
     return x
 
 
+def dist_ggcn_fused_forward(mesh, mg, pair, params, x, key, drop_rate: float,
+                            train: bool, nn_only: bool = False,
+                            compute_dtype=None):
+    """KERNEL:fused_edge — the gated chain as ONE ring-pipelined fused
+    kernel per layer with C = f' CHANNELS (per-channel online softmax):
+    the [vp, 2f'] payload [h || Ws.h] circulates, the dst half Wd.h stays
+    local, no [El, f] edge tensors anywhere (see dist_gat_fused_forward)."""
+    from neutronstarlite_tpu.parallel.dist_fused_edge import (
+        dist_fused_edge_aggregate,
+    )
+
+    from neutronstarlite_tpu.nn.layers import compute_cast
+
+    cast = compute_cast(compute_dtype)
+    x = cast(x)
+    n = len(params)
+    for i, layer in enumerate(params):
+        h = x @ cast(layer["W"])  # [P*vp, f']
+        hs = h @ cast(layer["Ws"])  # source half of the decomposed edge NN
+        hd = h @ cast(layer["Wd"])  # dst half, stays local
+        if nn_only:
+            out = jnp.zeros_like(h, dtype=jnp.float32)
+        else:
+            out = dist_fused_edge_aggregate(
+                mesh, pair, h, hs, hd, GGCN_LEAKY_SLOPE
+            )
+        out = out.astype(jnp.float32)
+        x = out if i == n - 1 else jax.nn.relu(out)
+        if train and i < n - 1:
+            x = dropout(jax.random.fold_in(key, i), x, drop_rate, train)
+    return x
+
+
 @register_algorithm("GGCNDIST", "GGCNCPUDIST", "GGNNDIST")
 class DistGGCNTrainer(DistGATTrainer):
     """Vertex-sharded full-batch GGCN (PARTITIONS cfg key picks the mesh)."""
 
     model_forward_fn = staticmethod(dist_ggcn_forward)
+    fused_forward_fn = staticmethod(dist_ggcn_fused_forward)
 
     def init_model_params(self, key):
         return init_ggcn_params(key, self.cfg.layer_sizes())
@@ -94,3 +128,8 @@ class DistGGCNTrainer(DistGATTrainer):
         """GGCN's mirror payload is [h || Ws.h] — 2f' columns per row
         (wire-counter pricing; see DistGATTrainer.mirror_payload_width)."""
         return 2 * f_out
+
+    @staticmethod
+    def edge_score_channels(f_out: int) -> int:
+        """GGCN's gate is per-channel: C = f' (fused payload/pricing)."""
+        return f_out

@@ -58,6 +58,7 @@ ops.aggregate.gather_dst_from_src.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import List
 
 import jax
@@ -72,6 +73,76 @@ from neutronstarlite_tpu.utils.logging import get_logger
 log = get_logger("blocked_ell")
 
 _MIN_K = 4
+
+
+def resolve_levels(levels: str = "") -> str:
+    """Level-construction mode for the stacked tables: ``pow2`` (the
+    original ladder — K = next power of two of each (tile, dst) run) or
+    ``binned`` (Accel-GCN-style degree binning: K values are the observed
+    run-length quantiles rounded up to ``_MIN_K`` multiples, so a skewed
+    graph's rows don't pad to the pow2 ceiling — a 130-edge run lands in a
+    132-slot row, not 256). ``""`` resolves NTS_ELL_LEVELS then ``pow2``
+    (the fused edge path defaults to ``binned`` at its call site)."""
+    lv = levels or os.environ.get("NTS_ELL_LEVELS", "") or "pow2"
+    if lv not in ("pow2", "binned"):
+        raise ValueError(
+            f"ELL level mode must be pow2 or binned, got {lv!r} "
+            "(NTS_ELL_LEVELS or the build's levels= argument)"
+        )
+    return lv
+
+
+def _binned_row_k(
+    row_len: np.ndarray, row_tile: np.ndarray, n_tiles: int
+) -> np.ndarray:
+    """Per-row level capacity, degree-binned (Accel-GCN's bucketing idea
+    re-derived for the stacked-tile layout). Start from the pow2 ladder's
+    degree bins, then fit each bin's capacity to the DATA:
+
+    - a bin's K shrinks from the pow2 ceiling to its observed max run
+      rounded up to a ``_MIN_K`` multiple (a skewed graph whose hub bin
+      holds runs of <= 130 pads rows to 132 slots, not 256);
+    - a bin splits at its row-count median when the split saves >= 25%
+      of the bin's slots PRICED ON THE STACKED ALLOCATION — a level
+      costs n_tiles * max-rows-in-any-one-tile * K, so a candidate split
+      whose halves concentrate in different tiles (each new level paying
+      its own per-tile max) prices high and is rejected.
+
+    Every row's capacity is <= its pow2 ceiling, shrinking and merging
+    only reduce a level's stacked cost, and splits fire only when the
+    stacked cost drops — so the total padded slots are never worse than
+    pow2 BY CONSTRUCTION (regression-tested, including the adversarial
+    tile-skew case), while the level count grows at most 2x."""
+    lens = np.maximum(row_len.astype(np.int64), 1)
+    tiles = row_tile.astype(np.int64)
+    pow2 = np.maximum(
+        2 ** np.ceil(np.log2(lens)).astype(np.int64), _MIN_K
+    )
+    up = lambda v: max(int(-(-int(v) // _MIN_K) * _MIN_K), _MIN_K)
+
+    def tile_rows(mask):
+        """max rows any one tile contributes — the n_l a level of these
+        rows allocates (times n_tiles * K, constant across candidates)."""
+        return (
+            int(np.bincount(tiles[mask], minlength=n_tiles).max())
+            if mask.any()
+            else 0
+        )
+
+    out = np.empty_like(lens)
+    for K in np.unique(pow2):
+        sel = pow2 == K
+        lb = lens[sel]
+        mx = up(lb.max())
+        med = up(np.median(lb))
+        if med < mx:
+            low = sel & (lens <= med)
+            cost_split = tile_rows(low) * med + tile_rows(sel & ~low) * mx
+            if cost_split <= 0.75 * tile_rows(sel) * mx:
+                out[sel] = np.where(lb <= med, med, mx)
+                continue
+        out[sel] = mx
+    return out
 
 
 @jax.tree_util.register_dataclass
@@ -110,9 +181,12 @@ class BlockedEll:
         src_num: int | None = None,  # source rows (default: square, = v_num)
         log_stats: bool = True,  # the ring builder runs P*P tiny builds and
         # logs ONE consolidated line itself (parallel/dist_ring_blocked.py)
+        levels: str = "",  # "" -> NTS_ELL_LEVELS / pow2; "binned" = degree-
+        # binned K values from the run-length distribution (resolve_levels)
     ) -> "BlockedEll":
         from neutronstarlite_tpu import native as native_rt
 
+        levels = resolve_levels(levels)
         src_num = v_num if src_num is None else int(src_num)
         n_tiles = -(-src_num // vt)
         # int32 fast path: with T*V < 2^31 the (tile, dst) key fits int32,
@@ -160,11 +234,17 @@ class BlockedEll:
         row_tile = tile_sorted[bounds].astype(np.int64)
         row_dst = dst_sorted[bounds].astype(np.int64)
 
-        # uniform global levels: K in {4, 8, ..., next_pow2(max run)};
-        # bounded by next_pow2(vt) since an in-tile run can't exceed vt
-        row_k = np.maximum(
-            2 ** np.ceil(np.log2(np.maximum(row_len, 1))).astype(np.int64), _MIN_K
-        )
+        # uniform global levels. pow2: K in {4, 8, ..., next_pow2(max run)}
+        # (bounded by next_pow2(vt) since an in-tile run can't exceed vt);
+        # binned: K at run-length quantiles (_binned_row_k) — same stacked
+        # layout and invariants, only the per-level capacities differ
+        if levels == "binned":
+            row_k = _binned_row_k(row_len, row_tile, n_tiles)
+        else:
+            row_k = np.maximum(
+                2 ** np.ceil(np.log2(np.maximum(row_len, 1))).astype(np.int64),
+                _MIN_K,
+            )
         src_local = (adj - tile_of_edge * np.asarray(vt, idx_t))[order]
         w_sorted = weights[order]
         if use_native:
@@ -173,9 +253,9 @@ class BlockedEll:
 
         nbrs, wgts, dsts = [], [], []
         pad_slots = real_slots = 0
-        K = _MIN_K
-        max_k = int(row_k.max()) if len(row_k) else _MIN_K
-        while K <= max_k:
+        # one stacked level per DISTINCT capacity (pow2 visits the same set
+        # its ladder would; binned visits the quantile capacities)
+        for K in sorted(int(k) for k in np.unique(row_k)):
             sel = np.nonzero(row_k == K)[0]
             if len(sel):
                 t_sel = row_tile[sel]
@@ -212,7 +292,6 @@ class BlockedEll:
                 dsts.append(dstr)
                 pad_slots += n_tiles * n_l * K - int(d.sum())
                 real_slots += int(d.sum())
-            K *= 2
         if real_slots and log_stats:
             log.info(
                 "blocked ELL: %d tiles of %d, %d levels, padding waste %.2fx "

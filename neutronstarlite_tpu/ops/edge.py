@@ -145,6 +145,14 @@ def aggregate_edge_to_dst_min(graph: DeviceGraph, edge_vals: jax.Array) -> jax.A
 
 
 def _edge_softmax_impl(v_num, csc_dst, mask, score):
+    # PINNED CONVENTION (regression-tested, tests/test_fused_edge.py):
+    # a destination whose incident edges are ALL padding (or that has no
+    # in-edges at all) must produce EXACT ZEROS, never NaN — the empty
+    # softmax normalizes over nothing, so its weights are defined as 0
+    # and the downstream weighted aggregate yields zero rows. The fused
+    # online softmax (ops/fused_edge.fused_finalize) reproduces exactly
+    # this: l == 0 -> out = 0. The padded -inf scores zero out in the
+    # exp, and the empty-segment denominator is guarded below.
     neg = jnp.asarray(-jnp.inf, dtype=score.dtype)
     masked = jnp.where(mask[:, None] > 0, score, neg)
     m = segment_max_sorted(masked, csc_dst, v_num)

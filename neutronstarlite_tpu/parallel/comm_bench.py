@@ -153,6 +153,106 @@ def bench_layers(v_num, avg_degree, f, partitions, steps, seed=3,
     return results
 
 
+def bench_edge_family(v_num, avg_degree, f, partitions, steps, seed=3,
+                      kernel_tile=0):
+    """The attention/edge-family leg (--edge-family): the eager mirror
+    GAT chain (one all_to_all + [El, .] edge tensors per layer) vs the
+    ring-pipelined fused edge kernel (KERNEL:fused_edge,
+    parallel/dist_fused_edge.py), one layer forward+backward each, plus
+    the analytic wire rows both ship — the measurement behind the
+    fused-vs-eager verdict `metrics_report --diff` gates in
+    scripts/ci_tier1.sh."""
+    import jax
+    import jax.numpy as jnp
+
+    from neutronstarlite_tpu.graph.storage import build_graph
+    from neutronstarlite_tpu.graph.synthetic import synthetic_power_law_graph
+    from neutronstarlite_tpu.models.gat import LEAKY_SLOPE
+    from neutronstarlite_tpu.models.gat_dist import dist_gat_layer
+    from neutronstarlite_tpu.parallel.dist_fused_edge import (
+        RingFusedEdgePair,
+        dist_fused_edge_aggregate,
+        fused_wire_cols,
+    )
+    from neutronstarlite_tpu.parallel.dist_graph import DistGraph
+    from neutronstarlite_tpu.parallel.dist_ring_blocked import default_ring_vt
+    from neutronstarlite_tpu.parallel.mesh import make_mesh, PARTITION_AXIS
+    from neutronstarlite_tpu.parallel.mirror import MirrorGraph
+    from jax.sharding import NamedSharding, PartitionSpec as PS
+
+    e_num = v_num * avg_degree
+    src, dst = synthetic_power_law_graph(v_num, e_num, seed=seed)
+    g = build_graph(src, dst, v_num, weight="ones")
+    mesh = make_mesh(partitions or None)
+    P = mesh.devices.size
+
+    mg = MirrorGraph.build(g, P)
+    tables = mg.shard(mesh)
+    dist = DistGraph.build(g, P)
+    ring_vt = default_ring_vt(dist.vp, kernel_tile)
+    pair = RingFusedEdgePair.build(dist, ring_vt).shard(mesh)
+
+    rng = np.random.default_rng(seed)
+    key = rng.standard_normal
+    W = jnp.asarray(key((f, f)).astype(np.float32))
+    a = jnp.asarray(key((2 * f, 1)).astype(np.float32))
+
+    def put(space, arr):
+        return jax.device_put(
+            jnp.asarray(space.pad_vertex_array(arr)),
+            NamedSharding(mesh, PS(PARTITION_AXIS, None)),
+        )
+
+    x_host = key((v_num, f)).astype(np.float32)
+    x_mirror = put(mg, x_host)
+    x_ring = put(dist, x_host)
+
+    def eager_layer(x):
+        return dist_gat_layer(mesh, mg, tables, W, a, x, last=True)
+
+    def fused_layer(x):
+        h = x @ W
+        al, ar = h @ a[:f], h @ a[f:]
+        return dist_fused_edge_aggregate(mesh, pair, h, al, ar, LEAKY_SLOPE)
+
+    def loss_of(fn):
+        return jax.jit(jax.value_and_grad(lambda x: (fn(x) ** 2).sum()))
+
+    results = {}
+    legs = {
+        "mirror_eager_edge": (
+            loss_of(eager_layer), x_mirror,
+            (P - 1) * mg.mb * (f + 1),  # [h || h.a_src] payload rows
+            mg.el * (2 * f + 3) * 4,  # [El, .] edge-tensor bytes/layer
+        ),
+        "ring_fused_edge": (
+            loss_of(fused_layer), x_ring,
+            (P - 1) * dist.vp * fused_wire_cols(f, 1)["fwd"],
+            0,  # no edge tensors, by construction (jaxpr-pinned in tests)
+        ),
+    }
+    for name, (fn, x, wire_vals, edge_bytes) in legs.items():
+        val, grad = fn(x)  # compile
+        jax.block_until_ready(grad)
+        t0 = time.time()
+        for _ in range(steps):
+            val, grad = fn(x)
+        jax.block_until_ready(grad)
+        dt = (time.time() - t0) / steps
+        results[name] = {
+            "step_s": round(dt, 5),
+            "wire_vals_per_dev_layer": int(wire_vals),
+            "edge_hbm_bytes_per_layer": int(edge_bytes),
+            "check": float(val),
+        }
+    results["meta"] = {
+        "v_num": v_num, "e_num": int(g.e_num), "feature": f, "P": P,
+        "vp": dist.vp, "mb": mg.mb, "ring_vt": ring_vt,
+        "device": str(jax.devices()[0]),
+    }
+    return results
+
+
 def ring_step_times(rbe, f: int, steps: int, seed: int = 5):
     """Per-ring-hop COMPUTE time, measured standalone: one jitted
     aggregate of device 0's stacked tables for each work step over a
@@ -192,12 +292,18 @@ def main(argv=None) -> int:
         "--kernel-tile", type=int, default=0,
         help="also bench the dist blocked layer (KERNEL_TILE:vt path)",
     )
+    ap.add_argument(
+        "--edge-family", action="store_true",
+        help="bench the attention/edge family instead: eager mirror GAT "
+        "chain vs the ring-pipelined fused edge kernel (KERNEL:fused_edge)",
+    )
     args = ap.parse_args(argv)
 
     from neutronstarlite_tpu.utils.platform import honor_platform_env
 
     honor_platform_env()
-    out = bench_layers(
+    bench = bench_edge_family if args.edge_family else bench_layers
+    out = bench(
         args.vertices, args.avg_degree, args.feature, args.partitions,
         args.steps, kernel_tile=args.kernel_tile,
     )

@@ -278,6 +278,25 @@ def _flatten_tables(rbe: RingBlockedEll):
     return flat, specs, counts
 
 
+def _regroup_tables(tables, counts, P):
+    """Invert _flatten_tables' layout into {step: (nbr, wgt, dst_row)
+    level lists} inside the shard_map body (the leading sharded axis is
+    sliced away here). ONE definition shared by the blocked ring and the
+    fused edge ring — the two layouts must stay in lockstep."""
+    per_step = {}
+    i = 0
+    for s in range(P):
+        c = counts[s]
+        if c:
+            per_step[s] = (
+                [a[0] for a in tables[i : i + c]],
+                [a[0] for a in tables[i + c : i + 2 * c]],
+                [a[0] for a in tables[i + 2 * c : i + 3 * c]],
+            )
+        i += 3 * c
+    return per_step
+
+
 def _ring_blocked_apply(
     mesh: Mesh, rbe: RingBlockedEll, x: jax.Array,
     wire_dtype: Optional[jnp.dtype] = None, mode: str = "full",
@@ -298,17 +317,7 @@ def _ring_blocked_apply(
     def body(*args):
         xs = args[-1]
         tables = args[:-1]
-        per_step = {}
-        i = 0
-        for s in range(P):
-            c = counts[s]
-            if c:
-                per_step[s] = (
-                    [a[0] for a in tables[i : i + c]],
-                    [a[0] for a in tables[i + c : i + 2 * c]],
-                    [a[0] for a in tables[i + 2 * c : i + 3 * c]],
-                )
-            i += 3 * c
+        per_step = _regroup_tables(tables, counts, P)
         # ONE f32 accumulator across all steps — per-step results never
         # round in the wire/compute dtype (the r5 ring-body policy)
         acc = jnp.zeros((rbe.vp, xs.shape[1]), jnp.float32)

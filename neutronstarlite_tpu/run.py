@@ -37,6 +37,22 @@ def apply_launcher_overrides(cfg: InputInfo) -> InputInfo:
                 f"NTS_PARTITIONS_OVERRIDE={slots!r} must be >= 0 "
                 "(0 = use all devices in the mesh)"
             )
+    kern = os.environ.get("NTS_KERNEL_OVERRIDE")
+    if kern and kern.strip():
+        # launcher parity for the KERNEL: key (the ci_tier1 fused-edge
+        # gate runs one smoke cfg through both the eager and fused
+        # paths); set-but-empty is NOT an override — the cfg's KERNEL
+        # stands, so `NTS_KERNEL_OVERRIDE= ` can't silently reroute a
+        # fused benchmark onto the eager chain
+        v = kern.strip().lower()
+        if v in ("eager", "none"):
+            v = ""
+        elif v != "fused_edge":
+            raise SystemExit(
+                f"NTS_KERNEL_OVERRIDE={kern!r} must be fused_edge or "
+                "eager/none (unset/empty = the cfg's KERNEL)"
+            )
+        cfg.kernel = v
     return cfg
 
 

@@ -390,6 +390,15 @@ def _diff_metrics(rec, srec):
         out["wire_bytes_fwd_per_epoch"] = (
             wire / n_epochs if wire is not None and n_epochs > 0 else None
         )
+        # the fused-edge structural gate (scripts/ci_tier1.sh): the
+        # attention/edge trainers pin their [Ep, f] edge-tensor HBM
+        # traffic estimate here — exactly 0 on the fused path, so any
+        # future regression that silently reroutes KERNEL:fused_edge back
+        # to the eager chain trips the zero-baseline absolute floor
+        gauges = rec.get("gauges") or {}
+        out["edge_hbm_bytes_per_epoch"] = gauges.get(
+            "kernel.edge_hbm_bytes_per_epoch"
+        )
     if srec is not None:
         answered = srec.get("requests", 0)
         shed = srec.get("shed", 0)
@@ -400,14 +409,69 @@ def _diff_metrics(rec, srec):
     return out
 
 
+def _micro_metrics(obj) -> Dict[str, Any]:
+    """A tools/micro_bench JSON as a --diff side: per-op median ms, with
+    the ``_eager`` / ``_fused`` suffix canonicalized away so a
+    fused-vs-eager comparison shares keys across its two sides (each side
+    should be produced with an --ops filter selecting one family — the
+    ci_tier1 edge-family leg does)."""
+    out: Dict[str, Any] = {}
+    for name, rec in (obj.get("ops") or {}).items():
+        ms = rec.get("ms")
+        if ms is None:
+            continue
+        for suf in ("_eager", "_fused"):
+            if name.endswith(suf):
+                name = name[: -len(suf)]
+                break
+        key = f"micro.{name}_ms"
+        if key in out:
+            # both variants of one op in a single JSON (micro_bench run
+            # without an --ops family filter) would silently compare a
+            # mix; keep the first and say so loudly instead
+            print(
+                f"diff: duplicate canonical metric {key} in micro_bench "
+                "side (both _eager and _fused present?) — keeping the "
+                "first; produce each side with an --ops family filter",
+                file=sys.stderr,
+            )
+            continue
+        out[key] = ms
+    return out
+
+
+def _side_metrics(path: str) -> Dict[str, Any]:
+    """One --diff side -> {metric: value}: an obs stream dir/file, or a
+    micro_bench JSON file (detected by its {"platform", "ops"} shape)."""
+    if os.path.isfile(path):
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                lines = fh.read().splitlines()
+        except OSError:
+            lines = []
+        for raw in lines:  # log lines may precede the one JSON line
+            raw = raw.strip()
+            if not raw.startswith("{"):
+                continue
+            try:
+                obj = json.loads(raw)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(obj, dict) and "ops" in obj and "platform" in obj:
+                return _micro_metrics(obj)
+            break  # a JSON object of another shape: treat as obs stream
+    return _diff_metrics(*_load_side(path))
+
+
 def run_diff(a_path: str, b_path: str, tol: float,
              as_json: bool = False) -> int:
     """Compare run B against baseline A; exit 2 when any shared metric
     regressed (grew) by more than ``tol`` (fractional, e.g. 0.05 = 5%;
     against a 0.0 baseline ``tol`` is the absolute threshold instead).
-    ``as_json`` emits one machine-readable object instead of the table."""
-    a = _diff_metrics(*_load_side(a_path))
-    b = _diff_metrics(*_load_side(b_path))
+    ``as_json`` emits one machine-readable object instead of the table.
+    A side may also be a micro_bench JSON file (see _side_metrics)."""
+    a = _side_metrics(a_path)
+    b = _side_metrics(b_path)
     shared = [
         k for k in a
         if a.get(k) is not None and b.get(k) is not None

@@ -67,7 +67,15 @@ def main(argv=None) -> int:
     from neutronstarlite_tpu.ops.bsp_ell import BspEllPair, bsp_gather_dst_from_src
     from neutronstarlite_tpu.ops.device_graph import DeviceGraph
     from neutronstarlite_tpu.ops.aggregate import gather_dst_from_src
+    from neutronstarlite_tpu.ops.edge import (
+        aggregate_edge_to_dst_weighted,
+        edge_softmax,
+    )
     from neutronstarlite_tpu.ops.ell import EllPair, ell_gather_dst_from_src
+    from neutronstarlite_tpu.ops.fused_edge import (
+        FusedEdgePair,
+        fused_edge_attention_aggregate,
+    )
     from neutronstarlite_tpu.ops.pallas_kernels import (
         PALLAS_MIN_K,
         gather_dst_from_src_pallas,
@@ -133,7 +141,39 @@ def main(argv=None) -> int:
         "big": lambda: jnp.asarray(
             key_rng("big").standard_normal(8 << 20).astype(np.float32)  # 32 MB
         ),
+        # ---- edge family (GAT/GGCN attention chains): unit-weight graph,
+        # the eager DeviceGraph chain vs the fused blocked kernel
+        "g1": lambda: build_graph(
+            *synthetic_power_law_graph(V, E, seed=args.seed), V,
+            weight="ones",
+        ),
+        "dg1": lambda: DeviceGraph.from_host(need("g1")),
+        "fused": lambda: FusedEdgePair.from_host(need("g1")),
+        "al": lambda: jnp.asarray(
+            key_rng("al").standard_normal((V, 1)).astype(np.float32)
+        ),
+        "ar": lambda: jnp.asarray(
+            key_rng("ar").standard_normal((V, 1)).astype(np.float32)
+        ),
+        "hs": lambda: jnp.asarray(
+            key_rng("hs").standard_normal((V, F)).astype(np.float32),
+            jnp.bfloat16,
+        ),
+        "hd": lambda: jnp.asarray(
+            key_rng("hd").standard_normal((V, F)).astype(np.float32),
+            jnp.bfloat16,
+        ),
     }
+
+    def eager_edge_chain(dg, h, a_src, a_dst, slope):
+        """The decoupled score -> per-dst softmax -> weighted-aggregate
+        chain over the [Ep]-shaped edge space (models/gat.py / ggcn.py)."""
+        score = jax.nn.leaky_relu(
+            a_src[dg.csc_src] + a_dst[dg.csc_dst], negative_slope=slope
+        )
+        s = edge_softmax(dg, score)
+        return aggregate_edge_to_dst_weighted(dg, s, h)
+
 
     def timed(name, make_fn, traffic_bytes=None, flops=None):
         """make_fn() -> fn(scalar) -> array; records median ms (+ rate)."""
@@ -183,6 +223,35 @@ def main(argv=None) -> int:
         ("bsp_streamed_bf16", ("bsp", "x"),
          lambda bsp, x: lambda s: bsp_gather_dst_from_src(bsp, x * s),
          dict(traffic_bytes=E * F * 2)),
+        # edge family: eager chain vs the fused blocked kernel, fwd+bwd
+        # (the fused backward is three streamed passes; forward-only
+        # timing would hide most of its cost). The `_eager` / `_fused`
+        # suffix pair is what metrics_report --diff canonicalizes when a
+        # micro_bench JSON is used as a diff side (scripts/ci_tier1.sh).
+        ("edge_gat_eager", ("dg1", "x", "al", "ar"),
+         lambda dg, x, al, ar: lambda s: jax.grad(
+             lambda h: (eager_edge_chain(dg, h, al, ar, 0.01) ** 2).sum()
+         )(x * s),
+         dict(traffic_bytes=3 * E * F * 2)),
+        ("edge_gat_fused", ("fused", "x", "al", "ar"),
+         lambda fe, x, al, ar: lambda s: jax.grad(
+             lambda h: (
+                 fused_edge_attention_aggregate(fe, h, al, ar, 0.01) ** 2
+             ).sum()
+         )(x * s),
+         dict(traffic_bytes=3 * E * F * 2)),
+        ("edge_ggcn_eager", ("dg1", "x", "hs", "hd"),
+         lambda dg, x, hs, hd: lambda s: jax.grad(
+             lambda h: (eager_edge_chain(dg, h, hs, hd, 0.2) ** 2).sum()
+         )(x * s),
+         dict(traffic_bytes=3 * E * F * 2)),
+        ("edge_ggcn_fused", ("fused", "x", "hs", "hd"),
+         lambda fe, x, hs, hd: lambda s: jax.grad(
+             lambda h: (
+                 fused_edge_attention_aggregate(fe, h, hs, hd, 0.2) ** 2
+             ).sum()
+         )(x * s),
+         dict(traffic_bytes=3 * E * F * 2)),
         # the two resident-kernel ops are LAST: they cannot lower to
         # Mosaic (ops/pallas_kernels.py) and the remote compile service is
         # known to HANG on lowering errors rather than surface them — if

@@ -140,6 +140,13 @@ class InputInfo:
     kernel_tile: int = 0  # OPTIM_KERNEL source-tile width (vertices): 0 =
     # plain ELL; >0 = blocked ELL (ops/blocked_ell.py) whose per-tile gather
     # table [vt, f] is sized to stay in the fast on-chip regime at any V
+    kernel: str = ""  # KERNEL: named-kernel selector for the attention/
+    # edge-op families: "" (the eager edge chain) or fused_edge (the
+    # blocked streaming SDDMM+softmax+SpMM kernel, ops/fused_edge.py —
+    # online per-dst softmax, no [Ep, f] edge tensors). Serves GAT / GGCN
+    # and their dist twins; anything else refuses loudly at the
+    # ToolkitBase lifecycle funnel (the DIST_PATH refusal pattern).
+    # KERNEL_TILE doubles as its source-tile height.
     pallas_kernel: bool = False  # OPTIM_KERNEL:1 + PALLAS:1 -> run the
     # aggregation through the fused streamed block-sparse Pallas kernel
     # (ops/bsp_ell.py — the one fused design Mosaic can compile: one-hot
@@ -233,6 +240,16 @@ class InputInfo:
             self.optim_kernel = bool(int(value))
         elif key == "KERNEL_TILE":
             self.kernel_tile = int(value)
+        elif key == "KERNEL":
+            v = value.strip().lower()
+            # validated like DIST_PATH/PRECISION: a typo'd value would
+            # silently run the eager edge chain while the user benchmarks
+            # it as the fused kernel
+            if v not in ("", "fused_edge"):
+                raise ValueError(
+                    f"KERNEL must be fused_edge (or empty), got {value!r}"
+                )
+            self.kernel = v
         elif key == "PALLAS":
             self.pallas_kernel = bool(int(value))
         elif key == "PARTITIONS":

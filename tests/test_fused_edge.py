@@ -1,0 +1,632 @@
+"""Fused SDDMM+softmax+SpMM blocked kernel (ops/fused_edge.py) vs the
+eager edge-op chain — the parity oracle sweep (ISSUE 6).
+
+The eager chain (models/gat.py / models/ggcn.py over ops/edge.py) is the
+golden: the fused streamed kernel computes the same scores, the same
+per-destination (per-channel) softmax, and the same weighted aggregation
+via an ONLINE softmax, so forward AND every input gradient must agree to
+float tolerance on arbitrary multigraphs — f32 and bf16, scalar (GAT) and
+multi-channel (GGCN) scores, skewed-degree and empty-partition graphs,
+single-chip and the ring_blocked dist twins (collective bitwise-equal to
+the sim). Structural pins: the fused forward's jaxpr holds no
+[Ep, f]-shaped aval, and the KERNEL config funnel refuses loudly.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tests.conftest import tiny_graph
+from neutronstarlite_tpu.ops.device_graph import DeviceGraph
+from neutronstarlite_tpu.ops.edge import (
+    aggregate_edge_to_dst_weighted,
+    edge_softmax,
+)
+from neutronstarlite_tpu.ops.fused_edge import (
+    FusedEdgePair,
+    fused_edge_attention_aggregate,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+GAT_SLOPE, GGCN_SLOPE = 0.01, 0.2
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(17)
+
+
+def eager_chain(dg: DeviceGraph, h, a_src, a_dst, slope):
+    """The decoupled reference chain: scatter halves to edges -> leaky ->
+    per-dst softmax -> weighted aggregate (the [Ep, .] edge space)."""
+    score = jax.nn.leaky_relu(
+        a_src[dg.csc_src] + a_dst[dg.csc_dst], negative_slope=slope
+    )
+    s = edge_softmax(dg, score)
+    return aggregate_edge_to_dst_weighted(dg, s, h)
+
+
+def _setup(rng, v_num=83, e_num=460, f=9, channels=1, dtype=jnp.float32,
+           vt=16, graph=None):
+    g = graph if graph is not None else tiny_graph(
+        rng, v_num=v_num, e_num=e_num, weight="ones"
+    )[0]
+    dg = DeviceGraph.from_host(g, edge_chunk=128)
+    fep = FusedEdgePair.from_host(g, vt=vt)
+    key = jax.random.PRNGKey(3)
+    h = jax.random.normal(key, (g.v_num, f), jnp.float32).astype(dtype)
+    C = channels if channels > 0 else f
+    a_src = jax.random.normal(
+        jax.random.fold_in(key, 1), (g.v_num, C), jnp.float32
+    ).astype(dtype)
+    a_dst = jax.random.normal(
+        jax.random.fold_in(key, 2), (g.v_num, C), jnp.float32
+    ).astype(dtype)
+    c = jax.random.normal(
+        jax.random.fold_in(key, 9), (g.v_num, f), jnp.float32
+    ).astype(dtype)
+    return g, dg, fep, h, a_src, a_dst, c
+
+
+def _assert_parity(dg, fep, h, a_src, a_dst, c, slope, rtol, atol):
+    want = eager_chain(dg, h, a_src, a_dst, slope)
+    got = fused_edge_attention_aggregate(fep, h, a_src, a_dst, slope)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        rtol=rtol, atol=atol,
+    )
+    ge = jax.grad(
+        lambda *a: (eager_chain(dg, *a, slope) * c).sum().astype(jnp.float32),
+        argnums=(0, 1, 2),
+    )(h, a_src, a_dst)
+    gf = jax.grad(
+        lambda *a: (
+            fused_edge_attention_aggregate(fep, *a, slope) * c
+        ).sum().astype(jnp.float32),
+        argnums=(0, 1, 2),
+    )(h, a_src, a_dst)
+    for a, b in zip(ge, gf):
+        np.testing.assert_allclose(
+            np.asarray(b, np.float32), np.asarray(a, np.float32),
+            rtol=rtol * 2, atol=atol * 2,
+        )
+
+
+@pytest.mark.parametrize("channels,slope", [(1, GAT_SLOPE), (0, GGCN_SLOPE)])
+def test_fused_matches_eager_f32(rng, channels, slope):
+    """GAT (C=1) and GGCN (C=f) forward + all three gradients, f32."""
+    _, dg, fep, h, a_src, a_dst, c = _setup(rng, channels=channels)
+    _assert_parity(dg, fep, h, a_src, a_dst, c, slope, 4e-5, 4e-6)
+
+
+@pytest.mark.parametrize("channels,slope", [(1, GAT_SLOPE), (0, GGCN_SLOPE)])
+def test_fused_matches_eager_bf16(rng, channels, slope):
+    """bf16 inputs: the fused kernel's f32 state keeps it inside the bf16
+    tolerance class of the eager chain (which also upcasts per-segment)."""
+    _, dg, fep, h, a_src, a_dst, c = _setup(
+        rng, channels=channels, dtype=jnp.bfloat16
+    )
+    _assert_parity(dg, fep, h, a_src, a_dst, c, slope, 5e-2, 5e-2)
+
+
+@pytest.mark.slow
+def test_fused_skewed_degree_graph(rng):
+    """Power-law degrees (hub destinations spanning many source tiles —
+    the online-softmax rescale path) at a tile size that forces multi-tile
+    runs, plus the degree-binned level build. Slow suite: tier-1 covers
+    the cross-tile rescale via test_fused_tile_size_invariance (vt=5)."""
+    from neutronstarlite_tpu.graph.storage import build_graph
+    from neutronstarlite_tpu.graph.synthetic import synthetic_power_law_graph
+
+    src, dst = synthetic_power_law_graph(300, 4000, seed=7)
+    g = build_graph(src, dst, 300, weight="ones")
+    _, dg, fep, h, a_src, a_dst, c = _setup(rng, f=8, vt=32, graph=g)
+    _assert_parity(dg, fep, h, a_src, a_dst, c, GAT_SLOPE, 1e-4, 1e-5)
+
+
+def test_fused_tile_size_invariance(rng):
+    """vt=V (single tile, no cross-tile rescale) and a tiny vt (state
+    rescaled on nearly every block) must agree with each other and the
+    eager chain."""
+    g, dg, _, h, a_src, a_dst, c = _setup(rng)
+    want = np.asarray(eager_chain(dg, h, a_src, a_dst, GAT_SLOPE))
+    for vt in (5, 16, g.v_num):
+        fep = FusedEdgePair.from_host(g, vt=vt)
+        got = fused_edge_attention_aggregate(
+            fep, h, a_src, a_dst, GAT_SLOPE
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), want, rtol=4e-5, atol=4e-6
+        )
+
+
+def test_empty_destination_zero_convention(rng):
+    """The PINNED convention (ISSUE 6 satellite): destinations with no
+    (real) in-edges produce EXACT zeros from the eager edge softmax and
+    the fused kernel alike — never NaN, never a normalize-over-empty."""
+    # star-ish graph: vertices past `hub` have no in-edges at all
+    v_num, hub = 40, 7
+    src = np.arange(v_num, dtype=np.uint32) % hub + np.uint32(hub)
+    dst = np.arange(v_num, dtype=np.uint32) % hub
+    from neutronstarlite_tpu.graph.storage import build_graph
+
+    g = build_graph(src % v_num, dst, v_num, weight="ones")
+    dg = DeviceGraph.from_host(g, edge_chunk=64)  # padded edge tail too
+    fep = FusedEdgePair.from_host(g, vt=8)
+    key = jax.random.PRNGKey(0)
+    h = jax.random.normal(key, (v_num, 5), jnp.float32)
+    a_src = jax.random.normal(jax.random.fold_in(key, 1), (v_num, 1))
+    a_dst = jax.random.normal(jax.random.fold_in(key, 2), (v_num, 1))
+
+    # the softmax itself: all-padding rows -> all-zero weights, no NaN
+    score = jax.nn.leaky_relu(
+        a_src[dg.csc_src] + a_dst[dg.csc_dst], negative_slope=GAT_SLOPE
+    )
+    s = np.asarray(edge_softmax(dg, score))
+    assert np.isfinite(s).all()
+    pad = np.asarray(dg.edge_mask) == 0
+    np.testing.assert_array_equal(s[pad], 0.0)
+
+    want = np.asarray(eager_chain(dg, h, a_src, a_dst, GAT_SLOPE))
+    got = np.asarray(
+        fused_edge_attention_aggregate(fep, h, a_src, a_dst, GAT_SLOPE)
+    )
+    assert np.isfinite(got).all()
+    np.testing.assert_array_equal(want[hub:], 0.0)  # empty dsts: exact 0
+    np.testing.assert_array_equal(got[hub:], 0.0)
+    np.testing.assert_allclose(got, want, rtol=4e-5, atol=4e-6)
+
+
+def test_degree_binned_levels_never_worse(rng):
+    """levels="binned" (the Accel-GCN-style construction) pads at most as
+    many slots as pow2 and aggregates identically."""
+    from neutronstarlite_tpu.graph.storage import build_graph
+    from neutronstarlite_tpu.graph.synthetic import synthetic_power_law_graph
+    from neutronstarlite_tpu.ops.blocked_ell import BlockedEll
+
+    src, dst = synthetic_power_law_graph(260, 3000, seed=3)
+    g = build_graph(src, dst, 260, weight="gcn_norm")
+    x = jnp.asarray(
+        rng.standard_normal((260, 7)).astype(np.float32)
+    )
+    outs, slots = {}, {}
+    for lv in ("pow2", "binned"):
+        b = BlockedEll.build(
+            g.v_num, g.column_offset, g.row_indices,
+            g.edge_weight_forward, vt=64, levels=lv,
+        )
+        outs[lv] = np.asarray(b.aggregate(x))
+        slots[lv] = sum(int(np.prod(n.shape)) for n in b.nbr)
+    np.testing.assert_allclose(
+        outs["binned"], outs["pow2"], rtol=1e-5, atol=1e-6
+    )
+    assert slots["binned"] <= slots["pow2"]
+    with pytest.raises(ValueError):
+        BlockedEll.build(
+            g.v_num, g.column_offset, g.row_indices,
+            g.edge_weight_forward, vt=64, levels="nope",
+        )
+
+    # adversarial tile skew: one pow2 bin whose low rows live in tile0
+    # and high rows in tile1 — a split here makes each new level pay its
+    # own per-tile max (n_tiles * n_l * K stacking), so the split
+    # decision must price the STACKED allocation and reject it (a
+    # row-count-only heuristic padded 1.42x MORE than pow2 on this)
+    v = 512
+    deg = np.zeros(v, np.int64)
+    deg[:110] = 130  # tile-0 runs, up-rounded capacity 132
+    deg[110:210] = 256  # tile-1 runs at the bin ceiling
+    offs = np.zeros(v + 1, np.int64)
+    offs[1:] = np.cumsum(deg)
+    idx = np.concatenate(
+        [np.arange(130)] * 110 + [256 + np.arange(256)] * 100
+    ).astype(np.int64)
+    ones = np.ones(offs[-1], np.float32)
+    skew_slots = {
+        lv: sum(
+            int(np.prod(n.shape))
+            for n in BlockedEll.build(
+                v, offs, idx, ones, vt=256, levels=lv, log_stats=False
+            ).nbr
+        )
+        for lv in ("pow2", "binned")
+    }
+    assert skew_slots["binned"] <= skew_slots["pow2"], skew_slots
+
+
+def _edge_feature_avals(fn, e_num, f_width, *args):
+    """Shapes in ``fn``'s jaxpr whose leading dim could hold the edge
+    space with a feature-width trailing dim — the [Ep, f] round-trip the
+    fused kernel must never materialize."""
+    jaxpr = jax.make_jaxpr(fn)(*args)
+    bad = []
+    for eqn in jaxpr.jaxpr.eqns:
+        for v in list(eqn.invars) + list(eqn.outvars):
+            shape = getattr(getattr(v, "aval", None), "shape", ())
+            if (
+                len(shape) >= 2
+                and shape[0] >= e_num
+                and shape[-1] == f_width
+            ):
+                bad.append(shape)
+    return bad
+
+
+@pytest.mark.parametrize("channels,slope", [(1, GAT_SLOPE), (0, GGCN_SLOPE)])
+def test_fused_jaxpr_has_no_edge_feature_aval(rng, channels, slope):
+    """ISSUE 6 acceptance: the fused forward's jaxpr contains no
+    [Ep, f]-shaped aval (the eager chain's does — the control)."""
+    g, dg, fep, h, a_src, a_dst, _ = _setup(rng, channels=channels)
+
+    fused_bad = _edge_feature_avals(
+        lambda *a: fused_edge_attention_aggregate(fep, *a, slope),
+        g.e_num, h.shape[1], h, a_src, a_dst,
+    )
+    assert not fused_bad, f"fused forward materializes {fused_bad}"
+    eager_bad = _edge_feature_avals(
+        lambda *a: eager_chain(dg, *a, slope),
+        g.e_num, h.shape[1], h, a_src, a_dst,
+    )
+    assert eager_bad, "control failed: eager chain shows no [Ep, f] aval"
+
+
+# ---- trainer integration ---------------------------------------------------
+
+
+def _planted(v_num=120, classes=3, f=10):
+    from neutronstarlite_tpu.graph.dataset import GNNDatum
+    from neutronstarlite_tpu.graph.synthetic import planted_partition_graph
+
+    src, dst, feature, label = planted_partition_graph(
+        v_num, classes, avg_degree=10, feature_size=f, seed=23
+    )
+    mask = (np.arange(v_num) % 3).astype(np.int32)
+    datum = GNNDatum(
+        feature=feature, label=label.astype(np.int32), mask=mask
+    )
+    return src, dst, datum, v_num, classes, f
+
+
+def _cfg(algo, v_num, f, classes, epochs=14, **kw):
+    from neutronstarlite_tpu.utils.config import InputInfo
+
+    cfg = InputInfo()
+    cfg.algorithm = algo
+    cfg.vertices = v_num
+    cfg.layer_string = f"{f}-16-{classes}"
+    cfg.epochs = epochs
+    cfg.learn_rate = 0.02
+    cfg.drop_rate = 0.0
+    cfg.decay_epoch = -1
+    for k, v in kw.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+@pytest.mark.parametrize(
+    "algo",
+    ["GATCPU", pytest.param("GGCNCPU", marks=pytest.mark.slow)],
+)
+def test_trainer_fused_matches_eager_trajectory(algo):
+    """End-to-end KERNEL:fused_edge: the per-epoch loss CURVE tracks the
+    eager chain's (same math, reassociated) and trains to quality. GGCN
+    rides the slow suite (tier-1 budget; its op-level parity is the f32/
+    bf16 sweep above)."""
+    from neutronstarlite_tpu.models.base import get_algorithm
+
+    src, dst, datum, v_num, classes, f = _planted()
+    losses = {}
+    for kernel in ("fused_edge", ""):
+        cfg = _cfg(algo, v_num, f, classes, kernel=kernel)
+        t = get_algorithm(algo).from_arrays(cfg, src, dst, datum, seed=1)
+        res = t.run()
+        losses[kernel] = list(t.loss_history)
+        if kernel == "fused_edge":
+            assert res["acc"]["train"] >= 0.9, res
+            gauges = t.run_summary_record["gauges"]
+            assert gauges["kernel.path"] == "fused_edge"
+            assert gauges["kernel.edge_hbm_bytes_per_epoch"] == 0
+        else:
+            assert t.run_summary_record["gauges"][
+                "kernel.edge_hbm_bytes_per_epoch"
+            ] > 0
+    np.testing.assert_allclose(
+        losses["fused_edge"], losses[""], rtol=2e-3, atol=2e-4
+    )
+
+
+@pytest.mark.slow
+def test_dist_sim_fused_matches_eager_mirror(monkeypatch):
+    """GATDIST under KERNEL:fused_edge + DIST_PATH:ring_blocked_sim (the
+    collective-free ring twin) tracks the eager mirror-chain sim. Slow
+    suite (trainer-level compile x2); tier-1 keeps the op-level ring sim
+    parity below."""
+    from neutronstarlite_tpu.models.base import get_algorithm
+
+    monkeypatch.setenv("NTS_DIST_SIMULATE", "1")
+    src, dst, datum, v_num, classes, f = _planted()
+    losses = {}
+    for kernel, dp in (("fused_edge", "ring_blocked_sim"), ("", "")):
+        cfg = _cfg(
+            "GATDIST", v_num, f, classes, epochs=8,
+            kernel=kernel, dist_path=dp, partitions=4,
+        )
+        t = get_algorithm("GATDIST").from_arrays(cfg, src, dst, datum, seed=1)
+        t.run()
+        losses[kernel] = list(t.loss_history)
+        if kernel == "fused_edge":
+            gauges = t.run_summary_record["gauges"]
+            assert gauges["wire.comm_layer"] == "ring_fused"
+            assert gauges["kernel.edge_hbm_bytes_per_epoch"] == 0
+    np.testing.assert_allclose(
+        losses["fused_edge"], losses[""], rtol=2e-3, atol=2e-4
+    )
+
+
+@pytest.mark.parametrize(
+    "C,slope",
+    [(1, GAT_SLOPE), pytest.param(6, GGCN_SLOPE, marks=pytest.mark.slow)],
+)
+def test_dist_op_sim_parity_both_families(rng, C, slope):
+    """Op-level: the ring sim twin (GAT C=1 and GGCN C=f) against the
+    single-chip eager oracle over the padded partition space, forward and
+    all gradients — covers GGCNDIST without a second trainer compile.
+    P=2 with a tiny graph keeps the tier-1 compile small (the softmax
+    state still crosses a partition boundary every hop); the GGCN channel
+    layout and wider meshes ride the slow suite."""
+    from neutronstarlite_tpu.parallel.dist_fused_edge import (
+        RingFusedEdgePair,
+        dist_fused_edge_aggregate,
+    )
+    from neutronstarlite_tpu.parallel.dist_graph import DistGraph
+
+    g, dg, _, h, _, _, c = _setup(rng, v_num=33, e_num=160, f=6, vt=16)
+    dist = DistGraph.build(g, 2)
+    pair = RingFusedEdgePair.build(dist, vt=16)
+    pad = lambda a: jnp.asarray(dist.pad_vertex_array(np.asarray(a)))
+    cp = pad(c)
+    key = jax.random.PRNGKey(C)
+    a_src = jax.random.normal(key, (g.v_num, C), jnp.float32)
+    a_dst = jax.random.normal(
+        jax.random.fold_in(key, 1), (g.v_num, C), jnp.float32
+    )
+    want = eager_chain(dg, h, a_src, a_dst, slope)
+    out = dist_fused_edge_aggregate(
+        None, pair, pad(h), pad(a_src), pad(a_dst), slope
+    )
+    np.testing.assert_allclose(
+        dist.unpad_vertex_array(np.asarray(out)), np.asarray(want),
+        rtol=4e-5, atol=4e-6,
+    )
+    ge = jax.grad(
+        lambda *a: (eager_chain(dg, *a, slope) * c).sum(),
+        argnums=(0, 1, 2),
+    )(h, a_src, a_dst)
+    gf = jax.grad(
+        lambda *a: (
+            dist_fused_edge_aggregate(None, pair, *a, slope) * cp
+        ).sum(),
+        argnums=(0, 1, 2),
+    )(pad(h), pad(a_src), pad(a_dst))
+    for a, b in zip(ge, gf):
+        np.testing.assert_allclose(
+            dist.unpad_vertex_array(np.asarray(b)), np.asarray(a),
+            rtol=1e-4, atol=1e-5,
+        )
+
+
+@pytest.mark.slow
+def test_dist_collective_bitwise_equals_sim(rng):
+    """The shard_map ppermute ring produces BITWISE the sim twin's output
+    and gradients (the ring_blocked oracle pattern). Slow suite: the
+    three-ring shard_map backward is the most expensive compile in the
+    sweep; tier-1 keeps the sim-twin parity above, and the collective
+    bitwise oracle runs with the rest of the slow dist tests."""
+    from jax.sharding import NamedSharding, PartitionSpec as PS
+
+    from neutronstarlite_tpu.parallel.dist_fused_edge import (
+        RingFusedEdgePair,
+        dist_fused_edge_aggregate,
+    )
+    from neutronstarlite_tpu.parallel.dist_graph import DistGraph
+    from neutronstarlite_tpu.parallel.mesh import PARTITION_AXIS, make_mesh
+
+    g, _, _, h, a_src, a_dst, c = _setup(rng, v_num=31, e_num=140, f=4)
+    mesh = make_mesh(2)
+    dist = DistGraph.build(g, 2)
+    pair = RingFusedEdgePair.build(dist, vt=8)
+    pairs = pair.shard(mesh)
+    pad = lambda a: jnp.asarray(dist.pad_vertex_array(np.asarray(a)))
+    put = lambda a: jax.device_put(
+        pad(a), NamedSharding(mesh, PS(PARTITION_AXIS, None))
+    )
+    out_real = dist_fused_edge_aggregate(
+        mesh, pairs, put(h), put(a_src), put(a_dst), GAT_SLOPE
+    )
+    out_sim = dist_fused_edge_aggregate(
+        None, pair, pad(h), pad(a_src), pad(a_dst), GAT_SLOPE
+    )
+    np.testing.assert_array_equal(np.asarray(out_real), np.asarray(out_sim))
+
+    cr, cs = put(c), pad(c)
+    gr = jax.grad(
+        lambda *a: (
+            dist_fused_edge_aggregate(mesh, pairs, *a, GAT_SLOPE) * cr
+        ).sum(),
+        argnums=(0, 1, 2),
+    )(put(h), put(a_src), put(a_dst))
+    gs = jax.grad(
+        lambda *a: (
+            dist_fused_edge_aggregate(None, pair, *a, GAT_SLOPE) * cs
+        ).sum(),
+        argnums=(0, 1, 2),
+    )(pad(h), pad(a_src), pad(a_dst))
+    for a, b in zip(gr, gs):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---- config funnel loudness (ISSUE 6 satellite) ----------------------------
+
+
+def test_kernel_key_validation():
+    from neutronstarlite_tpu.utils.config import InputInfo
+
+    cfg = InputInfo()
+    cfg._apply("KERNEL", "fused_edge")
+    assert cfg.kernel == "fused_edge"
+    with pytest.raises(ValueError, match="KERNEL"):
+        cfg._apply("KERNEL", "fusededge")
+
+
+def test_funnel_refusals():
+    from neutronstarlite_tpu.models.base import get_algorithm
+
+    src, dst, datum, v_num, classes, f = _planted()
+
+    # KERNEL:fused_edge on a non-edge family
+    cfg = _cfg("GCNCPU", v_num, f, classes, epochs=1, kernel="fused_edge")
+    with pytest.raises(ValueError, match="fused_edge is not available"):
+        get_algorithm("GCNCPU").from_arrays(cfg, src, dst, datum)
+
+    # PALLAS:1 without OPTIM_KERNEL:1 (previously silently ignored)
+    cfg = _cfg("GCNCPU", v_num, f, classes, epochs=1, pallas_kernel=True)
+    with pytest.raises(ValueError, match="PALLAS:1 requires OPTIM_KERNEL"):
+        get_algorithm("GCNCPU").from_arrays(cfg, src, dst, datum)
+
+    # conflicting kernel stacks
+    cfg = _cfg(
+        "GATCPU", v_num, f, classes, epochs=1,
+        kernel="fused_edge", optim_kernel=True,
+    )
+    with pytest.raises(ValueError, match="choose"):
+        get_algorithm("GATCPU").from_arrays(cfg, src, dst, datum)
+
+    # fused dist twins run the ring family only
+    cfg = _cfg(
+        "GATDIST", v_num, f, classes, epochs=1,
+        kernel="fused_edge", dist_path="all_gather", partitions=2,
+    )
+    with pytest.raises(ValueError, match="ring"):
+        get_algorithm("GATDIST").from_arrays(cfg, src, dst, datum)
+
+
+# ---- smoke cfg + diff gate (ISSUE 6 satellite: CI wiring) ------------------
+
+
+@pytest.fixture(scope="module")
+def fused_smoke_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("fused_metrics")
+    env_before = os.environ.get("NTS_METRICS_DIR")
+    os.environ["NTS_METRICS_DIR"] = str(d)
+    try:
+        from neutronstarlite_tpu.run import main as run_main
+
+        rc = run_main(
+            [os.path.join(REPO, "configs", "gat_cora_fused_smoke.cfg")]
+        )
+    finally:
+        if env_before is None:
+            os.environ.pop("NTS_METRICS_DIR", None)
+        else:
+            os.environ["NTS_METRICS_DIR"] = env_before
+    assert rc == 0
+    return d
+
+
+def test_fused_smoke_stream_and_gauges(fused_smoke_dir):
+    from neutronstarlite_tpu.obs import schema
+
+    files = sorted(glob.glob(os.path.join(str(fused_smoke_dir), "*.jsonl")))
+    assert files, "no JSONL stream written under NTS_METRICS_DIR"
+    events = [
+        json.loads(line)
+        for f in files
+        for line in open(f)
+        if line.strip()
+    ]
+    assert schema.validate_stream(events) == len(events)
+    summ = [e for e in events if e["event"] == "run_summary"][-1]
+    assert summ["epochs"] == 2
+    gauges = summ["gauges"]
+    assert gauges["kernel.path"] == "fused_edge"
+    assert gauges["kernel.edge_hbm_bytes_per_epoch"] == 0
+    assert gauges["kernel.fused_slots"] > 0
+
+
+def test_diff_gate_catches_eager_regression(fused_smoke_dir, tmp_path,
+                                            capsys):
+    """The scripts/ci_tier1.sh structural gate: against an expected-zero
+    baseline, the fused smoke passes and an eager-valued gauge trips."""
+    from neutronstarlite_tpu.tools.metrics_report import run_diff
+
+    base = tmp_path / "base.jsonl"
+    env_before = os.environ.get("NTS_METRICS_DIR")
+    os.environ["NTS_METRICS_DIR"] = str(tmp_path / "base_dir")
+    try:
+        from neutronstarlite_tpu import obs
+
+        m = obs.open_run("FUSED_EDGE_BASELINE")
+        m.gauge_set("kernel.edge_hbm_bytes_per_epoch", 0)
+        m.run_summary(
+            epochs=0, phases={}, memory={"available": False},
+            epoch_time={"first_s": None, "warm_median_s": None,
+                        "compile_overhead_s": None},
+        )
+        m.close()
+    finally:
+        if env_before is None:
+            os.environ.pop("NTS_METRICS_DIR", None)
+        else:
+            os.environ["NTS_METRICS_DIR"] = env_before
+    base_dir = str(tmp_path / "base_dir")
+    assert run_diff(base_dir, str(fused_smoke_dir), tol=0.05) == 0
+    capsys.readouterr()
+
+    # a "regressed" side: same stream shape, eager-sized gauge
+    bad = tmp_path / "bad"
+    bad.mkdir()
+    src_file = sorted(
+        glob.glob(os.path.join(str(fused_smoke_dir), "*.jsonl"))
+    )[0]
+    with open(src_file) as fh, open(bad / "stream.jsonl", "w") as out:
+        for line in fh:
+            rec = json.loads(line)
+            if rec.get("event") == "run_summary":
+                rec["gauges"]["kernel.edge_hbm_bytes_per_epoch"] = 12345678
+            out.write(json.dumps(rec) + "\n")
+    assert run_diff(base_dir, str(bad), tol=0.05) == 2
+    capsys.readouterr()
+
+
+def test_diff_micro_bench_sides(tmp_path, capsys):
+    """micro_bench JSON as --diff sides: the _eager/_fused suffixes
+    canonicalize to shared keys; fused-slower-than-tol trips."""
+    from neutronstarlite_tpu.tools.metrics_report import run_diff
+
+    def write(path, name, ms):
+        path.write_text(
+            "[INFO] log noise\n"  # micro_bench stdout carries log lines
+            + json.dumps(
+                {"platform": "cpu", "device": "x", "V": 1, "E": 1,
+                 "ops": {name: {"ms": ms}}}
+            )
+            + "\n"
+        )
+
+    a, b = tmp_path / "a.json", tmp_path / "b.json"
+    write(a, "edge_gat_eager", 50.0)
+    write(b, "edge_gat_fused", 30.0)
+    assert run_diff(str(a), str(b), tol=1.0) == 0
+    capsys.readouterr()
+    write(b, "edge_gat_fused", 150.0)  # > 2x eager at tol 1.0
+    assert run_diff(str(a), str(b), tol=1.0) == 2
+    capsys.readouterr()
