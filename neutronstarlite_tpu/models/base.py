@@ -123,6 +123,12 @@ class ToolkitBase:
     # everywhere else the key refuses loudly (see _check_kernel)
     supports_fused_edge = False
 
+    # trainers whose run loop honors SAMPLE_PIPELINE (the sampled family:
+    # gcn_sample; serving reuses the same key through ServeOptions) set
+    # this True; everywhere else an explicit mode refuses loudly — the
+    # DIST_PATH refusal pattern (see _check_sample_pipeline)
+    supports_sample_pipeline = False
+
     # ---- init_graph ------------------------------------------------------
     def _wants_ell(self) -> bool:
         """True when build_model will replace the DeviceGraph with ELL tables
@@ -256,9 +262,33 @@ class ToolkitBase:
                     "free attention path)"
                 )
 
+    def _check_sample_pipeline(self) -> None:
+        """SAMPLE_PIPELINE loudness at the lifecycle funnel: a mode the
+        run loop would silently ignore must refuse instead (the user is
+        benchmarking a pipeline that never runs). Resolved through
+        resolve_sample_pipeline so the NTS_SAMPLE_PIPELINE env override
+        cannot bypass the refusal the cfg key gets."""
+        cfg = self.cfg
+        if getattr(type(self), "supports_sample_pipeline", False):
+            return
+        from neutronstarlite_tpu.sample.pipeline import (
+            resolve_sample_pipeline,
+        )
+
+        mode = resolve_sample_pipeline(cfg)
+        if mode != "sync":
+            raise ValueError(
+                f"SAMPLE_PIPELINE:{mode} is not available for ALGORITHM "
+                f"{cfg.algorithm!r}: the async sampling pipeline serves "
+                "the sampled mini-batch family (GCNSAMPLESINGLE) and the "
+                "serve/ stack built on it; full-batch and dist trainers "
+                "never sample"
+            )
+
     def _finalize_datum(self) -> None:
         self._check_kernel()
         self._check_dist_path()
+        self._check_sample_pipeline()
         self.feature = jnp.asarray(self.datum.feature)
         self.label = jnp.asarray(self.datum.label.astype(np.int32))
         self.mask = jnp.asarray(self.datum.mask)

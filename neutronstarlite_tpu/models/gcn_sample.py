@@ -62,6 +62,8 @@ class GCNSampleTrainer(ToolkitBase):
     # ever sees padded batch subgraphs — uploading the full edge set to HBM
     # would waste gigabytes at Reddit scale for arrays never touched
     needs_device_graph = False
+    # SAMPLE_PIPELINE (sample/pipeline.py): sync | pipelined | device
+    supports_sample_pipeline = True
 
     def _finalize_datum(self) -> None:
         # the training batch stream (sample/parallel.py) forks its
@@ -79,7 +81,28 @@ class GCNSampleTrainer(ToolkitBase):
         n_layers = len(sizes) - 1
         self.fanouts = fanouts[-n_layers:]
         from neutronstarlite_tpu.sample.parallel import ParallelEpochSampler
+        from neutronstarlite_tpu.sample.pipeline import resolve_sample_pipeline
 
+        # SAMPLE_PIPELINE / NTS_SAMPLE_PIPELINE (sample/pipeline.py):
+        # sync keeps the in-loop host sampler (the parity oracle);
+        # pipelined prefetches deterministic batches + async H2D on a
+        # background thread; device additionally draws each hop on-device
+        self.sample_mode = resolve_sample_pipeline(cfg)
+        hop_sampler = None
+        if self.sample_mode == "device":
+            # the device table upload is a JAX backend touch, which is
+            # fine here: device mode samples inline (no forked pool)
+            from neutronstarlite_tpu.sample.device_sampler import (
+                DeviceUniformSampler,
+            )
+
+            hop_sampler = DeviceUniformSampler.from_host(self.host_graph)
+            log.info(
+                "SAMPLE_PIPELINE:device — on-device uniform hop sampler "
+                "(neighbor table [%d, %d], %d pre-thinned vertices)",
+                self.host_graph.v_num, hop_sampler.width,
+                hop_sampler.thinned,
+            )
         # one object for every worker count (workers=0 runs inline): the
         # per-(epoch, index) seeding makes the batch sequence bit-identical
         # regardless, so worker count is a pure throughput knob
@@ -89,8 +112,10 @@ class GCNSampleTrainer(ToolkitBase):
             cfg.batch_size,
             self.fanouts,
             seed=self.seed,
+            hop_sampler=hop_sampler,
         )
         self.sample_workers = self.par_sampler.workers
+        self._last_sample_s = 0.0
         super()._finalize_datum()
 
     def build_model(self) -> None:
@@ -215,55 +240,118 @@ class GCNSampleTrainer(ToolkitBase):
         log.info("%s Acc: %f %d %d", name, acc, total, correct)
         return acc
 
+    def _epoch_batches(self, epoch: int, pipeline):
+        """One epoch's device-ready batch tuples + the sample-time split.
+
+        Yields ``(nodes, hops, seed_mask, seeds)``; afterwards
+        ``self._last_sample_s`` holds the host time this epoch spent
+        WAITING on sampling — the full serial sample+convert time on the
+        sync path, the residual queue stall on the pipelined path (the
+        number the overlap is supposed to shrink)."""
+        if pipeline is not None:
+            yield from pipeline.epoch_stream(epoch)
+            self._last_sample_s = pipeline.last_epoch_stall_s
+            return
+        sample_s = 0.0
+        it = iter(self.par_sampler.sample_epoch(epoch))
+        while True:
+            t0 = get_time()
+            try:
+                b = next(it)
+            except StopIteration:
+                break
+            arrays = _batch_arrays(b)
+            sample_s += get_time() - t0
+            yield arrays
+        self._last_sample_s = sample_s
+
     def run(self) -> Dict[str, Any]:
         cfg = self.cfg
         key = jax.random.PRNGKey(self.seed + 1)
         log.info(
             "GNNmini::Engine[TPU.GCNSampleimpl] B=%d fanout=%s [%d] Epochs "
-            "(%d sample workers)",
+            "(%d sample workers, sampling %s)",
             cfg.batch_size, self.fanouts, cfg.epochs, self.sample_workers,
+            self.sample_mode,
         )
         loss = None
         # checkpoint/resume parity with the full-batch and dist trainers
         # (base.ckpt_* hooks) — also what hands trained weights to serve/:
         # the inference engine restores exactly these step dirs
         start_epoch = self.ckpt_begin()
-        for epoch in range(start_epoch, cfg.epochs):
-            t0 = get_time()
-            losses = []
-            for bi, b in enumerate(self.par_sampler.sample_epoch(epoch)):
-                nodes, hops, seed_mask, seeds = _batch_arrays(b)
-                bkey = jax.random.fold_in(key, epoch * 100003 + bi)
-                self.params, self.opt_state, loss = self._train_batch(
-                    self.params, self.opt_state, self.feature, self.label,
-                    nodes, hops, seed_mask, seeds, bkey,
+        pipeline = None
+        if self.sample_mode != "sync" and start_epoch < cfg.epochs:
+            from neutronstarlite_tpu.sample.pipeline import SamplePipeline
+
+            # fresh pipeline per run(): a supervised retry re-enters here
+            # and must re-schedule from its rollback epoch
+            pipeline = SamplePipeline(
+                self.par_sampler, range(start_epoch, cfg.epochs),
+                metrics=self.metrics, tracer=self.tracer,
+            )
+        try:
+            for epoch in range(start_epoch, cfg.epochs):
+                t0 = get_time()
+                losses = []
+                dispatch_s = 0.0
+                for bi, (nodes, hops, seed_mask, seeds) in enumerate(
+                    self._epoch_batches(epoch, pipeline)
+                ):
+                    bkey = jax.random.fold_in(key, epoch * 100003 + bi)
+                    td = get_time()
+                    self.params, self.opt_state, loss = self._train_batch(
+                        self.params, self.opt_state, self.feature, self.label,
+                        nodes, hops, seed_mask, seeds, bkey,
+                    )
+                    dispatch_s += get_time() - td
+                    losses.append(loss)
+                t_wait = get_time()
+                jax.block_until_ready(loss)
+                device_s = get_time() - t_wait
+                # chaos hook (NTS_FAULT_SPEC): nan_loss/stall/crash fire
+                # here, before the loss reaches history or the guards
+                epoch_loss = fault_point(
+                    "epoch_loss", epoch=epoch,
+                    value=float(np.mean([float(l) for l in losses])),
                 )
-                losses.append(loss)
-            jax.block_until_ready(loss)
-            # chaos hook (NTS_FAULT_SPEC): nan_loss/stall/crash fire here,
-            # before the loss reaches history or the guards
-            epoch_loss = fault_point(
-                "epoch_loss", epoch=epoch,
-                value=float(np.mean([float(l) for l in losses])),
-            )
-            dt = get_time() - t0
-            self.epoch_times.append(dt)
-            self.loss_history.append(float(epoch_loss))
-            gather_bytes = len(losses) * self._gather_bytes_per_batch
-            self.metrics.counter_add("sample.batches", len(losses))
-            self.metrics.counter_add(
-                "wire.feature_gather_bytes", gather_bytes
-            )
-            self.emit_epoch(
-                epoch, dt, self.loss_history[-1],
-                batches=len(losses), feature_gather_bytes=gather_bytes,
-            )
-            if epoch % max(1, cfg.epochs // 10) == 0 or epoch == cfg.epochs - 1:
-                log.info(
-                    "Epoch %d loss %f (%d batches)",
-                    epoch, self.loss_history[-1], len(losses),
+                dt = get_time() - t0
+                self.epoch_times.append(dt)
+                self.loss_history.append(float(epoch_loss))
+                gather_bytes = len(losses) * self._gather_bytes_per_batch
+                self.metrics.counter_add("sample.batches", len(losses))
+                self.metrics.counter_add(
+                    "wire.feature_gather_bytes", gather_bytes
                 )
-            self.ckpt_epoch_end(epoch)
+                # the host-observable epoch split (the fullbatch/gcn_dist
+                # attribution from PR 5, completing the trainer family):
+                # sample_wait = host time blocked on sampling (serial
+                # sample time when sync; residual pipeline stall when
+                # pipelined — the measured overlap win), step_dispatch =
+                # time issuing async device steps, step_device = the
+                # epoch-end wait for the device to drain
+                stages = {
+                    "sample_wait": self._last_sample_s,
+                    "step_dispatch": dispatch_s,
+                    "step_device": device_s,
+                }
+                self.emit_epoch(
+                    epoch, dt, self.loss_history[-1], stages=stages,
+                    batches=len(losses), feature_gather_bytes=gather_bytes,
+                )
+                if (
+                    epoch % max(1, cfg.epochs // 10) == 0
+                    or epoch == cfg.epochs - 1
+                ):
+                    log.info(
+                        "Epoch %d loss %f (%d batches)",
+                        epoch, self.loss_history[-1], len(losses),
+                    )
+                self.ckpt_epoch_end(epoch)
+        finally:
+            # drain on ANY exit — early stop, guard trip, worker fault —
+            # so no producer thread outlives its epoch loop
+            if pipeline is not None:
+                pipeline.close()
         self.ckpt_final()
         # training is done: release the sampling worker pool (a sweep that
         # builds many trainers must not accumulate forked children; a

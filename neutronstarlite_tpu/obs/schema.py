@@ -60,7 +60,9 @@ serve_summary (serve/): consolidated end-of-serving record (the serving
 
 span (obs/trace.py): one completed interval on the causal timeline
   name: str (non-empty), cat: str (phase | lifecycle | epoch | stage |
-  serve | ring | resilience | probe, open set),
+  serve | ring | resilience | probe | sample, open set; cat=sample spans
+  are the async sampling pipeline's sample_produce / h2d_copy /
+  sample_wait intervals, sample/pipeline.py),
   span_id: str (non-empty, unique within the stream),
   trace_id: str (non-empty; defaults to the run_id),
   parent_id: str | null (the enclosing span),

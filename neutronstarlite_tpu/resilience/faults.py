@@ -18,6 +18,9 @@ crash      epoch, rank (optional)       hard process death (os._exit) — the
                                         simulated preemption / OOM kill
 stall      epoch, ms (default 1000)     sleeps ms inside the epoch — the
                                         simulated hung step for the watchdog
+exc        epoch, point (optional)      raises RuntimeError at its fault
+                                        point — the in-process failure a
+                                        supervised run must roll through
 ckpt_corrupt save (1-based save index)  bit-flips the just-published
                                         arrays.npz — exercises digest
                                         verification + quarantine fallback
@@ -25,15 +28,22 @@ ckpt_corrupt save (1-based save index)  bit-flips the just-published
 
 Common args: ``times`` (how often the spec may fire, default 1) makes
 every fault one-shot by default, so a supervised retry replays the same
-epochs *without* the fault — the property the chaos tier-1 tests rely on.
+epochs *without* the fault — the property the chaos tier-1 tests rely on;
+``point`` retargets a spec to a different named fault point (default per
+kind: DEFAULT_POINTS).
 
 Fault points currently planted:
 
 - ``epoch_loss`` — every trainer epoch loop, right after the step's loss
   is materialized (models/fullbatch.py, gcn_dist.py, gcn_dist_cache.py,
-  gat_dist.py, gcn_sample.py). nan_loss/stall/crash fire here.
+  gat_dist.py, gcn_sample.py). nan_loss/stall/crash/exc fire here by
+  default.
 - ``save`` — utils/checkpoint.save_checkpoint, right after the npz
   checkpoint is atomically published. ckpt_corrupt fires here.
+- ``sample_produce`` — the async sampling pipeline's producer thread,
+  once per sampled batch (sample/pipeline.py); target it with
+  ``exc@point=sample_produce`` (or a stall) to kill/slow the sampling
+  worker mid-epoch.
 
 State (parsed plan + per-spec fired counts + the save counter) is
 process-global on purpose: a supervised retry inside the same process
@@ -53,7 +63,24 @@ from neutronstarlite_tpu.utils.logging import get_logger, process_index
 
 log = get_logger("faults")
 
-FAULT_KINDS = ("nan_loss", "crash", "stall", "ckpt_corrupt")
+FAULT_KINDS = ("nan_loss", "crash", "stall", "ckpt_corrupt", "exc")
+
+# every named fault point planted in the codebase; a spec naming any
+# other point would silently never fire — exactly the chaos-test failure
+# parse_fault_spec's loudness contract exists to prevent
+FAULT_POINTS = ("epoch_loss", "save", "sample_produce")
+
+# where each kind fires when the spec names no point= of its own. exc is
+# the generic in-process failure (raises RuntimeError at its point) —
+# e.g. ``exc@point=sample_produce`` kills the sampling pipeline's worker
+# mid-epoch so chaos tests can prove the supervisor rolls through it.
+DEFAULT_POINTS = {
+    "nan_loss": "epoch_loss",
+    "crash": "epoch_loss",
+    "stall": "epoch_loss",
+    "exc": "epoch_loss",
+    "ckpt_corrupt": "save",
+}
 
 # exit code of a simulated crash — distinguishable from a real failure's
 # rc=1 so the chaos subprocess test can assert the death was the injected
@@ -69,6 +96,8 @@ class FaultSpec:
     save: Optional[int] = None  # ckpt_corrupt: 1-based save counter
     ms: float = 1000.0  # stall: sleep duration
     times: int = 1  # max firings (one-shot by default)
+    point: Optional[str] = None  # fire at this named fault point
+    # (default: the kind's classic point, DEFAULT_POINTS)
     fired: int = 0
 
     def exhausted(self) -> bool:
@@ -76,7 +105,7 @@ class FaultSpec:
 
 
 _INT_ARGS = ("epoch", "rank", "save", "times")
-_ALLOWED_ARGS = frozenset(_INT_ARGS) | {"ms"}
+_ALLOWED_ARGS = frozenset(_INT_ARGS) | {"ms", "point"}
 
 
 def parse_fault_spec(text: str) -> List[FaultSpec]:
@@ -120,6 +149,11 @@ def parse_fault_spec(text: str) -> List[FaultSpec]:
                     f"bad fault arg value {arg!r} in NTS_FAULT_SPEC entry "
                     f"{entry!r}"
                 ) from None
+        if spec.point is not None and spec.point not in FAULT_POINTS:
+            raise ValueError(
+                f"unknown fault point {spec.point!r} in NTS_FAULT_SPEC "
+                f"entry {entry!r}; planted points: {FAULT_POINTS}"
+            )
         specs.append(spec)
     return specs
 
@@ -190,19 +224,39 @@ def fault_point(point: str, *, epoch: Optional[int] = None, value=None,
     for spec in plan:
         if spec.exhausted():
             continue
-        if point == "epoch_loss" and spec.kind == "nan_loss":
+        # each spec fires at ITS point: an explicit point= wins, else the
+        # kind's classic location (DEFAULT_POINTS) — so e.g.
+        # ``exc@point=sample_produce`` raises inside the sampling
+        # pipeline's worker while ``exc`` alone fires in the epoch loop
+        if (spec.point or DEFAULT_POINTS.get(spec.kind)) != point:
+            continue
+        if spec.kind == "nan_loss":
             if not _epoch_matches(spec, epoch):
                 continue
             spec.fired += 1
             log.warning("injecting nan_loss at epoch %s", epoch)
             value = float("nan")
-        elif point == "epoch_loss" and spec.kind == "stall":
+        elif spec.kind == "stall":
             if not _epoch_matches(spec, epoch):
                 continue
             spec.fired += 1
             log.warning("injecting %.0f ms stall at epoch %s", spec.ms, epoch)
             time.sleep(spec.ms / 1000.0)
-        elif point == "epoch_loss" and spec.kind == "crash":
+        elif spec.kind == "exc":
+            if not _epoch_matches(spec, epoch):
+                continue
+            spec.fired += 1
+            events.emit_fault(
+                "exc", point=point, epoch=epoch, injected=True,
+                rank=process_index(),
+            )
+            log.warning(
+                "injecting exception at point %s (epoch %s)", point, epoch
+            )
+            raise RuntimeError(
+                f"injected fault: exc at point {point!r} (epoch {epoch})"
+            )
+        elif spec.kind == "crash":
             if not _epoch_matches(spec, epoch):
                 continue
             if spec.rank is not None and spec.rank != process_index():
@@ -218,7 +272,7 @@ def fault_point(point: str, *, epoch: Optional[int] = None, value=None,
                 "injecting crash at epoch %s (exit %d)", epoch, CRASH_EXIT_CODE
             )
             os._exit(CRASH_EXIT_CODE)
-        elif point == "save" and spec.kind == "ckpt_corrupt":
+        elif spec.kind == "ckpt_corrupt":
             if spec.save is not None and spec.save != _save_count:
                 continue
             if path is None:

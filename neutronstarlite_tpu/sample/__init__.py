@@ -1,3 +1,7 @@
 from neutronstarlite_tpu.sample.sampler import Sampler, SampledBatch
 
 __all__ = ["Sampler", "SampledBatch"]
+
+# sample.pipeline (SamplePipeline / resolve_sample_pipeline) and
+# sample.device_sampler (DeviceUniformSampler) are imported lazily by
+# their consumers — both pull jax, which this package root must not.

@@ -1,0 +1,167 @@
+"""Jitted on-device uniform-neighbor sampling over a device-resident CSR.
+
+The host sampler (sample/sampler.py) draws per-destination uniform
+without-replacement neighbor sets by ranking random priorities over the
+candidate edge list — exact, but serial host work on the step loop's
+critical path. This module is the opt-in fast path for the uniform case
+(``SAMPLE_PIPELINE:device``): the neighbor structure lives on the device
+as a fixed-width table and the draw is one jitted program (gather +
+``jax.random`` + ``top_k``), the design the hardware-sampling paper's
+fixed-size neighbor buffers argue for (PAPERS.md, arXiv:2209.02916).
+
+Layout: a padded neighbor table ``nbr [V, D]`` (D = min(max in-degree,
+``NTS_SAMPLE_DEVICE_MAX_DEG``, default 512)) plus the effective degree
+``eff_deg [V]``. Vertices with more than D in-neighbors are pre-thinned
+to D uniformly at table build (seeded, host-side, once) — the fixed-width
+buffer's capacity rule; within the table every draw is exact uniform
+without replacement:
+
+    prio ~ U[0,1) per slot; padding slots get prio=2
+    chosen = top_k(-prio, fanout)         # k smallest priorities
+    valid  = chosen prio < 2              # slot was real
+
+which is precisely the host sampler's priority-ranking construction, so
+the two distributions match (tests/test_sample_pipeline.py pins this
+statistically, and exactly for deg <= fanout).
+
+Determinism: each draw consumes one 31-bit seed from the caller's numpy
+Generator (the Sampler's per-batch seeded rng), so device sampling is
+reproducible per (epoch, batch index) like the host path — but the draws
+themselves differ from the host sampler's (a different PRNG), so
+``device`` mode is distribution-equivalent, not bitwise-equal, to
+``sync``/``pipelined`` (docs/SAMPLING.md spells out the contract).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neutronstarlite_tpu.graph.storage import CSCGraph
+from neutronstarlite_tpu.utils.logging import get_logger
+
+log = get_logger("device_sampler")
+
+
+@functools.partial(jax.jit, static_argnums=(4,))
+def _hop(nbr, eff_deg, key, dsts, fanout: int):
+    """One uniform without-replacement draw for every dst row: k smallest
+    of per-slot random priorities, padding slots priced out at prio=2.
+    The table rides as an ARGUMENT (never a closure constant — a Reddit-
+    scale table inlined into the HLO would be a gigabyte-sized program)."""
+    rows = nbr[dsts]  # [B, D]
+    eff = eff_deg[dsts]  # [B]
+    slot = jnp.arange(rows.shape[1])[None, :]
+    prio = jax.random.uniform(key, rows.shape)
+    prio = jnp.where(slot < eff[:, None], prio, 2.0)
+    neg, idx = jax.lax.top_k(-prio, fanout)  # k smallest priorities
+    src = jnp.take_along_axis(rows, idx, axis=1)  # [B, fanout]
+    valid = -neg < 1.5  # padding slots carry prio 2
+    return src, valid
+
+
+def default_max_width() -> int:
+    raw = os.environ.get("NTS_SAMPLE_DEVICE_MAX_DEG", "")
+    if raw:
+        try:
+            return max(int(raw), 1)
+        except ValueError:
+            log.warning(
+                "NTS_SAMPLE_DEVICE_MAX_DEG=%r is not an int; using 512", raw
+            )
+    return 512
+
+
+class DeviceUniformSampler:
+    """Fixed-width device neighbor table + the jitted per-hop draw."""
+
+    def __init__(self, nbr, eff_deg, width: int, thinned: int):
+        self.width = int(width)
+        self.thinned = int(thinned)  # vertices whose neighbor set was capped
+        self.nbr = jax.device_put(nbr)  # [V, D] int32
+        self.eff_deg = jax.device_put(eff_deg)  # [V] int32
+
+    @classmethod
+    def from_host(
+        cls,
+        graph: CSCGraph,
+        max_width: Optional[int] = None,
+        seed: int = 0,
+    ) -> "DeviceUniformSampler":
+        cap = default_max_width() if max_width is None else max(int(max_width), 1)
+        deg = graph.in_degree.astype(np.int64)
+        v_num = graph.v_num
+        D = int(min(max(deg.max() if len(deg) else 1, 1), cap))
+        total = int(deg.sum())
+        # slot index of every edge within its destination's run; edge
+        # positions go through column_offset (the host sampler's gather),
+        # never an assumed-contiguous row_indices layout
+        within = np.arange(total) - np.repeat(np.cumsum(deg) - deg, deg)
+        starts = graph.column_offset[:-1].astype(np.int64)
+        pos = np.repeat(starts, deg) + within
+        src = graph.row_indices[pos].astype(np.int64)
+        dst = np.repeat(np.arange(v_num), deg)
+        thinned = int((deg > D).sum())
+        if thinned:
+            # pre-thin over-capacity vertices uniformly (the same random-
+            # priority ranking the host sampler uses, seeded once)
+            prio = np.random.default_rng(seed).random(total)
+            order = np.lexsort((prio, dst))
+            rank = np.arange(total) - np.repeat(np.cumsum(deg) - deg, deg)
+            keep = order[rank < D]
+            src, dst = src[keep], dst[keep]
+            eff = np.minimum(deg, D)
+            within = (
+                np.arange(len(src))
+                - np.repeat(np.cumsum(eff) - eff, eff)
+            )
+            log.warning(
+                "device sampler: %d vertices exceed the %d-wide neighbor "
+                "table; their neighbor sets are pre-thinned uniformly at "
+                "build (NTS_SAMPLE_DEVICE_MAX_DEG raises the cap)",
+                thinned, D,
+            )
+        else:
+            eff = deg
+        nbr = np.zeros((v_num, D), dtype=np.int32)
+        nbr[dst, within] = src.astype(np.int32)
+        return cls(nbr, eff.astype(np.int32), D, thinned)
+
+    def sample_neighbors(
+        self,
+        dsts: np.ndarray,
+        fanout: int,
+        rng: np.random.Generator,
+        cap: Optional[int] = None,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Host-facing drop-in for Sampler._sample_neighbors: (src global
+        ids, dst batch-local indices) for up to ``fanout`` distinct uniform
+        in-neighbors per dst. ``cap`` pads the dst set to a static shape so
+        the jitted draw compiles once per hop level (one compiled program
+        per (cap, fanout) pair — both come from the sampler's static
+        node_caps/fanouts, so the cache is tiny)."""
+        n_real = len(dsts)
+        B = int(cap) if cap is not None else n_real
+        if n_real > B:
+            raise ValueError(f"{n_real} dsts exceed the static cap {B}")
+        fanout = int(min(fanout, self.width))
+        dsts_pad = np.zeros(B, dtype=np.int64)
+        dsts_pad[:n_real] = dsts
+        key = jax.random.PRNGKey(int(rng.integers(0, 2**31 - 1)))
+        src, valid = _hop(self.nbr, self.eff_deg, key, dsts_pad, fanout)
+        src = np.asarray(src)
+        valid = np.array(valid)  # writable copy (device buffers are not)
+        valid[n_real:] = False  # padded dst rows are not real draws
+        dst_idx = np.broadcast_to(
+            np.arange(B, dtype=np.int64)[:, None], src.shape
+        )
+        keep = valid.ravel()
+        return (
+            src.ravel().astype(np.int64)[keep],
+            dst_idx.ravel()[keep],
+        )

@@ -124,6 +124,7 @@ class ParallelEpochSampler:
         seed: int = 0,
         workers: int | None = None,
         ctx_method: str | None = None,
+        hop_sampler=None,
     ):
         self.graph = graph
         self.seed_nids = np.asarray(seed_nids, dtype=np.int64)
@@ -131,6 +132,18 @@ class ParallelEpochSampler:
         self.fanouts = list(fanouts)
         self.base_seed = int(seed)
         self.workers = default_workers() if workers is None else max(workers, 0)
+        # on-device hop sampler (SAMPLE_PIPELINE:device): its tables are
+        # device buffers — unpicklable for spawn, and a forked child must
+        # not touch the live JAX runtime — so sampling goes inline (the
+        # draw itself is the part the device accelerates)
+        self.hop_sampler = hop_sampler
+        if hop_sampler is not None and self.workers > 0:
+            log.info(
+                "device hop sampler active: sampling runs inline "
+                "(%d workers disabled — device buffers cannot cross the "
+                "worker-process boundary)", self.workers,
+            )
+            self.workers = 0
         # fork (default): workers share the CSC copy-on-write — zero pickling,
         # but only safe BEFORE the first JAX backend touch. spawn: workers
         # pickle the graph once at pool start — costs RSS + startup at Reddit
@@ -223,6 +236,7 @@ class ParallelEpochSampler:
         s = Sampler(
             self.graph, seeds, self.batch_size, self.fanouts,
             seed=int(ss.generate_state(1)[0]),
+            hop_sampler=self.hop_sampler,
         )
         return s._make_batch(seeds)
 

@@ -1,0 +1,332 @@
+"""Async device-resident sampling pipeline: K-deep prefetch + H2D overlap.
+
+The sampled path (training via models/gcn_sample.py, serving via
+serve/sampling.py) draws neighbors in host numpy *synchronously inside the
+step loop* — the classic sample-and-aggregate bottleneck the hardware-
+sampling paper targets (PAPERS.md: "Hardware Acceleration of Sampling
+Algorithms in Sample and Aggregate GNNs", arXiv:2209.02916). JAX's async
+dispatch hides some of it by accident (the step returns before the device
+finishes), but nothing overlaps the host->device transfer of the padded
+CSR batch, and one slow sample stalls the whole chain.
+
+This module makes sampling a real pipeline stage:
+
+- ONE persistent producer thread walks the scheduled epochs through the
+  deterministic batch source (sample/parallel.ParallelEpochSampler — its
+  per-(epoch, index) SeedSequence seeding means the pipeline changes
+  *when* a batch is produced, never *what* is produced, so pipelined and
+  synchronous execution are bitwise-identical);
+- every produced SampledBatch is pushed through ``jax.device_put`` ON THE
+  PRODUCER THREAD, so the H2D copy of batch i+1 is in flight while the
+  device computes batch i (double buffering falls out of the queue depth);
+- the queue is BOUNDED (``NTS_SAMPLE_PREFETCH``, default 3): a stalled
+  consumer backpressures the producer instead of ballooning host memory
+  with padded batches;
+- the producer runs ahead ACROSS epoch boundaries (the whole epoch range
+  is scheduled up front), covering the epoch-edge bubble async dispatch
+  cannot;
+- worker failure propagates: an exception in the producer surfaces at the
+  consumer as :class:`SampleWorkerError` (a resilience HealthError, so a
+  supervised run rolls back and retries through the normal
+  rollback/restart machinery) — never a silent hang;
+- ``close()`` drains and joins — breaking out of an epoch mid-stream (an
+  early stop, a guard trip) leaves no running thread behind;
+- the producer plants a ``sample_produce`` fault point per batch
+  (resilience/faults: ``exc@point=sample_produce`` / ``stall@point=...``)
+  so chaos tests can kill the worker mid-epoch.
+
+Telemetry (obs/): per-batch ``sample_produce`` / ``h2d_copy`` spans on the
+producer and ``sample_wait`` spans on the consumer (all cat="sample"),
+plus ``sample.stall_ms`` (counter: consumer time blocked on the queue),
+``sample.produced`` / ``sample.h2d_ms`` counters and the
+``sample.queue_depth`` gauge (high-water mark). tools/trace_timeline
+derives the overlap verdict from exactly these spans.
+
+Selection: the ``SAMPLE_PIPELINE:`` cfg key / ``NTS_SAMPLE_PIPELINE`` env
+(resolved by :func:`resolve_sample_pipeline`): ``sync`` (default — the
+parity oracle), ``pipelined`` (this module over the host sampler), or
+``device`` (pipelined + the jitted on-device uniform hop sampler,
+sample/device_sampler.py). docs/SAMPLING.md has the full contract.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from typing import Any, Iterable, Optional
+
+import numpy as np
+
+from neutronstarlite_tpu.resilience.faults import fault_point
+from neutronstarlite_tpu.resilience.guards import HealthError
+from neutronstarlite_tpu.sample.sampler import SampledBatch
+from neutronstarlite_tpu.utils.logging import get_logger
+from neutronstarlite_tpu.utils.timing import get_time
+
+log = get_logger("sample_pipeline")
+
+SAMPLE_PIPELINE_MODES = ("sync", "pipelined", "device")
+
+
+class SampleWorkerError(HealthError):
+    """The pipeline's producer died; a supervised run treats it like any
+    other health fault (rollback to the last good checkpoint + retry)."""
+
+    code = "sample_worker"
+
+
+def resolve_sample_pipeline(cfg: Any = None) -> str:
+    """The active sampling mode: ``NTS_SAMPLE_PIPELINE`` env wins (launcher
+    parity with NTS_KERNEL_OVERRIDE — set-but-empty is NOT an override),
+    then the cfg's ``SAMPLE_PIPELINE:`` key, then ``sync``."""
+    raw = os.environ.get("NTS_SAMPLE_PIPELINE", "")
+    if not raw.strip():
+        raw = getattr(cfg, "sample_pipeline", "") if cfg is not None else ""
+    v = (raw or "").strip().lower()
+    if v in ("", "sync", "off", "0"):
+        return "sync"
+    if v in ("pipelined", "on", "1"):
+        return "pipelined"
+    if v == "device":
+        return "device"
+    raise ValueError(
+        f"SAMPLE_PIPELINE/NTS_SAMPLE_PIPELINE must be sync, pipelined or "
+        f"device, got {raw!r}"
+    )
+
+
+def default_depth() -> int:
+    """Prefetch depth (``NTS_SAMPLE_PREFETCH``, >= 1). 3 gives double
+    buffering plus one slot of slack for sampling-time jitter."""
+    raw = os.environ.get("NTS_SAMPLE_PREFETCH", "")
+    if raw:
+        try:
+            return max(int(raw), 1)
+        except ValueError:
+            log.warning("NTS_SAMPLE_PREFETCH=%r is not an int; using 3", raw)
+    return 3
+
+
+def batch_to_device(b: SampledBatch):
+    """SampledBatch -> the (nodes, hops, seed_mask, seeds) device pytree —
+    the exact structure models/gcn_sample._batch_arrays builds, but issued
+    through ONE ``jax.device_put`` so the transfer is dispatched (and on
+    accelerators, in flight) before the consumer ever touches the batch.
+    device_put canonicalizes dtypes identically to jnp.asarray, so the
+    compiled train step sees the same avals either way."""
+    import jax
+
+    return jax.device_put((
+        [np.asarray(n) for n in b.nodes],
+        [(h.src_local, h.dst_local, h.weight) for h in b.hops],
+        b.seed_mask,
+        b.seeds,
+    ))
+
+
+class _EpochDone:
+    __slots__ = ("epoch",)
+
+    def __init__(self, epoch: int):
+        self.epoch = epoch
+
+
+class _WorkerFailed:
+    __slots__ = ("msg",)
+
+    def __init__(self, msg: str):
+        self.msg = msg
+
+
+class SamplePipeline:
+    """Bounded prefetch queue between a deterministic batch source and the
+    training step loop.
+
+    ``source`` must expose ``sample_epoch(epoch)`` yielding SampledBatch
+    in deterministic order (sample/parallel.ParallelEpochSampler);
+    ``epochs`` is the ordered schedule the producer walks — the consumer
+    MUST call :meth:`epoch_stream` for exactly those epochs in that order.
+    ``transfer`` maps a SampledBatch to the payload the consumer receives
+    (default: :func:`batch_to_device`; tests inject identity).
+    """
+
+    def __init__(
+        self,
+        source: Any,
+        epochs: Iterable[int],
+        depth: Optional[int] = None,
+        metrics: Any = None,
+        tracer: Any = None,
+        transfer=batch_to_device,
+        stall_timeout_s: float = 120.0,
+    ):
+        self.source = source
+        self.epochs = list(epochs)
+        self.depth = default_depth() if depth is None else max(int(depth), 1)
+        self.metrics = metrics
+        self.tracer = tracer
+        self.transfer = transfer
+        self.stall_timeout_s = float(stall_timeout_s)
+        self._q: "queue.Queue" = queue.Queue(maxsize=self.depth)
+        self._stop = threading.Event()
+        self._peak_depth = 0
+        self.produced = 0
+        self.stall_s = 0.0  # total consumer wait, all epochs
+        self.last_epoch_stall_s = 0.0  # consumer wait within the last epoch
+        self._thread = threading.Thread(
+            target=self._produce, name="sample-pipeline", daemon=True
+        )
+        self._thread.start()
+
+    # ---- producer thread -------------------------------------------------
+    def _span(self, name: str, dur_s: float, t0: float, **attrs) -> None:
+        if self.tracer is not None:
+            self.tracer.complete(
+                name, dur_s=dur_s, t0=t0, cat="sample", **attrs
+            )
+
+    def _put(self, item) -> bool:
+        """Bounded put that stays responsive to close(); False = stopping."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _produce(self) -> None:
+        try:
+            for epoch in self.epochs:
+                if self._stop.is_set():
+                    return
+                it = iter(self.source.sample_epoch(epoch))
+                idx = 0
+                while not self._stop.is_set():
+                    t0 = get_time()
+                    try:
+                        b = next(it)
+                    except StopIteration:
+                        break
+                    # chaos hook: exc/stall/crash specs with
+                    # point=sample_produce fire here, inside the worker
+                    fault_point("sample_produce", epoch=epoch)
+                    t1 = get_time()
+                    payload = self.transfer(b)
+                    t2 = get_time()
+                    self._span("sample_produce", t1 - t0, t0,
+                               epoch=int(epoch), index=idx)
+                    self._span("h2d_copy", t2 - t1, t1,
+                               epoch=int(epoch), index=idx)
+                    if not self._put((epoch, idx, payload)):
+                        return
+                    self.produced += 1
+                    depth = self._q.qsize()
+                    if self.metrics is not None:
+                        self.metrics.counter_add("sample.produced")
+                        self.metrics.counter_add(
+                            "sample.h2d_ms", (t2 - t1) * 1000.0
+                        )
+                        if depth > self._peak_depth:
+                            self._peak_depth = depth
+                            self.metrics.gauge_set(
+                                "sample.queue_depth", depth
+                            )
+                    elif depth > self._peak_depth:
+                        self._peak_depth = depth
+                    idx += 1
+                if not self._put(_EpochDone(epoch)):
+                    return
+        except BaseException as e:  # surface at the consumer, never hang
+            import traceback
+
+            msg = f"{type(e).__name__}: {e}\n" + traceback.format_exc(limit=6)
+            log.warning("sampling pipeline worker failed: %s", e)
+            # bypass the bounded put's stop gate last: even a closing
+            # pipeline should record the failure if there is room
+            if not self._put(_WorkerFailed(msg)):
+                try:
+                    self._q.put_nowait(_WorkerFailed(msg))
+                except queue.Full:
+                    pass
+
+    # ---- consumer side ---------------------------------------------------
+    def _get(self):
+        waited = 0.0
+        while True:
+            try:
+                return self._q.get(timeout=0.25)
+            except queue.Empty:
+                waited += 0.25
+                if not self._thread.is_alive():
+                    raise SampleWorkerError(
+                        "sampling pipeline worker died without delivering "
+                        "its epoch (see the log for its traceback)"
+                    )
+                if waited >= self.stall_timeout_s:
+                    # a batch takes ~ms; this much silence means a wedged
+                    # worker (e.g. deadlocked child pool) — fail loudly,
+                    # never hang the epoch
+                    raise SampleWorkerError(
+                        f"sampling pipeline stalled for "
+                        f"{self.stall_timeout_s:g}s with a live worker"
+                    )
+
+    def epoch_stream(self, epoch: int):
+        """Yield this epoch's device-resident payloads in order. Epochs
+        must be consumed in the constructor's scheduled order."""
+        self.last_epoch_stall_s = 0.0
+        while True:
+            t0 = get_time()
+            item = self._get()
+            wait = get_time() - t0
+            self.stall_s += wait
+            self.last_epoch_stall_s += wait
+            if self.metrics is not None:
+                self.metrics.counter_add("sample.stall_ms", wait * 1000.0)
+            self._span("sample_wait", wait, t0, epoch=int(epoch))
+            if isinstance(item, _WorkerFailed):
+                raise SampleWorkerError(
+                    f"sampling pipeline worker failed: {item.msg}"
+                )
+            if isinstance(item, _EpochDone):
+                if item.epoch != epoch:
+                    raise SampleWorkerError(
+                        f"sampling pipeline out of order: consumer asked "
+                        f"for epoch {epoch}, producer finished "
+                        f"{item.epoch} (epochs must be consumed in the "
+                        "scheduled order)"
+                    )
+                return
+            e, idx, payload = item
+            if e != epoch:
+                raise SampleWorkerError(
+                    f"sampling pipeline out of order: got batch {idx} of "
+                    f"epoch {e} while consuming epoch {epoch}"
+                )
+            yield payload
+
+    @property
+    def peak_depth(self) -> int:
+        return self._peak_depth
+
+    def close(self) -> None:
+        """Drain and join the producer (idempotent). Safe mid-epoch: an
+        early-stopped consumer calls this and no thread survives it."""
+        self._stop.set()
+        # unblock a producer stuck in put() by draining whatever is queued;
+        # bounded — a producer wedged inside the source itself cannot be
+        # interrupted, only diagnosed
+        deadline = get_time() + 5.0
+        while self._thread.is_alive() and get_time() < deadline:
+            try:
+                self._q.get(timeout=0.05)
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=0.05)
+        if self._thread.is_alive():  # pragma: no cover - diagnostics only
+            log.warning(
+                "sampling pipeline worker did not exit within 5s of "
+                "close() (daemon thread; it dies with the process)"
+            )

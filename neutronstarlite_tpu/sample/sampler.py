@@ -65,8 +65,22 @@ class Sampler:
         seed: int = 0,
         use_native: Optional[bool] = None,
         rng: Optional[np.random.Generator] = None,
+        hop_sampler=None,
     ):
         self.graph = graph
+        # optional on-device uniform hop sampler (sample/device_sampler.py,
+        # SAMPLE_PIPELINE:device): replaces the per-hop draw only; dedup/
+        # remap/weights stay host-side. It draws via jax.random seeded from
+        # this sampler's Generator, so it excludes the native path (which
+        # seeds its own PRNG) the same way an injected rng does.
+        self.hop_sampler = hop_sampler
+        if hop_sampler is not None:
+            if use_native:
+                raise ValueError(
+                    "use_native=True cannot combine with a device "
+                    "hop_sampler; pass one or the other"
+                )
+            use_native = False
         self.seed_nids = np.asarray(seed_nids, dtype=np.int64)
         self.batch_size = batch_size
         if use_native and rng is not None:
@@ -97,10 +111,16 @@ class Sampler:
         self.node_caps = list(reversed(caps))  # node_caps[-1] == batch_size
 
     # -- vectorized per-dst uniform sampling without replacement ----------
-    def _sample_neighbors(self, dsts: np.ndarray, fanout: int):
+    def _sample_neighbors(self, dsts: np.ndarray, fanout: int, cap=None):
         """Return (src, dst_idx) pairs: for each dst, up to ``fanout``
-        distinct in-neighbors chosen uniformly (reservoir distribution)."""
+        distinct in-neighbors chosen uniformly (reservoir distribution).
+        ``cap`` is the hop's static dst capacity — only the device hop
+        sampler needs it (fixed shapes compile once per hop level)."""
         g = self.graph
+        if self.hop_sampler is not None:
+            return self.hop_sampler.sample_neighbors(
+                np.asarray(dsts, np.int64), fanout, self.rng, cap=cap
+            )
         if self.use_native:
             from neutronstarlite_tpu import native
 
@@ -142,7 +162,9 @@ class Sampler:
         cur_count = n_real
         for h in range(len(self.fanouts) - 1, -1, -1):
             fanout = self.fanouts[h]
-            src, dst_idx = self._sample_neighbors(cur_nodes, fanout)
+            src, dst_idx = self._sample_neighbors(
+                cur_nodes, fanout, cap=self.node_caps[h + 1]
+            )
             # dedup + batch-local remap (sampCSC::postprocessing's role;
             # native hash passes, or np.unique + searchsorted fallback —
             # identical sorted-unique semantics either way)

@@ -78,6 +78,11 @@ class ServeOptions:
     cache_cap: int = 0  # inference embedding cache entries (0 = disabled)
     cache_max_age_s: float = 60.0  # staleness bound for cached embeddings
     hot_threshold: int = 0  # out-degree >= threshold => cacheable vertex
+    sample_pipeline: str = "sync"  # SAMPLE_PIPELINE / NTS_SAMPLE_PIPELINE:
+    # sync (sample inside the flush, the parity oracle), pipelined (the
+    # flusher samples + stages H2D while a separate executor thread runs
+    # the previous flush on the device — serve/server.py two-stage flush),
+    # device (pipelined + the on-device uniform hop sampler)
 
     @classmethod
     def from_cfg(cls, cfg: Any = None) -> "ServeOptions":
@@ -118,6 +123,15 @@ class ServeOptions:
         o.hot_threshold = _env_override(
             "NTS_SERVE_HOT_THRESHOLD", int, o.hot_threshold
         )
+        # ONE grammar for the selector (env-wins, alias map, validation):
+        # sample.pipeline.resolve_sample_pipeline — imported lazily so
+        # this module stays importable without jax (metrics_report pulls
+        # latency_percentiles at module level)
+        from neutronstarlite_tpu.sample.pipeline import (
+            resolve_sample_pipeline,
+        )
+
+        o.sample_pipeline = resolve_sample_pipeline(cfg)
         if o.max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {o.max_batch}")
         if o.max_queue < 1:

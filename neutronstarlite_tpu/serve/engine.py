@@ -118,8 +118,27 @@ class InferenceEngine:
         self.compute_dtype = (
             jnp.bfloat16 if self.cfg.precision == "bfloat16" else None
         )
+        hop_sampler = None
+        if self.opts.sample_pipeline == "device":
+            # SAMPLE_PIPELINE:device — per-request fan-outs draw on-device
+            # (sample/device_sampler.py); distribution-equivalent to the
+            # host sampler, see docs/SAMPLING.md. The sampled trainer this
+            # engine restored through already built the neighbor table for
+            # the same mode — reuse it rather than uploading a second copy.
+            hop_sampler = getattr(
+                getattr(toolkit, "par_sampler", None), "hop_sampler", None
+            )
+            if hop_sampler is None:
+                from neutronstarlite_tpu.sample.device_sampler import (
+                    DeviceUniformSampler,
+                )
+
+                hop_sampler = DeviceUniformSampler.from_host(
+                    toolkit.host_graph
+                )
         self.sampler = ServeSampler(
-            toolkit.host_graph, self.fanouts, self.opts.ladder(), rng=rng
+            toolkit.host_graph, self.fanouts, self.opts.ladder(), rng=rng,
+            hop_sampler=hop_sampler,
         )
         self.buckets = self.sampler.buckets
         self._compiled: Dict[int, Any] = {}
@@ -260,14 +279,28 @@ class InferenceEngine:
         return compiled
 
     # ---- scoring ---------------------------------------------------------
+    def prepare_batch(self, batch: SampledBatch):
+        """SampledBatch -> device-resident (nodes, hops), the H2D stage of
+        the two-stage serve pipeline: issued through ONE ``jax.device_put``
+        so the copy is in flight while the previous flush executes."""
+        return jax.device_put((
+            [np.asarray(n) for n in batch.nodes],
+            [(h.src_local, h.dst_local, h.weight) for h in batch.hops],
+        ))
+
+    def execute_prepared(self, nodes, hops, bucket: int) -> np.ndarray:
+        """Run the bucket's AOT executable over already-device-resident
+        batch arrays (the executor stage)."""
+        compiled = self._ensure_compiled(int(bucket))
+        return np.asarray(compiled(self.params, self.feature, nodes, hops))
+
     def forward_batch(self, batch: SampledBatch,
                       bucket: Optional[int] = None) -> np.ndarray:
         """Logits [bucket, n_classes] for a prepared SampledBatch (rows
         beyond the real seed count are padding)."""
         b = int(bucket) if bucket is not None else len(batch.seeds)
-        compiled = self._ensure_compiled(b)
         nodes, hops = batch_device_args(batch)
-        return np.asarray(compiled(self.params, self.feature, nodes, hops))
+        return self.execute_prepared(nodes, hops, b)
 
     def predict(self, node_ids: np.ndarray) -> np.ndarray:
         """Fresh-sampled logits [n, n_classes] for arbitrary vertex ids."""

@@ -41,16 +41,19 @@ class ServeSampler:
         buckets: Sequence[int],
         seed: int = 0,
         rng: Optional[np.random.Generator] = None,
+        hop_sampler=None,
     ):
         self.graph = graph
         self.fanouts = list(fanouts)
         self.rng = np.random.default_rng(seed) if rng is None else rng
         # buckets share the injected Generator: draws interleave in request
-        # order, so a serving trace replays bit-identically from one seed
+        # order, so a serving trace replays bit-identically from one seed.
+        # hop_sampler (SAMPLE_PIPELINE:device): the on-device uniform draw
+        # (sample/device_sampler.py), shared across buckets too.
         self._samplers: Dict[int, Sampler] = {
             int(b): Sampler(
                 graph, np.empty(0, np.int64), int(b), self.fanouts,
-                rng=self.rng,
+                rng=self.rng, hop_sampler=hop_sampler,
             )
             for b in buckets
         }

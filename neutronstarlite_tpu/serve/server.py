@@ -22,6 +22,7 @@ from __future__ import annotations
 import argparse
 import itertools
 import os
+import queue as queue_mod
 import sys
 import threading
 import time
@@ -73,6 +74,25 @@ class InferenceServer:
         from neutronstarlite_tpu.obs.trace import Tracer
 
         self.tracer = Tracer(self.metrics)
+        # SAMPLE_PIPELINE:pipelined/device — two-stage flush: the batcher's
+        # flusher thread becomes the PRODUCER (cache pass + per-request
+        # fan-out sampling + async H2D staging) and a dedicated executor
+        # thread runs the AOT executable + replies, so sampling flush i+1
+        # overlaps device execution of flush i and the `sample` span leaves
+        # the batch_flush critical path. The queue is bounded: a stalled
+        # executor backpressures the producer, which backs up the batcher,
+        # which sheds — overload policy unchanged.
+        self.pipelined = self.opts.sample_pipeline in ("pipelined", "device")
+        self._prep_q: Optional[queue_mod.Queue] = None
+        self._exec_thread: Optional[threading.Thread] = None
+        self._producing = False
+        self._prep_peak = 0
+        if self.pipelined:
+            self._prep_q = queue_mod.Queue(maxsize=2)
+            self._exec_thread = threading.Thread(
+                target=self._exec_loop, name="serve-executor", daemon=True
+            )
+            self._exec_thread.start()
         self.batcher = MicroBatcher(self._flush, self.opts, self.metrics)
         self._stats_lock = threading.Lock()
         self._latencies_ms: List[float] = []
@@ -93,6 +113,9 @@ class InferenceServer:
 
     # ---- the flush path (batcher thread) ---------------------------------
     def _flush(self, requests: List[ServeRequest], reason: str) -> None:
+        if self.pipelined:
+            self._flush_pipelined(requests, reason)
+            return
         t0 = time.perf_counter()
         flush_id = next(_FLUSH_IDS)
         batch_span = self.tracer.begin(
@@ -116,19 +139,7 @@ class InferenceServer:
     def _flush_body(self, requests: List[ServeRequest], t0: float,
                     flush_id: int, batch_span):
         # cache pass: per requested id, a fresh cached row or a compute slot
-        all_ids: List[int] = []
-        seen = set()
-        cached_rows: Dict[int, np.ndarray] = {}
-        for r in requests:
-            for vid in r.node_ids.tolist():
-                if vid in seen:
-                    continue
-                seen.add(vid)
-                row = self.cache.lookup(vid)
-                if row is not None:
-                    cached_rows[vid] = row
-                else:
-                    all_ids.append(vid)
+        all_ids, cached_rows = self._cache_pass(requests)
         t_cache = time.perf_counter()
         bucket = None
         rows: Dict[int, np.ndarray] = dict(cached_rows)
@@ -166,6 +177,153 @@ class InferenceServer:
                 flush_id=flush_id,
             )
         return bucket, len(all_ids), exec_ms
+
+    # ---- the two-stage pipelined flush path ------------------------------
+    def _cache_pass(self, requests: List[ServeRequest]):
+        """Per requested id: a fresh cached row or a compute slot (shared
+        by both flush paths)."""
+        all_ids: List[int] = []
+        seen = set()
+        cached_rows: Dict[int, np.ndarray] = {}
+        for r in requests:
+            for vid in r.node_ids.tolist():
+                if vid in seen:
+                    continue
+                seen.add(vid)
+                row = self.cache.lookup(vid)
+                if row is not None:
+                    cached_rows[vid] = row
+                else:
+                    all_ids.append(vid)
+        return all_ids, cached_rows
+
+    def _flush_pipelined(self, requests: List[ServeRequest],
+                         reason: str) -> None:
+        """Producer stage (batcher thread): cache pass + fan-out sampling +
+        H2D staging, then hand off to the executor. All spans here are
+        retroactive completes keyed by flush_id (the critical-path join
+        key) — the batch_flush span itself is emitted by the executor once
+        the flush really finishes, so no cross-thread span stack is held
+        open across the queue."""
+        t0 = time.perf_counter()
+        flush_id = next(_FLUSH_IDS)
+        self._producing = True
+        try:
+            all_ids, cached_rows = self._cache_pass(requests)
+            t_cache = time.perf_counter()
+            bucket = None
+            prepared = None
+            uniq = None
+            t_sample = t_cache
+            t_h2d = t_cache
+            if all_ids:
+                uniq = np.asarray(all_ids, dtype=np.int64)
+                bucket = self.engine.sampler.bucket_for(len(uniq))
+                batch = self.engine.sampler.sample(bucket, uniq)
+                t_sample = time.perf_counter()
+                prepared = self.engine.prepare_batch(batch)
+                t_h2d = time.perf_counter()
+            for name, a, b in (
+                ("cache_lookup", t0, t_cache),
+                ("sample", t_cache, t_sample),
+                ("h2d_copy", t_sample, t_h2d),
+            ):
+                self.tracer.complete(
+                    name, dur_s=b - a, t0=a, cat="serve",
+                    flush_id=flush_id,
+                )
+        except BaseException:
+            self._producing = False
+            raise
+        self._producing = False
+        # bounded handoff: blocks when the executor is behind (backpressure
+        # flows to the batcher queue, whose bound sheds — policy unchanged)
+        self._prep_q.put(
+            (requests, reason, flush_id, t0, t_h2d, bucket, uniq,
+             cached_rows, prepared)
+        )
+        depth = self._prep_q.qsize()
+        if depth > self._prep_peak:
+            self._prep_peak = depth
+            if self.metrics is not None:
+                self.metrics.gauge_set("sample.queue_depth", depth)
+
+    def _exec_loop(self) -> None:
+        while True:
+            t_idle = time.perf_counter()
+            producing = self._producing
+            item = self._prep_q.get()
+            if item is None:
+                return
+            wait = time.perf_counter() - t_idle
+            if producing and self.metrics is not None:
+                # the executor was waiting ON the producer (a flush was
+                # mid-production when we went idle) — the residual,
+                # un-overlapped sampling time
+                self.metrics.counter_add("sample.stall_ms", wait * 1000.0)
+                self.tracer.complete(
+                    "sample_wait", dur_s=wait, t0=t_idle, cat="sample",
+                )
+            (requests, reason, flush_id, t0, t_h2d, bucket, uniq,
+             cached_rows, prepared) = item
+            try:
+                self._execute_prepared(
+                    requests, reason, flush_id, t0, t_h2d, bucket, uniq,
+                    cached_rows, prepared,
+                )
+            except BaseException as e:  # mirror MicroBatcher._loop
+                log.warning(
+                    "pipelined flush failed (%s): %s", type(e).__name__, e
+                )
+                self.tracer.complete(
+                    "batch_flush", dur_s=time.perf_counter() - t0, t0=t0,
+                    cat="serve", flush_id=flush_id, reason=reason,
+                    n_requests=len(requests), error=type(e).__name__,
+                )
+                for r in requests:
+                    if not r.done():
+                        r._complete(None, "error", e)
+
+    def _execute_prepared(self, requests, reason, flush_id, t0, t_h2d,
+                          bucket, uniq, cached_rows, prepared) -> None:
+        t_exec0 = time.perf_counter()
+        # the producer->executor queue wait: without this stage the serve
+        # critical path's stage sum would silently undershoot the recorded
+        # latency by exactly the handoff time in pipelined mode
+        self.tracer.complete(
+            "handoff", dur_s=t_exec0 - t_h2d, t0=t_h2d, cat="serve",
+            flush_id=flush_id,
+        )
+        rows: Dict[int, np.ndarray] = dict(cached_rows)
+        if prepared is not None:
+            nodes, hops = prepared
+            logits = self.engine.execute_prepared(nodes, hops, bucket)
+            for i, vid in enumerate(uniq.tolist()):
+                rows[vid] = logits[i]
+            self.cache.insert(uniq, logits[: len(uniq)])
+        t_exec = time.perf_counter()
+        exec_ms = (t_exec - t0) * 1000.0
+        for r in requests:
+            out = np.stack([rows[v] for v in r.node_ids.tolist()])
+            status = "cached" if all(
+                v in cached_rows for v in r.node_ids.tolist()
+            ) else "ok"
+            r._complete(out, status)
+        t_reply = time.perf_counter()
+        for name, a, b in (
+            ("execute", t_exec0, t_exec),
+            ("reply", t_exec, t_reply),
+        ):
+            self.tracer.complete(
+                name, dur_s=b - a, t0=a, cat="serve", flush_id=flush_id,
+            )
+        n_seeds = len(uniq) if uniq is not None else 0
+        self.tracer.complete(
+            "batch_flush", dur_s=t_reply - t0, t0=t0, cat="serve",
+            flush_id=flush_id, reason=reason, n_requests=len(requests),
+            bucket=bucket, n_seeds=n_seeds,
+        )
+        self._record(requests, reason, bucket, n_seeds, exec_ms, flush_id)
 
     def _record(self, requests: List[ServeRequest], reason: str,
                 bucket: Optional[int], n_seeds: int, exec_ms: float,
@@ -243,6 +401,12 @@ class InferenceServer:
             return self.stats()
         self._closed = True
         self.batcher.close()
+        if self._exec_thread is not None:
+            # the batcher has drained: everything is enqueued; the sentinel
+            # lands behind the last prepared flush (FIFO), so the executor
+            # finishes real work first
+            self._prep_q.put(None)
+            self._exec_thread.join(timeout=60.0)
         s = self.stats()
         if self.metrics is not None:
             snap = self.metrics.snapshot()
@@ -253,6 +417,7 @@ class InferenceServer:
                 latency_ms=s["latency_ms"],
                 throughput_rps=s["throughput_rps"],
                 counters=snap["counters"],
+                gauges=snap["gauges"],
                 cache=s["cache"],
                 compile_counts={
                     str(k): v for k, v in s["compile_counts"].items()

@@ -206,6 +206,7 @@ def render_serve(path: str, rec: Dict[str, Any],
             "#cache_hits={hits} misses={misses} entries={entries} "
             "expired={expired}".format(**cache)
         )
+    lines.extend(render_sample(rec))
     lines.extend(rec.get("_trace") or [])
     return "\n".join(lines)
 
@@ -250,6 +251,34 @@ def render_ring(events: List[Dict[str, Any]],
             f"#ring_hop_time_total={sum(timed) * 1000:.3f}(ms) over "
             f"{len(timed)} measured hops"
         )
+    return lines
+
+
+def render_sample(rec: Dict[str, Any]) -> List[str]:
+    """The async-sampling-pipeline block (sample/pipeline.py gauges +
+    counters the sampled trainer / serve stack pin). Empty for runs that
+    never pipelined sampling."""
+    gauges = rec.get("gauges") or {}
+    counters = rec.get("counters") or {}
+    if "sample.queue_depth" not in gauges and "sample.stall_ms" not in counters:
+        return []
+    lines = ["sampling pipeline:"]
+    depth = gauges.get("sample.queue_depth")
+    if depth is not None:
+        lines.append(
+            f"#sample_queue_depth_peak={int(depth)} (bounded prefetch; "
+            "NTS_SAMPLE_PREFETCH)"
+        )
+    stall = counters.get("sample.stall_ms")
+    produced = counters.get("sample.produced")
+    if stall is not None:
+        per = ""
+        if produced:
+            per = f" ({stall / produced:.3f} ms/batch over {int(produced)})"
+        lines.append(f"#sample_stall={stall:.3f}(ms){per}")
+    h2d = counters.get("sample.h2d_ms")
+    if h2d is not None:
+        lines.append(f"#sample_h2d={h2d:.3f}(ms)")
     return lines
 
 
@@ -312,6 +341,7 @@ def render_run(path: str, rec: Dict[str, Any]) -> str:
     if loss is not None:
         lines.append(f"#final_loss={loss}")
     lines.extend(rec.get("_ring") or [])
+    lines.extend(render_sample(rec))
     lines.extend(rec.get("_trace") or [])
     timeline = rec.get("_timeline") or []
     if timeline:
@@ -398,6 +428,13 @@ def _diff_metrics(rec, srec):
         gauges = rec.get("gauges") or {}
         out["edge_hbm_bytes_per_epoch"] = gauges.get(
             "kernel.edge_hbm_bytes_per_epoch"
+        )
+        # the async sampling pipeline's residual stall (sample/pipeline.py)
+        # — per epoch, like every other diff metric; absent on sync runs
+        # (the shared-metric filter skips it there)
+        stall = counters.get("sample.stall_ms")
+        out["sample_stall_ms_per_epoch"] = (
+            stall / n_epochs if stall is not None and n_epochs > 0 else None
         )
     if srec is not None:
         answered = srec.get("requests", 0)

@@ -262,6 +262,13 @@ def main(argv=None) -> int:
             "throughput_rps": stats["throughput_rps"], "summary": None,
         }
     lat = obs_view["latency_ms"]
+    # the serving-side sampling-pipeline telemetry (SAMPLE_PIPELINE:
+    # pipelined/device): queue depth + residual stall ride the
+    # serve_summary record's registry snapshot, so the open-loop p99
+    # report carries the overlap verdict next to the latency it buys
+    summary = obs_view.get("summary") or {}
+    s_counters = summary.get("counters") or {}
+    s_gauges = summary.get("gauges") or {}
     result = {
         "metric": "serve_p99_latency_ms",
         "value": lat["p99"],
@@ -286,6 +293,9 @@ def main(argv=None) -> int:
                 str(k): v for k, v in stats["compile_counts"].items()
             },
             "cache": stats["cache"],
+            "sample_pipeline": engine.opts.sample_pipeline,
+            "sample_queue_depth": s_gauges.get("sample.queue_depth"),
+            "sample_stall_ms": s_counters.get("sample.stall_ms"),
             "wall_s": wall_s,
             "metrics_stream": stream_path,
         },

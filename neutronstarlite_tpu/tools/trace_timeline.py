@@ -306,7 +306,57 @@ def ring_overlap_report(events: List[Dict[str, Any]]) -> Optional[Dict[str, Any]
     return None
 
 
-SERVE_STAGES = ("queue", "cache_lookup", "sample", "execute", "reply")
+def sample_pipeline_report(events: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """The async-sampling overlap verdict (sample/pipeline.py): total time
+    the producer spent sampling + staging H2D vs the residual time the
+    consumer actually waited on the queue. hidden_frac is the share of
+    sampling time the pipeline moved off the critical path — 1.0 means the
+    consumer never stalled, 0.0 means no overlap (the synchronous bound)."""
+    per_run: Dict[Any, Dict[str, float]] = {}
+    for s in spans_of(events):
+        # cat=sample only: the trainer ALSO rolls the per-epoch stall up
+        # into a "sample_wait" stage span (cat=stage) under each epoch —
+        # summing both would double-count every wait
+        if s.get("cat") != "sample":
+            continue
+        b = per_run.setdefault(
+            s.get("run_id"), {"produce": 0.0, "wait": 0.0, "h2d": 0.0,
+                              "n": 0}
+        )
+        if s["name"] == "sample_produce":
+            b["produce"] += s["dur_s"]
+            b["n"] += 1
+        elif s["name"] == "sample_wait":
+            b["wait"] += s["dur_s"]
+        elif s["name"] == "h2d_copy":
+            b["h2d"] += s["dur_s"]
+    # aggregate ONLY runs that actually produced batches: a merged dir can
+    # also hold a serve run whose executor emits sample_wait spans with no
+    # matching sample_produce — blending those in would deflate the
+    # training pipeline's verdict (the same cross-run rule the serve
+    # critical path applies via its (run_id, id) join keys)
+    rows = [b for b in per_run.values() if b["n"] > 0]
+    if not rows:
+        return None
+    produce_s = sum(b["produce"] for b in rows)
+    wait_s = sum(b["wait"] for b in rows)
+    h2d_s = sum(b["h2d"] for b in rows)
+    n = sum(b["n"] for b in rows)
+    busy = produce_s + h2d_s
+    return {
+        "batches": n,
+        "produce_s": produce_s,
+        "h2d_s": h2d_s,
+        "wait_s": wait_s,
+        "hidden_frac": (busy - min(wait_s, busy)) / busy if busy > 0 else None,
+    }
+
+
+# h2d_copy and handoff exist only on the pipelined flush (serve/server.py
+# two-stage path); sync flushes simply contribute 0.0 for them, keeping
+# the stage-sum ≡ latency contract valid in BOTH modes
+SERVE_STAGES = ("queue", "cache_lookup", "sample", "h2d_copy", "handoff",
+                "execute", "reply")
 
 
 def serve_critical_path(events: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
@@ -455,6 +505,17 @@ def timeline_block(events: List[Dict[str, Any]]) -> List[str]:
             f"compute_only={ring['compute_s'] * 1000:.3f}ms "
             f"exchange_only={ring['exchange_s'] * 1000:.3f}ms"
             f"{', sim rig' if ring.get('simulated') else ''})"
+        )
+    samp = sample_pipeline_report(events)
+    if samp is not None:
+        hidden = samp["hidden_frac"]
+        lines.append(
+            f"#sample_pipeline={samp['batches']} batch(es), "
+            f"produce={samp['produce_s'] * 1000:.3f}ms "
+            f"h2d={samp['h2d_s'] * 1000:.3f}ms "
+            f"consumer_wait={samp['wait_s'] * 1000:.3f}ms "
+            f"(hidden_frac="
+            f"{f'{hidden:.2f}' if hidden is not None else 'n/a'})"
         )
     serve = serve_critical_path(events)
     if serve is not None:

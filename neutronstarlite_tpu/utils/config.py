@@ -174,6 +174,14 @@ class InputInfo:
     serve_cache_max_age_s: float = 60.0  # cache staleness bound (seconds)
     serve_hot_threshold: int = 0  # out-degree >= threshold => cacheable
     # ("hot", the feature_cache hot/cold split rule); 0 = every vertex
+    sample_pipeline: str = ""  # SAMPLE_PIPELINE: sampling execution mode
+    # for the sampled path (training gcn_sample + serve/): "" / sync (the
+    # in-step-loop host sampler — the parity oracle), pipelined (K-deep
+    # prefetching background pipeline + async H2D, sample/pipeline.py;
+    # bitwise-identical batches to sync), or device (pipelined + the
+    # jitted on-device uniform hop sampler, sample/device_sampler.py —
+    # distribution-equivalent, not bitwise). Env override
+    # NTS_SAMPLE_PIPELINE (sample.pipeline.resolve_sample_pipeline).
 
     @staticmethod
     def read_from_cfg_file(path: str) -> "InputInfo":
@@ -326,6 +334,17 @@ class InputInfo:
             self.serve_cache_max_age_s = float(value)
         elif key == "SERVE_HOT_THRESHOLD":
             self.serve_hot_threshold = int(value)
+        elif key == "SAMPLE_PIPELINE":
+            v = value.strip().lower()
+            # validated like DIST_PATH/KERNEL: a typo'd value would
+            # silently run the synchronous sampler while the user
+            # benchmarks it as the pipeline
+            if v not in ("", "sync", "pipelined", "device"):
+                raise ValueError(
+                    f"SAMPLE_PIPELINE must be sync, pipelined or device, "
+                    f"got {value!r}"
+                )
+            self.sample_pipeline = v
         # unknown keys ignored, matching the reference's else-silence
 
     def layer_sizes(self) -> List[int]:

@@ -68,5 +68,83 @@ if [ "${NTS_CI_MICRO_FATAL:-0}" = "1" ] && [ "$micro_rc" -ne 0 ]; then
   fused_rc=$micro_rc
 fi
 
+# ---- sampling-pipeline gates (ISSUE 7) -------------------------------------
+# (1) STRUCTURAL (hard): run the pipeline smoke cfg twice — synchronous
+# (NTS_SAMPLE_PIPELINE=sync overriding the cfg) and pipelined (as written)
+# — and require (a) BITWISE loss parity between the two runs and (b) the
+# pipelined stream to actually carry the pipeline telemetry
+# (sample.stall_ms counter + sample_produce spans). NTS_NO_NATIVE=1 pins
+# the graph build deterministic across the two processes (the native
+# OpenMP builder orders tie edges nondeterministically per build), and
+# NTS_SAMPLE_WORKERS=0 keeps the single-core CI rig from forking a pool.
+samp_rc=0
+rm -rf /tmp/_t1_samp_sync /tmp/_t1_samp_pipe
+if JAX_PLATFORMS=cpu NTS_NO_NATIVE=1 NTS_SAMPLE_WORKERS=0 \
+    NTS_METRICS_DIR=/tmp/_t1_samp_sync NTS_SAMPLE_PIPELINE=sync \
+    timeout -k 10 300 python -m neutronstarlite_tpu.run \
+    configs/gcn_sample_pipeline_smoke.cfg > /tmp/_t1_samp_sync.log 2>&1 \
+  && JAX_PLATFORMS=cpu NTS_NO_NATIVE=1 NTS_SAMPLE_WORKERS=0 \
+    NTS_METRICS_DIR=/tmp/_t1_samp_pipe \
+    timeout -k 10 300 python -m neutronstarlite_tpu.run \
+    configs/gcn_sample_pipeline_smoke.cfg > /tmp/_t1_samp_pipe.log 2>&1
+then
+  JAX_PLATFORMS=cpu python - <<'EOF' || samp_rc=$?
+import glob, json, sys
+
+def load(d):
+    summary, events = None, []
+    for p in sorted(glob.glob(d + "/*.jsonl")):
+        for line in open(p, encoding="utf-8"):
+            line = line.strip()
+            if not line:
+                continue
+            e = json.loads(line)
+            events.append(e)
+            if e["event"] == "run_summary":
+                summary = e
+    return summary, events
+
+sync, _ = load("/tmp/_t1_samp_sync")
+pipe, pipe_events = load("/tmp/_t1_samp_pipe")
+assert sync and pipe, "missing run_summary on a gate side"
+assert sync["loss_history"] == pipe["loss_history"], (
+    "sync vs pipelined loss history diverged:\n"
+    f"  sync {sync['loss_history']}\n  pipe {pipe['loss_history']}"
+)
+counters = pipe.get("counters") or {}
+assert "sample.stall_ms" in counters, "pipelined run carries no sample.stall_ms"
+names = {e.get("name") for e in pipe_events if e["event"] == "span"}
+assert "sample_produce" in names, f"no sample_produce spans (got {sorted(names)})"
+assert "h2d_copy" in names, "no h2d_copy spans"
+print(
+    "sample gate: loss parity OK; stall "
+    f"{counters['sample.stall_ms']:.1f} ms over "
+    f"{int(counters.get('sample.produced', 0))} batches"
+)
+EOF
+else
+  samp_rc=$?
+fi
+if [ "$samp_rc" -ne 0 ]; then
+  echo "SAMPLE_PIPELINE_GATE=FAIL (rc=$samp_rc)"
+else
+  echo "SAMPLE_PIPELINE_GATE=OK"
+fi
+
+# (2) TIMING (advisory on the CPU rig): the same two obs streams through
+# metrics_report --diff (warm epoch time; sample_stall_ms is absent on the
+# sync side so only the shared timing metrics gate). A single-core rig
+# cannot overlap a producer thread with device compute, so this leg only
+# fails the build when NTS_CI_MICRO_FATAL=1 (on-chip rigs flip it on).
+samp_micro_rc=0
+JAX_PLATFORMS=cpu python -m neutronstarlite_tpu.tools.metrics_report \
+  --diff /tmp/_t1_samp_sync /tmp/_t1_samp_pipe --tol 1.0 \
+|| samp_micro_rc=$?
+echo "SAMPLE_PIPELINE_TIMING_GATE=rc$samp_micro_rc (advisory unless NTS_CI_MICRO_FATAL=1)"
+if [ "${NTS_CI_MICRO_FATAL:-0}" = "1" ] && [ "$samp_micro_rc" -ne 0 ]; then
+  samp_rc=$samp_micro_rc
+fi
+
 [ "$rc" -eq 0 ] && rc=$fused_rc
+[ "$rc" -eq 0 ] && rc=$samp_rc
 exit $rc
