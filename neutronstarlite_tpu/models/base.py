@@ -262,6 +262,30 @@ class ToolkitBase:
                     "free attention path)"
                 )
 
+    # trainers whose supervised path supports elastic degraded mode
+    # (NTS_ELASTIC=1: rank-loss liveness detection + survivor replan,
+    # resilience/elastic.py) — the fuse-op dist family (models/gcn_dist;
+    # GIN/CommNet inherit). Everywhere else the switch refuses loudly at
+    # the lifecycle funnel (the DIST_PATH refusal pattern): an elastic
+    # knob that silently cannot replan would let a rank loss kill the
+    # job the user armed elastic mode to survive.
+    supports_elastic = False
+
+    def _check_elastic(self) -> None:
+        from neutronstarlite_tpu.resilience import elastic
+
+        if not elastic.elastic_enabled():
+            return
+        if not getattr(type(self), "supports_elastic", False):
+            raise ValueError(
+                f"NTS_ELASTIC=1 is not available for ALGORITHM "
+                f"{self.cfg.algorithm!r}: elastic degraded-mode training "
+                "(rank-loss detection + survivor replan) serves the "
+                "fuse-op dist family (GCNDIST / GINDIST / COMMNETDIST "
+                "and their eager variants); single-chip and mirror-"
+                "family trainers have no partitioned plan to rebuild"
+            )
+
     def _check_sample_pipeline(self) -> None:
         """SAMPLE_PIPELINE loudness at the lifecycle funnel: a mode the
         run loop would silently ignore must refuse instead (the user is
@@ -289,6 +313,7 @@ class ToolkitBase:
         self._check_kernel()
         self._check_dist_path()
         self._check_sample_pipeline()
+        self._check_elastic()
         self.feature = jnp.asarray(self.datum.feature)
         self.label = jnp.asarray(self.datum.label.astype(np.int32))
         self.mask = jnp.asarray(self.datum.mask)

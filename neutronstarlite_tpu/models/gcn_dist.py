@@ -28,6 +28,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as PS
 
 from neutronstarlite_tpu.models.base import ToolkitBase, register_algorithm
+from neutronstarlite_tpu.resilience import elastic
 from neutronstarlite_tpu.resilience.faults import fault_point
 from neutronstarlite_tpu.models.gcn import init_gcn_params
 from neutronstarlite_tpu.nn.layers import batch_norm_apply, compute_cast, dropout
@@ -176,6 +177,7 @@ class DistGCNTrainer(ToolkitBase):
     weight_mode = "gcn_norm"
     with_bn = True
     supports_dist_path = True  # build_model honors DIST_PATH/WIRE_DTYPE
+    supports_elastic = True  # NTS_ELASTIC=1: liveness + survivor replan
     # per-layer NN over the exchanged aggregate; fuse-op model variants
     # (DistGINTrainer) override this and init_model_params only
     layer_nn = staticmethod(gcn_layer_nn)
@@ -251,6 +253,10 @@ class DistGCNTrainer(ToolkitBase):
                     layer_kind,
                 )
         self.comm_layer = layer_kind
+        # elastic telemetry: the currently-planned partition count — a
+        # survivor replan (resilience/elastic) rebuilds through here, so
+        # the gauge tracks degradation (e.g. 4 -> 3) for free
+        self.metrics.gauge_set("dist.active_partitions", P)
 
         if layer_kind == "ring_blocked":
             from neutronstarlite_tpu.parallel.dist_ring_blocked import (
@@ -658,6 +664,13 @@ class DistGCNTrainer(ToolkitBase):
         )
         start_epoch = self.ckpt_begin()
         loss = None
+        # rank-health monitor (resilience/elastic): one per attempt — a
+        # supervised retry (or a replan, which renumbers the survivors)
+        # re-enters run() and gets fresh miss counters for the new plan
+        self._liveness = (
+            elastic.LivenessMonitor(self.dist.partitions)
+            if elastic.elastic_enabled() else None
+        )
         if self._ring_plan is not None and os.environ.get(
             "NTS_OVERLAP_PROBE", "0"
         ) == "1":
@@ -723,6 +736,18 @@ class DistGCNTrainer(ToolkitBase):
                         seconds=None,
                         epoch_span=espan.span_id if espan else None,
                     )
+            if self._liveness is not None:
+                # per-partition heartbeats into the obs stream + miss-K /
+                # collective-timeout detection — after the epoch's
+                # telemetry (the loss is visible in the stream first),
+                # BEFORE ckpt_epoch_end: the raise lands at the rollback
+                # boundary the supervisor replans at, and the detection
+                # epoch never persists
+                self._liveness.epoch_end(
+                    epoch,
+                    alive=elastic.alive_partitions(self.dist.partitions),
+                    step_seconds=t_wait - t_disp,
+                )
             self.ckpt_epoch_end(epoch)
             if epoch % max(1, cfg.epochs // 20) == 0 or epoch == cfg.epochs - 1:
                 log.info("Epoch %d loss %f", epoch, float(loss))

@@ -8,8 +8,9 @@ consumers rely on and allows extra keys (forward compatibility).
 
 Envelope (all events):
   event: str       one of run_start | epoch | ring_step | run_summary |
-                   fault | recovery | serve_request | batch_flush | shed |
-                   serve_summary | span | stream_rotated (open set)
+                   fault | recovery | heartbeat | rank_loss | replan |
+                   serve_request | batch_flush | shed | serve_summary |
+                   span | stream_rotated (open set)
   run_id: str      "<algo>-<fingerprint>-<pid>"
   schema: int      SCHEMA_VERSION
   ts: float        wall-clock seconds (time.time())
@@ -34,9 +35,26 @@ fault (resilience/): a detected or injected fault occurrence
   epoch: int | absent, attempt: int | absent, injected: bool | absent
 
 recovery (resilience/): a recovery action taken
-  action: str   rollback | restart | resume | ckpt_fallback | giveup
-                (open set)
+  action: str   rollback | restart | resume | ckpt_fallback | giveup |
+                replan | ckpt_retry (open set)
   epoch/attempt/step: int | absent
+
+heartbeat (resilience/elastic.py): one partition's per-epoch liveness
+  beat (NTS_ELASTIC=1)
+  partition: int >= 0, epoch: int | absent
+
+rank_loss (resilience/elastic.py): the liveness monitor declared a
+  partition lost (missed-K heartbeats) or a collective timed out
+  partition: int >= 0 | null (a collective timeout cannot attribute),
+  reason: str (heartbeat_miss | collective_timeout, open set),
+  epoch: int | absent, missed_beats: int | absent
+
+replan (resilience/elastic.py): the supervisor rebuilt the distributed
+  plan for the survivors at the rollback boundary
+  from_partitions: int > 0, to_partitions: int > 0,
+  lost: int | absent (the dropped partition),
+  seconds: number | null (plan rebuild wall time),
+  moved_vertices: int | absent (vertices that changed owner)
 
 serve_request (serve/): one answered (or shed) inference request
   n_seeds: int > 0, status: str (ok | cached | shed, open set),
@@ -102,6 +120,9 @@ KNOWN_KINDS = (
     "ring_step",
     "fault",
     "recovery",
+    "heartbeat",
+    "rank_loss",
+    "replan",
     "serve_request",
     "batch_flush",
     "shed",
@@ -209,6 +230,40 @@ def validate_event(obj: Any) -> None:
                 obj[key], int
             ):
                 _fail(f"recovery.{key} must be an int when present")
+    elif kind == "heartbeat":
+        p = obj.get("partition")
+        if not isinstance(p, int) or isinstance(p, bool) or p < 0:
+            _fail(f"heartbeat.partition must be a non-negative int, got "
+                  f"{p!r}")
+        if "epoch" in obj and obj["epoch"] is not None and not isinstance(
+            obj["epoch"], int
+        ):
+            _fail("heartbeat.epoch must be an int when present")
+    elif kind == "rank_loss":
+        p = obj.get("partition")
+        if p is not None and (
+            not isinstance(p, int) or isinstance(p, bool) or p < 0
+        ):
+            _fail(f"rank_loss.partition must be a non-negative int or "
+                  f"null, got {p!r}")
+        if not isinstance(obj.get("reason"), str) or not obj["reason"]:
+            _fail("rank_loss.reason must be a non-empty string")
+        for key in ("epoch", "missed_beats"):
+            if key in obj and obj[key] is not None and not isinstance(
+                obj[key], int
+            ):
+                _fail(f"rank_loss.{key} must be an int when present")
+    elif kind == "replan":
+        for key in ("from_partitions", "to_partitions"):
+            v = obj.get(key)
+            if not isinstance(v, int) or isinstance(v, bool) or v <= 0:
+                _fail(f"replan.{key} must be a positive int, got {v!r}")
+        for key in ("lost", "moved_vertices", "epoch"):
+            if key in obj and obj[key] is not None and not isinstance(
+                obj[key], int
+            ):
+                _fail(f"replan.{key} must be an int when present")
+        _require_number(obj, "seconds", allow_none=True)
     elif kind == "serve_request":
         if not isinstance(obj.get("n_seeds"), int) or obj["n_seeds"] <= 0:
             _fail(f"serve_request.n_seeds must be a positive int, got "

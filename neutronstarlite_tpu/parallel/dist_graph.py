@@ -34,7 +34,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from neutronstarlite_tpu.graph.storage import CSCGraph, partition_offsets
-from neutronstarlite_tpu.parallel.vertex_space import PaddedVertexSpace, round_up
+from neutronstarlite_tpu.parallel.vertex_space import (
+    PaddedVertexSpace,
+    owner_of_vertices,
+    round_up,
+)
 
 _round_up = round_up  # layout helper shared with MirrorGraph
 
@@ -91,7 +95,7 @@ class DistGraph(PaddedVertexSpace):
         vp = _round_up(int(sizes.max()), lane_pad)
 
         # owner partition of each vertex id
-        owner = np.searchsorted(offsets, np.arange(g.v_num), side="right") - 1
+        owner = owner_of_vertices(offsets)
 
         src = g.row_indices.astype(np.int64)  # CSC order: dst-sorted
         dst = g.dst_of_edge.astype(np.int64)

@@ -15,6 +15,31 @@ def round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
+def owner_of_vertices(offsets: np.ndarray) -> np.ndarray:
+    """[V] owning partition of every original vertex id under a range
+    partition map (the searchsorted inverse of ``offsets``) — shared by
+    the DistGraph block grouping and the elastic replan accounting."""
+    v_num = int(offsets[-1])
+    return np.searchsorted(offsets, np.arange(v_num), side="right") - 1
+
+
+def reassigned_vertices(old_offsets: np.ndarray,
+                        new_offsets: np.ndarray) -> int:
+    """How many vertices change owner between two range-partition maps
+    of the same vertex space — the ``replan`` record's redistribution
+    size (a lost partition's whole range moves, plus every boundary
+    shift the P' re-balance introduces)."""
+    if int(old_offsets[-1]) != int(new_offsets[-1]):
+        raise ValueError(
+            "partition maps cover different vertex spaces: "
+            f"{int(old_offsets[-1])} vs {int(new_offsets[-1])}"
+        )
+    return int(
+        (owner_of_vertices(old_offsets) != owner_of_vertices(new_offsets))
+        .sum()
+    )
+
+
 class PaddedVertexSpace:
     """Mixin for containers with partitions / vp / offsets / v_num fields."""
 

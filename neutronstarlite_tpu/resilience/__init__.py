@@ -16,13 +16,22 @@ pillars (docs/RESILIENCE.md):
   (``NTS_MAX_RESTARTS`` / ``NTS_BACKOFF_BASE_S``), LR scale-down on
   repeated divergence, non-zero exit only when retries are exhausted;
 - :mod:`events` — every fault, guard trip, rollback, and retry lands as
-  a typed ``fault``/``recovery`` record in the obs/ JSONL stream.
+  a typed ``fault``/``recovery`` record in the obs/ JSONL stream;
+- :mod:`elastic` — degraded-mode distributed training (``NTS_ELASTIC=1``):
+  per-partition heartbeat liveness (``rank_loss`` detection on missed-K
+  beats or a collective timeout) and the survivor replan the supervisor
+  runs at the rollback boundary instead of dying with the lost rank.
 
 Checkpoint integrity (per-array sha256 digests, atomic publication,
 keep-last-K retention, quarantine + fallback) lives with the checkpoint
 code in utils/checkpoint.py and reports through :mod:`events`.
 """
 
+from neutronstarlite_tpu.resilience.elastic import (
+    LivenessMonitor,
+    RankLossError,
+    replan_survivors,
+)
 from neutronstarlite_tpu.resilience.events import (
     emit_fault,
     emit_recovery,
@@ -50,11 +59,14 @@ __all__ = [
     "DivergenceError",
     "FaultSpec",
     "HealthError",
+    "LivenessMonitor",
     "NonFiniteLossError",
     "NonFiniteParamsError",
+    "RankLossError",
     "RetriesExhaustedError",
     "StallError",
     "Watchdog",
+    "replan_survivors",
     "emit_fault",
     "emit_recovery",
     "fault_point",

@@ -24,6 +24,17 @@ exc        epoch, point (optional)      raises RuntimeError at its fault
 ckpt_corrupt save (1-based save index)  bit-flips the just-published
                                         arrays.npz — exercises digest
                                         verification + quarantine fallback
+rank_loss  epoch, partition (default 0) kills one SIM partition mid-epoch:
+                                        registers it dead with
+                                        resilience/elastic, so its
+                                        heartbeats stop and the liveness
+                                        monitor detects the loss — the
+                                        chaos input of the elastic
+                                        survivor-replan path (NTS_ELASTIC=1).
+                                        partition is in ORIGINAL launch
+                                        numbering: a spec firing after a
+                                        replan kills the same physical
+                                        rank under its renumbered index
 ========== ============================ =======================================
 
 Common args: ``times`` (how often the spec may fire, default 1) makes
@@ -63,7 +74,8 @@ from neutronstarlite_tpu.utils.logging import get_logger, process_index
 
 log = get_logger("faults")
 
-FAULT_KINDS = ("nan_loss", "crash", "stall", "ckpt_corrupt", "exc")
+FAULT_KINDS = ("nan_loss", "crash", "stall", "ckpt_corrupt", "exc",
+               "rank_loss")
 
 # every named fault point planted in the codebase; a spec naming any
 # other point would silently never fire — exactly the chaos-test failure
@@ -80,6 +92,7 @@ DEFAULT_POINTS = {
     "stall": "epoch_loss",
     "exc": "epoch_loss",
     "ckpt_corrupt": "save",
+    "rank_loss": "epoch_loss",
 }
 
 # exit code of a simulated crash — distinguishable from a real failure's
@@ -95,6 +108,7 @@ class FaultSpec:
     rank: Optional[int] = None  # crash: only on this process index
     save: Optional[int] = None  # ckpt_corrupt: 1-based save counter
     ms: float = 1000.0  # stall: sleep duration
+    partition: Optional[int] = None  # rank_loss: sim partition to kill
     times: int = 1  # max firings (one-shot by default)
     point: Optional[str] = None  # fire at this named fault point
     # (default: the kind's classic point, DEFAULT_POINTS)
@@ -104,7 +118,7 @@ class FaultSpec:
         return self.fired >= self.times
 
 
-_INT_ARGS = ("epoch", "rank", "save", "times")
+_INT_ARGS = ("epoch", "rank", "save", "times", "partition")
 _ALLOWED_ARGS = frozenset(_INT_ARGS) | {"ms", "point"}
 
 
@@ -272,6 +286,25 @@ def fault_point(point: str, *, epoch: Optional[int] = None, value=None,
                 "injecting crash at epoch %s (exit %d)", epoch, CRASH_EXIT_CODE
             )
             os._exit(CRASH_EXIT_CODE)
+        elif spec.kind == "rank_loss":
+            if not _epoch_matches(spec, epoch):
+                continue
+            spec.fired += 1
+            part = spec.partition if spec.partition is not None else 0
+            # the injection-site record (injected=True); the DETECTION
+            # record is the liveness monitor's typed ``rank_loss`` event,
+            # which only lands once the missed beats cross the K budget
+            events.emit_fault(
+                "rank_loss", point=point, epoch=epoch, partition=part,
+                injected=True, rank=process_index(),
+            )
+            log.warning(
+                "injecting rank loss: killing sim partition %d at epoch %s",
+                part, epoch,
+            )
+            from neutronstarlite_tpu.resilience import elastic
+
+            elastic.kill_partition(part)
         elif spec.kind == "ckpt_corrupt":
             if spec.save is not None and spec.save != _save_count:
                 continue

@@ -7,22 +7,36 @@ runs ``toolkit.run()``, and on a :class:`HealthError`:
 
 1. emits one typed ``fault`` record (kind = the guard's code) into the
    obs stream;
-2. gives up — :class:`RetriesExhaustedError` — once ``NTS_MAX_RESTARTS``
+2. gives up — :class:`RetriesExhaustedError`, naming every distinct
+   fault code seen across the attempts — once ``NTS_MAX_RESTARTS``
    (default 2) retries are spent; the launcher turns that into a non-zero
    exit;
-3. otherwise sleeps ``NTS_BACKOFF_BASE_S`` (default 0.5) x 2^(attempt-1);
-4. rolls back: when the run has a checkpoint dir with a restorable
+3. otherwise sleeps ``NTS_BACKOFF_BASE_S`` (default 0.5) x 2^(attempt-1)
+   x (1 + jitter), where jitter is a deterministic seeded fraction in
+   [0, 0.5) per (worker, attempt) — supervised workers that fail
+   together (one shared fault domain) must not hammer the checkpoint
+   store or the scheduler in lockstep when they restart;
+4. ELASTIC (``NTS_ELASTIC=1``): a :class:`~.elastic.RankLossError`
+   naming a lost partition does NOT retry the same plan — the plan is
+   rebuilt for the P-1 survivors at this rollback boundary
+   (``elastic.replan_survivors``: repartition, fresh ring schedule,
+   re-jit), the retry restores params (partition-independent) from the
+   last-good checkpoint over the rebuilt plan, and training continues
+   on the degraded mesh — ``recovery(action=replan)``. A
+   collective-timeout rank loss with no identified partition falls
+   back to the ordinary same-plan rollback below;
+5. rolls back: when the run has a checkpoint dir with a restorable
    checkpoint, the retry's ``run()`` re-enters through ``ckpt_begin`` and
    resumes from the last good step (the guards fire *before*
    ``ckpt_epoch_end``, so a poisoned epoch is never persisted). Without
    one, the model is rebuilt from scratch (fresh params — the in-memory
    state may be exactly what is poisoned);
-5. on repeated divergence, optionally scales the learning rate down by
+6. on repeated divergence, optionally scales the learning rate down by
    ``NTS_LR_BACKOFF`` (default 0.5, 1.0 disables) and rebuilds the jitted
    step so the new rate takes effect — the restore still happens over the
    rebuilt params;
-6. emits one ``recovery`` record (action = rollback | restart | +
-   ``lr_scale`` detail) and retries.
+7. emits one ``recovery`` record (action = rollback | restart | replan |
+   + ``lr_scale`` detail) and retries.
 
 A run that was hard-killed (crash fault, preemption, OOM) has no
 in-process supervisor left; its recovery is the *next* invocation
@@ -36,12 +50,14 @@ ones (a genuinely diverging run, an actually-hung step under
 
 from __future__ import annotations
 
+import contextlib
 import os
+import random
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
-from neutronstarlite_tpu.resilience import events, guards
-from neutronstarlite_tpu.utils.logging import get_logger
+from neutronstarlite_tpu.resilience import elastic, events, guards
+from neutronstarlite_tpu.utils.logging import get_logger, process_index
 
 log = get_logger("supervisor")
 
@@ -50,11 +66,52 @@ from neutronstarlite_tpu.resilience.guards import _env_float
 
 
 class RetriesExhaustedError(RuntimeError):
-    """Raised when every allowed restart failed; carries the last fault."""
+    """Raised when every allowed restart failed; carries the last fault
+    plus the distinct ``HealthError.code``s seen across the attempts (a
+    run that died on divergence after first tripping on a rank loss must
+    report both — the last fault alone misattributes the episode)."""
 
-    def __init__(self, msg: str, last_error: Optional[BaseException] = None):
+    def __init__(self, msg: str, last_error: Optional[BaseException] = None,
+                 codes: Optional[List[str]] = None):
         super().__init__(msg)
         self.last_error = last_error
+        self.codes = list(codes or [])
+
+
+def backoff_jitter_frac(attempt: int) -> float:
+    """Deterministic seeded backoff jitter in [0, 0.5): each (worker,
+    attempt) pair gets its own fraction — seeded by the JAX process
+    index (override: ``NTS_BACKOFF_JITTER_SEED``) — so co-failing
+    supervised workers desynchronize their retries while a re-run of
+    the same worker reproduces its delays exactly."""
+    seed = os.environ.get("NTS_BACKOFF_JITTER_SEED") or str(process_index())
+    return 0.5 * random.Random(f"{seed}:{attempt}").random()
+
+
+def _should_replan(toolkit, err: guards.HealthError) -> bool:
+    """Survivor replan applies when elastic mode is armed, the fault is a
+    rank loss that NAMES the lost partition, and the trainer has a
+    multi-partition plan to shrink."""
+    if not (elastic.elastic_enabled()
+            and isinstance(err, elastic.RankLossError)):
+        return False
+    if err.partition is None:
+        # collective-timeout detection cannot attribute the loss to one
+        # partition; dropping a guess would evict a healthy rank —
+        # ordinary same-plan rollback instead
+        log.warning(
+            "rank loss without an identified partition (%s): cannot "
+            "replan — falling back to same-plan rollback", err,
+        )
+        return False
+    dist = getattr(toolkit, "dist", None)
+    if dist is None or dist.partitions <= 1:
+        log.warning(
+            "rank loss but no multi-partition plan to shrink — falling "
+            "back to same-plan rollback"
+        )
+        return False
+    return True
 
 
 def _have_restorable_checkpoint(toolkit) -> bool:
@@ -107,7 +164,13 @@ def supervised_run(
 
     attempt = 0
     divergence_streak = 0
-    with guards.armed():
+    codes_seen: List[str] = []
+    # injected rank deaths (the rank_loss fault kind) must not leak into
+    # the NEXT supervised run constructed in this process — a leaked dead
+    # mark would trip a spurious rank_loss on a healthy plan after K
+    # epochs. In-run retries (inside the loop) still see the dead set.
+    with guards.armed(), contextlib.ExitStack() as cleanup:
+        cleanup.callback(elastic.reset)
         while True:
             watchdog = None
             if watchdog_s > 0 and use_interrupt:
@@ -153,15 +216,18 @@ def supervised_run(
                     "supervised run attempt %d failed: [%s] %s",
                     attempt, err.code, err,
                 )
+                if err.code not in codes_seen:
+                    codes_seen.append(err.code)
                 if attempt > max_restarts:
                     events.emit_recovery(
                         action="giveup", attempt=attempt, epoch=err.epoch
                     )
                     raise RetriesExhaustedError(
                         f"giving up after {attempt - 1} restart(s) "
-                        f"(NTS_MAX_RESTARTS={max_restarts}); last fault: "
-                        f"[{err.code}] {err}",
-                        last_error=err,
+                        f"(NTS_MAX_RESTARTS={max_restarts}); fault codes "
+                        f"seen across attempts: {', '.join(codes_seen)}; "
+                        f"last fault: [{err.code}] {err}",
+                        last_error=err, codes=codes_seen,
                     ) from err
                 divergence_streak = (
                     divergence_streak + 1
@@ -169,30 +235,53 @@ def supervised_run(
                 )
                 if backoff_base_s > 0:
                     delay = backoff_base_s * (2.0 ** (attempt - 1))
+                    delay *= 1.0 + backoff_jitter_frac(attempt)
                     log.info("backing off %.2fs before restart", delay)
                     with tracer.span("backoff", cat="resilience",
                                      attempt=attempt, delay_s=delay):
                         time.sleep(delay)
 
-                scale_lr = (
-                    divergence_streak >= 2 and lr_backoff > 0
-                    and lr_backoff != 1.0
-                )
-                if scale_lr:
-                    old = toolkit.cfg.learn_rate
-                    toolkit.cfg.learn_rate = old * lr_backoff
-                    log.warning(
-                        "repeated divergence: scaling LR %g -> %g",
-                        old, toolkit.cfg.learn_rate,
+                scale_lr = False
+                replan_extra: Dict[str, Any] = {}
+                if _should_replan(toolkit, err):
+                    # survivor replan at the rollback boundary: rebuild
+                    # the plan for P-1, then restore the (partition-
+                    # independent) params from the last-good checkpoint
+                    # over it — instead of burning retries on a plan
+                    # whose partition is gone
+                    with tracer.span("replan", cat="resilience",
+                                     attempt=attempt,
+                                     lost_partition=err.partition):
+                        new_p = elastic.replan_survivors(
+                            toolkit, err.partition
+                        )
+                    rollback = _have_restorable_checkpoint(toolkit)
+                    action = "replan"
+                    replan_extra = {"partitions": new_p}
+                    if metrics is not None:
+                        metrics.counter_add("resilience.replans")
+                else:
+                    scale_lr = (
+                        divergence_streak >= 2 and lr_backoff > 0
+                        and lr_backoff != 1.0
                     )
-                rollback = _have_restorable_checkpoint(toolkit)
-                if scale_lr or not rollback:
-                    # fresh params + re-jitted step (the new LR lives in
-                    # the closed-over AdamConfig); with a checkpoint, the
-                    # retry's ckpt_begin restores over the rebuilt params
-                    with tracer.span("rebuild", cat="resilience",
-                                     attempt=attempt):
-                        toolkit.build_model()
+                    if scale_lr:
+                        old = toolkit.cfg.learn_rate
+                        toolkit.cfg.learn_rate = old * lr_backoff
+                        log.warning(
+                            "repeated divergence: scaling LR %g -> %g",
+                            old, toolkit.cfg.learn_rate,
+                        )
+                    rollback = _have_restorable_checkpoint(toolkit)
+                    if scale_lr or not rollback:
+                        # fresh params + re-jitted step (the new LR lives
+                        # in the closed-over AdamConfig); with a
+                        # checkpoint, the retry's ckpt_begin restores
+                        # over the rebuilt params
+                        with tracer.span("rebuild", cat="resilience",
+                                         attempt=attempt):
+                            toolkit.build_model()
+                    action = "rollback" if rollback else "restart"
                 if not rollback:
                     # restart-from-scratch: the failed attempt's epoch
                     # telemetry must not pollute run_summary aggregates
@@ -201,19 +290,23 @@ def supervised_run(
                     toolkit.epoch_times.clear()
                     toolkit.loss_history.clear()
                     toolkit._first_epoch_trained = None
-                action = "rollback" if rollback else "restart"
                 if metrics is not None:
                     metrics.counter_add("resilience.restarts")
                 guards.new_attempt(toolkit)
-                # the retry resumes via ckpt_begin; the action string
+                # the retry resumes via ckpt_begin; the retry string
                 # suppresses its duplicate recovery(action=resume) record
                 # and tells it whether a failed restore must fall back to
                 # a model rebuild (rollback chosen but every retained
-                # step turned out corrupt)
-                toolkit._supervised_retry = action
+                # step turned out corrupt). A replan retry is a rollback
+                # (restore over the rebuilt P-1 plan) when a checkpoint
+                # exists, a restart otherwise.
+                toolkit._supervised_retry = (
+                    "rollback" if rollback else "restart"
+                )
                 events.emit_recovery(
                     action=action, attempt=attempt, epoch=err.epoch,
                     fault=err.code,
+                    **replan_extra,
                     **({"lr_scaled_to": toolkit.cfg.learn_rate}
                        if scale_lr else {}),
                 )

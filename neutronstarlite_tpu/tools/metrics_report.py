@@ -8,9 +8,13 @@ each record against the obs schema, and renders:
 - per run: the ``#key=value(ms)`` block — epoch timing attribution
   (first/warm/compile-overhead), the PhaseTimers buckets, then non-time
   counters (wire bytes, batches) and memory as ``#key=value`` lines;
-- per run: the recovery timeline — every ``fault`` / ``recovery`` record
-  (resilience/) with its offset from the stream's first event, so a
-  run's failure-and-recovery history reads at a glance;
+- per run: the recovery timeline — every ``fault`` / ``recovery`` (and
+  elastic ``rank_loss`` / ``replan``) record (resilience/) with its
+  offset from the stream's first event, so a run's
+  failure-and-recovery history reads at a glance;
+- per run: the elastic-timeline block (``NTS_ELASTIC=1`` runs) —
+  heartbeat volume, rank-loss detections, survivor replans with their
+  time-to-recover, and the final ``dist.active_partitions``;
 - per run: the span timeline block (tools/trace_timeline derived
   metrics) — span inventory, measured ring overlap efficiency, serve
   critical-path breakdown, retry cost — when the stream carries ``span``
@@ -286,19 +290,76 @@ _TIMELINE_SKIP = ("event", "run_id", "schema", "ts", "seq", "error")
 
 
 def recovery_timeline(events: List[Dict[str, Any]]) -> List[str]:
-    """``fault``/``recovery`` records as offset-stamped one-liners;
-    ``stream_rotated`` markers (the NTS_METRICS_MAX_MB guard) ride the
-    same timeline — a truncated history must say so in the report."""
+    """``fault``/``recovery`` records as offset-stamped one-liners; the
+    elastic ``rank_loss``/``replan`` records and ``stream_rotated``
+    markers (the NTS_METRICS_MAX_MB guard) ride the same timeline — a
+    truncated history must say so in the report."""
     t0 = events[0]["ts"] if events else 0.0
     lines: List[str] = []
     for e in events:
-        if e["event"] not in ("fault", "recovery", "stream_rotated"):
+        if e["event"] not in ("fault", "recovery", "rank_loss", "replan",
+                              "stream_rotated"):
             continue
         detail = " ".join(
             f"{k}={e[k]}" for k in sorted(e)
             if k not in _TIMELINE_SKIP and e[k] is not None
         )
         lines.append(f"  +{e['ts'] - t0:8.2f}s {e['event']:<8s} {detail}")
+    return lines
+
+
+def render_elastic(events: List[Dict[str, Any]],
+                   rec: Dict[str, Any]) -> List[str]:
+    """The elastic-timeline block (resilience/elastic, NTS_ELASTIC=1):
+    heartbeat volume, every rank-loss detection, every survivor replan
+    with its time-to-recover (rank_loss -> first post-replan epoch_end),
+    and the final dist.active_partitions gauge. Empty for runs that
+    never ran elastic."""
+    beats = [e for e in events if e["event"] == "heartbeat"]
+    losses = [e for e in events if e["event"] == "rank_loss"]
+    replans = [e for e in events if e["event"] == "replan"]
+    if not (beats or losses or replans):
+        return []
+    lines = ["elastic timeline:"]
+    if beats:
+        parts = {e["partition"] for e in beats}
+        lines.append(
+            f"#heartbeats={len(beats)} over {len(parts)} partition(s)"
+        )
+    for e in losses:
+        part = e.get("partition")
+        missed = e.get("missed_beats")
+        lines.append(
+            f"#rank_loss=partition "
+            f"{part if part is not None else '?'} at epoch "
+            f"{e.get('epoch')} ({e.get('reason', '?')}"
+            + (f", {missed} missed beats)" if missed is not None else ")")
+        )
+    # the rank_loss -> first-post-replan-epoch pairing has ONE
+    # implementation (trace_timeline.elastic_report, run_id-guarded for
+    # merged dirs); this block and the span-timeline verdict must never
+    # disagree on the same stream
+    from neutronstarlite_tpu.tools.trace_timeline import elastic_report
+
+    episodes = (elastic_report(events) or {}).get("episodes") or []
+    for e, ep in zip(replans, episodes):
+        secs = e.get("seconds")
+        moved = e.get("moved_vertices")
+        lines.append(
+            f"#replan={e['from_partitions']}->{e['to_partitions']} "
+            f"partitions (lost partition {e.get('lost')}"
+            + (f", {moved} vertices re-owned" if moved is not None else "")
+            + (f", rebuilt in {secs * 1000:.1f} ms)" if secs is not None
+               else ")")
+        )
+        if ep["recover_s"] is not None:
+            lines.append(
+                f"#time_to_recover={ep['recover_s']:.2f}s "
+                "(rank_loss -> first post-replan epoch_end)"
+            )
+    active = (rec.get("gauges") or {}).get("dist.active_partitions")
+    if active is not None:
+        lines.append(f"#active_partitions={int(active)}")
     return lines
 
 
@@ -341,6 +402,7 @@ def render_run(path: str, rec: Dict[str, Any]) -> str:
     if loss is not None:
         lines.append(f"#final_loss={loss}")
     lines.extend(rec.get("_ring") or [])
+    lines.extend(rec.get("_elastic") or [])
     lines.extend(render_sample(rec))
     lines.extend(rec.get("_trace") or [])
     timeline = rec.get("_timeline") or []
@@ -616,6 +678,7 @@ def main(argv=None) -> int:
             rec["_path"] = p
             rec["_timeline"] = recovery_timeline(events)
             rec["_ring"] = render_ring(events, rec)
+            rec["_elastic"] = render_elastic(events, rec)
             rec["_trace"] = trace_lines
         if srec is not None:
             srec["_path"] = p

@@ -16,8 +16,8 @@ process-local monotonic clocks. This CLI reconstructs one coherent view:
    place.
 3. **Chrome trace-event export** (``--chrome out.json``): complete ("X")
    events per span (pid = rank, tid = host thread), instant events for
-   fault / recovery / shed records — loadable in Perfetto or
-   chrome://tracing. When the run also wrote a ``jax.profiler`` trace
+   fault / recovery / shed / rank_loss / replan records — loadable in
+   Perfetto or chrome://tracing. When the run also wrote a ``jax.profiler`` trace
    (``NTS_PROFILE_DIR``), the host spans were emitted as
    ``TraceAnnotation``s inside it too, so the device-op view carries the
    same names — open both in one Perfetto window to line host causality
@@ -31,7 +31,9 @@ process-local monotonic clocks. This CLI reconstructs one coherent view:
      ``serve_request`` records by ``req_id``; the stage sum must match
      the recorded end-to-end latency (the tests pin the tolerance);
    - retry cost — per fault episode, time from the fault record to the
-     first epoch completed after recovery, plus replayed-epoch counts.
+     first epoch completed after recovery, plus replayed-epoch counts;
+   - elastic time-to-recover — per survivor replan, the time from the
+     rank_loss detection record to the first post-replan epoch end.
 
 Usage:
   python -m neutronstarlite_tpu.tools.trace_timeline <file-or-dir> [...]
@@ -162,7 +164,7 @@ def load_streams(paths: List[str]) -> List[Stream]:
 # Chrome trace export
 # ---------------------------------------------------------------------------
 
-_INSTANT_KINDS = ("fault", "recovery", "shed")
+_INSTANT_KINDS = ("fault", "recovery", "shed", "rank_loss", "replan")
 _ENVELOPE_OR_SPAN = (
     "event", "run_id", "schema", "ts", "seq", "name", "cat", "span_id",
     "trace_id", "parent_id", "t0", "dur_s", "rank", "thread",
@@ -232,6 +234,11 @@ def chrome_trace(streams: List[Stream]) -> Dict[str, Any]:
             label = (
                 e.get("kind") or e.get("action") or e.get("reason") or ""
             )
+            if e["event"] == "replan":
+                # the elastic degradation, readable off the marker name
+                label = (
+                    f"{e.get('from_partitions')}->{e.get('to_partitions')}"
+                )
             events.append({
                 "ph": "i",
                 "name": f"{e['event']}:{label}",
@@ -472,6 +479,49 @@ def retry_report(events: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
     }
 
 
+def elastic_report(events: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """The elastic degraded-mode verdict: per ``replan`` episode, the
+    time from the triggering ``rank_loss`` detection record to the first
+    post-replan epoch end — end-to-end time-to-recover, plan rebuild +
+    checkpoint restore + recompile included."""
+    replans = [e for e in events if e["event"] == "replan"]
+    if not replans:
+        return None
+    losses = [e for e in events if e["event"] == "rank_loss"]
+    epochs = [e for e in events if e["event"] == "epoch"]
+    episodes = []
+    for r in replans:
+        # same-run pairing only (the retry_report rule): a merged dir
+        # must not heal one run's rank loss with another run's epochs
+        rid = r.get("run_id")
+        trigger = next(
+            (x for x in reversed(losses)
+             if x.get("run_id") == rid and x["ts"] <= r["ts"]), None
+        )
+        healed = next(
+            (x for x in epochs
+             if x.get("run_id") == rid and x["ts"] > r["ts"]), None
+        )
+        episodes.append({
+            "from_partitions": r.get("from_partitions"),
+            "to_partitions": r.get("to_partitions"),
+            "lost": r.get("lost"),
+            "recover_s": (
+                healed["ts"] - trigger["ts"]
+                if healed is not None and trigger is not None else None
+            ),
+        })
+    recovered = [e["recover_s"] for e in episodes
+                 if e["recover_s"] is not None]
+    return {
+        "episodes": episodes,
+        "n": len(episodes),
+        "mean_recover_s": (
+            sum(recovered) / len(recovered) if recovered else None
+        ),
+    }
+
+
 def span_inventory(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     by_name: Dict[str, Dict[str, float]] = {}
     for s in spans_of(events):
@@ -528,6 +578,16 @@ def timeline_block(events: List[Dict[str, Any]]) -> List[str]:
             )
             + f" (critical={serve['critical_stage']}, n={serve['n']}, "
             f"max|stage_sum-latency|={serve['max_abs_mismatch_ms']:.3f}ms)"
+        )
+    ela = elastic_report(events)
+    if ela is not None:
+        last = ela["episodes"][-1]
+        mean = ela["mean_recover_s"]
+        lines.append(
+            f"#elastic={ela['n']} replan(s), last P "
+            f"{last['from_partitions']}->{last['to_partitions']} "
+            f"(lost partition {last['lost']}), time_to_recover="
+            f"{f'{mean:.2f}s' if mean is not None else 'n/a'}"
         )
     retry = retry_report(events)
     if retry is not None:
@@ -588,6 +648,7 @@ def main(argv=None) -> int:
         "ring_overlap": ring_overlap_report(merged),
         "serve_critical_path": serve_critical_path(merged),
         "retries": retry_report(merged),
+        "elastic": elastic_report(merged),
         "span_inventory": span_inventory(merged),
     }
     if args.chrome:

@@ -34,7 +34,13 @@ manager's ``max_to_keep``). ``restore_checkpoint`` verifies every digest
 before trusting a step; a truncated or bit-flipped checkpoint is
 QUARANTINED (renamed ``*.corrupt``, a ``fault`` record in the obs
 stream) and restore falls back to the previous retained step instead of
-crashing or silently loading garbage. ``tools/verify_checkpoint`` runs
+crashing or silently loading garbage. TRANSIENT read errors are not
+corruption: an IO-level failure (EIO, a stale NFS handle, a permission
+blip) is retried with bounded exponential backoff (``NTS_CKPT_RETRIES``,
+default 2, x ``NTS_CKPT_RETRY_BASE_S`` doubling — each retry a typed
+``recovery(action=ckpt_retry)`` record) before the step is given up on;
+only a failure that survives the retries — or a non-transient one
+(digest mismatch, manifest schema drift, a torn zip) — quarantines. ``tools/verify_checkpoint`` runs
 the same verification as a CLI preflight. The pre-integrity flat layout
 (manifest.json + arrays.npz directly under the dir) restores fine —
 legacy manifests simply carry no digests to verify.
@@ -47,6 +53,7 @@ import json
 import os
 import re
 import shutil
+import time
 import zipfile
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -313,12 +320,44 @@ def orbax_latest_step(path: str) -> Optional[int]:
 # ---- verification -----------------------------------------------------------
 
 
-class CheckpointCorruptError(RuntimeError):
-    """A step dir failed structural or digest verification."""
+def ckpt_retries() -> int:
+    """Bounded retries over TRANSIENT checkpoint read errors before a
+    step is given up on (``NTS_CKPT_RETRIES``, default 2, min 0)."""
+    try:
+        return max(int(os.environ.get("NTS_CKPT_RETRIES", "2")), 0)
+    except ValueError:
+        return 2
 
-    def __init__(self, msg: str, problems: Optional[List[str]] = None):
+
+def ckpt_retry_base_s() -> float:
+    """Base of the transient-read retry backoff (``NTS_CKPT_RETRY_BASE_S``,
+    default 0.1 s, doubling per attempt; min 0)."""
+    try:
+        return max(
+            float(os.environ.get("NTS_CKPT_RETRY_BASE_S", "0.1")), 0.0
+        )
+    except ValueError:
+        return 0.1
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A step dir failed structural or digest verification. ``transient``
+    marks an IO-level read failure (OSError) that a retry may clear —
+    the restore path backs off and re-reads those instead of
+    quarantining a perfectly good checkpoint over a filesystem blip."""
+
+    def __init__(self, msg: str, problems: Optional[List[str]] = None,
+                 transient: bool = False):
         super().__init__(msg)
         self.problems = problems or [msg]
+        self.transient = transient
+
+
+def _read_arrays(arrays_path: str) -> Dict[str, np.ndarray]:
+    """Load + materialize the npz (factored out so the transient-IO
+    retry tests can wrap it with a fail-then-succeed shim)."""
+    with np.load(arrays_path) as data:
+        return {k: data[k] for k in data.files}
 
 
 def verify_step_dir(
@@ -343,7 +382,13 @@ def verify_step_dir(
     try:
         with open(manifest_path) as fh:
             manifest = json.load(fh)
-    except (OSError, json.JSONDecodeError) as e:
+    except FileNotFoundError as e:  # vanished file: permanent, no retry
+        raise CheckpointCorruptError(f"{step_dir}: missing manifest: {e}")
+    except OSError as e:  # IO-level: possibly transient, retryable
+        raise CheckpointCorruptError(
+            f"{step_dir}: unreadable manifest: {e}", transient=True
+        )
+    except json.JSONDecodeError as e:
         raise CheckpointCorruptError(f"{step_dir}: unreadable manifest: {e}")
     if not isinstance(manifest.get("step"), int) or not isinstance(
         manifest.get("trees"), dict
@@ -354,9 +399,16 @@ def verify_step_dir(
     if not os.path.exists(arrays_path):
         raise CheckpointCorruptError(f"{step_dir}: missing {ARRAYS}")
     try:
-        with np.load(arrays_path) as data:
-            loaded = {k: data[k] for k in data.files}
-    except Exception as e:  # truncated/garbled zip: BadZipFile, OSError...
+        loaded = _read_arrays(arrays_path)
+    except FileNotFoundError as e:  # vanished file: permanent, no retry
+        raise CheckpointCorruptError(f"{step_dir}: missing {ARRAYS}: {e}")
+    except OSError as e:
+        # IO-level failure (EIO, stale NFS handle, permissions): the
+        # retry wrapper re-reads before anyone quarantines over it
+        raise CheckpointCorruptError(
+            f"{step_dir}: unreadable {ARRAYS}: {e}", transient=True
+        )
+    except Exception as e:  # truncated/garbled zip: BadZipFile, ValueError
         raise CheckpointCorruptError(f"{step_dir}: unreadable {ARRAYS}: {e}")
     declared = manifest.get("arrays", {})
     if manifest.get("format", 1) >= 2 and not isinstance(declared, dict):
@@ -392,6 +444,37 @@ def verify_step_dir(
             problems=problems,
         )
     return manifest, status, loaded
+
+
+def _verify_step_with_retries(step_dir: str):
+    """:func:`verify_step_dir` with bounded exponential backoff over
+    TRANSIENT IO errors (``NTS_CKPT_RETRIES`` x ``NTS_CKPT_RETRY_BASE_S``
+    doubling). Each retry is a typed ``recovery(action=ckpt_retry)``
+    record. Only a failure that survives the retries — or a
+    non-transient one (digest mismatch, schema drift, torn zip) —
+    reaches the caller's quarantine."""
+    retries = ckpt_retries()
+    attempt = 0
+    while True:
+        try:
+            return verify_step_dir(step_dir)
+        except CheckpointCorruptError as e:
+            if not e.transient or attempt >= retries:
+                raise
+            attempt += 1
+            delay = ckpt_retry_base_s() * (2.0 ** (attempt - 1))
+            log.warning(
+                "transient checkpoint read error in %s (retry %d/%d in "
+                "%.2fs): %s", step_dir, attempt, retries, delay, e,
+            )
+            from neutronstarlite_tpu.resilience import events
+
+            events.emit_recovery(
+                action="ckpt_retry", attempt=attempt, path=step_dir,
+                error=str(e)[:200],
+            )
+            if delay > 0:
+                time.sleep(delay)
 
 
 def _quarantine(step_dir: str, reason: str) -> None:
@@ -477,7 +560,7 @@ def restore_checkpoint(
     quarantined = 0
     for step, step_dir in reversed(list_steps(path)):
         try:
-            manifest, _status, arrays = verify_step_dir(step_dir)
+            manifest, _status, arrays = _verify_step_with_retries(step_dir)
             state = _rebuild_state(like, manifest, arrays)
         except CheckpointCorruptError as e:
             _quarantine(step_dir, str(e))

@@ -145,6 +145,58 @@ if [ "${NTS_CI_MICRO_FATAL:-0}" = "1" ] && [ "$samp_micro_rc" -ne 0 ]; then
   samp_rc=$samp_micro_rc
 fi
 
+# ---- elastic degraded-mode gate (ISSUE 9) ----------------------------------
+# STRUCTURAL (hard): inject a rank loss into the 4-partition sim-ring
+# elastic smoke cfg and require the supervisor to survive it: the run
+# exits 0 (supervised replan, not a retry-exhausted death), the stream
+# carries the rank_loss detection and a replan record with 4 -> 3
+# partitions, and the dist.active_partitions gauge ends at 3.
+elastic_rc=0
+rm -rf /tmp/_t1_elastic /tmp/_t1_elastic_ck
+if JAX_PLATFORMS=cpu NTS_METRICS_DIR=/tmp/_t1_elastic NTS_ELASTIC=1 \
+    NTS_HEARTBEAT_MISS_K=1 NTS_BACKOFF_BASE_S=0 \
+    NTS_FAULT_SPEC='rank_loss@partition=2,epoch=1' \
+    timeout -k 10 600 python -m neutronstarlite_tpu.run \
+    configs/gcn_dist_elastic_smoke.cfg > /tmp/_t1_elastic.log 2>&1
+then
+  JAX_PLATFORMS=cpu python - <<'EOF' || elastic_rc=$?
+import glob, json
+
+from neutronstarlite_tpu.obs import schema
+
+events = []
+for p in sorted(glob.glob("/tmp/_t1_elastic/*.jsonl")):
+    for line in open(p, encoding="utf-8"):
+        line = line.strip()
+        if line:
+            events.append(json.loads(line))
+assert schema.validate_stream(events) == len(events)
+losses = [e for e in events if e["event"] == "rank_loss"]
+replans = [e for e in events if e["event"] == "replan"]
+assert losses, "no rank_loss detection record in the stream"
+assert replans, "no replan record in the stream"
+r = replans[-1]
+assert (r["from_partitions"], r["to_partitions"]) == (4, 3), r
+summ = [e for e in events if e["event"] == "run_summary"][-1]
+active = summ["gauges"].get("dist.active_partitions")
+assert active == 3, f"dist.active_partitions={active!r}, want 3 after replan"
+print(
+    "elastic gate: replanned 4->3 (lost partition "
+    f"{r.get('lost')}, {r.get('moved_vertices')} vertices re-owned); "
+    "run completed on the degraded mesh"
+)
+EOF
+else
+  elastic_rc=$?
+  tail -30 /tmp/_t1_elastic.log
+fi
+if [ "$elastic_rc" -ne 0 ]; then
+  echo "ELASTIC_GATE=FAIL (rc=$elastic_rc)"
+else
+  echo "ELASTIC_GATE=OK"
+fi
+
 [ "$rc" -eq 0 ] && rc=$fused_rc
 [ "$rc" -eq 0 ] && rc=$samp_rc
+[ "$rc" -eq 0 ] && rc=$elastic_rc
 exit $rc

@@ -1,0 +1,520 @@
+"""Elastic degraded-mode chaos suite (ISSUE 9), on CPU.
+
+What is pinned here:
+
+- end-to-end: ``rank_loss@partition=2`` injected into a 4-partition
+  ``ring_blocked_sim`` run is detected by the liveness monitor
+  (missed-K heartbeats), survived by the supervisor's survivor replan
+  (P'=3 at the rollback boundary, params restored from the last-good
+  checkpoint), and the run finishes with a finite, decreasing loss —
+  with the full telemetry story (heartbeat / rank_loss / replan records,
+  ``dist.active_partitions`` 4 -> 3) in the obs stream;
+- the replan-equivalence oracle: post-replan training is BITWISE equal
+  to a fresh P'-partition run restored from the same checkpoint (the
+  PR 2 resume-equivalence oracle pattern — both sides share one host
+  graph, because the native builder orders tie edges per build);
+- liveness monitor units: miss-K trip, recovery-resets-miss-count,
+  collective timeout (first-epoch exemption), knob clamps;
+- the lifecycle-funnel refusal: NTS_ELASTIC=1 on a non-dist trainer
+  refuses loudly instead of silently never replanning;
+- satellites: transient-IO checkpoint read retries (vs immediate
+  digest-mismatch quarantine), deterministic seeded supervisor backoff
+  jitter, and RetriesExhaustedError naming every fault code seen.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from neutronstarlite_tpu.graph.storage import build_graph
+from neutronstarlite_tpu.models.base import get_algorithm
+from neutronstarlite_tpu.models.gcn import GCNTrainer
+from neutronstarlite_tpu.obs.registry import MetricsRegistry
+from neutronstarlite_tpu.obs.schema import validate_stream
+from neutronstarlite_tpu.resilience import elastic, events, faults, guards
+from neutronstarlite_tpu.resilience import supervisor
+from neutronstarlite_tpu.resilience.supervisor import (
+    RetriesExhaustedError,
+    supervised_run,
+)
+from neutronstarlite_tpu.utils import checkpoint
+from neutronstarlite_tpu.utils.config import InputInfo
+from tests.test_models import _planted_cfg, _planted_data
+
+
+@pytest.fixture(autouse=True)
+def _clean_elastic_state(monkeypatch):
+    """Fault plans and the dead-partition registry are process-global by
+    design (a supervised retry must see them); tests must not."""
+    for var in ("NTS_FAULT_SPEC", "NTS_ELASTIC", "NTS_HEARTBEAT_MISS_K",
+                "NTS_COLLECTIVE_TIMEOUT_S", "NTS_GUARDS",
+                "NTS_CKPT_RETRIES", "NTS_CKPT_RETRY_BASE_S"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("NTS_BACKOFF_BASE_S", "0")
+    faults.reset()
+    elastic.reset()
+    yield
+    faults.reset()
+    elastic.reset()
+
+
+def _stream_events(metrics_dir):
+    files = sorted(glob.glob(os.path.join(str(metrics_dir), "*.jsonl")))
+    assert files, f"no metrics stream under {metrics_dir}"
+    evs = []
+    for f in files:
+        with open(f) as fh:
+            evs.extend(json.loads(line) for line in fh if line.strip())
+    validate_stream(evs)
+    return evs
+
+
+def _of(evs, kind):
+    return [e for e in evs if e["event"] == kind]
+
+
+def _dist_cfg(epochs=6, partitions=4, v_num=200, f=8, classes=3):
+    cfg = InputInfo()
+    cfg.algorithm = "GCNDIST"
+    cfg.vertices = v_num
+    cfg.layer_string = f"{f}-8-{classes}"
+    cfg.epochs = epochs
+    cfg.learn_rate = 0.01
+    cfg.weight_decay = 1e-4
+    cfg.decay_epoch = -1
+    cfg.drop_rate = 0.0
+    cfg.partitions = partitions
+    cfg.dist_path = "ring_blocked_sim"
+    cfg.kernel_tile = 16
+    return cfg
+
+
+def _dist_rig(seed=11, v_num=200, f=8, classes=3):
+    src, dst, datum = _planted_data(v_num=v_num, classes=classes, f=f,
+                                    seed=seed)
+    # one shared host graph: bitwise comparisons across trainers must not
+    # eat the native builder's per-build tie-edge ordering wobble
+    g = build_graph(src, dst, v_num, weight="gcn_norm")
+    return src, dst, datum, g
+
+
+# ---- end-to-end: rank loss -> replan -> degraded finish ---------------------
+
+
+def test_rank_loss_replans_to_survivors_and_finishes(tmp_path, monkeypatch):
+    """The ISSUE 9 acceptance scenario on the sim twin: partition 2 of 4
+    dies at epoch 1, detection trips after NTS_HEARTBEAT_MISS_K=2 missed
+    beats, the supervisor replans to P'=3 at the rollback boundary, and
+    the run finishes without operator intervention."""
+    monkeypatch.setenv("NTS_METRICS_DIR", str(tmp_path / "obs"))
+    monkeypatch.setenv("NTS_ELASTIC", "1")
+    monkeypatch.setenv("NTS_HEARTBEAT_MISS_K", "2")
+    monkeypatch.setenv("NTS_FAULT_SPEC", "rank_loss@partition=2,epoch=1")
+    monkeypatch.setenv("NTS_MAX_RESTARTS", "2")
+    faults.reset()
+    src, dst, datum, g = _dist_rig(seed=11)
+    cfg = _dist_cfg(epochs=6, partitions=4)
+    cfg.checkpoint_dir = str(tmp_path / "ck")
+    cfg.checkpoint_every = 1
+    trainer = get_algorithm("GCNDIST").from_arrays(
+        cfg, src, dst, datum, host_graph=g
+    )
+    result = supervised_run(trainer)
+
+    assert np.isfinite(result["loss"])
+    # the plan really degraded: 3 survivors own the whole vertex range
+    assert trainer.dist.partitions == 3
+    assert cfg.partitions == 3
+    assert int(trainer.dist.offsets[-1]) == cfg.vertices
+    # logical trajectory: every epoch exactly once, finite, improving
+    assert len(trainer.loss_history) == 6
+    assert all(np.isfinite(v) for v in trainer.loss_history)
+    assert trainer.loss_history[-1] < trainer.loss_history[0]
+    assert trainer.metrics.snapshot()["gauges"]["dist.active_partitions"] == 3
+
+    evs = _stream_events(tmp_path / "obs")
+    # detection: the typed rank_loss record names partition + reason
+    losses = _of(evs, "rank_loss")
+    assert losses and losses[0]["partition"] == 2
+    assert losses[0]["reason"] == "heartbeat_miss"
+    assert losses[0]["missed_beats"] == 2
+    # the survivor replan record
+    replans = _of(evs, "replan")
+    assert len(replans) == 1
+    assert replans[0]["from_partitions"] == 4
+    assert replans[0]["to_partitions"] == 3
+    assert replans[0]["lost"] == 2
+    assert replans[0]["moved_vertices"] > 0
+    # supervisor story: rank_loss fault + recovery(action=replan)
+    assert any(fr["kind"] == "rank_loss" for fr in _of(evs, "fault"))
+    recov = [r for r in _of(evs, "recovery") if r["action"] == "replan"]
+    assert len(recov) == 1 and recov[0]["partitions"] == 3
+    # heartbeats: 4 partitions beat before the loss, 3 after the replan
+    beats = _of(evs, "heartbeat")
+    assert {b["partition"] for b in beats if b["epoch"] == 0} == {0, 1, 2, 3}
+    last_epoch = max(b["epoch"] for b in beats)
+    assert {b["partition"] for b in beats if b["epoch"] == last_epoch} == \
+        {0, 1, 2}
+    # the replan span landed (the supervisor wraps the rebuild)
+    spans = [e for e in evs if e["event"] == "span"]
+    assert any(s["name"] == "replan" for s in spans)
+
+
+def test_replan_equivalence_oracle_bitwise(tmp_path):
+    """Post-replan training state ≡ a fresh P'-partition run restored
+    from the same checkpoint: both resume at the same step, train the
+    same epochs at P'=3, and must agree BITWISE on the loss curve and
+    the final params (the sim twin runs one deterministic XLA program on
+    both sides)."""
+    src, dst, datum, g = _dist_rig(seed=7)
+    algo = get_algorithm("GCNDIST")
+    ck_a = str(tmp_path / "ck_a")
+
+    # phase 1: 3 epochs at P=4 produce the shared checkpoint (step-3)
+    cfg_pre = _dist_cfg(epochs=3, partitions=4)
+    cfg_pre.checkpoint_dir = ck_a
+    cfg_pre.checkpoint_every = 1
+    algo.from_arrays(cfg_pre, src, dst, datum, host_graph=g).run()
+    ck_b = str(tmp_path / "ck_b")
+    shutil.copytree(ck_a, ck_b)  # side A keeps checkpointing into ck_a
+
+    # side A: a 4-partition trainer replanned to P'=3 (the degraded-mode
+    # path minus the fault theater), resumed from the checkpoint
+    cfg_a = _dist_cfg(epochs=6, partitions=4)
+    cfg_a.checkpoint_dir = ck_a
+    cfg_a.checkpoint_every = 1
+    ta = algo.from_arrays(cfg_a, src, dst, datum, host_graph=g)
+    elastic.replan_survivors(ta, lost_partition=2)
+    assert ta.dist.partitions == 3
+    ta.run()  # ckpt_begin restores step-3, trains epochs 3..5 at P'=3
+
+    # side B: a FRESH P'=3 run restored from the same checkpoint
+    cfg_b = _dist_cfg(epochs=6, partitions=3)
+    cfg_b.checkpoint_dir = ck_b
+    cfg_b.checkpoint_every = 1
+    tb = algo.from_arrays(cfg_b, src, dst, datum, host_graph=g)
+    tb.run()
+
+    assert len(ta.loss_history) == 3 and len(tb.loss_history) == 3
+    assert ta.loss_history == tb.loss_history  # bitwise, not approx
+    for a, b in zip(jax.tree_util.tree_leaves(ta.params),
+                    jax.tree_util.tree_leaves(tb.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_double_rank_loss_replans_twice(tmp_path, monkeypatch):
+    """Two partitions die before the FIRST detection: the dead set must
+    renumber (not clear) across the first replan, so the second loss is
+    still detected on the degraded plan and a second replan lands —
+    4 -> 3 -> 2 — instead of silently resurrecting the planted fault."""
+    monkeypatch.setenv("NTS_METRICS_DIR", str(tmp_path / "obs"))
+    monkeypatch.setenv("NTS_ELASTIC", "1")
+    monkeypatch.setenv("NTS_HEARTBEAT_MISS_K", "1")
+    monkeypatch.setenv(
+        "NTS_FAULT_SPEC",
+        "rank_loss@partition=1,epoch=1;rank_loss@partition=3,epoch=1",
+    )
+    monkeypatch.setenv("NTS_MAX_RESTARTS", "3")
+    faults.reset()
+    src, dst, datum, g = _dist_rig(seed=13)
+    cfg = _dist_cfg(epochs=5, partitions=4)
+    cfg.checkpoint_dir = str(tmp_path / "ck")
+    cfg.checkpoint_every = 1
+    trainer = get_algorithm("GCNDIST").from_arrays(
+        cfg, src, dst, datum, host_graph=g
+    )
+    result = supervised_run(trainer)
+    assert np.isfinite(result["loss"])
+    assert trainer.dist.partitions == 2
+    evs = _stream_events(tmp_path / "obs")
+    replans = _of(evs, "replan")
+    assert [(r["from_partitions"], r["to_partitions"]) for r in replans] \
+        == [(4, 3), (3, 2)]
+    # the second detection names old partition 3 under its NEW index (2)
+    losses = _of(evs, "rank_loss")
+    assert [l["partition"] for l in losses] == [1, 2]
+
+
+def test_dead_set_renumbers_after_loss():
+    elastic.kill_partition(1)
+    elastic.kill_partition(3)
+    elastic.renumber_after_loss(1)
+    assert elastic.dead_partitions() == {2}  # old 3 under the new numbering
+    elastic.renumber_after_loss(2)
+    assert elastic.dead_partitions() == set()
+
+
+def test_kill_partition_translates_original_ids_after_replan():
+    """Fault specs are written against the ORIGINAL plan numbering; a
+    spec firing after a replan must kill the same physical rank under
+    its new index, and one naming an already-evicted rank is ignored."""
+    elastic.renumber_after_loss(0)  # original 0 gone: 1,2,3 -> 0,1,2
+    elastic.kill_partition(3)  # original rank 3 == current index 2
+    assert elastic.dead_partitions() == {2}
+    elastic.kill_partition(0)  # original 0 already evicted: no-op
+    assert elastic.dead_partitions() == {2}
+    assert elastic.current_index_of(0) is None
+    assert elastic.current_index_of(2) == 1
+
+
+def test_rank_loss_out_of_range_partition_refuses():
+    """rank_loss@partition=7 on a 4-partition plan would never be
+    reported missing — the chaos test would pass vacuously. The
+    fault-spec loudness contract demands a refusal instead."""
+    elastic.kill_partition(7)
+    with pytest.raises(ValueError, match="partition"):
+        elastic.alive_partitions(4)
+
+
+def test_supervised_run_clears_dead_set_on_exit():
+    """An injected rank death must not leak into the NEXT supervised run
+    in the same process (it would trip a spurious rank_loss on a healthy
+    plan); the in-run retries still see it."""
+    elastic.kill_partition(1)
+    tk = _FlakyToolkit([
+        guards.NonFiniteLossError("nan", epoch=1),
+        guards.NonFiniteLossError("nan", epoch=1),
+    ])
+    with pytest.raises(RetriesExhaustedError):
+        supervised_run(tk, max_restarts=1, backoff_base_s=0)
+    assert elastic.dead_partitions() == set()
+
+
+# ---- liveness monitor units -------------------------------------------------
+
+
+def test_liveness_miss_k_trip(monkeypatch):
+    monkeypatch.setenv("NTS_GUARDS", "1")
+    mon = elastic.LivenessMonitor(4, miss_k=3)
+    mon.epoch_end(0, alive=[0, 1, 2, 3])
+    mon.epoch_end(1, alive=[0, 1, 3])  # miss 1
+    mon.epoch_end(2, alive=[0, 1, 3])  # miss 2
+    with pytest.raises(elastic.RankLossError) as ei:
+        mon.epoch_end(3, alive=[0, 1, 3])  # miss 3 == K
+    assert ei.value.partition == 2
+    assert ei.value.epoch == 3
+    assert ei.value.code == "rank_loss"
+
+
+def test_liveness_recovery_resets_miss_count(monkeypatch):
+    """A partition that beats again before K is NOT a rank loss —
+    transient network wobble must not evict a healthy rank."""
+    monkeypatch.setenv("NTS_GUARDS", "1")
+    mon = elastic.LivenessMonitor(2, miss_k=2)
+    mon.epoch_end(0, alive=[0])  # 1 missed 1
+    mon.epoch_end(1, alive=[0, 1])  # 1 recovered: counter resets
+    mon.epoch_end(2, alive=[0])  # missed 1 again — still under K
+    with pytest.raises(elastic.RankLossError):
+        mon.epoch_end(3, alive=[0])  # 2 consecutive misses
+
+
+def test_collective_timeout_trips_after_first_epoch(monkeypatch):
+    """The collective budget exempts the attempt's first epoch (it pays
+    compile/restore, the StallError exemption) and cannot attribute the
+    loss to one partition."""
+    monkeypatch.setenv("NTS_GUARDS", "1")
+    mon = elastic.LivenessMonitor(2, collective_timeout=0.1)
+    mon.epoch_end(0, alive=[0, 1], step_seconds=9.0)  # exempt
+    with pytest.raises(elastic.RankLossError) as ei:
+        mon.epoch_end(1, alive=[0, 1], step_seconds=9.0)
+    assert ei.value.partition is None
+
+
+def test_liveness_knob_clamps(monkeypatch):
+    monkeypatch.setenv("NTS_HEARTBEAT_MISS_K", "0")
+    assert elastic.heartbeat_miss_k() == 1  # clamped, never insta-dead
+    monkeypatch.setenv("NTS_HEARTBEAT_MISS_K", "banana")
+    assert elastic.heartbeat_miss_k() == 3  # default on garbage
+    monkeypatch.setenv("NTS_COLLECTIVE_TIMEOUT_S", "-4")
+    assert elastic.collective_timeout_s() == 0.0  # negative clamps to off
+    monkeypatch.setenv("NTS_COLLECTIVE_TIMEOUT_S", "2.5")
+    assert elastic.collective_timeout_s() == 2.5
+    mon = elastic.LivenessMonitor(2, miss_k=-3)
+    assert mon.miss_k == 1
+
+
+def test_liveness_unarmed_warns_not_raises(monkeypatch):
+    """Outside supervision (guards unarmed) the monitor keeps the seed
+    behavior: records flow, nothing raises."""
+    monkeypatch.delenv("NTS_GUARDS", raising=False)
+    mon = elastic.LivenessMonitor(2, miss_k=1)
+    mon.epoch_end(0, alive=[0])
+    mon.epoch_end(1, alive=[0])  # still no raise
+
+
+def test_rank_loss_fault_kills_sim_partition(monkeypatch):
+    monkeypatch.setenv("NTS_FAULT_SPEC", "rank_loss@partition=1,epoch=0")
+    faults.reset()
+    faults.fault_point("epoch_loss", epoch=0, value=0.5)
+    assert elastic.dead_partitions() == {1}
+    assert elastic.alive_partitions(4) == [0, 2, 3]
+    elastic.reset()
+    assert elastic.alive_partitions(4) == [0, 1, 2, 3]
+
+
+# ---- lifecycle-funnel refusal -----------------------------------------------
+
+
+def test_elastic_refused_on_non_dist_trainer(monkeypatch):
+    """NTS_ELASTIC=1 on a trainer with no partitioned plan must refuse
+    loudly at the funnel — a silently inert elastic switch would let the
+    rank loss it was armed against kill the job anyway."""
+    monkeypatch.setenv("NTS_ELASTIC", "1")
+    src, dst, datum = _planted_data(seed=5)
+    with pytest.raises(ValueError, match="NTS_ELASTIC"):
+        GCNTrainer.from_arrays(_planted_cfg(epochs=2), src, dst, datum)
+
+
+# ---- satellite: checkpoint transient-IO retry -------------------------------
+
+
+def _make_ckpt(tmp_path):
+    state = {"params": {"W": jnp.arange(6.0)}, "opt": {"m": jnp.zeros(3)}}
+    ck = str(tmp_path / "ck")
+    checkpoint.save_checkpoint(ck, state, 1)
+    return ck, state
+
+
+def _recording_sink(tmp_path):
+    path = str(tmp_path / "retry_obs.jsonl")
+    return MetricsRegistry("retry-run", algorithm="X", fingerprint="f",
+                           path=path), path
+
+
+def test_ckpt_transient_io_retries_then_restores(tmp_path, monkeypatch):
+    """Two simulated EIO reads then success: the restore backs off and
+    re-reads instead of quarantining a perfectly good checkpoint, and
+    each retry lands as a typed recovery(action=ckpt_retry) record."""
+    ck, state = _make_ckpt(tmp_path)
+    monkeypatch.setenv("NTS_CKPT_RETRIES", "3")
+    monkeypatch.setenv("NTS_CKPT_RETRY_BASE_S", "0")
+    real = checkpoint._read_arrays
+    calls = {"n": 0}
+
+    def flaky(path):
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise OSError("simulated EIO")
+        return real(path)
+
+    monkeypatch.setattr(checkpoint, "_read_arrays", flaky)
+    reg, obs_path = _recording_sink(tmp_path)
+    events.set_sink(reg)
+    try:
+        got = checkpoint.restore_checkpoint(ck, state)
+    finally:
+        events.set_sink(None)
+        reg.close()
+    assert got is not None and got[1] == 1
+    assert calls["n"] == 3
+    assert not any(d.endswith(".corrupt") for d in os.listdir(ck))
+    evs = [json.loads(l) for l in open(obs_path) if l.strip()]
+    retries = [e for e in evs
+               if e["event"] == "recovery" and e["action"] == "ckpt_retry"]
+    assert [e["attempt"] for e in retries] == [1, 2]
+
+
+def test_ckpt_transient_exhausted_quarantines(tmp_path, monkeypatch):
+    """A transient error that never clears still ends in quarantine —
+    the retries bound the tolerance, they do not suspend integrity."""
+    ck, state = _make_ckpt(tmp_path)
+    monkeypatch.setenv("NTS_CKPT_RETRIES", "1")
+    monkeypatch.setenv("NTS_CKPT_RETRY_BASE_S", "0")
+    calls = {"n": 0}
+
+    def dead(path):
+        calls["n"] += 1
+        raise OSError("persistent EIO")
+
+    monkeypatch.setattr(checkpoint, "_read_arrays", dead)
+    assert checkpoint.restore_checkpoint(ck, state) is None
+    assert calls["n"] == 2  # initial + 1 retry
+    assert any(d.endswith(".corrupt") for d in os.listdir(ck))
+
+
+def test_ckpt_digest_mismatch_quarantines_immediately(tmp_path, monkeypatch):
+    """Only transient IO retries; on-disk damage (digest mismatch / torn
+    zip) quarantines on the FIRST read — re-reading corruption would
+    just delay the fallback."""
+    ck, state = _make_ckpt(tmp_path)
+    step_dir = checkpoint.list_steps(ck)[-1][1]
+    faults._corrupt_file(os.path.join(step_dir, checkpoint.ARRAYS))
+    monkeypatch.setenv("NTS_CKPT_RETRIES", "5")
+    real = checkpoint._read_arrays
+    calls = {"n": 0}
+
+    def counting(path):
+        calls["n"] += 1
+        return real(path)
+
+    monkeypatch.setattr(checkpoint, "_read_arrays", counting)
+    reg, obs_path = _recording_sink(tmp_path)
+    events.set_sink(reg)
+    try:
+        assert checkpoint.restore_checkpoint(ck, state) is None
+    finally:
+        events.set_sink(None)
+        reg.close()
+    assert calls["n"] == 1  # no retries burned on real corruption
+    assert any(d.endswith(".corrupt") for d in os.listdir(ck))
+    evs = [json.loads(l) for l in open(obs_path) if l.strip()]
+    assert not any(e["event"] == "recovery" and e["action"] == "ckpt_retry"
+                   for e in evs)
+
+
+# ---- satellite: supervisor jitter + multi-code give-up ----------------------
+
+
+def test_backoff_jitter_deterministic(monkeypatch):
+    monkeypatch.setenv("NTS_BACKOFF_JITTER_SEED", "7")
+    a = supervisor.backoff_jitter_frac(1)
+    assert a == supervisor.backoff_jitter_frac(1)  # reproducible
+    assert 0.0 <= a < 0.5
+    assert a != supervisor.backoff_jitter_frac(2)  # per-attempt spread
+    monkeypatch.setenv("NTS_BACKOFF_JITTER_SEED", "8")
+    assert supervisor.backoff_jitter_frac(1) != a  # per-worker spread
+
+
+class _FlakyCfg:
+    checkpoint_dir = ""
+    learn_rate = 0.01
+
+
+class _FlakyToolkit:
+    """Raises a scripted sequence of HealthErrors from run()."""
+
+    def __init__(self, errors):
+        self.cfg = _FlakyCfg()
+        self.metrics = None
+        self.tracer = None
+        self.epoch_times = []
+        self.loss_history = []
+        self._first_epoch_trained = None
+        self._errors = list(errors)
+
+    def run(self):
+        raise self._errors.pop(0)
+
+    def build_model(self):
+        pass
+
+
+def test_retries_exhausted_names_every_code_seen():
+    tk = _FlakyToolkit([
+        guards.NonFiniteLossError("nan", epoch=1),
+        guards.StallError("hung", epoch=2),
+    ])
+    with pytest.raises(RetriesExhaustedError) as ei:
+        supervised_run(tk, max_restarts=1, backoff_base_s=0)
+    assert ei.value.codes == ["nonfinite_loss", "stall"]
+    msg = str(ei.value)
+    assert "nonfinite_loss" in msg and "stall" in msg

@@ -31,6 +31,11 @@ def _emit_all(reg: registry.MetricsRegistry) -> None:
     reg.event("fault", kind="nonfinite_loss", epoch=1, attempt=1,
               injected=True)
     reg.event("recovery", action="rollback", epoch=1, attempt=1)
+    reg.event("heartbeat", partition=0, epoch=0)
+    reg.event("rank_loss", partition=2, epoch=1, reason="heartbeat_miss",
+              missed_beats=3)
+    reg.event("replan", from_partitions=4, to_partitions=3, lost=2,
+              seconds=0.25, moved_vertices=1200)
     reg.event("serve_request", n_seeds=2, status="ok", total_ms=3.5,
               queue_ms=1.0, req_id="q1", flush_id=0)
     reg.event("batch_flush", n_requests=1, n_seeds=2, reason="deadline",
@@ -71,6 +76,9 @@ RENDER_MARKERS = {
     "ring_step": "ring-pipelined exchange:",
     "fault": "kind=nonfinite_loss",
     "recovery": "action=rollback",
+    "heartbeat": "#heartbeats=",
+    "rank_loss": "#rank_loss=",
+    "replan": "#replan=",
     "serve_request": "finish serving !",
     "batch_flush": "#batches=",
     "shed": "#shed=",
@@ -132,6 +140,9 @@ def test_validator_rejects_mutations_per_kind(tmp_path):
         "ring_step": {"step": 0},
         "fault": {"kind": ""},
         "recovery": {"action": ""},
+        "heartbeat": {"partition": -1},
+        "rank_loss": {"reason": ""},
+        "replan": {"from_partitions": 0},
         "serve_request": {"n_seeds": 0},
         "batch_flush": {"reason": ""},
         "shed": {"reason": ""},
