@@ -8,6 +8,7 @@ from neutronstarlite_tpu.graph.storage import (
     partition_offsets,
 )
 from neutronstarlite_tpu.graph.dataset import GNNDatum
+from neutronstarlite_tpu.graph.digest import graph_digest
 from neutronstarlite_tpu.graph.synthetic import synthetic_power_law_graph
 
 __all__ = [
@@ -19,5 +20,6 @@ __all__ = [
     "gcn_norm_weights",
     "partition_offsets",
     "GNNDatum",
+    "graph_digest",
     "synthetic_power_law_graph",
 ]

@@ -1,0 +1,50 @@
+"""Canonical graph content digest for the autotuner decision cache.
+
+``graph_digest(g)`` hashes the graph's STRUCTURE — per-destination
+canonicalized neighbor multisets — into one sha256 hex string, the
+per-graph half of the tune-cache key (neutronstarlite_tpu/tune/cache.py).
+
+Canonicalization matters: the native OpenMP adjacency builder orders tie
+edges (same destination, concurrent writers) nondeterministically per
+BUILD, so two byte-identical edge files can yield CSC arrays that differ
+in within-segment edge order (the PR 7 deflake root cause,
+tests/test_bench.py::_canonical_csc). A digest over the raw CSC arrays
+would therefore change across builds of the SAME graph and turn every
+cache lookup into a spurious miss. Sorting each destination segment by
+source id (a stable lexsort over (dst, src)) makes the digest a function
+of the neighbor MULTISET only — duplicate edges keep their multiplicity,
+order wobble disappears, and the native and NumPy builders agree bitwise
+(pinned by tests/test_graph.py::test_graph_digest_native_numpy_agree).
+
+Edge weights are deliberately NOT hashed: the weight mode (gcn_norm /
+ones) is a property of the algorithm family — itself a separate cache-key
+field — and the float pipeline differs between the native (C) and NumPy
+builders in ways that could break bitwise equality without changing the
+graph.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+
+def graph_digest(g) -> str:
+    """sha256 hex digest of a CSCGraph's canonicalized structure.
+
+    Hash input: (v_num, e_num, in-degree offsets, and the CSC source ids
+    sorted within each destination segment) — all cast to fixed-width
+    little-endian dtypes so builder-dependent array dtypes (int32 vs
+    int64 offsets) cannot change the digest either.
+    """
+    dst = np.asarray(g.dst_of_edge, dtype=np.int64)
+    src = np.asarray(g.row_indices, dtype=np.int64)
+    # stable sort by (dst, src): dst_of_edge is already non-decreasing,
+    # so this only canonicalizes the within-segment tie order
+    perm = np.lexsort((src, dst))
+    h = hashlib.sha256()
+    h.update(np.array([g.v_num, g.e_num], dtype="<i8").tobytes())
+    h.update(np.asarray(g.column_offset, dtype="<i8").tobytes())
+    h.update(src[perm].astype("<i8").tobytes())
+    return h.hexdigest()

@@ -170,6 +170,13 @@ class ToolkitBase:
             self.host_graph = build_graph(
                 src, dst, cfg.vertices, weight=self.weight_mode
             )
+            # auto-knob resolution needs only host_graph + cfg, and the
+            # _wants_fused_edge/_wants_ell upload decision below needs
+            # the RESOLVED kernel — resolving here (not in
+            # _finalize_datum, where it re-runs as a no-op) keeps
+            # KERNEL:auto from paying the O(E) DeviceGraph upload a
+            # pinned KERNEL:fused_edge skips
+            self._resolve_tune_autos()
             if self._build_device_graph():
                 self.graph = DeviceGraph.from_host(
                     self.host_graph, edge_chunk=cfg.edge_chunk or None
@@ -309,7 +316,23 @@ class ToolkitBase:
                 "never sample"
             )
 
+    def _resolve_tune_autos(self) -> None:
+        """Auto-knob resolution (tune/select): DIST_PATH:auto /
+        KERNEL:auto / ELL_LEVELS:auto / WIRE_DTYPE:auto resolve through
+        the measured-decision cache (NTS_TUNE) into concrete cfg values.
+        Called right after host_graph exists (init_graph / from_arrays)
+        so the DeviceGraph upload decision sees the resolved kernel, and
+        again — as a no-op — at the head of _finalize_datum for any
+        construction path that skipped it. The funnel's validity checks
+        always run AFTER resolution on the concrete values, so even a
+        corrupt cache entry cannot smuggle in a combination the funnel
+        refuses."""
+        from neutronstarlite_tpu.tune import select as tune_select
+
+        tune_select.resolve_auto_knobs(self)
+
     def _finalize_datum(self) -> None:
+        self._resolve_tune_autos()
         self._check_kernel()
         self._check_dist_path()
         self._check_sample_pipeline()
@@ -346,6 +369,7 @@ class ToolkitBase:
             if host_graph is not None
             else build_graph(src, dst, cfg.vertices, weight=cls.weight_mode)
         )
+        t._resolve_tune_autos()  # see init_graph: before the upload decision
         if t._build_device_graph():
             t.graph = DeviceGraph.from_host(
                 t.host_graph, edge_chunk=cfg.edge_chunk or None

@@ -76,8 +76,12 @@ class FullBatchTrainer(ToolkitBase):
             self.graph = None
             from neutronstarlite_tpu.ops.fused_edge import FusedEdgePair
 
+            # ELL_LEVELS (cfg or the tune/ autotuner's resolved choice)
+            # selects the fused tables' level ladder; "" keeps the path
+            # default (binned) via the NTS_ELL_LEVELS env fallback
             self.compute_graph = FusedEdgePair.from_host(
-                self.host_graph, vt=cfg.kernel_tile
+                self.host_graph, vt=cfg.kernel_tile,
+                levels=getattr(cfg, "ell_levels", ""),
             )
             log.info(
                 "KERNEL:fused_edge: blocked streaming SDDMM+softmax+SpMM "

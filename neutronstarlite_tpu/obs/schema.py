@@ -10,7 +10,8 @@ Envelope (all events):
   event: str       one of run_start | epoch | ring_step | run_summary |
                    fault | recovery | heartbeat | rank_loss | replan |
                    serve_request | batch_flush | shed | serve_summary |
-                   span | stream_rotated (open set)
+                   tune_trial | tune_decision | span | stream_rotated
+                   (open set)
   run_id: str      "<algo>-<fingerprint>-<pid>"
   schema: int      SCHEMA_VERSION
   ts: float        wall-clock seconds (time.time())
@@ -76,6 +77,31 @@ serve_summary (serve/): consolidated end-of-serving record (the serving
   counters: object (the registry snapshot: serve.* counters incl.
   per-bucket compile counts)
 
+tune_trial (tune/runner.py): one autotuner candidate scored — a timed
+  micro-trial (source=measured), an analytic-prior-only entry
+  (source=prior) when the candidate cannot be measured on this rig, or
+  a candidate the prior cut below the trial budget (source=pruned)
+  candidate: str (non-empty canonical tuple label,
+  "dist_path|kernel|ell_levels|wire_dtype" with "-" for empty axes),
+  family: str (non-empty; the tune-space family + trainer class),
+  source: str (measured | prior | pruned, open set),
+  seconds: number | null (warm trial step time; null for prior-only),
+  predicted_bytes: int | absent (the analytic prior's byte score),
+  partitions: int | absent
+
+tune_decision (tune/select.py): the resolved auto-knob tuple a trainer
+  will build with (DIST_PATH:auto / KERNEL:auto / WIRE_DTYPE:auto /
+  ELL_LEVELS:auto), whether freshly measured, replayed from the
+  persisted cache, or prior-derived (e.g. inside the elastic replan
+  recovery path, which never measures)
+  candidate: str (non-empty), family: str (non-empty),
+  source: str (measured | cached | prior, open set),
+  partitions: int > 0,
+  seconds: number | null (the winning candidate's measured score),
+  predicted_bytes: int | absent,
+  decision: object | absent ({dist_path, kernel, ell_levels,
+  wire_dtype} as strings — the concrete cfg values applied)
+
 span (obs/trace.py): one completed interval on the causal timeline
   name: str (non-empty), cat: str (phase | lifecycle | epoch | stage |
   serve | ring | resilience | probe | sample, open set; cat=sample spans
@@ -127,6 +153,8 @@ KNOWN_KINDS = (
     "batch_flush",
     "shed",
     "serve_summary",
+    "tune_trial",
+    "tune_decision",
     "span",
     "stream_rotated",
     "run_summary",
@@ -286,6 +314,25 @@ def validate_event(obj: Any) -> None:
             _fail("shed.reason must be a non-empty string")
         if "queue_depth" in obj and not isinstance(obj["queue_depth"], int):
             _fail("shed.queue_depth must be an int when present")
+    elif kind in ("tune_trial", "tune_decision"):
+        for key in ("candidate", "family", "source"):
+            if not isinstance(obj.get(key), str) or not obj[key]:
+                _fail(f"{kind}.{key} must be a non-empty string, got "
+                      f"{obj.get(key)!r}")
+        _require_number(obj, "seconds", allow_none=True)
+        if "predicted_bytes" in obj and obj["predicted_bytes"] is not None \
+                and not isinstance(obj["predicted_bytes"], int):
+            _fail(f"{kind}.predicted_bytes must be an int when present")
+        p = obj.get("partitions")
+        if kind == "tune_decision":
+            if not isinstance(p, int) or isinstance(p, bool) or p <= 0:
+                _fail(f"tune_decision.partitions must be a positive int, "
+                      f"got {p!r}")
+            d = obj.get("decision")
+            if d is not None and not isinstance(d, dict):
+                _fail(f"tune_decision.decision must be an object, got {d!r}")
+        elif p is not None and (not isinstance(p, int) or isinstance(p, bool)):
+            _fail(f"tune_trial.partitions must be an int when present")
     elif kind == "span":
         for key in ("name", "cat", "span_id", "trace_id"):
             if not isinstance(obj.get(key), str) or not obj[key]:

@@ -315,6 +315,15 @@ def replan_survivors(toolkit, lost_partition: int) -> int:
     # this detection stays dead under the new numbering and is detected
     # (and replanned away) on the retry
     renumber_after_loss(int(lost_partition))
+    # a trainer whose knobs were tuner-resolved (DIST_PATH:auto etc.,
+    # tune/select) re-consults the decision cache for P' BEFORE the plan
+    # rebuilds: a cached P' entry is a hit, otherwise the analytic prior
+    # decides (decision_source=prior in the tune_decision record) — the
+    # recovery path never runs measurements, a degraded cluster
+    # mid-rollback is the wrong place to benchmark
+    from neutronstarlite_tpu.tune import select as tune_select
+
+    tune_select.reconsult_for_replan(toolkit)
     toolkit.build_model()
     seconds = time.perf_counter() - t0
     moved = None

@@ -286,6 +286,49 @@ def render_sample(rec: Dict[str, Any]) -> List[str]:
     return lines
 
 
+def render_tuning(events: List[Dict[str, Any]],
+                  rec: Dict[str, Any]) -> List[str]:
+    """The autotuner block (tune/; DIST_PATH:auto / KERNEL:auto /
+    WIRE_DTYPE:auto under NTS_TUNE): every decision with its source
+    (measured | cached | prior) and score, plus the trial inventory.
+    Empty for runs that never consulted the tuner."""
+    trials = [e for e in events if e["event"] == "tune_trial"]
+    decisions = [e for e in events if e["event"] == "tune_decision"]
+    gauges = rec.get("gauges") or {}
+    if not (trials or decisions):
+        # records may have rotated away (NTS_METRICS_MAX_MB); the gauge
+        # snapshot in run_summary still pins the decision
+        if "tune.decision" not in gauges:
+            return []
+        return [
+            "tuning:",
+            f"#tune_decision={gauges['tune.decision']} "
+            f"(source={gauges.get('tune.decision_source')}, "
+            f"P={gauges.get('tune.partitions')})",
+        ]
+    lines = ["tuning:"]
+    for d in decisions:
+        secs = d.get("seconds")
+        pred = d.get("predicted_bytes")
+        lines.append(
+            f"#tune_decision={d['candidate']} (source={d['source']}, "
+            f"P={d.get('partitions')}"
+            + (f", score={secs * 1000:.3f}ms" if secs is not None else "")
+            + (f", predicted={pred}B" if pred is not None else "")
+            + ")"
+        )
+    if trials:
+        by_source: Dict[str, int] = {}
+        for t in trials:
+            by_source[t["source"]] = by_source.get(t["source"], 0) + 1
+        lines.append(
+            f"#tune_trials={len(trials)} ("
+            + " ".join(f"{k}={v}" for k, v in sorted(by_source.items()))
+            + ")"
+        )
+    return lines
+
+
 _TIMELINE_SKIP = ("event", "run_id", "schema", "ts", "seq", "error")
 
 
@@ -402,6 +445,7 @@ def render_run(path: str, rec: Dict[str, Any]) -> str:
     if loss is not None:
         lines.append(f"#final_loss={loss}")
     lines.extend(rec.get("_ring") or [])
+    lines.extend(rec.get("_tune") or [])
     lines.extend(rec.get("_elastic") or [])
     lines.extend(render_sample(rec))
     lines.extend(rec.get("_trace") or [])
@@ -678,6 +722,7 @@ def main(argv=None) -> int:
             rec["_path"] = p
             rec["_timeline"] = recovery_timeline(events)
             rec["_ring"] = render_ring(events, rec)
+            rec["_tune"] = render_tuning(events, rec)
             rec["_elastic"] = render_elastic(events, rec)
             rec["_trace"] = trace_lines
         if srec is not None:

@@ -16,7 +16,8 @@ process-local monotonic clocks. This CLI reconstructs one coherent view:
    place.
 3. **Chrome trace-event export** (``--chrome out.json``): complete ("X")
    events per span (pid = rank, tid = host thread), instant events for
-   fault / recovery / shed / rank_loss / replan records — loadable in
+   fault / recovery / shed / rank_loss / replan / tune_trial /
+   tune_decision records — loadable in
    Perfetto or chrome://tracing. When the run also wrote a ``jax.profiler`` trace
    (``NTS_PROFILE_DIR``), the host spans were emitted as
    ``TraceAnnotation``s inside it too, so the device-op view carries the
@@ -164,7 +165,8 @@ def load_streams(paths: List[str]) -> List[Stream]:
 # Chrome trace export
 # ---------------------------------------------------------------------------
 
-_INSTANT_KINDS = ("fault", "recovery", "shed", "rank_loss", "replan")
+_INSTANT_KINDS = ("fault", "recovery", "shed", "rank_loss", "replan",
+                  "tune_trial", "tune_decision")
 _ENVELOPE_OR_SPAN = (
     "event", "run_id", "schema", "ts", "seq", "name", "cat", "span_id",
     "trace_id", "parent_id", "t0", "dur_s", "rank", "thread",
@@ -234,6 +236,12 @@ def chrome_trace(streams: List[Stream]) -> Dict[str, Any]:
             label = (
                 e.get("kind") or e.get("action") or e.get("reason") or ""
             )
+            if e["event"] in ("tune_trial", "tune_decision"):
+                # the candidate tuple (and decision source), readable off
+                # the marker name in Perfetto
+                label = str(e.get("candidate") or "?")
+                if e["event"] == "tune_decision":
+                    label = f"{label} [{e.get('source')}]"
             if e["event"] == "replan":
                 # the elastic degradation, readable off the marker name
                 label = (

@@ -80,6 +80,45 @@ def peak_resident_rows(kind: str, P: int, vp: int, mb: int = 0) -> int:
     return P * vp
 
 
+def predict_all(g, P: int, f: int, widths=None, itemsize: int = 4) -> dict:
+    """Machine-readable per-strategy prediction for one (graph, P, f):
+    exchange rows, peak resident rows, and bytes per epoch — the
+    autotuner's analytic prior (neutronstarlite_tpu/tune/runner.py) and
+    the CLI ``--json`` payload in one function.
+
+    ``widths``: the per-layer exchange widths (defaults to ``[f]`` — one
+    exchange per epoch at feature width f); ``itemsize``: wire bytes per
+    value (4 = f32, 2 = bf16 wire/compute). All strategies are priced by
+    the SAME :func:`exchange_rows_per_device` /
+    :func:`peak_resident_rows` formulas the live obs counters use, so the
+    prior, the offline report, and the run-time telemetry can never
+    disagree.
+    """
+    from neutronstarlite_tpu.parallel.mirror import MirrorGraph, SplitMirror
+
+    mb_uni, vp = MirrorGraph.estimate_mb(g, P)
+    mb, _ = SplitMirror.estimate_mb_remote(g, P)
+    widths = [int(w) for w in (widths if widths else [f])]
+    mbs = {"mirror": mb, "mirror_uniform": mb_uni}
+    strategies = {}
+    for kind in ("ring", "ell", "blocked", "ring_blocked", "mirror",
+                 "mirror_uniform"):
+        m = mbs.get(kind, 0)
+        rows = exchange_rows_per_device(kind, P, vp, m)
+        peak = peak_resident_rows(kind, P, vp, m)
+        strategies[kind] = {
+            "exchange_rows": int(rows),
+            "peak_resident_rows": int(peak),
+            "bytes_per_epoch": int(rows * sum(widths) * itemsize),
+            "peak_resident_bytes": int(peak * max(widths) * itemsize),
+        }
+    return {
+        "P": int(P), "f": int(f), "vp": int(vp), "mb": int(mb),
+        "mb_uniform": int(mb_uni), "widths": widths,
+        "itemsize": int(itemsize), "strategies": strategies,
+    }
+
+
 def accounting(g, P: int, f: int, refresh: int, budget_bytes: int,
                thresholds=None) -> dict:
     """All counts are per device per layer unless stated; bytes are f32
@@ -191,6 +230,12 @@ def main(argv=None) -> int:
     ap.add_argument("--feature", type=int, default=602)
     ap.add_argument("--refresh", type=int, default=3)
     ap.add_argument("--budget-mib", type=int, default=256)
+    ap.add_argument(
+        "--json", action="store_true",
+        help="machine-readable mode: print the predict_all() per-strategy "
+        "prediction (exchange rows, peak resident rows, bytes/epoch) as "
+        "one JSON line and skip the DepCache ladder / auto-policy audit",
+    )
     args = ap.parse_args(argv)
 
     if args.cora:
@@ -208,6 +253,12 @@ def main(argv=None) -> int:
         d, v_num, e_num, _ = build_and_cache_graph(args.scale)
         g, _, _ = load_cached_graph(d)
         name = f"reddit_synth_x{args.scale:g}"
+
+    if args.json:
+        out = predict_all(g, args.partitions, args.feature)
+        out["graph"] = name
+        print(json.dumps(out))
+        return 0
 
     out = accounting(
         g, args.partitions, args.feature, args.refresh,

@@ -1,0 +1,47 @@
+"""tune/ — measured-telemetry autotuner with a persisted decision cache.
+
+The repo carries four dist exchange strategies, three kernel paths,
+two ELL level ladders, and a wire-dtype knob — all historically chosen
+by hand per config. This subsystem makes ``DIST_PATH:auto``,
+``KERNEL:auto``, ``WIRE_DTYPE:auto`` and ``ELL_LEVELS:auto`` resolve
+from MEASUREMENT instead (SCV-GNN's thesis: format choice should follow
+the measured sparsity structure):
+
+- :mod:`tune.space` — the typed candidate space, validated against the
+  SAME lifecycle-funnel rules ``models/base.py`` enforces, so the tuner
+  can never propose a combination the funnel would refuse;
+- :mod:`tune.runner` — per-candidate scoring: an analytic prior from
+  ``tools/wire_accounting.predict_all`` prunes the space, then short
+  jitted timed micro-trials (comm_bench-style legs; sim twins on the
+  collective-free rig) score the survivors;
+- :mod:`tune.cache` — the persisted per-graph decision cache under
+  ``NTS_TUNE_DIR``, keyed by (graph content digest, algorithm family,
+  P, layer widths, backend fingerprint), schema-versioned, atomically
+  published, loudly stale;
+- :mod:`tune.select` — the resolution hook the ToolkitBase lifecycle
+  funnel calls before its validity checks, and the re-consultation the
+  elastic survivor replan runs for P' = P - 1 (cache hit or analytic
+  prior — never a measurement inside the recovery path).
+
+Knobs: ``NTS_TUNE=off|cached|measure`` (mode), ``NTS_TUNE_DIR``
+(cache directory), ``NTS_TUNE_STEPS`` (timed steps per trial),
+``NTS_TUNE_MAX_TRIALS`` (prior-pruned trial budget). docs/TUNING.md has
+the full contract.
+"""
+
+from neutronstarlite_tpu.tune.cache import (  # noqa: F401
+    CacheKey,
+    backend_fingerprint,
+    tune_dir,
+    tune_mode,
+)
+from neutronstarlite_tpu.tune.space import (  # noqa: F401
+    AXES,
+    Candidate,
+    enumerate_candidates,
+    family_of,
+)
+from neutronstarlite_tpu.tune.select import (  # noqa: F401
+    reconsult_for_replan,
+    resolve_auto_knobs,
+)

@@ -1,0 +1,176 @@
+"""Persisted per-graph autotuner decision cache.
+
+One JSON file per decision under ``NTS_TUNE_DIR``, keyed by
+(graph content digest, algorithm family, partition count, layer stack,
+backend fingerprint) — the five facts a measured decision is valid for.
+The digest is the canonicalized-structure hash (graph/digest.py), so the
+native builder's nondeterministic tie-edge ordering cannot turn a warm
+cache into misses; the backend fingerprint (jax version, platform,
+device kind, device count) invalidates decisions measured on different
+silicon or a different runtime.
+
+Publication is ATOMIC (the checkpoint-manifest pattern: tmp-write +
+``os.replace``), so a writer crashing mid-store can never leave a torn
+entry under the final name — a reader either sees the previous complete
+entry or none.
+
+Staleness is LOUD, never silent: the full key is embedded in the entry
+and re-verified on load (a filename collision or a hand-moved file must
+not smuggle a foreign decision in), the entry schema is versioned
+(``TUNE_SCHEMA_VERSION`` mismatch = warn + miss = re-tune), and a torn
+or unparseable entry is a warned miss rather than a crash. Only
+MEASURED decisions are persisted — prior-only resolutions (NTS_TUNE=
+cached on a cold cache, or the elastic-replan recovery path) are
+recomputed each time, so a later ``NTS_TUNE=measure`` run still runs
+real trials instead of inheriting an unmeasured guess.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from neutronstarlite_tpu.utils.logging import get_logger
+
+log = get_logger("tune")
+
+TUNE_SCHEMA_VERSION = 1
+
+_MODES = ("off", "cached", "measure")
+
+
+def tune_mode() -> str:
+    """``NTS_TUNE``: off (default — auto knobs keep their legacy meaning
+    or refuse), cached (consult the cache; decide from the analytic
+    prior on a miss, never measure), or measure (run timed trials on a
+    miss and persist the decision)."""
+    raw = (os.environ.get("NTS_TUNE", "") or "off").strip().lower()
+    if raw not in _MODES:
+        raise ValueError(
+            f"NTS_TUNE must be one of {'|'.join(_MODES)}, got {raw!r}"
+        )
+    return raw
+
+
+def tune_dir() -> Optional[str]:
+    """The decision-cache directory (``NTS_TUNE_DIR``), or None — without
+    it, measured decisions live only for the process."""
+    return os.environ.get("NTS_TUNE_DIR") or None
+
+
+def backend_fingerprint() -> str:
+    """What the measurement was taken ON: jax version, platform, device
+    kind, and visible device count. Any change re-tunes — a decision
+    measured on 8 CPU sim devices says nothing about a v5e pod."""
+    import jax
+
+    devs = jax.devices()
+    kind = getattr(devs[0], "device_kind", "?") if devs else "?"
+    return (
+        f"jax-{jax.__version__}/{jax.default_backend()}/"
+        f"{kind}x{len(devs)}"
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class CacheKey:
+    """The five-fact validity domain of one cached decision."""
+
+    graph_digest: str
+    family: str  # tune-space family + trainer class, e.g. dist_dense/DistGCNTrainer
+    partitions: int
+    layers: str  # the LAYERS stack string (feature width f + hidden widths)
+    backend: str
+
+    def as_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def filename(self) -> str:
+        blob = json.dumps(self.as_dict(), sort_keys=True)
+        return f"tune-{hashlib.sha256(blob.encode()).hexdigest()[:16]}.json"
+
+    def path(self, directory: str) -> str:
+        return os.path.join(directory, self.filename())
+
+
+def load(key: CacheKey, directory: Optional[str] = None
+         ) -> Optional[Dict[str, Any]]:
+    """The cached entry for ``key``, or None (miss). Every staleness
+    cause is a WARNED miss — schema drift, embedded-key mismatch, torn
+    JSON — never a silent reuse and never a crash."""
+    directory = directory or tune_dir()
+    if not directory:
+        return None
+    path = key.path(directory)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            entry = json.load(fh)
+    except (OSError, json.JSONDecodeError) as e:
+        log.warning(
+            "tune cache: %s is unreadable (%s) — treating as a miss and "
+            "re-tuning", path, e,
+        )
+        return None
+    if not isinstance(entry, dict):
+        log.warning("tune cache: %s is not an object — re-tuning", path)
+        return None
+    if entry.get("tune_schema") != TUNE_SCHEMA_VERSION:
+        log.warning(
+            "tune cache: %s has schema %r != %d — stale entry, re-tuning",
+            path, entry.get("tune_schema"), TUNE_SCHEMA_VERSION,
+        )
+        return None
+    if entry.get("key") != key.as_dict():
+        log.warning(
+            "tune cache: %s embeds key %r but was looked up as %r (digest "
+            "or backend drift, or a hand-moved file) — refusing to reuse, "
+            "re-tuning", path, entry.get("key"), key.as_dict(),
+        )
+        return None
+    decision = entry.get("decision")
+    if not isinstance(decision, dict) or not decision.get("candidate"):
+        log.warning("tune cache: %s carries no decision — re-tuning", path)
+        return None
+    return entry
+
+
+def store(key: CacheKey, decision: Dict[str, Any],
+          trials: Optional[List[Dict[str, Any]]] = None,
+          directory: Optional[str] = None,
+          autos: Optional[List[str]] = None) -> Optional[str]:
+    """Atomically publish a MEASURED decision; returns the entry path, or
+    None when no cache directory is configured (a warned no-op — the
+    decision still applies to this run, it just cannot be reused)."""
+    directory = directory or tune_dir()
+    if not directory:
+        log.warning(
+            "NTS_TUNE_DIR is unset: the measured tune decision %s will "
+            "not be persisted (every future run re-measures)",
+            decision.get("candidate"),
+        )
+        return None
+    os.makedirs(directory, exist_ok=True)
+    path = key.path(directory)
+    entry = {
+        "tune_schema": TUNE_SCHEMA_VERSION,
+        "key": key.as_dict(),
+        "created_ts": time.time(),
+        # which axes were FREE when this was measured: a later lookup
+        # whose auto set is wider must re-tune (the entry never explored
+        # the newly freed axis) — tune/select._decide checks this
+        "autos": sorted(autos or []),
+        "decision": dict(decision),
+        "trials": list(trials or []),
+    }
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(entry, fh, indent=1, sort_keys=True)
+    os.replace(tmp, path)  # the commit point: readers see all or nothing
+    log.info("tune cache: stored %s -> %s", decision.get("candidate"), path)
+    return path

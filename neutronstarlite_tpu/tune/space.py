@@ -1,0 +1,216 @@
+"""The autotuner's typed candidate space.
+
+A candidate is one (dist_path, kernel, ell_levels, wire_dtype) tuple —
+exactly the four auto-capable cfg axes. :func:`enumerate_candidates`
+yields the tuples that are (a) shaped for the trainer's algorithm family,
+(b) consistent with every axis the user PINNED (a non-auto cfg value is
+a constraint, not a suggestion), and (c) accepted by the SAME
+lifecycle-funnel validity rules ``models/base.py`` enforces at run time
+— each surviving tuple is probed through the trainer class's own
+``_check_kernel`` / ``_check_dist_path``, so the tuner can never propose
+a combination the funnel would refuse (and a future funnel rule
+tightens the space automatically).
+
+Families (discriminated by the funnel capability flags, the same ones
+the refusals key off):
+
+- ``dist_dense`` (``supports_dist_path``: GCNDIST / GINDIST /
+  COMMNETDIST + eager variants) — DIST_PATH all_gather vs ring_blocked,
+  WIRE_DTYPE f32 vs bf16 (ring only: the all_gather family ships the
+  compute dtype, so proposing bf16 wire there would tune a knob the
+  build warns it ignores). The all_gather family has no collective-free
+  sim twin, so on a sim rig (NTS_DIST_SIMULATE=1 /
+  DIST_PATH:ring_blocked_sim) or a rig with fewer than P devices it is
+  not a candidate at all — it could neither be measured nor built.
+- ``edge_single`` (``supports_fused_edge`` single-chip: GATCPU /
+  GGCNCPU) — KERNEL eager vs fused_edge, ELL_LEVELS binned vs pow2 for
+  the fused tables.
+- ``edge_dist`` (``supports_fused_edge`` dist twins: GATDIST /
+  GGCNDIST) — KERNEL eager (mirror all_to_all chain) vs fused_edge
+  (ring schedule). The ring stacked tables keep the shared pow2 ladder
+  (cross-device K fragmentation pads more — PR 6), so ELL_LEVELS is not
+  an axis here.
+- ``plain`` (everything else) — the space is the single empty tuple;
+  ``auto`` degrades to the family's only valid choice.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+from typing import List, Optional, Set
+
+from neutronstarlite_tpu.utils.logging import get_logger
+
+log = get_logger("tune")
+
+# the auto-capable cfg axes, in canonical label order
+AXES = ("dist_path", "kernel", "ell_levels", "wire_dtype")
+
+
+@dataclasses.dataclass(frozen=True)
+class Candidate:
+    """One point of the candidate space; empty string = the axis default
+    (eager kernel / heuristic dist path / path-default levels / compute-
+    dtype wire)."""
+
+    dist_path: str = ""
+    kernel: str = ""
+    ell_levels: str = ""
+    wire_dtype: str = ""
+
+    def label(self) -> str:
+        """Canonical record/cache label: axis values joined by '|', '-'
+        for empty — e.g. ``ring_blocked|-|-|bf16``."""
+        return "|".join(getattr(self, a) or "-" for a in AXES)
+
+    def as_dict(self) -> dict:
+        return {a: getattr(self, a) for a in AXES}
+
+    @staticmethod
+    def from_label(label: str) -> "Candidate":
+        parts = label.split("|")
+        if len(parts) != len(AXES):
+            raise ValueError(f"malformed candidate label {label!r}")
+        return Candidate(**{
+            a: ("" if v == "-" else v) for a, v in zip(AXES, parts)
+        })
+
+
+def family_of(trainer_cls) -> str:
+    """The tune-space family of a trainer class (see module docstring)."""
+    if getattr(trainer_cls, "supports_dist_path", False):
+        return "dist_dense"
+    if getattr(trainer_cls, "supports_fused_edge", False):
+        if not getattr(trainer_cls, "needs_device_graph", True):
+            return "edge_dist"
+        return "edge_single"
+    return "plain"
+
+
+def auto_axes(cfg) -> Set[str]:
+    """The axes the cfg marks ``auto`` — the only ones the tuner may set."""
+    return {a for a in AXES if getattr(cfg, a, "") == "auto"}
+
+
+def _norm(axis: str, value: str) -> str:
+    """Axis-value normalization for pinned-axis comparison: the sim
+    spelling of the ring path and the dtype aliases collapse."""
+    v = (value or "").strip().lower()
+    if axis == "dist_path" and v == "ring_blocked_sim":
+        return "ring_blocked"
+    if axis == "wire_dtype":
+        return {"f32": "", "float32": "", "bfloat16": "bf16"}.get(v, v)
+    return v
+
+
+def apply_candidate(cfg, cand: Candidate, autos: Optional[Set[str]] = None):
+    """A copy of ``cfg`` with the candidate applied. Only the AUTO axes
+    take the candidate's value — pinned axes keep the user's spelling
+    (``ring_blocked_sim`` stays the sim twin), which is also why the
+    funnel probe below validates exactly the cfg the trainer would
+    build."""
+    if autos is None:
+        autos = set(AXES)
+    out = copy.copy(cfg)
+    for a in autos:
+        setattr(out, a, getattr(cand, a))
+    return out
+
+
+def candidate_valid(trainer_cls, cfg, cand: Candidate,
+                    autos: Optional[Set[str]] = None) -> bool:
+    """Probe the candidate through the trainer class's OWN lifecycle-
+    funnel checks (``_check_kernel`` + ``_check_dist_path``) — the reuse
+    that makes 'the tuner can never propose what the funnel refuses' a
+    structural property instead of a parallel rule set."""
+    probe = object.__new__(trainer_cls)
+    probe.cfg = apply_candidate(cfg, cand, autos)
+    try:
+        trainer_cls._check_kernel(probe)
+        trainer_cls._check_dist_path(probe)
+    except ValueError:
+        return False
+    return True
+
+
+def _axis_values(family: str, axis: str, autos: Set[str], cfg,
+                 include_all_gather: bool) -> List[str]:
+    """The values one axis ranges over. A pinned (non-auto) axis is a
+    CONSTRAINT: it contributes exactly the user's value (including the
+    empty string — '' is a concrete choice: eager kernel, heuristic dist
+    path, compute-dtype wire, path-default ladder). Only an ``auto``
+    axis enumerates."""
+    if axis not in autos:
+        return [getattr(cfg, axis, "")]
+    if family == "dist_dense":
+        if axis == "dist_path":
+            return (["all_gather"] if include_all_gather else []) + \
+                ["ring_blocked"]
+        if axis == "wire_dtype":
+            return ["", "bf16"]
+    elif family == "edge_single":
+        if axis == "kernel":
+            return ["", "fused_edge"]
+        if axis == "ell_levels":
+            return ["binned", "pow2"]
+    elif family == "edge_dist":
+        if axis == "kernel":
+            return ["", "fused_edge"]
+    return [""]
+
+
+def _consistent(family: str, cand: Candidate) -> bool:
+    """Cross-axis rules the funnel only WARNS about (a warn-and-ignore
+    combination must not become a distinct candidate — it would measure
+    identically to its base tuple and pollute the space)."""
+    if family == "dist_dense" and _norm("wire_dtype", cand.wire_dtype):
+        # WIRE_DTYPE only rides the ring-pipelined exchange; on the
+        # all_gather family it is warned-ignored
+        if _norm("dist_path", cand.dist_path) != "ring_blocked":
+            return False
+    if family == "edge_single" and cand.ell_levels:
+        # the level-ladder knob only shapes the fused blocked tables
+        if cand.kernel != "fused_edge":
+            return False
+    return True
+
+
+def mesh_reachable(partitions: int) -> bool:
+    """Whether a real P-device mesh can be built on this rig."""
+    import jax
+
+    return len(jax.devices()) >= max(int(partitions), 1)
+
+
+def enumerate_candidates(trainer_cls, cfg, partitions: int,
+                         simulate: bool = False) -> List[Candidate]:
+    """The valid candidate tuples for (trainer family, cfg, P) on this
+    rig: the product of the auto axes' value sets (pinned axes held at
+    the user's value), minus warn-ignored cross-axis combinations, minus
+    everything the trainer's own lifecycle-funnel checks refuse."""
+    family = family_of(trainer_cls)
+    autos = auto_axes(cfg)
+    include_ag = not simulate and mesh_reachable(partitions)
+    values = {
+        a: _axis_values(family, a, autos, cfg, include_ag) for a in AXES
+    }
+    out = []
+    for dp in values["dist_path"]:
+        for kn in values["kernel"]:
+            # an auto ladder only enumerates where the knob exists: the
+            # eager chain has no fused tables, so it pairs with the empty
+            # (path-default) value instead of vanishing from the space
+            lvs = (
+                [""] if "ell_levels" in autos and kn != "fused_edge"
+                else values["ell_levels"]
+            )
+            for lv in lvs:
+                for wd in values["wire_dtype"]:
+                    cand = Candidate(dist_path=dp, kernel=kn,
+                                     ell_levels=lv, wire_dtype=wd)
+                    if _consistent(family, cand) and candidate_valid(
+                        trainer_cls, cfg, cand, autos
+                    ):
+                        out.append(cand)
+    return out

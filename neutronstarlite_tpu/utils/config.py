@@ -135,8 +135,15 @@ class InputInfo:
     # (its collective-free twin, single-core CI parity)
     wire_dtype: str = ""  # ICI exchange dtype for the ring-pipelined path:
     # "" / f32 / float32 (ship the compute dtype) or bf16 / bfloat16
-    # (halve wire bytes; the per-step accumulator stays f32). Env override
+    # (halve wire bytes; the per-step accumulator stays f32), or auto (let
+    # the tune/ autotuner choose — resolved through the decision cache at
+    # build_model time, NTS_TUNE=cached|measure). Env override
     # NTS_WIRE_DTYPE (parallel/ring_schedule.resolve_wire_dtype).
+    ell_levels: str = ""  # BlockedEll level-ladder policy for the fused
+    # edge tables (ops/blocked_ell.resolve_levels): "" (the path default:
+    # binned for single-chip fused tables, pow2 for the ring stacked
+    # tables), pow2, binned, or auto (tune/ autotuner). NTS_ELL_LEVELS
+    # env keeps its historical precedence for non-auto values.
     kernel_tile: int = 0  # OPTIM_KERNEL source-tile width (vertices): 0 =
     # plain ELL; >0 = blocked ELL (ops/blocked_ell.py) whose per-tile gather
     # table [vt, f] is sized to stay in the fast on-chip regime at any V
@@ -253,9 +260,10 @@ class InputInfo:
             # validated like DIST_PATH/PRECISION: a typo'd value would
             # silently run the eager edge chain while the user benchmarks
             # it as the fused kernel
-            if v not in ("", "fused_edge"):
+            if v not in ("", "fused_edge", "auto"):
                 raise ValueError(
-                    f"KERNEL must be fused_edge (or empty), got {value!r}"
+                    f"KERNEL must be fused_edge or auto (or empty), "
+                    f"got {value!r}"
                 )
             self.kernel = v
         elif key == "PALLAS":
@@ -310,12 +318,23 @@ class InputInfo:
             self.dist_path = v
         elif key == "WIRE_DTYPE":
             v = value.strip().lower()
-            if v not in ("", "f32", "float32", "bf16", "bfloat16"):
+            if v not in ("", "f32", "float32", "bf16", "bfloat16", "auto"):
                 raise ValueError(
-                    f"WIRE_DTYPE must be f32/float32 or bf16/bfloat16, "
-                    f"got {value!r}"
+                    f"WIRE_DTYPE must be f32/float32, bf16/bfloat16 or "
+                    f"auto, got {value!r}"
                 )
             self.wire_dtype = v
+        elif key == "ELL_LEVELS":
+            v = value.strip().lower()
+            # validated like DIST_PATH/KERNEL: a typo'd ladder name would
+            # silently run the path default while the user benchmarks the
+            # other ladder
+            if v not in ("", "pow2", "binned", "auto"):
+                raise ValueError(
+                    f"ELL_LEVELS must be pow2, binned or auto (or empty), "
+                    f"got {value!r}"
+                )
+            self.ell_levels = v
         elif key == "UNDIRECTED":
             self.undirected = bool(int(value))
         elif key == "DATA_FORMAT":

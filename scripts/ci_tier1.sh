@@ -196,7 +196,78 @@ else
   echo "ELASTIC_GATE=OK"
 fi
 
+# ---- autotuner gate (ISSUE 10) ---------------------------------------------
+# STRUCTURAL (hard): run the all-auto tune smoke cfg twice into one
+# NTS_TUNE_DIR. Run 1 (NTS_TUNE=measure) must exit 0 with a schema-valid
+# stream carrying exactly one tune_decision whose tuple is a member of
+# the funnel-valid candidate space, plus >=1 measured tune_trial. Run 2
+# (NTS_TUNE=cached) must exit 0 with ZERO tune_trial records (cache hit,
+# no re-measuring) and the IDENTICAL decision.
+tune_rc=0
+rm -rf /tmp/_t1_tune_obs1 /tmp/_t1_tune_obs2 /tmp/_t1_tune_cache
+if JAX_PLATFORMS=cpu NTS_DIST_SIMULATE=1 NTS_TUNE=measure \
+    NTS_TUNE_DIR=/tmp/_t1_tune_cache NTS_METRICS_DIR=/tmp/_t1_tune_obs1 \
+    timeout -k 10 300 python -m neutronstarlite_tpu.run \
+    configs/gcn_dist_tune_smoke.cfg > /tmp/_t1_tune1.log 2>&1 \
+  && JAX_PLATFORMS=cpu NTS_DIST_SIMULATE=1 NTS_TUNE=cached \
+    NTS_TUNE_DIR=/tmp/_t1_tune_cache NTS_METRICS_DIR=/tmp/_t1_tune_obs2 \
+    timeout -k 10 300 python -m neutronstarlite_tpu.run \
+    configs/gcn_dist_tune_smoke.cfg > /tmp/_t1_tune2.log 2>&1
+then
+  JAX_PLATFORMS=cpu python - <<'EOF' || tune_rc=$?
+import glob, json
+
+from neutronstarlite_tpu.models import get_algorithm
+from neutronstarlite_tpu.obs import schema
+from neutronstarlite_tpu.tune import space
+from neutronstarlite_tpu.utils.config import InputInfo
+
+def load(d):
+    evs = []
+    for p in sorted(glob.glob(d + "/*.jsonl")):
+        for line in open(p, encoding="utf-8"):
+            line = line.strip()
+            if line:
+                evs.append(json.loads(line))
+    assert schema.validate_stream(evs) == len(evs)
+    return evs
+
+run1 = load("/tmp/_t1_tune_obs1")
+run2 = load("/tmp/_t1_tune_obs2")
+d1 = [e for e in run1 if e["event"] == "tune_decision"]
+assert len(d1) == 1, f"run 1: want exactly one tune_decision, got {len(d1)}"
+assert d1[0]["source"] == "measured", d1[0]
+t1 = [e for e in run1 if e["event"] == "tune_trial"]
+assert any(t["seconds"] is not None for t in t1), "run 1 measured nothing"
+# the decided tuple is a member of the funnel-valid candidate space
+cfg = InputInfo.read_from_cfg_file("configs/gcn_dist_tune_smoke.cfg")
+cls = get_algorithm(cfg.algorithm)
+valid = {c.label() for c in space.enumerate_candidates(
+    cls, cfg, cfg.partitions, simulate=True)}
+assert d1[0]["candidate"] in valid, (d1[0]["candidate"], sorted(valid))
+# run 2: cache hit — zero trials, identical decision
+t2 = [e for e in run2 if e["event"] == "tune_trial"]
+assert not t2, f"cached run re-measured: {len(t2)} tune_trial records"
+d2 = [e for e in run2 if e["event"] == "tune_decision"]
+assert len(d2) == 1 and d2[0]["source"] == "cached", d2
+assert d2[0]["candidate"] == d1[0]["candidate"], (d1[0], d2[0])
+print(
+    f"tune gate: measured -> {d1[0]['candidate']} over {len(t1)} "
+    f"trial(s); cached replay identical with zero trials"
+)
+EOF
+else
+  tune_rc=$?
+  tail -30 /tmp/_t1_tune1.log /tmp/_t1_tune2.log 2>/dev/null
+fi
+if [ "$tune_rc" -ne 0 ]; then
+  echo "TUNE_GATE=FAIL (rc=$tune_rc)"
+else
+  echo "TUNE_GATE=OK"
+fi
+
 [ "$rc" -eq 0 ] && rc=$fused_rc
 [ "$rc" -eq 0 ] && rc=$samp_rc
 [ "$rc" -eq 0 ] && rc=$elastic_rc
+[ "$rc" -eq 0 ] && rc=$tune_rc
 exit $rc

@@ -43,6 +43,18 @@ def _emit_all(reg: registry.MetricsRegistry) -> None:
     reg.event("shed", reason="queue_full (depth 8)", queue_depth=8,
               req_id="q2")
     reg.event(
+        "tune_trial", family="dist_dense/DistGCNTrainer",
+        candidate="ring_blocked|-|-|bf16", source="measured",
+        seconds=0.012, predicted_bytes=123456, partitions=4,
+    )
+    reg.event(
+        "tune_decision", family="dist_dense/DistGCNTrainer",
+        candidate="ring_blocked|-|-|bf16", source="measured",
+        seconds=0.012, predicted_bytes=123456, partitions=4,
+        decision={"dist_path": "ring_blocked", "kernel": "",
+                  "ell_levels": "", "wire_dtype": "bf16"},
+    )
+    reg.event(
         "serve_summary", requests=1, shed=1,
         latency_ms={"p50": 3.5, "p95": 3.5, "p99": None},
         throughput_rps=10.0, counters={"serve.requests": 1},
@@ -83,6 +95,8 @@ RENDER_MARKERS = {
     "batch_flush": "#batches=",
     "shed": "#shed=",
     "serve_summary": "#p99_latency=",
+    "tune_trial": "#tune_trials=",
+    "tune_decision": "#tune_decision=",
     "span": "span timeline:",
     "stream_rotated": "stream_rotated",
     "run_summary": "finish algorithm !",
@@ -147,6 +161,8 @@ def test_validator_rejects_mutations_per_kind(tmp_path):
         "batch_flush": {"reason": ""},
         "shed": {"reason": ""},
         "serve_summary": {"latency_ms": "fast"},
+        "tune_trial": {"candidate": ""},
+        "tune_decision": {"partitions": 0},
         "span": {"dur_s": -1.0},
         "stream_rotated": {"bytes_written": "lots"},
         "run_summary": {"epoch_time": None},

@@ -1,0 +1,565 @@
+"""tune/ autotuner suite (ISSUE 10), on CPU.
+
+What is pinned here:
+
+- the candidate space is the funnel, not a parallel rule set: every
+  enumerated tuple passes the trainer class's own ``_check_kernel`` /
+  ``_check_dist_path`` probes, and tuples those checks refuse are absent
+  from the space (fused_edge never appears for the GCN dist family, the
+  all_gather family never appears on a sim rig, bf16 wire never pairs
+  with the all_gather exchange);
+- cache behavior: hit round-trip, digest / backend / schema-version
+  staleness (each a loud miss, never a silent reuse, never a crash),
+  embedded-key verification against hand-moved files, and atomic
+  publication (a crashed writer's tmp droppings and a torn final file
+  are both misses);
+- ``auto`` resolution end to end: DIST_PATH:auto + KERNEL:auto +
+  WIRE_DTYPE:auto on a 4-partition sim dist trainer under
+  NTS_TUNE=measure resolves to a funnel-valid tuple whose measured score
+  is <= every other trialed candidate's, emits one typed
+  ``tune_decision`` + per-candidate ``tune_trial`` records and the
+  tune.* gauges, and persists the decision;
+- determinism: ``NTS_TUNE=cached`` twice yields identical decisions —
+  with a warm cache (hit path, zero trials) and with a cold one (the
+  analytic prior is deterministic);
+- the pinned-tuple equivalence oracle: training under the resolved auto
+  knobs is BITWISE equal to an explicit cfg pinning the same tuple;
+- elastic integration: a survivor replan re-consults the cache for
+  P' = P - 1 — a warm P' entry is a ``cached`` decision, a cold one
+  falls back to the analytic prior (``decision_source=prior``), and no
+  measurement ever runs inside the recovery path;
+- the loudness contract: KERNEL:auto (or WIRE_DTYPE/ELL_LEVELS:auto)
+  with the tuner off refuses at the lifecycle funnel; DIST_PATH:auto
+  keeps its pre-tuner legacy meaning there;
+- satellites: wire_accounting.predict_all machine-readable predictions
+  (priced by the same formulas as the live counters) and its --json CLI.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+from neutronstarlite_tpu.graph.storage import build_graph
+from neutronstarlite_tpu.models import get_algorithm
+from neutronstarlite_tpu.obs.schema import validate_stream
+from neutronstarlite_tpu.tune import cache, runner, select, space
+from neutronstarlite_tpu.utils.config import InputInfo
+from tests.test_models import _planted_data
+
+
+@pytest.fixture(autouse=True)
+def _clean_tune_env(monkeypatch):
+    for var in ("NTS_TUNE", "NTS_TUNE_DIR", "NTS_TUNE_STEPS",
+                "NTS_TUNE_MAX_TRIALS", "NTS_DIST_SIMULATE",
+                "NTS_ELL_LEVELS", "NTS_WIRE_DTYPE"):
+        monkeypatch.delenv(var, raising=False)
+    yield
+
+
+def _dist_cfg(partitions=4, epochs=2, v_num=120, f=8, classes=3):
+    cfg = InputInfo()
+    cfg.algorithm = "GCNDIST"
+    cfg.vertices = v_num
+    cfg.layer_string = f"{f}-8-{classes}"
+    cfg.epochs = epochs
+    cfg.decay_epoch = -1
+    cfg.drop_rate = 0.0
+    cfg.partitions = partitions
+    cfg.kernel_tile = 16
+    cfg.dist_path = "auto"
+    cfg.kernel = "auto"
+    cfg.wire_dtype = "auto"
+    return cfg
+
+
+def _rig(seed=3, v_num=120, f=8, classes=3):
+    src, dst, datum = _planted_data(v_num=v_num, classes=classes, f=f,
+                                    seed=seed)
+    # one shared host graph: bitwise comparisons across trainers must not
+    # eat the native builder's per-build tie-edge ordering wobble
+    g = build_graph(src, dst, v_num, weight="gcn_norm")
+    return src, dst, datum, g
+
+
+def _events(metrics_dir):
+    evs = []
+    for p in sorted(glob.glob(os.path.join(str(metrics_dir), "*.jsonl"))):
+        with open(p) as fh:
+            evs.extend(json.loads(line) for line in fh if line.strip())
+    validate_stream(evs)
+    return evs
+
+
+def _of(evs, kind):
+    return [e for e in evs if e["event"] == kind]
+
+
+# ---- candidate space --------------------------------------------------------
+
+
+def test_space_every_proposed_tuple_passes_the_funnel():
+    """Enumeration reuses the funnel: every candidate, applied to the
+    cfg, passes the trainer's own validity checks without raising."""
+    cases = [
+        ("GCNDIST", _dist_cfg(), 4, True),
+        ("GCNDIST", _dist_cfg(), 4, False),
+    ]
+    gat = InputInfo()
+    gat.algorithm = "GATCPU"
+    gat.layer_string = "8-8-3"
+    gat.kernel = "auto"
+    gat.ell_levels = "auto"
+    cases.append(("GATCPU", gat, 1, False))
+    gatd = InputInfo()
+    gatd.algorithm = "GATDIST"
+    gatd.layer_string = "8-8-3"
+    gatd.partitions = 2
+    gatd.kernel = "auto"
+    cases.append(("GATDIST", gatd, 2, True))
+    for algo, cfg, P, sim in cases:
+        cls = get_algorithm(algo)
+        cands = space.enumerate_candidates(cls, cfg, P, simulate=sim)
+        assert cands, (algo, sim)
+        for cand in cands:
+            probe = object.__new__(cls)
+            probe.cfg = space.apply_candidate(cfg, cand,
+                                              space.auto_axes(cfg))
+            cls._check_kernel(probe)  # must not raise
+            cls._check_dist_path(probe)
+
+
+def test_space_refused_tuples_are_absent():
+    cls = get_algorithm("GCNDIST")
+    cfg = _dist_cfg()
+    cands = space.enumerate_candidates(cls, cfg, 4, simulate=True)
+    labels = [c.label() for c in cands]
+    # the funnel refuses fused_edge for the GCN family -> never proposed
+    assert all(c.kernel != "fused_edge" for c in cands)
+    # fused_edge on GCNDIST reports invalid through the probe too
+    assert not space.candidate_valid(
+        cls, cfg, space.Candidate(kernel="fused_edge"), space.auto_axes(cfg)
+    )
+    # no all_gather on a sim rig (the gather family has no sim twin)...
+    assert "all_gather|-|-|-" not in labels
+    # ...and bf16 wire only ever rides the ring
+    with_mesh = space.enumerate_candidates(cls, cfg, 4, simulate=False)
+    assert "all_gather|-|-|-" in [c.label() for c in with_mesh]
+    for c in with_mesh:
+        if c.wire_dtype:
+            assert c.dist_path == "ring_blocked"
+
+
+def test_space_pinned_axis_is_a_constraint():
+    cls = get_algorithm("GATCPU")
+    cfg = InputInfo()
+    cfg.algorithm = "GATCPU"
+    cfg.layer_string = "8-8-3"
+    cfg.ell_levels = "auto"  # KERNEL stays pinned at "" (eager)
+    cands = space.enumerate_candidates(cls, cfg, 1)
+    assert [c.label() for c in cands] == ["-|-|-|-"]
+
+
+def test_candidate_label_roundtrip():
+    c = space.Candidate(dist_path="ring_blocked", wire_dtype="bf16")
+    assert space.Candidate.from_label(c.label()) == c
+    with pytest.raises(ValueError):
+        space.Candidate.from_label("ring_blocked|bf16")
+
+
+# ---- decision cache ---------------------------------------------------------
+
+
+def _key(**over):
+    base = dict(graph_digest="d" * 64, family="dist_dense/DistGCNTrainer",
+                partitions=4, layers="8-8-3", backend="jax-1/cpu/cpux8")
+    base.update(over)
+    return cache.CacheKey(**base)
+
+
+def _decision():
+    return {"dist_path": "ring_blocked", "kernel": "", "ell_levels": "",
+            "wire_dtype": "bf16", "candidate": "ring_blocked|-|-|bf16",
+            "seconds": 0.01, "predicted_bytes": 4096, "source": "measured"}
+
+
+def test_cache_hit_miss_and_staleness(tmp_path, caplog):
+    d = str(tmp_path)
+    key = _key()
+    assert cache.load(key, d) is None  # cold miss
+    path = cache.store(key, _decision(), directory=d)
+    assert path and os.path.exists(path)
+    entry = cache.load(key, d)
+    assert entry["decision"]["candidate"] == "ring_blocked|-|-|bf16"
+
+    # digest change -> different key -> miss (re-tune)
+    assert cache.load(_key(graph_digest="e" * 64), d) is None
+    # backend change -> miss
+    assert cache.load(_key(backend="jax-1/tpu/v5ex8"), d) is None
+    # schema-version bump -> loud miss, entry not trusted
+    with open(path) as fh:
+        raw = json.load(fh)
+    raw["tune_schema"] = cache.TUNE_SCHEMA_VERSION + 1
+    with open(path, "w") as fh:
+        json.dump(raw, fh)
+    assert cache.load(key, d) is None
+    # embedded-key verification: a hand-moved file under another key's
+    # filename must not smuggle a foreign decision in
+    cache.store(key, _decision(), directory=d)
+    other = _key(partitions=3)
+    os.replace(path, other.path(d))
+    assert cache.load(other, d) is None
+
+
+def test_cache_atomic_publication_under_a_crashed_writer(tmp_path):
+    d = str(tmp_path)
+    key = _key()
+    # a writer that died between tmp-write and os.replace leaves only the
+    # tmp file: the final name does not exist -> clean miss
+    tmp = key.path(d) + ".tmp-999"
+    os.makedirs(d, exist_ok=True)
+    with open(tmp, "w") as fh:
+        fh.write('{"tune_schema": 1, "key": {')  # torn mid-write
+    assert cache.load(key, d) is None
+    # a torn FINAL file (pre-atomic writer, bit rot) is a warned miss,
+    # not a crash — and a fresh store over it recovers
+    with open(key.path(d), "w") as fh:
+        fh.write('{"tune_schema": 1,')
+    assert cache.load(key, d) is None
+    cache.store(key, _decision(), directory=d)
+    assert cache.load(key, d) is not None
+
+
+def test_cache_auto_widening_is_a_loud_miss(tmp_path, monkeypatch):
+    """An entry measured with an axis PINNED must not be replayed once
+    that axis goes auto — the stored decision never explored it, so a
+    cached replay would silently skip the comparison the auto spelling
+    asks for. Widening the auto set re-tunes."""
+    monkeypatch.setenv("NTS_TUNE", "measure")
+    monkeypatch.setenv("NTS_TUNE_DIR", str(tmp_path / "cache"))
+    monkeypatch.setenv("NTS_DIST_SIMULATE", "1")
+    src, dst, datum, g = _rig(seed=13)
+    algo = get_algorithm("GCNDIST")
+    cfg1 = _dist_cfg()
+    cfg1.wire_dtype = ""  # pinned: the entry never compares f32 vs bf16
+    algo.from_arrays(cfg1, src, dst, datum, host_graph=g)
+
+    monkeypatch.setenv("NTS_METRICS_DIR", str(tmp_path / "obs"))
+    t2 = algo.from_arrays(_dist_cfg(), src, dst, datum, host_graph=g)
+    evs = _events(tmp_path / "obs")
+    d = _of(evs, "tune_decision")
+    assert len(d) == 1 and d[0]["source"] == "measured"  # re-tuned
+    assert _of(evs, "tune_trial"), "widened auto set must re-measure"
+    # ...and the re-tuned entry (wider autos) now serves the wide lookup
+    monkeypatch.setenv("NTS_TUNE", "cached")
+    t3 = algo.from_arrays(_dist_cfg(), src, dst, datum, host_graph=g)
+    assert t3.metrics.snapshot()["gauges"]["tune.decision_source"] == \
+        "cached"
+
+
+def test_store_without_dir_is_a_warned_noop():
+    assert cache.store(_key(), _decision(), directory=None) is None
+
+
+# ---- auto resolution end to end --------------------------------------------
+
+
+def test_auto_resolution_measure_end_to_end(tmp_path, monkeypatch):
+    monkeypatch.setenv("NTS_TUNE", "measure")
+    monkeypatch.setenv("NTS_TUNE_DIR", str(tmp_path / "cache"))
+    monkeypatch.setenv("NTS_METRICS_DIR", str(tmp_path / "obs"))
+    monkeypatch.setenv("NTS_DIST_SIMULATE", "1")
+    src, dst, datum, g = _rig()
+    trainer = get_algorithm("GCNDIST").from_arrays(
+        _dist_cfg(), src, dst, datum, host_graph=g
+    )
+    cfg = trainer.cfg
+    # the auto knobs resolved to concrete, funnel-valid values
+    assert cfg.dist_path == "ring_blocked"
+    assert cfg.kernel == ""
+    assert cfg.wire_dtype in ("", "bf16")
+    result = trainer.run()
+    assert np.isfinite(result["loss"])
+
+    evs = _events(tmp_path / "obs")
+    decisions = _of(evs, "tune_decision")
+    assert len(decisions) == 1
+    d = decisions[0]
+    assert d["source"] == "measured"
+    assert d["partitions"] == 4
+    assert d["seconds"] is not None
+    trials = _of(evs, "tune_trial")
+    assert len(trials) == 2  # ring f32 + ring bf16 (sim rig: no all_gather)
+    measured = [t for t in trials if t["seconds"] is not None]
+    assert measured, "no candidate was actually measured"
+    # the winner's measured score is <= every other trialed candidate's
+    assert d["candidate"] in {t["candidate"] for t in measured}
+    assert d["seconds"] <= min(t["seconds"] for t in measured) + 1e-12
+    # the chosen tuple is in the funnel-valid space
+    cand = space.Candidate.from_label(d["candidate"])
+    assert space.candidate_valid(type(trainer), cfg, cand, set(space.AXES))
+    # gauges pin the decision for report consumers
+    gauges = trainer.metrics.snapshot()["gauges"]
+    assert gauges["tune.decision"] == d["candidate"]
+    assert gauges["tune.decision_source"] == "measured"
+    # the decision persisted (one atomic JSON entry)
+    files = glob.glob(str(tmp_path / "cache" / "tune-*.json"))
+    assert len(files) == 1
+
+
+def test_cached_roundtrip_zero_trials_and_bitwise_pinned_parity(
+        tmp_path, monkeypatch):
+    """Measure once; then (a) a cached re-run makes the identical
+    decision with zero trials, and (b) its loss history is bitwise equal
+    to an explicit cfg pinning the same tuple."""
+    monkeypatch.setenv("NTS_TUNE", "measure")
+    monkeypatch.setenv("NTS_TUNE_DIR", str(tmp_path / "cache"))
+    monkeypatch.setenv("NTS_DIST_SIMULATE", "1")
+    src, dst, datum, g = _rig(seed=5)
+    algo = get_algorithm("GCNDIST")
+    t1 = algo.from_arrays(_dist_cfg(), src, dst, datum, host_graph=g)
+    d1 = t1.metrics.snapshot()["gauges"]["tune.decision"]
+    t1.run()
+
+    monkeypatch.setenv("NTS_TUNE", "cached")
+    monkeypatch.setenv("NTS_METRICS_DIR", str(tmp_path / "obs2"))
+    t2 = algo.from_arrays(_dist_cfg(), src, dst, datum, host_graph=g)
+    t2.run()
+    evs = _events(tmp_path / "obs2")
+    assert not _of(evs, "tune_trial"), "cached run must not re-measure"
+    d2 = _of(evs, "tune_decision")
+    assert len(d2) == 1 and d2[0]["source"] == "cached"
+    assert d2[0]["candidate"] == d1
+
+    # explicit cfg pinning the decided tuple: bitwise-identical training
+    monkeypatch.delenv("NTS_TUNE")
+    monkeypatch.delenv("NTS_TUNE_DIR")
+    cand = space.Candidate.from_label(d1)
+    pinned = _dist_cfg()
+    pinned.dist_path = cand.dist_path
+    pinned.kernel = cand.kernel
+    pinned.ell_levels = cand.ell_levels
+    pinned.wire_dtype = cand.wire_dtype
+    t3 = algo.from_arrays(pinned, src, dst, datum, host_graph=g)
+    t3.run()
+    assert t2.loss_history == t3.loss_history  # bitwise, not approx
+
+
+def test_cached_mode_cold_cache_is_deterministic(tmp_path, monkeypatch):
+    """NTS_TUNE=cached twice on a COLD cache: the analytic-prior path
+    decides, deterministically, with zero trials both times."""
+    monkeypatch.setenv("NTS_TUNE", "cached")
+    monkeypatch.setenv("NTS_TUNE_DIR", str(tmp_path / "never_written"))
+    monkeypatch.setenv("NTS_DIST_SIMULATE", "1")
+    src, dst, datum, g = _rig(seed=9)
+    algo = get_algorithm("GCNDIST")
+    snaps = []
+    for _ in range(2):
+        t = algo.from_arrays(_dist_cfg(), src, dst, datum, host_graph=g)
+        snap = t.metrics.snapshot()["gauges"]
+        snaps.append((snap["tune.decision"], snap["tune.decision_source"]))
+        assert "tune.trials" not in t.metrics.snapshot()["counters"]
+    assert snaps[0] == snaps[1]
+    assert snaps[0][1] == "prior"
+    # prior-only decisions are never persisted: a later measure run must
+    # still actually measure
+    assert not glob.glob(str(tmp_path / "never_written" / "*.json"))
+
+
+def test_auto_off_refuses_tuner_only_knobs(monkeypatch):
+    src, dst, datum, g = _rig(seed=2)
+    cfg = _dist_cfg()  # KERNEL:auto + WIRE_DTYPE:auto + DIST_PATH:auto
+    with pytest.raises(ValueError, match="NTS_TUNE"):
+        get_algorithm("GCNDIST").from_arrays(cfg, src, dst, datum,
+                                             host_graph=g)
+
+
+def test_dist_path_auto_keeps_legacy_meaning_when_off(monkeypatch):
+    """DIST_PATH:auto predates the tuner: with NTS_TUNE=off it still
+    defers to the COMM_LAYER heuristic instead of refusing."""
+    monkeypatch.setenv("NTS_DIST_SIMULATE", "1")
+    src, dst, datum, g = _rig(seed=2)
+    cfg = _dist_cfg()
+    cfg.kernel = ""
+    cfg.wire_dtype = ""
+    trainer = get_algorithm("GCNDIST").from_arrays(cfg, src, dst, datum,
+                                                   host_graph=g)
+    assert cfg.dist_path == "auto"  # untouched; build ran the heuristic
+    assert trainer.comm_layer in ("ring", "ell", "mirror")
+
+
+# ---- elastic replan integration --------------------------------------------
+
+
+def test_replan_reconsults_prior_fallback(tmp_path, monkeypatch):
+    """Replan with a COLD P'=3 cache: the recovery path decides from the
+    analytic prior (decision_source=prior) and never measures."""
+    from neutronstarlite_tpu.resilience import elastic
+
+    monkeypatch.setenv("NTS_TUNE", "measure")
+    monkeypatch.setenv("NTS_TUNE_DIR", str(tmp_path / "cache"))
+    monkeypatch.setenv("NTS_METRICS_DIR", str(tmp_path / "obs"))
+    monkeypatch.setenv("NTS_DIST_SIMULATE", "1")
+    src, dst, datum, g = _rig(seed=4)
+    trainer = get_algorithm("GCNDIST").from_arrays(
+        _dist_cfg(), src, dst, datum, host_graph=g
+    )
+    trials_before = len(_of(_events(tmp_path / "obs"), "tune_trial"))
+    try:
+        elastic.replan_survivors(trainer, lost_partition=2)
+    finally:
+        elastic.reset()
+    assert trainer.dist.partitions == 3
+    evs = _events(tmp_path / "obs")
+    decisions = _of(evs, "tune_decision")
+    assert len(decisions) == 2  # initial measure + replan re-consult
+    assert decisions[-1]["source"] == "prior"
+    assert decisions[-1]["partitions"] == 3
+    # no measuring inside the recovery path
+    assert len(_of(evs, "tune_trial")) == trials_before
+    gauges = trainer.metrics.snapshot()["gauges"]
+    assert gauges["tune.decision_source"] == "prior"
+    assert gauges["tune.partitions"] == 3
+
+
+def test_replan_reconsults_cached_p_minus_1_hit(tmp_path, monkeypatch):
+    """Replan with a WARM P'=3 entry (measured earlier): the recovery
+    path replays it (decision_source=cached), zero trials."""
+    from neutronstarlite_tpu.resilience import elastic
+
+    monkeypatch.setenv("NTS_TUNE", "measure")
+    monkeypatch.setenv("NTS_TUNE_DIR", str(tmp_path / "cache"))
+    monkeypatch.setenv("NTS_DIST_SIMULATE", "1")
+    src, dst, datum, g = _rig(seed=6)
+    algo = get_algorithm("GCNDIST")
+    # warm the P=3 entry with a real measured decision
+    algo.from_arrays(_dist_cfg(partitions=3), src, dst, datum, host_graph=g)
+
+    monkeypatch.setenv("NTS_METRICS_DIR", str(tmp_path / "obs"))
+    trainer = algo.from_arrays(_dist_cfg(partitions=4), src, dst, datum,
+                               host_graph=g)
+    trials_before = len(_of(_events(tmp_path / "obs"), "tune_trial"))
+    try:
+        elastic.replan_survivors(trainer, lost_partition=1)
+    finally:
+        elastic.reset()
+    evs = _events(tmp_path / "obs")
+    assert _of(evs, "tune_decision")[-1]["source"] == "cached"
+    assert _of(evs, "tune_decision")[-1]["partitions"] == 3
+    assert len(_of(evs, "tune_trial")) == trials_before
+
+
+# ---- satellites -------------------------------------------------------------
+
+
+def test_predict_all_matches_the_live_counter_formulas(rng):
+    from neutronstarlite_tpu.tools.wire_accounting import (
+        exchange_rows_per_device,
+        peak_resident_rows,
+        predict_all,
+    )
+    from tests.conftest import tiny_graph
+
+    g, _ = tiny_graph(rng, v_num=60, e_num=400)
+    out = predict_all(g, 4, 16, widths=[16, 8])
+    P, vp, mb = out["P"], out["vp"], out["mb"]
+    for kind in ("ring", "ell", "blocked", "ring_blocked"):
+        s = out["strategies"][kind]
+        assert s["exchange_rows"] == exchange_rows_per_device(kind, P, vp)
+        assert s["peak_resident_rows"] == peak_resident_rows(kind, P, vp)
+        assert s["bytes_per_epoch"] == s["exchange_rows"] * (16 + 8) * 4
+    m = out["strategies"]["mirror"]
+    assert m["exchange_rows"] == exchange_rows_per_device(
+        "mirror", P, vp, mb
+    )
+    # the memory halves diverge where they should: ring double-buffers
+    assert (out["strategies"]["ring_blocked"]["peak_resident_rows"]
+            < out["strategies"]["ell"]["peak_resident_rows"])
+
+
+def test_wire_accounting_json_cli(capsys):
+    from neutronstarlite_tpu.tools.wire_accounting import main
+
+    rc = main(["--cora", "--partitions", "4", "--feature", "32", "--json"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    obj = json.loads(out)
+    assert obj["graph"] == "cora"
+    assert set(obj["strategies"]) >= {"ring", "ell", "ring_blocked",
+                                      "mirror"}
+    for s in obj["strategies"].values():
+        assert set(s) >= {"exchange_rows", "peak_resident_rows",
+                          "bytes_per_epoch"}
+
+
+def test_analytic_prior_orders_dist_candidates_sanely(rng):
+    """ring+bf16 < ring+f32 < all_gather on the prior scale (same wire
+    volume, but the ring double-buffers and bf16 halves the bytes)."""
+    from tests.conftest import tiny_graph
+
+    g, _ = tiny_graph(rng, v_num=80, e_num=500)
+    cands = [
+        space.Candidate(dist_path="all_gather"),
+        space.Candidate(dist_path="ring_blocked"),
+        space.Candidate(dist_path="ring_blocked", wire_dtype="bf16"),
+    ]
+    priors = runner.analytic_priors(g, 4, [16, 8, 4], "dist_dense", cands)
+    ag = priors["all_gather|-|-|-"]
+    rf = priors["ring_blocked|-|-|-"]
+    rb = priors["ring_blocked|-|-|bf16"]
+    assert rb < rf < ag
+
+
+def test_edge_single_auto_resolution(tmp_path, monkeypatch):
+    """KERNEL:auto + ELL_LEVELS:auto on the single-chip GAT family:
+    trials run the eager chain vs both fused ladders, and the decision
+    builds."""
+    monkeypatch.setenv("NTS_TUNE", "measure")
+    monkeypatch.setenv("NTS_TUNE_DIR", str(tmp_path / "cache"))
+    monkeypatch.setenv("NTS_METRICS_DIR", str(tmp_path / "obs"))
+    src, dst, datum = _planted_data(v_num=100, classes=3, f=8, seed=8)
+    g = build_graph(src, dst, 100, weight="ones")
+    cfg = InputInfo()
+    cfg.algorithm = "GATCPU"
+    cfg.vertices = 100
+    cfg.layer_string = "8-8-3"
+    cfg.epochs = 1
+    cfg.decay_epoch = -1
+    cfg.drop_rate = 0.0
+    cfg.kernel = "auto"
+    cfg.ell_levels = "auto"
+    trainer = get_algorithm("GATCPU").from_arrays(cfg, src, dst, datum,
+                                                  host_graph=g)
+    assert cfg.kernel in ("", "fused_edge")
+    if cfg.kernel == "fused_edge":
+        assert cfg.ell_levels in ("binned", "pow2")
+    result = trainer.run()
+    assert np.isfinite(result["loss"])
+    evs = _events(tmp_path / "obs")
+    assert len(_of(evs, "tune_decision")) == 1
+    assert len(_of(evs, "tune_trial")) == 3
+
+
+def test_tuning_block_renders(tmp_path, monkeypatch, capsys):
+    """metrics_report renders the tuning: block from a tuned stream."""
+    monkeypatch.setenv("NTS_TUNE", "measure")
+    monkeypatch.setenv("NTS_TUNE_DIR", str(tmp_path / "cache"))
+    monkeypatch.setenv("NTS_METRICS_DIR", str(tmp_path / "obs"))
+    monkeypatch.setenv("NTS_DIST_SIMULATE", "1")
+    src, dst, datum, g = _rig(seed=12)
+    trainer = get_algorithm("GCNDIST").from_arrays(
+        _dist_cfg(), src, dst, datum, host_graph=g
+    )
+    trainer.run()
+    from neutronstarlite_tpu.tools.metrics_report import main as report_main
+
+    rc = report_main([str(tmp_path / "obs")])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "tuning:" in out
+    assert "#tune_decision=" in out
+    assert "#tune_trials=2" in out
