@@ -203,57 +203,103 @@ print(json.dumps({
 """
 
 
+def _probe_metrics():
+    """A tiny obs registry for the probe's typed ``backend_probe`` records
+    (only when NTS_METRICS_DIR is set — the probe must stay zero-cost and
+    zero-risk on bare runs). The probe has timed out every bench round
+    since r05 with zero trace in any stream; these records make the
+    stale-anchor cause visible in metrics_report."""
+    if not os.environ.get("NTS_METRICS_DIR"):
+        return None
+    try:
+        from neutronstarlite_tpu.obs import open_run
+
+        return open_run("BACKENDPROBE")
+    except Exception as e:  # telemetry must never block the probe
+        print(f"backend_probe telemetry unavailable: {e}", file=sys.stderr)
+        return None
+
+
 def probe_backend(timeout_s: float, attempts: int, backoff_s: float,
                   scale: float = 1.0):
     """Run the backend probe in a subprocess (isolates a hung/poisoned PJRT
-    init from this process) with a hard timeout; retry with backoff.
+    init from this process) with a hard timeout; retry with backoff. Each
+    attempt leaves one typed ``backend_probe`` obs record
+    (attempt/outcome/platform/seconds).
 
     Returns the probe's parsed JSON on success. On failure, falls back to
     the last persisted same-scale measurement (exit 0, marked stale);
     raises SystemExit(1) with diagnostics only when there is nothing to
     salvage."""
     last = ""
-    for attempt in range(1, attempts + 1):
-        t0 = time.time()
-        try:
-            r = subprocess.run(
-                [sys.executable, "-c", _PROBE_SRC],
-                capture_output=True, text=True, timeout=timeout_s,
+    reg = _probe_metrics()
+
+    def record(attempt, outcome, t0, platform=None, **extra):
+        if reg is not None:
+            reg.event(
+                "backend_probe", attempt=attempt, outcome=outcome,
+                seconds=round(time.time() - t0, 3), platform=platform,
+                timeout_s=timeout_s, **extra,
             )
-        except subprocess.TimeoutExpired as e:
-            last = (
-                f"probe attempt {attempt}/{attempts}: TIMEOUT after "
-                f"{timeout_s:.0f}s (backend init hang). "
-                f"stderr tail: {(e.stderr or '')[-2000:]}"
-            )
-            print(last, file=sys.stderr, flush=True)
-            continue
-        if r.returncode == 0 and r.stdout.strip():
+
+    try:
+        for attempt in range(1, attempts + 1):
+            t0 = time.time()
             try:
-                info = json.loads(r.stdout.strip().splitlines()[-1])
-                print(
-                    f"backend probe ok in {time.time()-t0:.1f}s: "
-                    f"{info['platform']} {info['devices']}",
-                    file=sys.stderr, flush=True,
+                r = subprocess.run(
+                    [sys.executable, "-c", _PROBE_SRC],
+                    capture_output=True, text=True, timeout=timeout_s,
                 )
-                return info
-            except (json.JSONDecodeError, KeyError):
-                pass
-        last = (
-            f"probe attempt {attempt}/{attempts}: rc={r.returncode}. "
-            f"stderr tail: {r.stderr[-2000:]}"
+            except subprocess.TimeoutExpired as e:
+                last = (
+                    f"probe attempt {attempt}/{attempts}: TIMEOUT after "
+                    f"{timeout_s:.0f}s (backend init hang). "
+                    f"stderr tail: {(e.stderr or '')[-2000:]}"
+                )
+                record(attempt, "timeout", t0,
+                       error=(e.stderr or "")[-500:] or None)
+                print(last, file=sys.stderr, flush=True)
+                continue
+            if r.returncode == 0 and r.stdout.strip():
+                try:
+                    info = json.loads(r.stdout.strip().splitlines()[-1])
+                    # index the required keys BEFORE recording "ok": a
+                    # parseable-but-malformed probe line must fall through
+                    # to the single "error" record, not leave both
+                    platform, devices = info["platform"], info["devices"]
+                except (json.JSONDecodeError, KeyError):
+                    pass
+                else:
+                    record(
+                        attempt, "ok", t0, platform=platform,
+                        devices=devices, init_s=info.get("init_s"),
+                    )
+                    print(
+                        f"backend probe ok in {time.time()-t0:.1f}s: "
+                        f"{platform} {devices}",
+                        file=sys.stderr, flush=True,
+                    )
+                    return info
+            last = (
+                f"probe attempt {attempt}/{attempts}: rc={r.returncode}. "
+                f"stderr tail: {r.stderr[-2000:]}"
+            )
+            record(attempt, "error", t0, rc=r.returncode,
+                   error=r.stderr[-500:] or None)
+            print(last, file=sys.stderr, flush=True)
+            if attempt < attempts:
+                time.sleep(backoff_s)
+        print(
+            "FATAL: TPU/JAX backend unavailable after "
+            f"{attempts} probe attempts. Last failure:\n{last}",
+            file=sys.stderr, flush=True,
         )
-        print(last, file=sys.stderr, flush=True)
-        if attempt < attempts:
-            time.sleep(backoff_s)
-    print(
-        "FATAL: TPU/JAX backend unavailable after "
-        f"{attempts} probe attempts. Last failure:\n{last}",
-        file=sys.stderr, flush=True,
-    )
-    raise SystemExit(
-        emit_stale_or_fail(scale, "backend unavailable", diag=last)
-    )
+        raise SystemExit(
+            emit_stale_or_fail(scale, "backend unavailable", diag=last)
+        )
+    finally:
+        if reg is not None:
+            reg.close()
 
 
 def start_watchdog(deadline_s: float):

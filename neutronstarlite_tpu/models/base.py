@@ -113,6 +113,18 @@ class ToolkitBase:
         # fault/recovery records from any layer (fault injection, guard
         # trips, checkpoint quarantine) land in this trainer's stream
         res_events.set_sink(self.metrics)
+        # live telemetry plane (obs/): the SLO burn-rate engine evaluates
+        # NTS_SLO_SPEC objectives (epoch_pNN_ms on trainers; serving arms
+        # its own latency objectives) — ticked per epoch in emit_epoch —
+        # and the opt-in scrape endpoint (NTS_METRICS_PORT) serves
+        # /metrics, /healthz, /slo off this registry: a process-level
+        # singleton that rebinds to the newest trainer (train-then-serve
+        # runs hand the same stream to the serve stack, which rebinds)
+        from neutronstarlite_tpu.obs import exporter as obs_exporter
+        from neutronstarlite_tpu.obs.slo import SloEngine
+
+        self.slo = SloEngine.from_env(self.metrics, scope="train")
+        obs_exporter.maybe_start(self.metrics, slo=self.slo)
 
     # dist trainers build their own partitioned layout; the single-device
     # DeviceGraph upload would be O(E) wasted HBM for them
@@ -695,6 +707,14 @@ class ToolkitBase:
             epoch, seconds,
             loss=float(loss) if loss is not None else None, **extra,
         )
+        # step-time distribution (obs/hist): epoch quantiles that survive
+        # rotation and merge across ranks — the scalar epoch timing stat
+        # only carries min/max/avg
+        self.metrics.hist_observe("train.epoch_ms", seconds * 1000.0)
+        if self.slo is not None:
+            # epoch objectives (epoch_pNN_ms) evaluate once per epoch; a
+            # breach emits slo_status and snapshots the flight recorder
+            self.slo.tick()
         # the epoch (and its stages) as spans on the causal timeline —
         # retroactive: the epoch just ended, so end ~= now and the stream's
         # mono->wall recovery (trace.py docstring) holds
@@ -737,6 +757,8 @@ class ToolkitBase:
         """
         if self.run_summary_record is not None:
             return self.run_summary_record
+        if self.slo is not None:
+            self.slo.close()  # final forced evaluation -> last slo_status
         # close the root lifecycle span BEFORE the summary so the span is
         # part of the stream the summary consolidates
         if self._run_span is not None:

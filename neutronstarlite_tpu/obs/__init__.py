@@ -15,13 +15,23 @@ volume) and ad-hoc bench prints:
   tools/metrics_report consume it);
 - :mod:`trace` — hierarchical span tracing (trace_id / span_id /
   parent_id) over the same JSONL stream; tools/trace_timeline merges the
-  per-rank span files into one causal timeline and a Chrome trace.
+  per-rank span files into one causal timeline and a Chrome trace;
+- :mod:`hist` — log-bucketed mergeable latency histograms (bounded
+  relative quantile error, fixed memory) serialized as typed ``hist``
+  records so tail quantiles survive rotation and multi-rank runs;
+- :mod:`slo` — declarative objectives (``NTS_SLO_SPEC``) evaluated as
+  rolling multi-window burn rates; the serve admission/shed signal;
+- :mod:`exporter` — the opt-in HTTP pull endpoint (``NTS_METRICS_PORT``):
+  /metrics (Prometheus text), /healthz, /slo;
+- :mod:`flight` — the always-on bounded flight recorder: the last N
+  records at full resolution, dumped on fault/breach/SIGUSR2.
 
 Every trainer run emits one ``run_summary`` record; ``tools/metrics_report``
 renders one or more streams into the reference-shaped ``#key=value(ms)``
 report and a cross-run comparison table. See docs/OBSERVABILITY.md.
 """
 
+from neutronstarlite_tpu.obs.hist import LogHistogram
 from neutronstarlite_tpu.obs.registry import (
     MetricsRegistry,
     config_fingerprint,
@@ -32,6 +42,7 @@ from neutronstarlite_tpu.obs.schema import SCHEMA_VERSION, validate_event
 from neutronstarlite_tpu.obs.trace import Tracer
 
 __all__ = [
+    "LogHistogram",
     "MetricsRegistry",
     "SCHEMA_VERSION",
     "Tracer",

@@ -1,0 +1,238 @@
+"""Opt-in pull-based HTTP telemetry endpoint (``NTS_METRICS_PORT``).
+
+Serves three paths from a lock-light snapshot of the live registry —
+scrapes copy the metric dicts under the registry lock (microseconds) and
+format OUTSIDE it, so a scrape can never block a serve flush or a ring
+step:
+
+- ``/metrics`` — Prometheus text exposition: counters, numeric gauges,
+  timing summaries (``_count``/``_sum``), and every LogHistogram as a
+  cumulative-bucket histogram over the fixed ``le`` ladder
+  (obs/hist.PROM_EDGES_MS) plus ``_sum``/``_count``;
+- ``/healthz`` — JSON liveness: run identity, uptime, fault/restart
+  counters, the supervisor state gauge, elastic partition count;
+- ``/slo`` — the SLO engine's current objective verdicts as JSON (404
+  when no engine is armed).
+
+``NTS_METRICS_PORT=0`` binds an ephemeral port (``exporter.port`` reports
+it — tests and in-process drivers use this); the listener binds
+``NTS_METRICS_HOST`` (default 127.0.0.1 — expose deliberately, not by
+default). One exporter per process: :func:`maybe_start` is a singleton
+that REBINDS to the newest registry (train-then-serve runs hand off the
+same stream; the latest-wins convention of resilience/events.set_sink).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+
+from neutronstarlite_tpu.obs.hist import PROM_EDGES_MS
+from neutronstarlite_tpu.utils.logging import get_logger
+
+log = get_logger("obs")
+
+
+def _prom_name(name: str) -> str:
+    out = "".join(c if c.isalnum() or c == "_" else "_" for c in name)
+    return f"nts_{out}"
+
+
+def prometheus_text(registry, slo=None) -> str:
+    """Render one Prometheus text-format snapshot of the registry.
+
+    A name can exist as BOTH a scalar and a histogram (sample.stall_ms
+    is a cumulative counter and a distribution; sample.queue_depth a
+    high-water gauge and a distribution) — Prometheus rejects a second
+    TYPE declaration for one family, so the colliding scalar renders
+    under a suffixed name (`_total` for counters, `_peak` for gauges)
+    and the histogram keeps the bare family."""
+    snap = registry.snapshot(include_hists=False)
+    hists = registry.hists()
+    lines: List[str] = []
+    for name, v in sorted(snap["counters"].items()):
+        pn = _prom_name(name + "_total" if name in hists else name)
+        lines.append(f"# TYPE {pn} counter")
+        lines.append(f"{pn} {float(v):g}")
+    for name, v in sorted(snap["gauges"].items()):
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            continue  # non-numeric gauges (strings) have no Prom encoding
+        pn = _prom_name(name + "_peak" if name in hists else name)
+        lines.append(f"# TYPE {pn} gauge")
+        lines.append(f"{pn} {float(v):g}")
+    for name, t in sorted(snap["timings"].items()):
+        pn = _prom_name(name + "_seconds")
+        lines.append(f"# TYPE {pn} summary")
+        lines.append(f"{pn}_count {int(t['count'])}")
+        lines.append(f"{pn}_sum {float(t['total_s']):g}")
+    for name, h in sorted(hists.items()):
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} histogram")
+        cumulative = 0
+        for edge in PROM_EDGES_MS:
+            cumulative = h.count_le(edge)
+            lines.append(f'{pn}_bucket{{le="{edge:g}"}} {cumulative}')
+        lines.append(f'{pn}_bucket{{le="+Inf"}} {h.count}')
+        lines.append(f"{pn}_sum {h.sum:g}")
+        lines.append(f"{pn}_count {h.count}")
+    if slo is not None:
+        for v in slo.verdicts():
+            pn = _prom_name("slo_burn_rate")
+            lines.append(
+                f'{pn}{{objective="{v["objective"]}"}} '
+                f'{v["burn_rate"] if v["burn_rate"] is not None else "NaN"}'
+            )
+            lines.append(
+                f'nts_slo_breached{{objective="{v["objective"]}"}} '
+                f'{1 if v["state"] == "breach" else 0}'
+            )
+    return "\n".join(lines) + "\n"
+
+
+def health_payload(registry, started_at: float) -> Dict[str, Any]:
+    snap = registry.snapshot(include_hists=False)
+    counters = snap["counters"]
+    gauges = snap["gauges"]
+    gave_up = bool(gauges.get("resilience.gave_up"))
+    return {
+        "ok": not gave_up,
+        "run_id": registry.run_id,
+        "algorithm": registry.algorithm,
+        "uptime_s": round(time.time() - started_at, 3),
+        "supervisor": {
+            "state": gauges.get("resilience.state"),
+            "attempt": gauges.get("resilience.attempt"),
+            "faults": counters.get("resilience.faults", 0),
+            "restarts": counters.get("resilience.restarts", 0),
+            "replans": counters.get("resilience.replans", 0),
+        },
+        "liveness": {
+            "active_partitions": gauges.get("dist.active_partitions"),
+            "last_event_ts": registry.last_event_ts,
+        },
+    }
+
+
+class MetricsExporter:
+    """The HTTP listener; ``registry``/``slo`` are rebindable live."""
+
+    def __init__(self, registry, port: int, host: str = "127.0.0.1",
+                 slo=None):
+        self.registry = registry
+        self.slo = slo
+        self.started_at = time.time()
+        exporter = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *_a):  # scrapes must not spam the log
+                pass
+
+            def _send(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 (http.server API)
+                try:
+                    path = self.path.split("?", 1)[0]
+                    if path == "/metrics":
+                        body = prometheus_text(
+                            exporter.registry, exporter.slo
+                        ).encode()
+                        self._send(
+                            200, body,
+                            "text/plain; version=0.0.4; charset=utf-8",
+                        )
+                    elif path == "/healthz":
+                        body = json.dumps(health_payload(
+                            exporter.registry, exporter.started_at
+                        )).encode()
+                        self._send(200, body, "application/json")
+                    elif path == "/slo":
+                        if exporter.slo is None:
+                            self._send(
+                                404,
+                                b'{"error": "no SLO engine armed '
+                                b'(NTS_SLO_SPEC unset)"}',
+                                "application/json",
+                            )
+                        else:
+                            exporter.slo.tick()
+                            body = json.dumps(
+                                exporter.slo.verdicts()
+                            ).encode()
+                            self._send(200, body, "application/json")
+                    else:
+                        self._send(404, b"not found\n", "text/plain")
+                except Exception as e:  # a bad scrape must not kill serving
+                    try:
+                        self._send(
+                            500, f"scrape failed: {e}\n".encode(),
+                            "text/plain",
+                        )
+                    except Exception:
+                        pass
+
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="obs-exporter",
+            daemon=True,
+        )
+        self._thread.start()
+        log.info("metrics exporter listening on http://%s:%d "
+                 "(/metrics /healthz /slo)", host, self.port)
+
+    def rebind(self, registry, slo=None) -> None:
+        """Latest surface wins for BOTH fields: keeping a previous run's
+        SLO engine (bound to its closed registry) would serve stale /slo
+        verdicts next to the new registry's /metrics."""
+        self.registry = registry
+        self.slo = slo
+
+    def close(self) -> None:
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except Exception:
+            pass
+        self._thread.join(timeout=5.0)
+
+
+_singleton: Optional[MetricsExporter] = None
+_singleton_lock = threading.Lock()
+
+
+def maybe_start(registry, slo=None) -> Optional[MetricsExporter]:
+    """Start (or rebind) the process's exporter when ``NTS_METRICS_PORT``
+    is set; None otherwise. Never raises — a taken port degrades to a
+    warning, not a dead trainer."""
+    global _singleton
+    raw = os.environ.get("NTS_METRICS_PORT", "")
+    if not raw:
+        return None
+    with _singleton_lock:
+        if _singleton is not None:
+            _singleton.rebind(registry, slo)
+            return _singleton
+        try:
+            port = int(raw)
+        except ValueError:
+            log.warning("NTS_METRICS_PORT=%r is not an int; exporter off",
+                        raw)
+            return None
+        host = os.environ.get("NTS_METRICS_HOST", "127.0.0.1")
+        try:
+            _singleton = MetricsExporter(registry, port, host=host, slo=slo)
+        except OSError as e:
+            log.warning("metrics exporter could not bind %s:%s (%s); "
+                        "exporter off", host, port, e)
+            return None
+        return _singleton

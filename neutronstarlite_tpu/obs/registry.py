@@ -19,6 +19,8 @@ import threading
 import time
 from typing import Any, Dict, Optional
 
+from neutronstarlite_tpu.obs import flight as flight_mod
+from neutronstarlite_tpu.obs.hist import LogHistogram
 from neutronstarlite_tpu.obs.schema import SCHEMA_VERSION
 from neutronstarlite_tpu.utils.logging import get_logger, process_index
 
@@ -106,7 +108,16 @@ class MetricsRegistry:
         self._counters: Dict[str, float] = {}
         self._gauges: Dict[str, Any] = {}
         self._timings: Dict[str, _TimingStat] = {}
+        self._hists: Dict[str, LogHistogram] = {}
         self._seq = 0
+        self.last_event_ts: Optional[float] = None
+        # the always-on flight ring (obs/flight): every record this
+        # registry emits lands in it; trigger records dump it. The newest
+        # registry owns the process's SIGUSR2 snapshot target.
+        self.flight = None
+        if flight_mod.flight_enabled():
+            self.flight = flight_mod.FlightRecorder()
+            flight_mod.set_active(self.flight)
         # the sink opens LAZILY on the first substantive event (anything
         # beyond run_start): tools that construct trainers without running
         # them (aot_check, tests) must not litter NTS_METRICS_DIR with
@@ -119,6 +130,7 @@ class MetricsRegistry:
         self._max_bytes = max_stream_bytes()
         self._bytes_written = 0
         self.rotations = 0
+        self._reemitting_hists = False
         self.summary: Optional[Dict[str, Any]] = None
 
     # ---- metric primitives ----------------------------------------------
@@ -137,13 +149,66 @@ class MetricsRegistry:
                 stat = self._timings[name] = _TimingStat()
             stat.observe(float(seconds))
 
-    def snapshot(self) -> Dict[str, Any]:
+    def hist_observe(self, name: str, value: float, unit: str = "ms") -> None:
+        """O(1) record into the named LogHistogram (created on first use)
+        — the distribution-preserving alternative to counter_add/observe
+        for latency-shaped metrics (obs/hist.py has the error bound)."""
         with self._lock:
-            return {
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = LogHistogram(unit=unit)
+            h.record(value)
+
+    def hist(self, name: str) -> Optional[LogHistogram]:
+        """The live histogram object (shared, not a copy — read-only use;
+        the SLO engine reads bucket geometry off it)."""
+        with self._lock:
+            return self._hists.get(name)
+
+    def hists(self) -> Dict[str, LogHistogram]:
+        """{name: copy} — a consistent point-in-time snapshot (exporter)."""
+        with self._lock:
+            return {k: h.copy() for k, h in self._hists.items()}
+
+    def hist_view(self, name: str):
+        """(count, zero_count, buckets copy) for one histogram, or None —
+        the SLO engine's rolling-window subtraction source; cheaper than a
+        full copy (no geometry objects rebuilt)."""
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                return None
+            return (h.count, h.zero_count, dict(h.buckets))
+
+    def counter_get(self, name: str) -> float:
+        with self._lock:
+            return self._counters.get(name, 0.0)
+
+    def snapshot(self, include_hists: bool = True) -> Dict[str, Any]:
+        """The metric-state copy; ``include_hists=False`` skips the
+        histogram serialization for consumers that only want scalars
+        (the exporter's /healthz, or /metrics which takes LogHistogram
+        copies via hists() instead of dicts)."""
+        with self._lock:
+            out = {
                 "counters": dict(self._counters),
                 "gauges": dict(self._gauges),
                 "timings": {k: t.as_dict() for k, t in self._timings.items()},
             }
+            if include_hists:
+                out["hists"] = {
+                    k: h.to_dict() for k, h in self._hists.items()
+                }
+            return out
+
+    def emit_hists(self) -> None:
+        """One typed ``hist`` record per histogram — a CUMULATIVE snapshot
+        (the latest per name supersedes earlier ones; obs/hist.py has the
+        merge semantics). Called at finalize/close, and re-emitted into
+        the fresh chunk after an NTS_METRICS_MAX_MB rotation so quantiles
+        survive the truncation that used to lose p99 entirely."""
+        for name, d in sorted(self.snapshot()["hists"].items()):
+            self.event("hist", name=name, **d)
 
     # ---- event stream ----------------------------------------------------
     def event(self, event_kind: str, **fields: Any) -> Dict[str, Any]:
@@ -162,6 +227,8 @@ class MetricsRegistry:
             "seq": seq,
         }
         rec.update(fields)
+        self.last_event_ts = rec["ts"]
+        rotated = False
         if self.path is not None:
             line = json.dumps(rec, default=str) + "\n"
             # sink state + writes stay under the lock: serving emits events
@@ -170,8 +237,8 @@ class MetricsRegistry:
             # interleaved buffered writes tear lines mid-record
             with self._lock:
                 if self.path is None:  # another thread disabled the sink
-                    return rec
-                if self._fh is None and event_kind == "run_start":
+                    pass
+                elif self._fh is None and event_kind == "run_start":
                     self._pending.append(line)
                 else:
                     try:
@@ -185,24 +252,53 @@ class MetricsRegistry:
                         self._fh.write(line)
                         self._fh.flush()
                         self._bytes_written += len(line)
-                        self._maybe_rotate_locked()
+                        rotated = self._maybe_rotate_locked()
                     except OSError as e:  # telemetry must never kill a run
                         log.warning(
                             "metrics write failed (%s); disabling sink", e
                         )
                         self._fh = None
                         self.path = None
+        # outside the lock: the flight ring/triggers and any post-rotation
+        # histogram re-emission must never run under the writer lock
+        return self._post_event(rec, rotated)
+
+    def _post_event(self, rec: Dict[str, Any], rotated: bool) -> Dict[str, Any]:
+        """Outside-the-lock tail of event(): the flight ring/triggers, and
+        the post-rotation histogram re-emission (cumulative snapshots into
+        the fresh chunk so quantiles survive the truncation)."""
+        if rotated and not self._reemitting_hists:
+            self._reemitting_hists = True  # hist records may themselves
+            try:                           # rotate; never recurse
+                # bounded retry: if the re-emission itself crosses the cap
+                # mid-sequence, the fresh chunk would hold only a suffix of
+                # the snapshots — emit once more so the newest chunk ends
+                # with a complete set (two rounds bound the work; a cap
+                # smaller than one snapshot set stays truncated, with the
+                # .1 chunk still carrying the rest)
+                for _ in range(2):
+                    before = self.rotations
+                    self.emit_hists()
+                    if self.rotations == before:
+                        break
+            finally:
+                self._reemitting_hists = False
+        if self.flight is not None:
+            self.flight.record(rec)
+            self.flight.consider(rec)
         return rec
 
-    def _maybe_rotate_locked(self) -> None:
+    def _maybe_rotate_locked(self) -> bool:
         """NTS_METRICS_MAX_MB guard — called with ``self._lock`` held right
         after a write. When the stream crosses the cap, the current file is
         rotated aside to ``<path>.1`` (one previous chunk retained; an older
         ``.1`` is overwritten — bounded disk, not unbounded history) and a
         LOUD ``stream_rotated`` record opens the fresh file, so a consumer
-        that sees a truncated history knows it was truncated and why."""
+        that sees a truncated history knows it was truncated and why.
+        Returns True when a rotation happened (event() then re-emits the
+        histogram snapshots into the fresh chunk)."""
         if not self._max_bytes or self._bytes_written < self._max_bytes:
-            return
+            return False
         rotated_to = self.path + ".1"
         try:
             self._fh.close()
@@ -212,7 +308,7 @@ class MetricsRegistry:
             log.warning("metrics rotation failed (%s); disabling sink", e)
             self._fh = None
             self.path = None
-            return
+            return False
         seq = self._seq
         self._seq += 1
         marker = {
@@ -238,6 +334,7 @@ class MetricsRegistry:
             "first %d bytes to %s (older rotations are overwritten)",
             self.path, marker["bytes_written"], rotated_to,
         )
+        return True
 
     def epoch_event(
         self, epoch: int, seconds: float, loss: Optional[float] = None,
@@ -254,7 +351,10 @@ class MetricsRegistry:
 
     def run_summary(self, **fields: Any) -> Dict[str, Any]:
         """Emit the consolidated end-of-run record (metric snapshot + the
-        caller's aggregates); kept on ``self.summary``."""
+        caller's aggregates); kept on ``self.summary``. The final
+        cumulative ``hist`` snapshots are flushed first so every finalized
+        stream carries its distributions as typed records."""
+        self.emit_hists()
         snap = self.snapshot()
         rec = self.event(
             "run_summary",
@@ -263,6 +363,7 @@ class MetricsRegistry:
             counters=snap["counters"],
             gauges=snap["gauges"],
             timings=snap["timings"],
+            hists=snap["hists"],
             **fields,
         )
         self.summary = rec

@@ -10,7 +10,8 @@ Envelope (all events):
   event: str       one of run_start | epoch | ring_step | run_summary |
                    fault | recovery | heartbeat | rank_loss | replan |
                    serve_request | batch_flush | shed | serve_summary |
-                   tune_trial | tune_decision | span | stream_rotated
+                   tune_trial | tune_decision | span | stream_rotated |
+                   hist | slo_status | backend_probe
                    (open set)
   run_id: str      "<algo>-<fingerprint>-<pid>"
   schema: int      SCHEMA_VERSION
@@ -119,6 +120,37 @@ span (obs/trace.py): one completed interval on the causal timeline
 stream_rotated (obs/registry.py): the NTS_METRICS_MAX_MB size guard fired
   reason: str, rotated_to: str | null, bytes_written: int
 
+hist (obs/hist.py): one CUMULATIVE snapshot of a log-bucketed mergeable
+  latency histogram — within a stream the latest record per
+  (run_id, name) supersedes earlier ones; records from different
+  streams/ranks merge by bucket addition (that is what lets p99 survive
+  NTS_METRICS_MAX_MB rotation and multi-rank runs)
+  name: str (non-empty; e.g. serve.latency_ms), unit: str | absent,
+  growth: number > 1 (bucket ratio; sqrt(growth)-1 is the relative
+  quantile error bound, ~1% at the default 1.02),
+  min_value: number > 0 (bucket-0 lower edge),
+  count: int >= 0, sum: number, zero_count: int >= 0,
+  min: number | null, max: number | null,
+  buckets: array of [index, count] pairs (index int >= 0, count int > 0)
+
+slo_status (obs/slo.py): one objective's burn-rate verdict — emitted on
+  every state transition and on the objective's first evaluation
+  (NTS_SLO_SPEC)
+  objective: str (non-empty; the spec entry, e.g. serve_p99_ms<=75@5m),
+  metric: str (non-empty), state: str (ok | breach, open set),
+  threshold: number, window_s: number > 0,
+  value: number | null (the window's observed value),
+  burn_rate: number | null (long window), burn_rate_short: number | null,
+  window_count: int | absent (samples in the window)
+
+backend_probe (bench.py): one accelerator-backend probe attempt — the
+  subprocess PJRT-init check bench runs before measuring; a timed-out
+  probe (the stale-anchor cause) now leaves a typed trace
+  attempt: int > 0, outcome: str (ok | timeout | error, open set),
+  seconds: number >= 0 (attempt wall time),
+  platform: str | null (the answering backend; null on failure),
+  devices / error / init_s: open context fields
+
 run_summary:
   algorithm: str, fingerprint: str,
   counters/gauges/timings: objects (the registry snapshot),
@@ -157,6 +189,9 @@ KNOWN_KINDS = (
     "tune_decision",
     "span",
     "stream_rotated",
+    "hist",
+    "slo_status",
+    "backend_probe",
     "run_summary",
 )
 
@@ -353,6 +388,63 @@ def validate_event(obj: Any) -> None:
             _fail("stream_rotated.reason must be a non-empty string")
         if not isinstance(obj.get("bytes_written"), int):
             _fail("stream_rotated.bytes_written must be an int")
+    elif kind == "hist":
+        if not isinstance(obj.get("name"), str) or not obj["name"]:
+            _fail("hist.name must be a non-empty string")
+        _require_number(obj, "growth")
+        if obj["growth"] <= 1:
+            _fail(f"hist.growth must be > 1, got {obj['growth']!r}")
+        _require_number(obj, "min_value")
+        if obj["min_value"] <= 0:
+            _fail(f"hist.min_value must be > 0, got {obj['min_value']!r}")
+        for key in ("count", "zero_count"):
+            v = obj.get(key)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                _fail(f"hist.{key} must be a non-negative int, got {v!r}")
+        _require_number(obj, "sum")
+        _require_number(obj, "min", allow_none=True)
+        _require_number(obj, "max", allow_none=True)
+        buckets = obj.get("buckets")
+        if not isinstance(buckets, list):
+            _fail(f"hist.buckets must be an array, got {buckets!r}")
+        for pair in buckets:
+            if (not isinstance(pair, (list, tuple)) or len(pair) != 2
+                    or not all(isinstance(x, int) and not isinstance(x, bool)
+                               for x in pair)
+                    or pair[0] < 0 or pair[1] <= 0):
+                _fail(f"hist.buckets entries must be [index>=0, count>0] "
+                      f"int pairs, got {pair!r}")
+    elif kind == "slo_status":
+        for key in ("objective", "metric", "state"):
+            if not isinstance(obj.get(key), str) or not obj[key]:
+                _fail(f"slo_status.{key} must be a non-empty string, got "
+                      f"{obj.get(key)!r}")
+        _require_number(obj, "threshold")
+        _require_number(obj, "window_s")
+        if obj["window_s"] <= 0:
+            _fail(f"slo_status.window_s must be > 0, got "
+                  f"{obj['window_s']!r}")
+        _require_number(obj, "value", allow_none=True)
+        _require_number(obj, "burn_rate", allow_none=True)
+        if "burn_rate_short" in obj:
+            _require_number(obj, "burn_rate_short", allow_none=True)
+        if "window_count" in obj and obj["window_count"] is not None \
+                and not isinstance(obj["window_count"], int):
+            _fail("slo_status.window_count must be an int when present")
+    elif kind == "backend_probe":
+        a = obj.get("attempt")
+        if not isinstance(a, int) or isinstance(a, bool) or a <= 0:
+            _fail(f"backend_probe.attempt must be a positive int, got {a!r}")
+        if not isinstance(obj.get("outcome"), str) or not obj["outcome"]:
+            _fail("backend_probe.outcome must be a non-empty string")
+        _require_number(obj, "seconds")
+        if obj["seconds"] < 0:
+            _fail(f"backend_probe.seconds must be >= 0, got "
+                  f"{obj['seconds']!r}")
+        p = obj.get("platform")
+        if p is not None and not isinstance(p, str):
+            _fail(f"backend_probe.platform must be a string or null, "
+                  f"got {p!r}")
     elif kind == "serve_summary":
         for key in ("requests", "shed"):
             if not isinstance(obj.get(key), int) or obj[key] < 0:

@@ -182,10 +182,18 @@ def supervised_run(
             attempt_span = tracer.begin(
                 "attempt", cat="resilience", attempt=attempt + 1
             )
+            if metrics is not None:
+                # /healthz (obs/exporter) surfaces these live: which
+                # attempt the supervisor is on and whether it is still
+                # trying — a scrape can tell a retrying run from a dead one
+                metrics.gauge_set("resilience.state", "running")
+                metrics.gauge_set("resilience.attempt", attempt + 1)
             try:
                 try:
                     result = toolkit.run()
                     tracer.end(attempt_span, outcome="ok")
+                    if metrics is not None:
+                        metrics.gauge_set("resilience.state", "ok")
                     return result
                 except KeyboardInterrupt:
                     # only a watchdog-initiated interrupt is a fault; a
@@ -218,7 +226,15 @@ def supervised_run(
                 )
                 if err.code not in codes_seen:
                     codes_seen.append(err.code)
+                if metrics is not None:
+                    metrics.gauge_set("resilience.state", "retrying")
                 if attempt > max_restarts:
+                    if metrics is not None:
+                        metrics.gauge_set("resilience.state", "gave_up")
+                        metrics.gauge_set("resilience.gave_up", 1)
+                    # the giveup recovery record is a flight-recorder
+                    # trigger (obs/flight): the last N records before the
+                    # terminal failure dump at full resolution
                     events.emit_recovery(
                         action="giveup", attempt=attempt, epoch=err.epoch
                     )

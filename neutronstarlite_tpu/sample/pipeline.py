@@ -228,6 +228,12 @@ class SamplePipeline:
                         self.metrics.counter_add(
                             "sample.h2d_ms", (t2 - t1) * 1000.0
                         )
+                        # depth as a distribution (obs/hist), not just a
+                        # peak: stall diagnosis sees whether the queue sat
+                        # empty (consumer-starved) or full (backpressured)
+                        self.metrics.hist_observe(
+                            "sample.queue_depth", depth, unit=""
+                        )
                         if depth > self._peak_depth:
                             self._peak_depth = depth
                             self.metrics.gauge_set(
@@ -285,6 +291,7 @@ class SamplePipeline:
             self.last_epoch_stall_s += wait
             if self.metrics is not None:
                 self.metrics.counter_add("sample.stall_ms", wait * 1000.0)
+                self.metrics.hist_observe("sample.stall_ms", wait * 1000.0)
             self._span("sample_wait", wait, t0, epoch=int(epoch))
             if isinstance(item, _WorkerFailed):
                 raise SampleWorkerError(

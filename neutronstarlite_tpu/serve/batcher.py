@@ -35,11 +35,14 @@ log = get_logger("serve")
 
 
 def latency_percentiles(samples_ms) -> dict:
-    """{p50, p95, p99} (ms, linear-interpolated np.percentile) — THE
-    percentile definition for every serving surface: the live
-    serve_summary, serve_bench, and metrics_report's synthesized summary
-    all call this, so their numbers are comparable. Lives here (not
-    server.py) so the report CLI can import it without pulling jax."""
+    """{p50, p95, p99} (ms, linear-interpolated np.percentile) over RAW
+    samples — since the live telemetry plane (obs/hist) this is only the
+    FALLBACK definition for pre-histogram streams: the live serve
+    surfaces (stats(), serve_summary, serve_bench, metrics_report's
+    synthesized summary) all report quantiles from the mergeable
+    LogHistogram, which survives rotation and bounds memory. Lives here
+    (not server.py) so the report CLI can import it without pulling
+    jax."""
     if not samples_ms:
         return {"p50": None, "p95": None, "p99": None}
     arr = np.asarray(list(samples_ms), dtype=np.float64)
@@ -231,10 +234,16 @@ class MicroBatcher:
         flush_fn: Callable[[List[ServeRequest], str], None],
         options: ServeOptions,
         metrics: Any = None,
+        slo: Any = None,
     ):
         self.flush_fn = flush_fn
         self.opts = options
         self.metrics = metrics
+        # the SLO burn-rate engine (obs/slo.SloEngine, NTS_SLO_SPEC):
+        # when armed, burn-rate shedding is the FIRST admission gate —
+        # under sustained overload it fires long before the static
+        # max_queue bound below does (the start of SLO-driven routing)
+        self.slo = slo
         self._pending: List[ServeRequest] = []
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
@@ -258,6 +267,14 @@ class MicroBatcher:
             reason = (
                 f"request_too_large ({len(ids)} seeds > max_batch "
                 f"{self.opts.max_batch})"
+            )
+        if reason is None and self.slo is not None:
+            # burn-rate gate before the hard bound: while a latency
+            # objective is breaching, the effective queue bound shrinks
+            # to max_queue / burn (the depth read is advisory — shedding
+            # is a heuristic, the hard bound below stays exact)
+            reason = self.slo.shed_advice(
+                len(self._pending), self.opts.max_queue
             )
         if reason is None:
             with self._cond:

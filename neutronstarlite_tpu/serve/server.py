@@ -38,7 +38,6 @@ from neutronstarlite_tpu.serve.batcher import (  # noqa: E402
     MicroBatcher,
     ServeOptions,
     ServeRequest,
-    latency_percentiles,
 )
 from neutronstarlite_tpu.serve.engine import InferenceEngine  # noqa: E402
 from neutronstarlite_tpu.serve.sampling import EmbeddingCache  # noqa: E402
@@ -74,6 +73,19 @@ class InferenceServer:
         from neutronstarlite_tpu.obs.trace import Tracer
 
         self.tracer = Tracer(self.metrics)
+        # the live telemetry plane (obs/): latency distributions become
+        # mergeable histograms on the registry, the SLO burn-rate engine
+        # (NTS_SLO_SPEC) evaluates them and drives burn-rate shedding in
+        # the batcher below, and the HTTP exporter (NTS_METRICS_PORT)
+        # serves /metrics, /healthz and /slo off the same registry
+        from neutronstarlite_tpu.obs import exporter as obs_exporter
+        from neutronstarlite_tpu.obs.slo import SloEngine
+
+        self.slo = (
+            SloEngine.from_env(self.metrics, scope="serve")
+            if self.metrics is not None else None
+        )
+        self.exporter = obs_exporter.maybe_start(self.metrics, slo=self.slo)
         # SAMPLE_PIPELINE:pipelined/device — two-stage flush: the batcher's
         # flusher thread becomes the PRODUCER (cache pass + per-request
         # fan-out sampling + async H2D staging) and a dedicated executor
@@ -93,9 +105,18 @@ class InferenceServer:
                 target=self._exec_loop, name="serve-executor", daemon=True
             )
             self._exec_thread.start()
-        self.batcher = MicroBatcher(self._flush, self.opts, self.metrics)
+        self.batcher = MicroBatcher(
+            self._flush, self.opts, self.metrics, slo=self.slo
+        )
+        # the registry histogram is cumulative across every server bound
+        # to it (a restarted server shares the run's registry); this
+        # server's quantiles subtract the at-construction snapshot so
+        # stats()/serve_summary describe THIS server's requests only
+        self._lat_baseline = (
+            self.metrics.hists().get("serve.latency_ms")
+            if self.metrics is not None else None
+        )
         self._stats_lock = threading.Lock()
-        self._latencies_ms: List[float] = []
         self._t_first: Optional[float] = None
         self._t_last: Optional[float] = None
         self.request_count = 0
@@ -243,6 +264,11 @@ class InferenceServer:
              cached_rows, prepared)
         )
         depth = self._prep_q.qsize()
+        if self.metrics is not None:
+            # depth as a distribution, not just a peak: stall diagnosis
+            # needs to see whether the queue sat empty (producer-bound)
+            # or full (executor-bound), not one high-water number
+            self.metrics.hist_observe("sample.queue_depth", depth, unit="")
         if depth > self._prep_peak:
             self._prep_peak = depth
             if self.metrics is not None:
@@ -334,9 +360,6 @@ class InferenceServer:
                 self._t_first = requests[0].t_submit
             self._t_last = now
             self.request_count += len(requests)
-            for r in requests:
-                if r.total_ms is not None:
-                    self._latencies_ms.append(r.total_ms)
         if self.metrics is None:
             return
         self.metrics.counter_add("serve.batches")
@@ -347,6 +370,15 @@ class InferenceServer:
                 "serve.padded_seeds", max(bucket - n_seeds, 0)
             )
         self.metrics.observe("serve.exec", exec_ms / 1000.0)
+        # flush-stage + per-bucket latency distributions (obs/hist): the
+        # registry histograms are what stats()/serve_summary report, what
+        # the SLO engine windows over, and what the stream's `hist`
+        # records persist — no raw-record full-sorts anywhere downstream
+        self.metrics.hist_observe("serve.exec_ms", exec_ms)
+        if bucket is not None:
+            self.metrics.hist_observe(
+                f"serve.exec_ms.bucket_{bucket}", exec_ms
+            )
         self.metrics.event(
             "batch_flush", n_requests=len(requests), n_seeds=n_seeds,
             reason=reason, bucket=bucket, exec_ms=exec_ms,
@@ -355,6 +387,10 @@ class InferenceServer:
         for r in requests:
             if r.status == "cached":
                 self.metrics.counter_add("serve.cached_requests")
+            if r.total_ms is not None:
+                self.metrics.hist_observe("serve.latency_ms", r.total_ms)
+            if r.queue_ms is not None:
+                self.metrics.hist_observe("serve.queue_ms", r.queue_ms)
             self.metrics.event(
                 "serve_request", n_seeds=len(r.node_ids), status=r.status,
                 total_ms=r.total_ms, queue_ms=r.queue_ms,
@@ -373,17 +409,39 @@ class InferenceServer:
                 "queue", dur_s=r.t_flush - r.t_submit, t0=r.t_submit,
                 cat="serve", parent=span, req_id=r.req_id,
             )
+        if self.slo is not None:
+            # completions are the SLO engine's observation stream; a tick
+            # here keeps burn rates fresh even when no new arrivals are
+            # calling the batcher's admission gate
+            self.slo.tick()
 
     # ---- SLO telemetry ---------------------------------------------------
+    def _latency_quantiles(self) -> Dict[str, Optional[float]]:
+        """{p50, p95, p99} off the live latency histogram — fixed memory
+        no matter how many requests were served (the raw-list full-sort
+        this replaces grew without bound). hists() copies under the
+        registry lock (stats() is called from monitoring threads while
+        the flusher mutates the live buckets), and the at-construction
+        baseline is subtracted so the numbers are THIS server's."""
+        h = (
+            self.metrics.hists().get("serve.latency_ms")
+            if self.metrics is not None else None
+        )
+        if h is not None:
+            h = h.delta(self._lat_baseline)
+        if h is None or h.count == 0:
+            return {"p50": None, "p95": None, "p99": None}
+        return h.quantiles()
+
     def stats(self) -> Dict[str, Any]:
         with self._stats_lock:
-            lat = latency_percentiles(self._latencies_ms)
             span = (
                 self._t_last - self._t_first
                 if self._t_first is not None and self._t_last is not None
                 else None
             )
             served = self.request_count
+        lat = self._latency_quantiles()
         rps = served / span if span and span > 0 else None
         return {
             "requests": served,
@@ -407,8 +465,15 @@ class InferenceServer:
             # finishes real work first
             self._prep_q.put(None)
             self._exec_thread.join(timeout=60.0)
+        if self.slo is not None:
+            self.slo.close()  # final forced evaluation -> last slo_status
         s = self.stats()
         if self.metrics is not None:
+            # final cumulative hist snapshots BEFORE the summary: the
+            # stream's quantiles survive rotation, and downstream
+            # consumers (serve_bench, metrics_report) read these instead
+            # of full-sorting raw serve_request records
+            self.metrics.emit_hists()
             snap = self.metrics.snapshot()
             self.metrics.event(
                 "serve_summary",
@@ -418,6 +483,7 @@ class InferenceServer:
                 throughput_rps=s["throughput_rps"],
                 counters=snap["counters"],
                 gauges=snap["gauges"],
+                hists=snap["hists"],
                 cache=s["cache"],
                 compile_counts={
                     str(k): v for k, v in s["compile_counts"].items()

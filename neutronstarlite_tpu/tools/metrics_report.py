@@ -19,7 +19,19 @@ each record against the obs schema, and renders:
   metrics) — span inventory, measured ring overlap efficiency, serve
   critical-path breakdown, retry cost — when the stream carries ``span``
   records;
+- per run: the latency-histogram block (merged ``hist`` records with
+  their bounded-error quantiles), the slo timeline (``slo_status`` +
+  ``shed`` records interleaved), and the backend-probe block
+  (``backend_probe`` records; probe-only streams — a bench whose backend
+  never answered — render as their own small block);
 - across runs: a comparison table keyed by run_id/algorithm/fingerprint.
+
+Serving percentiles are read from the stream's merged ``hist`` records
+(cumulative snapshots that survive NTS_METRICS_MAX_MB rotation) with the
+raw ``serve_request`` full-sort as the pre-histogram fallback; ``--diff``
+treats the histogram quantile error bound as an implicit tolerance floor
+for serve_p99_ms. Flight-recorder dumps (``flight_*.jsonl``, obs/flight)
+are ordinary record streams and render natively.
 
 A file with epoch events but no run_summary (killed run) still renders:
 the summary is synthesized from the epoch events, marked ``(synthesized)``.
@@ -53,6 +65,7 @@ if REPO not in sys.path:
 
 from neutronstarlite_tpu.obs import schema  # noqa: E402
 from neutronstarlite_tpu.obs.collectors import steady_state_stats  # noqa: E402
+from neutronstarlite_tpu.obs.hist import latest_hists  # noqa: E402
 
 
 def expand_paths(args: List[str]) -> List[str]:
@@ -149,23 +162,42 @@ def summarize_serve(events: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
     if not reqs:
         return None
     served = [e for e in reqs if e["status"] != "shed"]
-    lat = [e["total_ms"] for e in served if e.get("total_ms") is not None]
-    # same percentile definition as the live serve_summary
-    # (serve.batcher.latency_percentiles — jax-free import), so a
-    # died-server report stays comparable to a clean one
-    from neutronstarlite_tpu.serve.batcher import latency_percentiles
+    # quantiles come from the merged `hist` records when the stream
+    # carries any (cumulative snapshots survive NTS_METRICS_MAX_MB
+    # rotation — the raw serve_request sort below loses every rotated-away
+    # request, which used to lose p99 entirely); raw full-sort is the
+    # fallback for pre-histogram streams only
+    hist = latest_hists(events).get("serve.latency_ms")
+    if hist is not None and hist.count:
+        latency = hist.quantiles()
+        source = "hist"
+    else:
+        from neutronstarlite_tpu.serve.batcher import latency_percentiles
 
+        lat = [
+            e["total_ms"] for e in served if e.get("total_ms") is not None
+        ]
+        latency = latency_percentiles(lat)
+        source = "raw"
     ts = [e["ts"] for e in served]
     span = (max(ts) - min(ts)) if len(ts) > 1 else 0.0
+    # after rotation the surviving raw records undercount; the histogram's
+    # cumulative count covers every answered request — use the larger
+    # (sheds and throughput stay raw-derived: the hist holds no timestamps
+    # and sheds record no latency)
+    n_answered = len(served)
+    if source == "hist":
+        n_answered = max(n_answered, hist.count)
     return {
         "event": "serve_summary",
         "run_id": reqs[-1]["run_id"],
         # "requests" counts ANSWERED requests, matching the live record
         # (InferenceServer.request_count only counts flushed requests;
         # sheds are separate there too)
-        "requests": len(served),
+        "requests": n_answered,
         "shed": sum(1 for e in reqs if e["status"] == "shed"),
-        "latency_ms": latency_percentiles(lat),
+        "latency_ms": latency,
+        "latency_source": source,
         "throughput_rps": (len(ts) / span) if span > 0 else None,
         "counters": {},
         "synthesized": True,
@@ -211,6 +243,8 @@ def render_serve(path: str, rec: Dict[str, Any],
             "expired={expired}".format(**cache)
         )
     lines.extend(render_sample(rec))
+    lines.extend(rec.get("_hists") or [])
+    lines.extend(rec.get("_slo") or [])
     lines.extend(rec.get("_trace") or [])
     return "\n".join(lines)
 
@@ -326,6 +360,93 @@ def render_tuning(events: List[Dict[str, Any]],
             + " ".join(f"{k}={v}" for k, v in sorted(by_source.items()))
             + ")"
         )
+    return lines
+
+
+def render_hists(events: List[Dict[str, Any]]) -> List[str]:
+    """The latency-histogram block: every merged ``hist`` record with its
+    count and bounded-error quantiles. Empty for pre-histogram streams."""
+    hists = latest_hists(events)
+    if not hists:
+        return []
+
+    def _q(v):
+        return f"{v:.3f}" if v is not None else "n/a"
+
+    lines = ["latency histograms:"]
+    for name, h in sorted(hists.items()):
+        q = h.quantiles()
+        lines.append(
+            f"#hist_{name}=count={h.count} p50={_q(q['p50'])} "
+            f"p95={_q(q['p95'])} p99={_q(q['p99'])} "
+            f"max={_q(h.max)} (quantile err <= {h.rel_error * 100:.1f}%)"
+        )
+    return lines
+
+
+_MAX_SHED_LINES = 40
+
+
+def slo_timeline(events: List[Dict[str, Any]]) -> List[str]:
+    """``slo_status`` verdicts and ``shed`` rejections as ONE
+    offset-stamped timeline — burn-rate breaches next to the sheds they
+    caused. Empty when the stream carries no slo_status records (plain
+    queue-bound sheds stay in the serve block's #shed counter)."""
+    slos = [e for e in events if e["event"] == "slo_status"]
+    if not slos:
+        return []
+    sheds = [e for e in events if e["event"] == "shed"]
+    t0 = events[0]["ts"] if events else 0.0
+    lines = ["slo timeline:"]
+    shown_sheds = 0
+    for e in sorted(slos + sheds, key=lambda e: (e["ts"], e["seq"])):
+        off = e["ts"] - t0
+        if e["event"] == "slo_status":
+            burn = e.get("burn_rate")
+            val = e.get("value")
+            lines.append(
+                f"  +{off:8.2f}s slo      {e['metric']} state={e['state']}"
+                f" burn={f'{burn:.2f}' if burn is not None else 'n/a'}"
+                f" value={f'{val:.3f}' if val is not None else 'n/a'}"
+                f" (objective {e['objective']})"
+            )
+        else:
+            shown_sheds += 1
+            if shown_sheds > _MAX_SHED_LINES:
+                continue
+            lines.append(
+                f"  +{off:8.2f}s shed     reason={e.get('reason')}"
+                + (f" depth={e['queue_depth']}"
+                   if e.get("queue_depth") is not None else "")
+            )
+    if shown_sheds > _MAX_SHED_LINES:
+        lines.append(
+            f"  ... and {shown_sheds - _MAX_SHED_LINES} more shed(s) "
+            "(full detail in the stream)"
+        )
+    return lines
+
+
+def render_probes(events: List[Dict[str, Any]]) -> List[str]:
+    """The ``backend_probe`` block (bench.py's subprocess PJRT check) —
+    the stale-anchor cause, visible at last. Empty without probes."""
+    probes = [e for e in events if e["event"] == "backend_probe"]
+    if not probes:
+        return []
+    lines = ["backend probes:"]
+    for e in probes:
+        lines.append(
+            f"#backend_probe=attempt {e['attempt']} "
+            f"outcome={e['outcome']} "
+            f"platform={e.get('platform') or '?'} "
+            f"{e['seconds']:.1f}s"
+            + (f" (timeout_s={e['timeout_s']:g})"
+               if e.get("timeout_s") is not None else "")
+        )
+        err = e.get("error")
+        if err:
+            tail = str(err).strip().splitlines()[-1][:160]
+            lines.append(f"    error: {tail}")
     return lines
 
 
@@ -448,6 +569,9 @@ def render_run(path: str, rec: Dict[str, Any]) -> str:
     lines.extend(rec.get("_tune") or [])
     lines.extend(rec.get("_elastic") or [])
     lines.extend(render_sample(rec))
+    lines.extend(rec.get("_hists") or [])
+    lines.extend(rec.get("_slo") or [])
+    lines.extend(rec.get("_probe") or [])
     lines.extend(rec.get("_trace") or [])
     timeline = rec.get("_timeline") or []
     if timeline:
@@ -606,13 +730,23 @@ def _side_metrics(path: str) -> Dict[str, Any]:
     return _diff_metrics(*_load_side(path))
 
 
+# per-metric tolerance floors: serve percentiles are histogram-derived
+# (obs/hist, bounded relative quantile error ~1% per side), so two
+# identical distributions can legitimately differ by up to ~2% between
+# sides — a --tol below that would flag quantization noise as regression.
+# The floor is implicit: the effective tolerance is max(--tol, floor).
+_TOL_FLOORS = {"serve_p99_ms": 0.0202}
+
+
 def run_diff(a_path: str, b_path: str, tol: float,
              as_json: bool = False) -> int:
     """Compare run B against baseline A; exit 2 when any shared metric
     regressed (grew) by more than ``tol`` (fractional, e.g. 0.05 = 5%;
     against a 0.0 baseline ``tol`` is the absolute threshold instead).
-    ``as_json`` emits one machine-readable object instead of the table.
-    A side may also be a micro_bench JSON file (see _side_metrics)."""
+    Histogram-derived metrics carry their quantile error bound as an
+    implicit tolerance floor (_TOL_FLOORS). ``as_json`` emits one
+    machine-readable object instead of the table. A side may also be a
+    micro_bench JSON file (see _side_metrics)."""
     a = _side_metrics(a_path)
     b = _side_metrics(b_path)
     shared = [
@@ -629,6 +763,7 @@ def run_diff(a_path: str, b_path: str, tol: float,
     detail: Dict[str, Dict[str, Any]] = {}
     for k in shared:
         va, vb = float(a[k]), float(b[k])
+        eff_tol = max(tol, _TOL_FLOORS.get(k, 0.0))
         if va > 0:
             delta = (vb - va) / va
             dstr = f"{delta * 100:+.1f}%"
@@ -638,7 +773,7 @@ def run_diff(a_path: str, b_path: str, tol: float,
         # zero baseline: no relative delta exists, so --tol acts as an
         # absolute floor (shed_rate 0 -> 0.0001 passes at --tol 0.05
         # instead of failing on ANY nonzero value)
-        regressed = vb > va * (1.0 + tol) if va > 0 else vb > tol
+        regressed = vb > va * (1.0 + eff_tol) if va > 0 else vb > tol
         if regressed:
             regressions.append(f"{k}: {va:g} -> {vb:g} ({dstr})")
         detail[k] = {"a": va, "b": vb, "delta": delta,
@@ -705,7 +840,25 @@ def main(argv=None) -> int:
             continue
         rec = summarize(p, events)
         srec = summarize_serve(events)
+        probe_lines = render_probes(events)
         if rec is None and srec is None:
+            if probe_lines:
+                # a probe-only stream (bench.py's backend check with no
+                # run behind it — every timed-out round since r05 looks
+                # like this) renders its own small block
+                probes = [
+                    e for e in events if e["event"] == "backend_probe"
+                ]
+                rows.append({
+                    "event": "backend_probe_report",
+                    "run_id": probes[-1]["run_id"],
+                    "attempts": len(probes),
+                    "outcomes": [e["outcome"] for e in probes],
+                    "_path": p,
+                    "_probe_only": True,
+                    "_probe": probe_lines,
+                })
+                continue
             # a run_start-only stream (trainer constructed/crashed before
             # its first epoch) is skippable noise, not a render failure —
             # but a directory yielding NOTHING still exits 1 below
@@ -718,17 +871,24 @@ def main(argv=None) -> int:
         from neutronstarlite_tpu.tools.trace_timeline import timeline_block
 
         trace_lines = timeline_block(events)
+        hist_lines = render_hists(events)
+        slo_lines = slo_timeline(events)
         if rec is not None:
             rec["_path"] = p
             rec["_timeline"] = recovery_timeline(events)
             rec["_ring"] = render_ring(events, rec)
             rec["_tune"] = render_tuning(events, rec)
             rec["_elastic"] = render_elastic(events, rec)
+            rec["_hists"] = hist_lines
+            rec["_slo"] = slo_lines
+            rec["_probe"] = probe_lines
             rec["_trace"] = trace_lines
         if srec is not None:
             srec["_path"] = p
             srec["_events"] = events
             srec["_serve"] = True
+            srec["_hists"] = hist_lines if rec is None else []
+            srec["_slo"] = slo_lines if rec is None else []
             srec["_trace"] = trace_lines if rec is None else []
         rows.extend(r for r in (rec, srec) if r is not None)
     if not rows:
@@ -740,12 +900,16 @@ def main(argv=None) -> int:
         ))
     else:
         for rec in rows:
-            if rec.get("_serve"):
+            if rec.get("_probe_only"):
+                print(f"== backend probe — {rec['_path']}")
+                print("\n".join(rec["_probe"]))
+            elif rec.get("_serve"):
                 print(render_serve(rec["_path"], rec, rec["_events"]))
             else:
                 print(render_run(rec["_path"], rec))
             print()
-        train_rows = [r for r in rows if not r.get("_serve")]
+        train_rows = [r for r in rows if not r.get("_serve")
+                      and not r.get("_probe_only")]
         if len(train_rows) > 1:
             print(render_table(train_rows))
     return 1 if failed else 0

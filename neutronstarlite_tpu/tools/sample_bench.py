@@ -55,6 +55,24 @@ def measure_mode(mode, cfg_proto, src, dst, datum, host_graph):
     warm = epochs[1:] if len(epochs) > 1 else epochs
     batches = int(counters.get("sample.batches", 0)) / max(len(epochs), 1)
     warm_epoch_s = float(np.median(warm)) if warm else 0.0
+    # distributions off the registry histograms (obs/hist) instead of
+    # scalar peaks/full-sorts: the depth histogram separates a queue that
+    # sat empty (producer-bound) from one that sat full (consumer-bound) —
+    # one high-water number cannot
+    from neutronstarlite_tpu.obs.hist import LogHistogram
+
+    hists = snap.get("hists") or {}
+
+    def _hq(name):
+        d = hists.get(name)
+        if not d or not d.get("count"):
+            return None
+        h = LogHistogram.from_dict(d)
+        q = h.quantiles()
+        q["max"] = h.max
+        q["count"] = h.count
+        return q
+
     jax.clear_caches()
     return {
         "mode": mode,
@@ -64,8 +82,10 @@ def measure_mode(mode, cfg_proto, src, dst, datum, host_graph):
             round(batches / warm_epoch_s, 2) if warm_epoch_s > 0 else None
         ),
         "sample_stall_ms_total": counters.get("sample.stall_ms"),
+        "sample_stall_ms_dist": _hq("sample.stall_ms"),
         "sample_h2d_ms_total": counters.get("sample.h2d_ms"),
         "queue_depth_peak": snap["gauges"].get("sample.queue_depth"),
+        "queue_depth_dist": _hq("sample.queue_depth"),
         # full precision: the sync==pipelined parity flag below is a
         # BITWISE claim — rounding would hide exactly the sub-1e-6
         # divergence a pipeline-determinism regression produces

@@ -36,7 +36,7 @@ import sys
 import tempfile
 import threading
 import time
-from typing import Any, Dict, List
+from typing import Any, Dict
 
 import numpy as np
 
@@ -132,43 +132,61 @@ def run_open_loop(server, v_num: int, n_requests: int, rps: float,
 
 
 def percentiles_from_stream(path: str) -> Dict[str, Any]:
-    """Recompute the SLO numbers from the serving obs JSONL records."""
-    from neutronstarlite_tpu.obs import schema
+    """Recompute the SLO numbers from the serving obs JSONL records.
 
-    lat: List[float] = []
-    ts: List[float] = []
-    shed = 0
-    flushes = 0
+    Quantiles come from the stream's merged ``hist`` records (obs/hist:
+    cumulative snapshots, fixed memory, survive NTS_METRICS_MAX_MB
+    rotation); the raw full-sort of every serve_request line — O(N) memory
+    and blind to rotated-away requests — is only the fallback for
+    pre-histogram streams. A rotated ``<path>.1`` chunk is read first so
+    counts cover the whole run where it survived."""
+    from neutronstarlite_tpu.obs import schema
+    from neutronstarlite_tpu.obs.hist import latest_hists
+
+    events = []
+    rotated = path + ".1"
+    for chunk in ([rotated, path] if os.path.exists(rotated) else [path]):
+        with open(chunk, "r", encoding="utf-8") as fh:
+            for raw in fh:
+                raw = raw.strip()
+                if not raw:
+                    continue
+                obj = json.loads(raw)
+                schema.validate_event(obj)
+                events.append(obj)
+    reqs = [e for e in events if e["event"] == "serve_request"]
+    served = [
+        e for e in reqs
+        if e["status"] != "shed" and e.get("total_ms") is not None
+    ]
+    ts = [e["ts"] for e in served]
     summary = None
-    with open(path, "r", encoding="utf-8") as fh:
-        for raw in fh:
-            raw = raw.strip()
-            if not raw:
-                continue
-            obj = json.loads(raw)
-            schema.validate_event(obj)
-            if obj["event"] == "serve_request":
-                if obj["status"] == "shed":
-                    shed += 1
-                elif obj.get("total_ms") is not None:
-                    lat.append(obj["total_ms"])
-                    ts.append(obj["ts"])
-            elif obj["event"] == "batch_flush":
-                flushes += 1
-            elif obj["event"] == "serve_summary":
-                summary = obj
-    out: Dict[str, Any] = {"served": len(lat), "shed": shed,
-                           "batches": flushes, "summary": summary}
-    if lat:
+    for e in events:
+        if e["event"] == "serve_summary":
+            summary = e
+    out: Dict[str, Any] = {
+        "served": len(served),
+        "shed": sum(1 for e in reqs if e["status"] == "shed"),
+        "batches": sum(1 for e in events if e["event"] == "batch_flush"),
+        "summary": summary,
+    }
+    h = latest_hists(events).get("serve.latency_ms")
+    if h is not None and h.count:
+        out["latency_ms"] = h.quantiles()
+        out["latency_source"] = "hist"
+        out["served"] = max(out["served"], h.count)
+    elif served:
+        lat = [e["total_ms"] for e in served]
         p50, p95, p99 = np.percentile(np.asarray(lat), [50, 95, 99])
         out["latency_ms"] = {
             "p50": float(p50), "p95": float(p95), "p99": float(p99),
         }
-        span = max(ts) - min(ts)
-        out["throughput_rps"] = len(lat) / span if span > 0 else None
+        out["latency_source"] = "raw"
     else:
         out["latency_ms"] = {"p50": None, "p95": None, "p99": None}
-        out["throughput_rps"] = None
+        out["latency_source"] = None
+    span = (max(ts) - min(ts)) if len(ts) > 1 else 0.0
+    out["throughput_rps"] = len(ts) / span if span > 0 else None
     return out
 
 
@@ -284,6 +302,7 @@ def main(argv=None) -> int:
             "p95_ms": lat["p95"],
             "p99_ms": lat["p99"],
             "throughput_rps": obs_view["throughput_rps"],
+            "latency_source": obs_view.get("latency_source"),
             "served": obs_view["served"],
             "shed": obs_view["shed"],
             "errors": errors,

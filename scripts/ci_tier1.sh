@@ -266,8 +266,117 @@ else
   echo "TUNE_GATE=OK"
 fi
 
+# ---- live telemetry gate (ISSUE 11) ----------------------------------------
+# STRUCTURAL (hard): drive the serve smoke cfg with the exporter + SLO
+# engine armed and inject a fault mid-serve. Requires: a live /metrics
+# scrape that parses and carries the latency histogram, /healthz +
+# /slo answering, a schema-valid stream with merged `hist` records and
+# exactly one slo_status-emitting stream, and a schema-valid flight dump
+# from the injected fault.
+obs_rc=0
+rm -rf /tmp/_t1_obs
+if JAX_PLATFORMS=cpu NTS_METRICS_DIR=/tmp/_t1_obs NTS_METRICS_PORT=0 \
+    NTS_SLO_SPEC='serve_p99_ms<=75@1m;shed_rate<=0.5@1m' \
+    NTS_FLIGHT_DIR=/tmp/_t1_obs/flight NTS_SAMPLE_WORKERS=0 \
+    timeout -k 10 600 python - <<'EOF' > /tmp/_t1_obs.log 2>&1
+import glob, json, os, tempfile, urllib.request
+
+import numpy as np
+
+from neutronstarlite_tpu.utils.platform import honor_platform_env
+
+honor_platform_env()
+from neutronstarlite_tpu.serve.engine import InferenceEngine
+from neutronstarlite_tpu.serve.server import InferenceServer
+from neutronstarlite_tpu.tools.serve_bench import ensure_checkpoint
+from neutronstarlite_tpu.utils.config import InputInfo
+
+cfg_path = "configs/serve_cora_smoke.cfg"
+cfg = InputInfo.read_from_cfg_file(cfg_path)
+base_dir = os.path.dirname(os.path.abspath(cfg_path))
+ckpt = tempfile.mkdtemp(prefix="obs_gate_ckpt_")
+cfg.checkpoint_dir = ckpt
+ensure_checkpoint(cfg, base_dir, ckpt, train=True)
+engine = InferenceEngine.from_config(
+    cfg, base_dir=base_dir, ckpt_dir=ckpt, rng=np.random.default_rng(0)
+)
+engine.warmup()
+server = InferenceServer(engine)
+assert server.exporter is not None, "exporter did not start"
+assert server.slo is not None, "SLO engine did not arm"
+v = engine.toolkit.host_graph.v_num
+rng = np.random.default_rng(1)
+for _ in range(30):
+    try:
+        server.predict(rng.integers(0, v, 1), timeout=60.0)
+    except Exception:
+        pass  # burn-rate sheds are an allowed outcome under the tight SLO
+# live scrape MID-RUN (the non-blocking snapshot contract)
+port = server.exporter.port
+def get(path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=10
+    ) as r:
+        return r.read().decode()
+txt = get("/metrics")
+assert "nts_serve_latency_ms_bucket" in txt, "no latency histogram in /metrics"
+for line in txt.splitlines():
+    if not line.startswith("#"):
+        float(line.rsplit(" ", 1)[1])  # every sample parses
+hz = json.loads(get("/healthz"))
+assert hz["ok"] is True, hz
+slo = json.loads(get("/slo"))
+assert slo and slo[0]["objective"].startswith("serve_p99_ms"), slo
+# injected fault -> flight dump off the live ring
+from neutronstarlite_tpu.resilience import events
+
+events.emit_fault("nonfinite_loss", epoch=1, injected=True)
+server.close()
+
+from neutronstarlite_tpu.obs import schema
+from neutronstarlite_tpu.obs.hist import latest_hists
+
+evs = []
+for p in sorted(glob.glob("/tmp/_t1_obs/*.jsonl")):
+    for line in open(p, encoding="utf-8"):
+        line = line.strip()
+        if line:
+            evs.append(json.loads(line))
+assert schema.validate_stream(evs) == len(evs)
+hists = latest_hists(evs)
+assert hists.get("serve.latency_ms") is not None, "no hist records"
+assert hists["serve.latency_ms"].count > 0
+slos = [e for e in evs if e["event"] == "slo_status"]
+assert slos, "no slo_status records in the stream"
+slo_streams = {e["run_id"] for e in slos}
+assert len(slo_streams) == 1, f"slo_status from {len(slo_streams)} streams"
+dumps = sorted(glob.glob("/tmp/_t1_obs/flight/flight_*.jsonl"))
+assert dumps, "injected fault left no flight dump"
+drecs = [json.loads(l) for l in open(dumps[-1], encoding="utf-8")
+         if l.strip()]
+assert schema.validate_stream(drecs) == len(drecs)
+assert any(e["event"] == "fault" for e in drecs), "fault not in the dump"
+print(
+    f"obs gate: /metrics histogram OK ({hists['serve.latency_ms'].count} "
+    f"samples); {len(slos)} slo_status record(s) from one stream; flight "
+    f"dump carries {len(drecs)} schema-valid records"
+)
+EOF
+then
+  grep "obs gate:" /tmp/_t1_obs.log
+else
+  obs_rc=$?
+  tail -30 /tmp/_t1_obs.log
+fi
+if [ "$obs_rc" -ne 0 ]; then
+  echo "OBS_GATE=FAIL (rc=$obs_rc)"
+else
+  echo "OBS_GATE=OK"
+fi
+
 [ "$rc" -eq 0 ] && rc=$fused_rc
 [ "$rc" -eq 0 ] && rc=$samp_rc
 [ "$rc" -eq 0 ] && rc=$elastic_rc
 [ "$rc" -eq 0 ] && rc=$tune_rc
+[ "$rc" -eq 0 ] && rc=$obs_rc
 exit $rc

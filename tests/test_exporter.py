@@ -1,0 +1,217 @@
+"""obs/exporter: Prometheus rendering, endpoint contracts, and scrape
+safety under a concurrent flush storm.
+
+The exporter's contract is that a scrape returns a *consistent* snapshot
+(cumulative histogram buckets monotone, count == +Inf bucket) and never
+blocks or breaks the writers — tested by hammering the registry from
+writer threads while scraping in parallel.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from neutronstarlite_tpu.obs import registry
+from neutronstarlite_tpu.obs.exporter import (
+    MetricsExporter,
+    health_payload,
+    maybe_start,
+    prometheus_text,
+)
+
+
+def make_registry():
+    return registry.MetricsRegistry("run-exp", algorithm="SERVE",
+                                    fingerprint="f")
+
+
+def get(port, path, timeout=10.0):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=timeout
+    ) as resp:
+        return resp.status, resp.read().decode()
+
+
+# ---- text rendering --------------------------------------------------------
+
+
+def test_prometheus_text_shapes():
+    reg = make_registry()
+    reg.counter_add("serve.requests", 7)
+    reg.gauge_set("dist.active_partitions", 3)
+    reg.gauge_set("tune.decision", "ring|-|-|bf16")  # non-numeric: skipped
+    reg.observe("serve.exec", 0.25)
+    for v in (1.0, 2.0, 40.0, 900.0):
+        reg.hist_observe("serve.latency_ms", v)
+    txt = prometheus_text(reg)
+    assert "# TYPE nts_serve_requests counter" in txt
+    assert "nts_serve_requests 7" in txt
+    assert "nts_dist_active_partitions 3" in txt
+    assert "tune.decision" not in txt and "ring|" not in txt
+    assert "nts_serve_exec_seconds_count 1" in txt
+    # histogram: monotone cumulative buckets, count == +Inf bucket
+    assert "# TYPE nts_serve_latency_ms histogram" in txt
+    buckets = [
+        int(line.rsplit(" ", 1)[1])
+        for line in txt.splitlines()
+        if line.startswith("nts_serve_latency_ms_bucket")
+    ]
+    assert buckets == sorted(buckets)
+    assert buckets[-1] == 4  # le="+Inf"
+    assert "nts_serve_latency_ms_count 4" in txt
+    # a name living as BOTH a scalar and a histogram (sample.stall_ms,
+    # sample.queue_depth) must not emit two TYPE lines for one family —
+    # the scalar renders suffixed, the histogram keeps the bare name
+    reg.counter_add("sample.stall_ms", 12.5)
+    reg.hist_observe("sample.stall_ms", 12.5)
+    reg.gauge_set("sample.queue_depth", 3)
+    reg.hist_observe("sample.queue_depth", 3, unit="")
+    txt = prometheus_text(reg)
+    assert "nts_sample_stall_ms_total 12.5" in txt
+    assert "nts_sample_queue_depth_peak 3" in txt
+    names = [
+        line.split()[2]
+        for line in txt.splitlines() if line.startswith("# TYPE")
+    ]
+    assert len(names) == len(set(names)), f"duplicate TYPE family: {names}"
+    # a prometheus line is "name{labels} value" or "name value"
+    for line in txt.splitlines():
+        if line.startswith("#"):
+            continue
+        name, value = line.rsplit(" ", 1)
+        float(value)  # every sample parses
+
+
+def test_health_payload_reflects_supervisor_state():
+    reg = make_registry()
+    reg.gauge_set("resilience.state", "retrying")
+    reg.gauge_set("resilience.attempt", 2)
+    reg.counter_add("resilience.faults", 1)
+    h = health_payload(reg, started_at=0.0)
+    assert h["ok"] is True
+    assert h["supervisor"]["state"] == "retrying"
+    assert h["supervisor"]["faults"] == 1
+    reg.gauge_set("resilience.gave_up", 1)
+    assert health_payload(reg, started_at=0.0)["ok"] is False
+
+
+# ---- HTTP endpoints --------------------------------------------------------
+
+
+@pytest.fixture()
+def exporter():
+    reg = make_registry()
+    exp = MetricsExporter(reg, port=0)  # ephemeral
+    yield reg, exp
+    exp.close()
+
+
+def test_endpoints_serve_and_unknown_404(exporter):
+    reg, exp = exporter
+    reg.counter_add("serve.requests", 3)
+    status, body = get(exp.port, "/metrics")
+    assert status == 200 and "nts_serve_requests 3" in body
+    status, body = get(exp.port, "/healthz")
+    assert status == 200
+    payload = json.loads(body)
+    assert payload["ok"] is True and payload["run_id"] == "run-exp"
+    # /slo without an armed engine: 404, with a reason
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        get(exp.port, "/slo")
+    assert ei.value.code == 404
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        get(exp.port, "/nope")
+    assert ei.value.code == 404
+
+
+def test_slo_endpoint_with_engine(exporter):
+    from neutronstarlite_tpu.obs.slo import SloEngine, parse_slo_spec
+
+    reg, exp = exporter
+    eng = SloEngine(reg, parse_slo_spec("serve_p99_ms<=50@5s"))
+    exp.rebind(reg, slo=eng)
+    for _ in range(10):
+        reg.hist_observe("serve.latency_ms", 500.0)
+    status, body = get(exp.port, "/slo")
+    assert status == 200
+    verdicts = json.loads(body)
+    assert verdicts[0]["objective"] == "serve_p99_ms<=50@5s"
+    assert verdicts[0]["state"] in ("ok", "breach")
+
+
+def test_scrape_during_flush_storm_is_consistent(exporter):
+    """Writer threads hammer every metric type while scrapes run in
+    parallel: every scrape must parse, every histogram scrape must be
+    internally consistent (monotone buckets, +Inf == count), and the
+    writers must finish unimpeded (the lock-light contract)."""
+    reg, exp = exporter
+    stop = threading.Event()
+    errors = []
+
+    def writer(idx):
+        i = 0
+        while not stop.is_set():
+            reg.hist_observe("serve.latency_ms", float(1 + (i % 500)))
+            reg.counter_add("serve.requests")
+            reg.observe("serve.exec", 0.001)
+            reg.event("shed", reason="storm", queue_depth=i)
+            i += 1
+
+    writers = [
+        threading.Thread(target=writer, args=(i,), daemon=True)
+        for i in range(4)
+    ]
+    for t in writers:
+        t.start()
+    try:
+        for _ in range(25):
+            status, body = get(exp.port, "/metrics")
+            assert status == 200
+            buckets = []
+            count = None
+            for line in body.splitlines():
+                if line.startswith("#"):
+                    continue
+                name, value = line.rsplit(" ", 1)
+                float(value)
+                if name.startswith("nts_serve_latency_ms_bucket"):
+                    buckets.append(int(value))
+                elif name == "nts_serve_latency_ms_count":
+                    count = int(value)
+            if buckets:
+                assert buckets == sorted(buckets), "non-monotone cumulative"
+                assert buckets[-1] == count, "+Inf bucket != count"
+            status, body = get(exp.port, "/healthz")
+            assert status == 200 and json.loads(body)["ok"] is True
+    finally:
+        stop.set()
+        for t in writers:
+            t.join(timeout=5.0)
+    assert not errors
+
+
+# ---- the singleton ---------------------------------------------------------
+
+
+def test_maybe_start_gated_and_rebinds(monkeypatch):
+    monkeypatch.delenv("NTS_METRICS_PORT", raising=False)
+    assert maybe_start(make_registry()) is None  # off by default
+
+    import neutronstarlite_tpu.obs.exporter as exp_mod
+
+    monkeypatch.setattr(exp_mod, "_singleton", None)
+    monkeypatch.setenv("NTS_METRICS_PORT", "0")
+    reg_a = make_registry()
+    exp = maybe_start(reg_a)
+    try:
+        assert exp is not None and exp.registry is reg_a
+        reg_b = make_registry()
+        assert maybe_start(reg_b) is exp  # one listener per process
+        assert exp.registry is reg_b      # ...rebound to the newest run
+    finally:
+        exp.close()
+        monkeypatch.setattr(exp_mod, "_singleton", None)

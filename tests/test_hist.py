@@ -1,0 +1,191 @@
+"""obs/hist property tests: the quantile error bound, merge laws, and the
+stream serialization round trip.
+
+The bound under test is the documented contract (docs/OBSERVABILITY.md):
+any reported quantile is within ``sqrt(growth) - 1`` (~1% at the default
+1.02) of the nearest-rank exact order statistic — on 1e5-sample lognormal
+traffic AND on pathological shapes (constant, bimodal, heavy tail,
+sub-min_value dust, zeros). Merging must be associative, commutative, and
+rank-order invariant: however samples are partitioned across histograms,
+the merged quantiles are bit-identical to the single-observer ones.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+
+import numpy as np
+import pytest
+
+from neutronstarlite_tpu.obs import registry, schema
+from neutronstarlite_tpu.obs.hist import (
+    LogHistogram,
+    latest_hists,
+    merged_quantiles,
+)
+
+QS = (0.5, 0.9, 0.95, 0.99, 0.999)
+
+
+def exact_nearest_rank(sorted_vals: np.ndarray, q: float) -> float:
+    return float(sorted_vals[max(1, math.ceil(q * len(sorted_vals))) - 1])
+
+
+def fill(values) -> LogHistogram:
+    h = LogHistogram()
+    for v in values:
+        h.record(float(v))
+    return h
+
+
+def assert_quantiles_within_bound(h: LogHistogram, values) -> None:
+    s = np.sort(np.asarray(values, dtype=np.float64))
+    for q in QS:
+        exact = exact_nearest_rank(s, q)
+        est = h.quantile(q)
+        if exact <= 0:
+            assert est == 0.0
+        elif exact < h.min_value:
+            # sub-min values clamp into bucket 0 — the documented floor
+            assert est <= h.bucket_upper(0)
+        else:
+            rel = abs(est - exact) / exact
+            assert rel <= h.rel_error + 1e-12, (
+                f"q={q}: est {est} vs exact {exact} (rel {rel:.4f} > "
+                f"bound {h.rel_error:.4f})"
+            )
+
+
+# ---- the 1% error bound ----------------------------------------------------
+
+
+def test_quantile_error_bound_lognormal_1e5():
+    rng = np.random.default_rng(7)
+    xs = np.exp(rng.normal(3.0, 1.2, 100_000))  # ms-scale tail traffic
+    assert_quantiles_within_bound(fill(xs), xs)
+
+
+@pytest.mark.parametrize("name,values", [
+    ("constant", np.full(10_000, 42.0)),
+    ("bimodal", np.concatenate([np.full(50_000, 1.0),
+                                np.full(50_000, 5000.0)])),
+    ("pareto_heavy_tail",
+     (np.random.default_rng(3).pareto(1.5, 100_000) + 1.0) * 2.0),
+    ("uniform_tiny", np.random.default_rng(5).uniform(1e-5, 1e-2, 50_000)),
+    ("with_zeros", np.concatenate([np.zeros(1000),
+                                   np.random.default_rng(9).uniform(
+                                       1.0, 100.0, 9000)])),
+    ("single_sample", np.array([17.3])),
+])
+def test_quantile_error_bound_pathological(name, values):
+    assert_quantiles_within_bound(fill(values), values)
+
+
+def test_sub_min_and_nonpositive_values_clamp_not_crash():
+    h = LogHistogram()
+    for v in (-5.0, 0.0, 1e-9, 1e-6):
+        h.record(v)
+    assert h.count == 4 and h.zero_count == 2
+    assert h.quantile(0.25) == 0.0  # the zeros rank below every bucket
+    assert h.quantile(1.0) <= h.bucket_upper(0)
+
+
+def test_fixed_memory_bucket_cap():
+    h = LogHistogram()
+    h.record(1e300)  # astronomically beyond the representable range
+    from neutronstarlite_tpu.obs.hist import MAX_BUCKETS
+
+    assert max(h.buckets) == MAX_BUCKETS - 1
+    assert h.max == 1e300  # exact extrema are tracked outside the buckets
+
+
+# ---- merge laws ------------------------------------------------------------
+
+
+def test_merge_associative_commutative_and_rank_invariant():
+    rng = np.random.default_rng(11)
+    xs = np.exp(rng.normal(2.0, 1.5, 30_000))
+    whole = fill(xs)
+
+    # three different partitionings of the same samples
+    parts_a = [xs[:10_000], xs[10_000:11_000], xs[11_000:]]
+    parts_b = [xs[::3], xs[1::3], xs[2::3]]  # interleaved (order shuffled)
+    for parts in (parts_a, parts_b):
+        h1, h2, h3 = (fill(p) for p in parts)
+        left = h1.copy().merge(h2.copy()).merge(h3.copy())
+        right = h1.copy().merge(h2.copy().merge(h3.copy()))
+        comm = h3.copy().merge(h1.copy()).merge(h2.copy())
+        for m in (left, right, comm):
+            assert m.buckets == whole.buckets
+            assert m.count == whole.count
+            assert m.zero_count == whole.zero_count
+            assert m.min == whole.min and m.max == whole.max
+            # float sums differ only by addition order
+            assert m.sum == pytest.approx(whole.sum, rel=1e-9)
+            for q in QS:
+                assert m.quantile(q) == whole.quantile(q)
+
+
+def test_merge_refuses_geometry_mismatch():
+    a = LogHistogram(growth=1.02)
+    b = LogHistogram(growth=1.05)
+    with pytest.raises(ValueError, match="geometry"):
+        a.merge(b)
+
+
+# ---- serialization round trip through schema validation --------------------
+
+
+def test_hist_record_roundtrip_through_schema(tmp_path):
+    rng = np.random.default_rng(13)
+    xs = np.exp(rng.normal(3.0, 1.0, 5000))
+    path = tmp_path / "h.jsonl"
+    reg = registry.MetricsRegistry("run-h", algorithm="A", fingerprint="f",
+                                   path=str(path))
+    for v in xs:
+        reg.hist_observe("serve.latency_ms", float(v))
+    reg.emit_hists()
+    reg.close()
+
+    events = [json.loads(l) for l in open(path) if l.strip()]
+    assert schema.validate_stream(events) == len(events)
+    h = latest_hists(events)["serve.latency_ms"]
+    live = reg.hist("serve.latency_ms")
+    assert h.to_dict() == live.to_dict()  # byte-identical reconstruction
+    for q in QS:
+        assert h.quantile(q) == live.quantile(q)
+    assert merged_quantiles(events, "serve.latency_ms") == live.quantiles()
+    assert merged_quantiles(events, "no.such.hist") is None
+
+
+def test_latest_cumulative_snapshot_wins_and_ranks_merge(tmp_path):
+    """Within a stream the newest snapshot supersedes older ones (they are
+    cumulative); across streams (ranks) snapshots MERGE — the multi-rank
+    p99 story."""
+    xs = np.random.default_rng(17).uniform(1.0, 100.0, 2000)
+
+    def stream(name, values, run_id):
+        p = tmp_path / name
+        reg = registry.MetricsRegistry(run_id, algorithm="A",
+                                       fingerprint="f", path=str(p))
+        mid = len(values) // 2
+        for v in values[:mid]:
+            reg.hist_observe("serve.latency_ms", float(v))
+        reg.emit_hists()  # the stale mid-run snapshot
+        for v in values[mid:]:
+            reg.hist_observe("serve.latency_ms", float(v))
+        reg.emit_hists()  # the cumulative final one
+        reg.close()
+        return [json.loads(l) for l in open(p) if l.strip()]
+
+    ev_a = stream("a.jsonl", xs[:1000], "rank-a")
+    ev_b = stream("b.jsonl", xs[1000:], "rank-b")
+    # per stream: latest wins (full count, not half)
+    assert latest_hists(ev_a)["serve.latency_ms"].count == 1000
+    # merged across ranks: the single-observer histogram
+    merged = latest_hists(ev_a + ev_b)["serve.latency_ms"]
+    whole = fill(xs)
+    assert merged.buckets == whole.buckets
+    for q in QS:
+        assert merged.quantile(q) == whole.quantile(q)

@@ -68,6 +68,21 @@ def _emit_all(reg: registry.MetricsRegistry) -> None:
               reason="NTS_METRICS_MAX_MB: stream exceeded 1 MB",
               rotated_to="x.jsonl.1", bytes_written=1048600)
     reg.event(
+        "hist", name="serve.latency_ms", unit="ms", growth=1.02,
+        min_value=0.001, count=3, sum=10.5, zero_count=0,
+        min=2.0, max=5.0, buckets=[[340, 2], [367, 1]],
+    )
+    reg.event(
+        "slo_status", objective="serve_p99_ms<=75@5m",
+        metric="serve_p99_ms", state="breach", threshold=75.0,
+        window_s=300.0, value=120.0, burn_rate=3.2, burn_rate_short=4.1,
+        window_count=420,
+    )
+    reg.event(
+        "backend_probe", attempt=1, outcome="timeout", seconds=120.0,
+        platform=None, timeout_s=120.0, error="backend init hang",
+    )
+    reg.event(
         "run_summary", algorithm="GCNDIST", fingerprint="cafecafecafe",
         counters={"wire.bytes_fwd": 4096}, gauges={}, timings={},
         epochs=1,
@@ -99,6 +114,9 @@ RENDER_MARKERS = {
     "tune_decision": "#tune_decision=",
     "span": "span timeline:",
     "stream_rotated": "stream_rotated",
+    "hist": "#hist_serve.latency_ms=",
+    "slo_status": "slo timeline:",
+    "backend_probe": "#backend_probe=",
     "run_summary": "finish algorithm !",
 }
 
@@ -165,6 +183,9 @@ def test_validator_rejects_mutations_per_kind(tmp_path):
         "tune_decision": {"partitions": 0},
         "span": {"dur_s": -1.0},
         "stream_rotated": {"bytes_written": "lots"},
+        "hist": {"buckets": [[340, 0]]},
+        "slo_status": {"state": ""},
+        "backend_probe": {"attempt": 0},
         "run_summary": {"epoch_time": None},
     }
     assert set(mutations) == set(schema.KNOWN_KINDS)
