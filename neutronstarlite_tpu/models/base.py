@@ -234,7 +234,25 @@ class ToolkitBase:
     def _check_dist_path(self) -> None:
         cfg = self.cfg
         if getattr(type(self), "supports_dist_path", False):
+            # mesh-vs-knob consistency for the family that CAN build a
+            # 2D mesh (loud refusals: all_gather/mirror/OPTIM_KERNEL
+            # cannot feature-shard; PARTITIONS must agree with Pv*Pf)
+            from neutronstarlite_tpu.parallel.partitioner import (
+                check_mesh_cfg,
+            )
+
+            check_mesh_cfg(cfg)
             return
+        mesh = getattr(cfg, "mesh", "")
+        if mesh not in ("", "auto"):
+            raise ValueError(
+                f"MESH:{mesh} is not available for ALGORITHM "
+                f"{cfg.algorithm!r}: the 2D (vertex x feature) mesh "
+                "partitioner (parallel/partitioner.py) serves the fuse-op "
+                "dist family (GCNDIST / GINDIST / COMMNETDIST and their "
+                "eager variants); other families have no feature-shardable "
+                "exchange"
+            )
         dist_path = getattr(cfg, "dist_path", "")
         if dist_path not in ("", "auto"):
             raise ValueError(
@@ -396,6 +414,15 @@ class ToolkitBase:
     # ---- dist-trainer mesh resolution ------------------------------------
     simulate: Optional[bool] = None  # None -> read NTS_DIST_SIMULATE
 
+    def resolve_simulate(self) -> bool:
+        """ONE resolution of the sim-twin switch (class attr pin or
+        NTS_DIST_SIMULATE=1), shared by resolve_mesh and the 2D
+        partitioner branch so the env read can never drift between the
+        1D and mesh paths."""
+        if self.simulate is None:
+            self.simulate = os.environ.get("NTS_DIST_SIMULATE", "0") == "1"
+        return self.simulate
+
     def resolve_mesh(self):
         """(mesh, partitions) for dist trainers. ``simulate`` (class attr or
         NTS_DIST_SIMULATE=1) selects the collective-free sim ops with
@@ -403,9 +430,7 @@ class ToolkitBase:
         PARTITIONS (or all) devices."""
         from neutronstarlite_tpu.parallel.mesh import make_mesh
 
-        if self.simulate is None:
-            self.simulate = os.environ.get("NTS_DIST_SIMULATE", "0") == "1"
-        if self.simulate:
+        if self.resolve_simulate():
             return None, (self.cfg.partitions or 2)
         mesh = make_mesh(self.cfg.partitions or None)
         return mesh, mesh.devices.size

@@ -19,12 +19,15 @@ from neutronstarlite_tpu.nn.layers import dropout
 
 
 def commnet_layer_nn(i, n_layers, layer, agg, x_in, valid_mask, key,
-                     drop_rate, train, compute_dtype=None):
+                     drop_rate, train, compute_dtype=None, contract=None):
     """Communication step over the exchanged aggregate — identical math to
-    the single-chip twin (models/commnet.py:commnet_forward)."""
+    the single-chip twin (models/commnet.py:commnet_forward). ``contract``
+    is the 2D-mesh feature-axis contraction; BOTH matmuls consume the
+    feature-sharded layer input (agg and the skip path x_in)."""
+    mm = contract or (lambda a, w: a @ w)
     cast = compute_cast(compute_dtype)
     agg, x_in = cast(agg), cast(x_in)
-    h = jax.nn.relu(agg @ cast(layer["C"]) + x_in @ cast(layer["H"]))
+    h = jax.nn.relu(mm(agg, cast(layer["C"])) + mm(x_in, cast(layer["H"])))
     if train and i < n_layers - 1:
         h = dropout(jax.random.fold_in(key, i), h, drop_rate, train)
     return h
@@ -35,6 +38,9 @@ class DistCommNetTrainer(DistGCNTrainer):
     """Vertex-sharded full-batch CommNet (PARTITIONS cfg key)."""
 
     layer_nn = staticmethod(commnet_layer_nn)
+    # 2D-mesh feature padding: layer 0's C and H both carry the input-
+    # feature dim (parallel/partitioner.pad_params_feature_dim)
+    mesh_pad_keys = ("C", "H")
 
     def init_model_params(self, key):
         return init_commnet_params(key, self.cfg.layer_sizes())

@@ -42,20 +42,37 @@ from neutronstarlite_tpu.utils.timing import get_time
 log = get_logger("gcn_dist")
 
 
+def exchange_widths(eager: bool, sizes):
+    """The per-layer EXCHANGE widths of a fuse-op dist stack: standard
+    order ships each layer's INPUT width (``sizes[:-1]``); the eager
+    (NN-then-exchange) variants ship the post-matmul widths
+    (``sizes[1:]``). ONE definition shared by the live wire gauges
+    below, the tune prior (tune/runner.analytic_priors), and the
+    elastic mesh reshape (resilience/elastic.replan_survivors) — three
+    consumers that must never price different widths for one trainer."""
+    return list(sizes[1:] if eager else sizes[:-1])
+
+
 def gcn_layer_nn(i, n_layers, layer, agg, x_in, valid_mask, key, drop_rate,
-                 train, compute_dtype=None):
+                 train, compute_dtype=None, contract=None):
     """GCN's per-layer NN over the exchanged aggregate (the reference's
     vertexForward, GCN_CPU.hpp:215-228). ``compute_dtype=bf16`` runs bn +
     matmul in bf16 and RETURNS bf16, so the next layer's exchange ships
-    half the bytes (the single-chip family's policy, models/gcn.py)."""
+    half the bytes (the single-chip family's policy, models/gcn.py).
+    ``contract`` replaces the feature-axis matmul on a 2D (vertex x
+    feature) mesh (parallel/partitioner.Partitioner.contract: the
+    feature-sharded contraction — XLA's all-reduce on a real mesh, the
+    slab-partial sum in the sim twin); None = plain matmul, and a
+    2D-padded activation meets a padded parameter only through it."""
+    mm = contract or (lambda a, w: a @ w)
     cast = compute_cast(compute_dtype)
     agg = cast(agg)
     if i == n_layers - 1:
-        return agg @ cast(layer["W"])
+        return mm(agg, cast(layer["W"]))
     if "bn" in layer:
         agg = batch_norm_apply(jax.tree.map(cast, layer["bn"]), agg,
                                valid_mask=valid_mask)
-    h = jax.nn.relu(agg @ cast(layer["W"]))
+    h = jax.nn.relu(mm(agg, cast(layer["W"])))
     return dropout(jax.random.fold_in(key, i), h, drop_rate, train)
 
 
@@ -74,6 +91,7 @@ def dist_gcn_forward(
     no_exchange: bool = False,
     compute_dtype=None,
     wire_dtype=None,
+    partitioner=None,
 ):
     """``blocks`` selects the exchange: the [P, P, Eb] 3-tuple is the
     ppermute ring, a DistEllPair is the OPTIM_KERNEL gather-only path, a
@@ -111,6 +129,7 @@ def dist_gcn_forward(
     )
     from neutronstarlite_tpu.parallel.dist_ring_blocked import (
         RingBlockedPair,
+        dist_ring2d_gather_dst_from_src,
         dist_ring_blocked_gather_dst_from_src,
         dist_ring_blocked_gather_simulated,
     )
@@ -122,7 +141,20 @@ def dist_gcn_forward(
             # nn_time/graph_time split (models/debuginfo.py)
             return v
         if isinstance(blocks, RingBlockedPair):
+            if partitioner is not None and mesh is not None:
+                # the partitioner's 2D (vertex x feature) mesh: the ring
+                # rotates over the vertex axis while each device works a
+                # [vp, f/Pf] feature slab (parallel/partitioner.py)
+                return dist_ring2d_gather_dst_from_src(
+                    mesh, blocks, v, wire_dtype, pf=partitioner.pf
+                )
             if mesh is None:
+                # collective-free sim twin — also the 2D layout's
+                # exchange twin: the aggregation is feature-column-
+                # independent, so the full-width sim IS bitwise the
+                # slab-sharded collective ring (the 2D-specific math,
+                # the contraction's partial-sum order, lives in
+                # partitioner.contract below)
                 return dist_ring_blocked_gather_simulated(
                     blocks, v, wire_dtype
                 )
@@ -153,6 +185,12 @@ def dist_gcn_forward(
     # accumulator (ring bodies, ELL K-reduction, split-mirror body), and
     # the logits return f32
     x = compute_cast(compute_dtype)(x)
+    # 2D mesh: the feature-axis contraction (partitioner.contract — W
+    # row-padding + the slab-partial sum in sim / XLA's all-reduce on a
+    # real mesh) replaces the plain matmul, and each layer's activation
+    # is re-pinned to the (vertex, feature) layout so the next exchange
+    # starts slab-resident
+    contract = partitioner.contract if partitioner is not None else None
     n_layers = len(params)
     for i, layer in enumerate(params):
         if eager:
@@ -160,12 +198,16 @@ def dist_gcn_forward(
             # result (layer_nn's ``agg`` argument is the raw input here)
             x = exchange(
                 layer_nn(i, n_layers, layer, x, x, valid_mask, key,
-                         drop_rate, train, compute_dtype=compute_dtype)
+                         drop_rate, train, compute_dtype=compute_dtype,
+                         contract=contract)
             )
         else:
             h = exchange(x)
             x = layer_nn(i, n_layers, layer, h, x, valid_mask, key,
-                         drop_rate, train, compute_dtype=compute_dtype)
+                         drop_rate, train, compute_dtype=compute_dtype,
+                         contract=contract)
+        if partitioner is not None and mesh is not None and i < n_layers - 1:
+            x = partitioner.constrain(x)
     return x.astype(jnp.float32)
 
 
@@ -178,6 +220,10 @@ class DistGCNTrainer(ToolkitBase):
     with_bn = True
     supports_dist_path = True  # build_model honors DIST_PATH/WIRE_DTYPE
     supports_elastic = True  # NTS_ELASTIC=1: liveness + survivor replan
+    # 2D-mesh feature padding (parallel/partitioner.pad_params_feature_dim):
+    # layer 0's W and bn carry the input-feature dim; model variants
+    # (GIN/CommNet) override with their own parameter names
+    mesh_pad_keys = ("W", "bn")
     # per-layer NN over the exchanged aggregate; fuse-op model variants
     # (DistGINTrainer) override this and init_model_params only
     layer_nn = staticmethod(gcn_layer_nn)
@@ -222,10 +268,31 @@ class DistGCNTrainer(ToolkitBase):
         return choice
 
     def build_model(self) -> None:
+        from neutronstarlite_tpu.parallel import partitioner as pmod
+
         cfg = self.cfg
         self.wire_dtype = None
         self._ring_plan = None
-        if cfg.dist_path in ("ring_blocked", "ring_blocked_sim"):
+        spec = pmod.mesh_spec_of(cfg)
+        self.mesh_spec = spec
+        self.partitioner = None
+        if spec is not None:
+            # MESH:Pv,Pf — the 2D (vertex x feature) partitioner places
+            # the plane on a (Pv, Pf) mesh: the ring_blocked schedule is
+            # the layout it emits ((Pv, 1) is bitwise the 1D ring), with
+            # Pf > 1 sharding every exchange/resident buffer down to
+            # [vp, f/Pf] slabs (parallel/partitioner.py)
+            pmod.check_mesh_cfg(cfg)
+            if cfg.dist_path == "ring_blocked_sim":
+                self.simulate = True
+            part = pmod.Partitioner.build(
+                spec, simulate=self.resolve_simulate()
+            )
+            self.partitioner = part
+            self.mesh = part.mesh  # 2D Mesh, or None on the sim twin
+            P = spec.pv
+            layer_kind = "ring_blocked"
+        elif cfg.dist_path in ("ring_blocked", "ring_blocked_sim"):
             # the pipelined ring (parallel/dist_ring_blocked.py); the _sim
             # spelling forces the collective-free twin (single-core CI) —
             # NTS_DIST_SIMULATE=1 does the same for the bare spelling
@@ -284,9 +351,14 @@ class DistGCNTrainer(ToolkitBase):
             vt = default_ring_vt(self.dist.vp, cfg.kernel_tile)
             pair = RingBlockedPair.build(self.dist, vt=vt)
             est = pair.padding_stats(stats["real_edges"])
-            self.blocks = (
-                pair.shard(self.mesh) if self.mesh is not None else pair
-            )
+            if self.mesh is None:
+                self.blocks = pair
+            elif self.partitioner is not None:
+                # 2D mesh: tables shard over the vertex axis, replicated
+                # across the feature axis (every slab runs the schedule)
+                self.blocks = pair.shard(self.mesh, axis=pmod.VERTEX_AXIS)
+            else:
+                self.blocks = pair.shard(self.mesh)
             self.wire_dtype = resolve_wire_dtype(cfg.wire_dtype)
             log.info(
                 "DIST_PATH ring_blocked%s: double-buffered ring (vt=%d, "
@@ -423,9 +495,7 @@ class DistGCNTrainer(ToolkitBase):
         rows = exchange_rows_per_device(
             layer_kind, P, self.dist.vp, getattr(self.dist, "mb", 0)
         )
-        # standard order exchanges each layer's INPUT width; eager
-        # (NN-then-exchange) ships the post-matmul widths
-        widths = sizes[1:] if type(self).eager else sizes[:-1]
+        widths = exchange_widths(type(self).eager, sizes)
         itemsize = 2 if cfg.precision == "bfloat16" else 4
         if self.wire_dtype is not None:
             # WIRE_DTYPE narrows what rides the ICI independently of the
@@ -445,9 +515,12 @@ class DistGCNTrainer(ToolkitBase):
 
             # static per-epoch ring facts -> typed per-step ring_step
             # records (run loop) + the exchange-residency gauge the smoke
-            # test pins against wire_accounting
+            # test pins against wire_accounting. A 2D mesh prices each
+            # hop at its feature-slab width (slab_width(w, Pf)) — the
+            # same single definition wire_accounting.predict_mesh uses
             self._ring_plan = ring_wire_plan(
-                self.blocks.fwd, widths, itemsize
+                self.blocks.fwd, widths, itemsize,
+                pf=spec.pf if spec is not None else 1,
             )
             # the live counter must equal the per-hop record sum: a
             # trimmed skip SUFFIX ships fewer hops than the dense
@@ -473,6 +546,24 @@ class DistGCNTrainer(ToolkitBase):
             self.metrics.gauge_set(
                 "ring.transfers", self._ring_plan["transfers"]
             )
+            # the O(vp * f/Pf) memory claim as a live number (equals the
+            # full width on the 1D mesh — Pf degenerates to 1)
+            self.metrics.gauge_set(
+                "wire.peak_resident_feature_bytes",
+                self._ring_plan["peak_resident_feature_bytes"],
+            )
+            if spec is not None:
+                # mesh.* gauges: the resolved 2D shape, per-axis sizes,
+                # and the slab columns each rotation hop carries —
+                # what OBSERVABILITY.md's mesh addendum documents and
+                # the MESH_GATE pins against predict_mesh
+                self.metrics.gauge_set("mesh.shape", spec.label())
+                self.metrics.gauge_set("mesh.pv", spec.pv)
+                self.metrics.gauge_set("mesh.pf", spec.pf)
+                self.metrics.gauge_set("mesh.devices", spec.devices)
+                self.metrics.gauge_set(
+                    "mesh.slab_cols", self._ring_plan["slab_cols"]
+                )
         elif layer_kind == "ell":
             # the all_gather family materializes every shard per device
             self.metrics.gauge_set("wire.peak_resident_rows", P * self.dist.vp)
@@ -481,7 +572,16 @@ class DistGCNTrainer(ToolkitBase):
         # keeps everything as single logical host-backed arrays, the
         # DistGCNCacheTrainer placement convention)
         pad = self.dist.pad_vertex_array
-        if self.mesh is not None:
+        if self.partitioner is not None and self.mesh is not None:
+            # logical-axis placement (T5X rules): features live on the
+            # (vertex, feature) plane — each device holds a [vp, f/Pf]
+            # slab; labels/masks shard the vertex axis only; params
+            # replicate
+            vsh = self.partitioner.sharding("vertex", "feature")
+            vsh1 = self.partitioner.sharding("vertex")
+            rsh = self.partitioner.sharding()
+            put = jax.device_put
+        elif self.mesh is not None:
             vsh = NamedSharding(self.mesh, PS(PARTITION_AXIS, None))
             vsh1 = NamedSharding(self.mesh, PS(PARTITION_AXIS))
             rsh = NamedSharding(self.mesh, PS())
@@ -489,7 +589,12 @@ class DistGCNTrainer(ToolkitBase):
         else:
             vsh = vsh1 = rsh = None
             put = lambda a, s: jax.tree.map(jnp.asarray, a)  # noqa: E731
-        self.feature_p = put(pad(self.datum.feature), vsh)
+        feat = pad(self.datum.feature)
+        if self.partitioner is not None:
+            # zero-pad the feature width to a Pf multiple (sim too, so
+            # the twin trains the exact arrays the collective path ships)
+            feat = pmod.pad_feature_cols(feat, self.partitioner.pf)
+        self.feature_p = put(feat, vsh)
         self.label_p = put(pad(self.datum.label.astype(np.int32)), vsh1)
         self.valid_p = put(self.dist.valid_mask(), vsh1)
         train01 = (self.datum.mask == 0).astype(np.float32)
@@ -499,6 +604,13 @@ class DistGCNTrainer(ToolkitBase):
 
         key = jax.random.PRNGKey(self.seed)
         params = self.init_model_params(key)
+        if self.partitioner is not None:
+            # zero rows meet the zero feature columns: the padded model
+            # trains the unpadded math bit-for-bit on real coordinates
+            params = pmod.pad_params_feature_dim(
+                params, type(self).mesh_pad_keys, sizes[0],
+                self.partitioner.pf,
+            )
         self.params = put(params, rsh)
         self.adam_cfg = AdamConfig(
             alpha=cfg.learn_rate,
@@ -518,6 +630,7 @@ class DistGCNTrainer(ToolkitBase):
         # wide accumulation, f32 logits)
         compute_dtype = jnp.bfloat16 if cfg.precision == "bfloat16" else None
         wire_dtype = self.wire_dtype
+        part = self.partitioner
 
         # ``blocks`` (the O(E) sharded edge arrays) is a jit ARGUMENT, not a
         # closure: captured arrays are inlined into the HLO as constants,
@@ -529,7 +642,7 @@ class DistGCNTrainer(ToolkitBase):
                 logits = dist_gcn_forward(
                     mesh, dist, blocks, p, feature, valid, key, drop_rate,
                     True, layer_nn, eager, compute_dtype=compute_dtype,
-                    wire_dtype=wire_dtype,
+                    wire_dtype=wire_dtype, partitioner=part,
                 )
                 return masked_nll(logits, label, train01), logits
 
@@ -542,7 +655,7 @@ class DistGCNTrainer(ToolkitBase):
             return dist_gcn_forward(
                 mesh, dist, blocks, params, feature, valid, key, 0.0, False,
                 layer_nn, eager, compute_dtype=compute_dtype,
-                wire_dtype=wire_dtype,
+                wire_dtype=wire_dtype, partitioner=part,
             )
 
         self._train_step = train_step
@@ -556,6 +669,7 @@ class DistGCNTrainer(ToolkitBase):
                 mesh, dist, blocks, params, feature, valid, key, drop_rate,
                 True, layer_nn, eager, no_exchange=no_exchange,
                 compute_dtype=compute_dtype, wire_dtype=wire_dtype,
+                partitioner=part,
             )
             return masked_nll(logits, label, train01)
 
@@ -577,6 +691,64 @@ class DistGCNTrainer(ToolkitBase):
         self._dbg_fwd = fwd_loss
         self._dbg_nn = fwd_nn_only
         self._dbg_grad = fwd_grad
+
+    # ---- checkpoint canonicalization on a 2D mesh ------------------------
+    # Checkpoints store the UNPADDED parameter shapes: a 2D run's mesh
+    # feature padding (parallel/partitioner.pad_params_feature_dim) is
+    # stripped on save and re-applied on restore, so a checkpoint written
+    # under (2, 2) restores into the 1D path, a different Pf, or the
+    # reshaped mesh an elastic replan emits — without this, the replan's
+    # checkpoint restore would die on the pad-row shape mismatch.
+    def _mesh_pad_dims(self):
+        """(fin, pf) when this trainer's params carry mesh feature
+        padding; None otherwise (1D, or a width that divides Pf)."""
+        from neutronstarlite_tpu.parallel.partitioner import padded_width
+
+        if self.partitioner is None:
+            return None
+        fin = self.cfg.layer_sizes()[0]
+        pf = self.partitioner.pf
+        if padded_width(fin, pf) == fin:
+            return None
+        return fin, pf
+
+    def _map_param_padding(self, state, fn):
+        import dataclasses as _dc
+
+        opt = state["opt"]
+        return {
+            "params": fn(state["params"]),
+            "opt": _dc.replace(opt, m=fn(opt.m), v=fn(opt.v)),
+        }
+
+    def checkpoint_state(self):
+        state = super().checkpoint_state()
+        dims = self._mesh_pad_dims()
+        if dims is None:
+            return state
+        from neutronstarlite_tpu.parallel.partitioner import (
+            unpad_params_feature_dim,
+        )
+
+        fin, pf = dims
+        keys = type(self).mesh_pad_keys
+        return self._map_param_padding(
+            state, lambda p: unpad_params_feature_dim(p, keys, fin, pf)
+        )
+
+    def _apply_restored(self, state) -> None:
+        dims = self._mesh_pad_dims()
+        if dims is not None:
+            from neutronstarlite_tpu.parallel.partitioner import (
+                pad_params_feature_dim,
+            )
+
+            fin, pf = dims
+            keys = type(self).mesh_pad_keys
+            state = self._map_param_padding(
+                state, lambda p: pad_params_feature_dim(p, keys, fin, pf)
+            )
+        super()._apply_restored(state)
 
     def debug_info(self, key, n: int = 3) -> str:
         """Exchange-vs-compute attribution for the dist step — the
@@ -622,11 +794,22 @@ class DistGCNTrainer(ToolkitBase):
             measure_overlap,
         )
 
+        from neutronstarlite_tpu.parallel.mesh import (
+            FEATURE_AXIS,
+            PARTITION_AXIS,
+            VERTEX_AXIS,
+        )
+
+        axes = (
+            (VERTEX_AXIS, FEATURE_AXIS)
+            if self.partitioner is not None
+            else (PARTITION_AXIS, None)
+        )
         h = self.tracer.begin("ring_overlap_probe", cat="probe")
         try:
             probe = measure_overlap(
                 self.blocks.fwd, self.feature_p, mesh=self.mesh,
-                wire_dtype=self.wire_dtype,
+                wire_dtype=self.wire_dtype, axes=axes,
             )
         except BaseException as e:
             # run() swallows probe failures; the span must still emit (and
@@ -734,6 +917,7 @@ class DistGCNTrainer(ToolkitBase):
                         "ring_step", epoch=epoch, step=hop["step"],
                         bytes=int(hop["bytes"]), skipped=hop["skipped"],
                         seconds=None,
+                        slab_cols=int(hop["slab_cols"]),
                         epoch_span=espan.span_id if espan else None,
                     )
             if self._liveness is not None:

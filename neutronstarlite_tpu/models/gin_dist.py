@@ -22,14 +22,18 @@ from neutronstarlite_tpu.nn.layers import batch_norm_apply, compute_cast, dropou
 
 
 def gin_layer_nn(i, n_layers, layer, agg, x_in, valid_mask, key, drop_rate,
-                 train, compute_dtype=None):
+                 train, compute_dtype=None, contract=None):
     """GIN vertexForward over the exchanged aggregate: MLP((agg + x)) with
     bn on every layer's output, relu/dropout on hidden layers only — the
     same structure as the single-chip twin (models/gin.py:gin_forward),
-    with the dist valid-mask excluded from the bn statistics."""
+    with the dist valid-mask excluded from the bn statistics. ``contract``
+    is the 2D-mesh feature-axis contraction for the FIRST matmul (the one
+    consuming the feature-sharded exchange; W2 contracts the replicated
+    hidden width and stays a plain matmul)."""
+    mm = contract or (lambda a, w: a @ w)
     cast = compute_cast(compute_dtype)
     agg, x_in = cast(agg), cast(x_in)
-    h = jax.nn.relu((agg + x_in) @ cast(layer["W1"]))
+    h = jax.nn.relu(mm(agg + x_in, cast(layer["W1"])))
     h = h @ cast(layer["W2"])
     if i < n_layers - 1:
         h = jax.nn.relu(h)
@@ -44,6 +48,9 @@ class DistGINTrainer(DistGCNTrainer):
     """Vertex-sharded full-batch GIN (PARTITIONS cfg key picks the mesh)."""
 
     layer_nn = staticmethod(gin_layer_nn)
+    # 2D-mesh feature padding (parallel/partitioner.pad_params_feature_dim):
+    # layer 0's W1 is the only parameter carrying the input-feature dim
+    mesh_pad_keys = ("W1",)
 
     def init_model_params(self, key):
         return init_gin_params(key, self.cfg.layer_sizes())

@@ -29,7 +29,11 @@ ring_step (parallel/dist_ring_blocked.py): one rotation hop of the
   this step dropped by the static skip schedule),
   seconds: number | null (per-hop wall time is not separable inside one
   XLA program; comm_bench fills it from standalone measurement),
-  epoch: int | absent
+  epoch: int | absent,
+  slab_cols: int > 0 | absent (the feature-slab columns this hop
+  carried across all layer exchanges — sum of slab_width(w, Pf) on a
+  2D (vertex x feature) mesh, the full widths on the 1D layout;
+  parallel/partitioner.py; the mesh.* gauges carry the shape)
 
 fault (resilience/): a detected or injected fault occurrence
   kind: str     nonfinite_loss | nonfinite_params | divergence | stall |
@@ -53,10 +57,13 @@ rank_loss (resilience/elastic.py): the liveness monitor declared a
 
 replan (resilience/elastic.py): the supervisor rebuilt the distributed
   plan for the survivors at the rollback boundary
-  from_partitions: int > 0, to_partitions: int > 0,
+  from_partitions: int > 0, to_partitions: int > 0 (VERTEX partitions),
   lost: int | absent (the dropped partition),
   seconds: number | null (plan rebuild wall time),
-  moved_vertices: int | absent (vertices that changed owner)
+  moved_vertices: int | absent (vertices that changed owner),
+  from_mesh / to_mesh: str | absent (a 2D-mesh plan's replan is a MESH
+  RESHAPE — the (Pv, Pf) labels before/after, e.g. "2x2" -> "3x1";
+  parallel/partitioner.py)
 
 serve_request (serve/): one answered (or shed) inference request
   n_seeds: int > 0, status: str (ok | cached | shed, open set),
@@ -277,6 +284,12 @@ def validate_event(obj: Any) -> None:
             obj["epoch"], int
         ):
             _fail("ring_step.epoch must be an int when present")
+        sc = obj.get("slab_cols")
+        if "slab_cols" in obj and (
+            not isinstance(sc, int) or isinstance(sc, bool) or sc <= 0
+        ):
+            _fail(f"ring_step.slab_cols must be a positive int when "
+                  f"present, got {sc!r}")
     elif kind == "fault":
         if not isinstance(obj.get("kind"), str) or not obj["kind"]:
             _fail("fault.kind must be a non-empty string")
@@ -326,6 +339,12 @@ def validate_event(obj: Any) -> None:
                 obj[key], int
             ):
                 _fail(f"replan.{key} must be an int when present")
+        for key in ("from_mesh", "to_mesh"):
+            if key in obj and (
+                not isinstance(obj[key], str) or not obj[key]
+            ):
+                _fail(f"replan.{key} must be a non-empty string when "
+                      "present")
         _require_number(obj, "seconds", allow_none=True)
     elif kind == "serve_request":
         if not isinstance(obj.get("n_seeds"), int) or obj["n_seeds"] <= 0:

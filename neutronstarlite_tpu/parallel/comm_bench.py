@@ -253,6 +253,133 @@ def bench_edge_family(v_num, avg_degree, f, partitions, steps, seed=3,
     return results
 
 
+def bench_mesh(v_num, avg_degree, f, pv, pf, steps, seed=3, kernel_tile=0,
+               side="both", simulate=None):
+    """The ``--mesh Pv,Pf`` leg: 1D vertex sharding over Pv*Pf devices vs
+    the 2D (vertex x feature) layout (parallel/partitioner.py) on the
+    same graph — one jitted exchange fwd+bwd each, plus the analytic
+    wire/residency numbers both layouts are priced at
+    (tools/wire_accounting.predict_mesh). On the CPU rig (or with
+    ``simulate``) each leg times its collective-free sim twin; with a
+    reachable mesh the real collectives run (1D ppermute ring vs the 2D
+    slab ring + its pad boundary).
+
+    The output is micro_bench-shaped ({"platform", "ops"}) so
+    ``metrics_report --diff`` gates it directly: produce side A with
+    ``--side 1d`` and side B with ``--side 2d`` — the ``_1d``/``_2d``
+    suffixes canonicalize to one shared metric key, exactly the
+    fused-edge micro gate pattern."""
+    import jax
+    import jax.numpy as jnp
+
+    from neutronstarlite_tpu.graph.storage import build_graph
+    from neutronstarlite_tpu.graph.synthetic import synthetic_power_law_graph
+    from neutronstarlite_tpu.parallel.dist_graph import DistGraph
+    from neutronstarlite_tpu.parallel.dist_ring_blocked import (
+        RingBlockedPair,
+        default_ring_vt,
+        dist_ring2d_gather_dst_from_src,
+        dist_ring_blocked_gather_dst_from_src,
+        dist_ring_blocked_gather_simulated,
+    )
+    from neutronstarlite_tpu.parallel.mesh import (
+        FEATURE_AXIS,
+        VERTEX_AXIS,
+        make_mesh,
+        make_mesh2d,
+    )
+    from neutronstarlite_tpu.parallel.partitioner import pad_feature_cols
+    from neutronstarlite_tpu.tools.wire_accounting import predict_mesh
+
+    P = pv * pf
+    if simulate is None:
+        simulate = len(jax.devices()) < P
+    e_num = v_num * avg_degree
+    src, dst = synthetic_power_law_graph(v_num, e_num, seed=seed)
+    g = build_graph(src, dst, v_num, weight="gcn_norm")
+    rng = np.random.default_rng(seed)
+
+    def loss_of(fn):
+        return jax.jit(jax.value_and_grad(lambda x: (fn(x) ** 2).sum()))
+
+    legs = {}
+    if side in ("both", "1d"):
+        d1 = DistGraph.build(g, P)
+        p1 = RingBlockedPair.build(d1, vt=default_ring_vt(d1.vp, kernel_tile))
+        xh = d1.pad_vertex_array(
+            rng.standard_normal((v_num, f)).astype(np.float32)
+        )
+        if simulate:
+            fn = loss_of(lambda x: dist_ring_blocked_gather_simulated(p1, x))
+            x1 = jnp.asarray(xh)
+        else:
+            from jax.sharding import NamedSharding, PartitionSpec as PS
+            from neutronstarlite_tpu.parallel.mesh import PARTITION_AXIS
+
+            m1 = make_mesh(P)
+            p1s = p1.shard(m1)
+            fn = loss_of(
+                lambda x: dist_ring_blocked_gather_dst_from_src(m1, p1s, x)
+            )
+            x1 = jax.device_put(
+                jnp.asarray(xh), NamedSharding(m1, PS(PARTITION_AXIS, None))
+            )
+        pred1 = predict_mesh(g, P, 1, [f])
+        legs["mesh_exchange_1d"] = (fn, x1, pred1)
+    if side in ("both", "2d"):
+        d2 = DistGraph.build(g, pv)
+        p2 = RingBlockedPair.build(d2, vt=default_ring_vt(d2.vp, kernel_tile))
+        xh = pad_feature_cols(
+            d2.pad_vertex_array(
+                rng.standard_normal((v_num, f)).astype(np.float32)
+            ),
+            pf,
+        )
+        if simulate:
+            fn = loss_of(lambda x: dist_ring_blocked_gather_simulated(p2, x))
+            x2 = jnp.asarray(xh)
+        else:
+            from jax.sharding import NamedSharding, PartitionSpec as PS
+
+            m2 = make_mesh2d(pv, pf)
+            p2s = p2.shard(m2, axis=VERTEX_AXIS)
+            fn = loss_of(
+                lambda x: dist_ring2d_gather_dst_from_src(m2, p2s, x, pf=pf)
+            )
+            x2 = jax.device_put(
+                jnp.asarray(xh),
+                NamedSharding(m2, PS(VERTEX_AXIS, FEATURE_AXIS)),
+            )
+        pred2 = predict_mesh(g, pv, pf, [f])
+        legs["mesh_exchange_2d"] = (fn, x2, pred2)
+
+    ops = {}
+    for name, (fn, x, pred) in legs.items():
+        val, grad = fn(x)  # compile
+        jax.block_until_ready(grad)
+        t0 = time.time()
+        for _ in range(steps):
+            val, grad = fn(x)
+        jax.block_until_ready(grad)
+        ops[name] = {
+            "ms": round((time.time() - t0) / steps * 1e3, 4),
+            "wire_bytes_per_dev_layer": pred["bytes_per_epoch"],
+            "peak_resident_feature_bytes": pred[
+                "peak_resident_feature_bytes"
+            ],
+            "slab_widths": pred["slab_widths"],
+            "check": float(val),
+        }
+    return {
+        "platform": str(jax.devices()[0]),
+        "ops": ops,
+        "meta": {
+            "v_num": v_num, "e_num": int(g.e_num), "feature": f,
+            "pv": pv, "pf": pf, "simulated": bool(simulate),
+        },
+    }
+
+
 def ring_step_times(rbe, f: int, steps: int, seed: int = 5):
     """Per-ring-hop COMPUTE time, measured standalone: one jitted
     aggregate of device 0's stacked tables for each work step over a
@@ -297,11 +424,36 @@ def main(argv=None) -> int:
         help="bench the attention/edge family instead: eager mirror GAT "
         "chain vs the ring-pipelined fused edge kernel (KERNEL:fused_edge)",
     )
+    ap.add_argument(
+        "--mesh", default="",
+        help="Pv,Pf — bench the 1D layout (Pv*Pf vertex partitions) vs "
+        "the 2D (vertex x feature) mesh layout instead (sim twins on the "
+        "CPU rig, real collectives when a mesh is reachable); emits "
+        "micro_bench-shaped JSON metrics_report --diff can gate",
+    )
+    ap.add_argument(
+        "--side", default="both", choices=("both", "1d", "2d"),
+        help="with --mesh: emit one leg only (produce each --diff side "
+        "with its own leg so the _1d/_2d suffixes canonicalize to a "
+        "shared key)",
+    )
     args = ap.parse_args(argv)
 
     from neutronstarlite_tpu.utils.platform import honor_platform_env
 
     honor_platform_env()
+    if args.mesh:
+        from neutronstarlite_tpu.parallel.partitioner import MeshSpec
+
+        spec = MeshSpec.parse(args.mesh)
+        out = bench_mesh(
+            args.vertices, args.avg_degree, args.feature, spec.pv, spec.pf,
+            args.steps, kernel_tile=args.kernel_tile, side=args.side,
+        )
+        # ONE line (the micro_bench convention): metrics_report's --diff
+        # side detection parses single-line JSON objects
+        print(json.dumps(out))
+        return 0
     bench = bench_edge_family if args.edge_family else bench_layers
     out = bench(
         args.vertices, args.avg_degree, args.feature, args.partitions,

@@ -204,11 +204,15 @@ class RingBlockedEll:
             int(math.prod(n.shape)) for levels in self.nbr for n in levels
         )
 
-    def shard(self, mesh: Mesh) -> "RingBlockedEll":
+    def shard(self, mesh: Mesh, axis: str = PARTITION_AXIS) -> "RingBlockedEll":
+        """``axis`` is the mesh axis the step tables shard over: the 1D
+        ``p`` axis, or the 2D partitioner's vertex axis (the tables are
+        then REPLICATED over the feature axis — every feature slab runs
+        the same schedule)."""
         from jax.sharding import NamedSharding
 
         def put(a):
-            spec = PS(PARTITION_AXIS, *([None] * (a.ndim - 1)))
+            spec = PS(axis, *([None] * (a.ndim - 1)))
             return jax.device_put(a, NamedSharding(mesh, spec))
 
         return RingBlockedEll(
@@ -262,18 +266,20 @@ class RingBlockedPair:
             "bwd_waste_ratio": bwd / max(real_edges, 1),
         }
 
-    def shard(self, mesh: Mesh) -> "RingBlockedPair":
-        return RingBlockedPair(fwd=self.fwd.shard(mesh), bwd=self.bwd.shard(mesh))
+    def shard(self, mesh: Mesh, axis: str = PARTITION_AXIS) -> "RingBlockedPair":
+        return RingBlockedPair(
+            fwd=self.fwd.shard(mesh, axis), bwd=self.bwd.shard(mesh, axis)
+        )
 
 
-def _flatten_tables(rbe: RingBlockedEll):
+def _flatten_tables(rbe: RingBlockedEll, axis: str = PARTITION_AXIS):
     """(flat array list, in_specs, per-step level counts) — the shard_map
     argument layout; the body re-groups by the static count list."""
     flat, specs = [], []
     for s in range(rbe.partitions):
         for a in (*rbe.nbr[s], *rbe.wgt[s], *rbe.dst_row[s]):
             flat.append(a)
-            specs.append(PS(PARTITION_AXIS, *([None] * (a.ndim - 1))))
+            specs.append(PS(axis, *([None] * (a.ndim - 1))))
     counts = [len(rbe.nbr[s]) for s in range(rbe.partitions)]
     return flat, specs, counts
 
@@ -300,6 +306,7 @@ def _regroup_tables(tables, counts, P):
 def _ring_blocked_apply(
     mesh: Mesh, rbe: RingBlockedEll, x: jax.Array,
     wire_dtype: Optional[jnp.dtype] = None, mode: str = "full",
+    axes: tuple = (PARTITION_AXIS, None),
 ) -> jax.Array:
     """The double-buffered shard_map ring (one direction).
 
@@ -308,11 +315,20 @@ def _ring_blocked_apply(
     every step's blocked tables against the resident shard (identical
     table work, zero hops), ``exchange_only`` runs the bare ppermute hop
     chain (returning the final in-flight buffer so XLA cannot drop the
-    dependent chain). ``full`` is the production overlapped body."""
+    dependent chain). ``full`` is the production overlapped body.
+
+    ``axes = (vertex_axis, feature_axis)``: the mesh axis the ring
+    rotates over, and the axis ``x``'s feature columns shard over —
+    ``None`` on the 1D mesh (features replicated, today's layout),
+    the partitioner's feature axis on a 2D mesh, where the IDENTICAL
+    body runs per feature slab (the aggregation is feature-column-
+    independent) and every buffer inside the body is ``[vp, f/Pf]`` —
+    the hop ships a slab, never the full width."""
+    vertex_axis, feature_axis = axes
     P = rbe.partitions
     perm = ring_perm(P, rbe.direction)
     n_hops = rbe.n_transfers()
-    flat, specs, counts = _flatten_tables(rbe)
+    flat, specs, counts = _flatten_tables(rbe, vertex_axis)
 
     def body(*args):
         xs = args[-1]
@@ -333,7 +349,7 @@ def _ring_blocked_apply(
             # exactly once — when first shipped (re-casts are identity).
             if send:
                 sent = cur if wire_dtype is None else cur.astype(wire_dtype)
-                nxt = lax.ppermute(sent, PARTITION_AXIS, perm)
+                nxt = lax.ppermute(sent, vertex_axis, perm)
             if mode != "exchange_only" and s in per_step:
                 view = rbe._device_step_view(*per_step[s])
                 # s>0 table work always consumes a wire-dtype buffer: in
@@ -355,8 +371,8 @@ def _ring_blocked_apply(
     fn = shard_map(
         body,
         mesh=mesh,
-        in_specs=tuple(specs) + (PS(PARTITION_AXIS, None),),
-        out_specs=PS(PARTITION_AXIS, None),
+        in_specs=tuple(specs) + (PS(vertex_axis, feature_axis),),
+        out_specs=PS(vertex_axis, feature_axis),
     )
     return fn(*flat, x)
 
@@ -378,6 +394,54 @@ def dist_ring_blocked_gather_dst_from_src(
 
     def apply_bwd(_, g):
         return (_ring_blocked_apply(mesh, pair.bwd, g, wire_dtype),)
+
+    apply.defvjp(apply_fwd, apply_bwd)
+    return apply(x)
+
+
+def _ring2d_apply(
+    mesh: Mesh, rbe: RingBlockedEll, x: jax.Array,
+    wire_dtype: Optional[jnp.dtype], pf: int, mode: str = "full",
+) -> jax.Array:
+    """One direction of the 2D ring: the SAME body as the 1D path, with
+    the rotation over the partitioner's vertex axis and ``x``'s feature
+    columns sharded ``pf`` ways over the feature axis. A width that does
+    not divide ``pf`` is zero-padded to the next multiple around the
+    shard_map boundary (shard_map requires even division; the pad
+    columns aggregate to zero and are sliced back off) — the body never
+    sees a full-width ``[vp, f]`` buffer either way."""
+    from neutronstarlite_tpu.parallel.mesh import FEATURE_AXIS, VERTEX_AXIS
+    from neutronstarlite_tpu.parallel.partitioner import padded_width
+
+    f = x.shape[1]
+    fp = padded_width(f, pf)
+    xin = jnp.pad(x, ((0, 0), (0, fp - f))) if fp != f else x
+    out = _ring_blocked_apply(
+        mesh, rbe, xin, wire_dtype, mode,
+        axes=(VERTEX_AXIS, FEATURE_AXIS),
+    )
+    return out[:, :f] if fp != f else out
+
+
+def dist_ring2d_gather_dst_from_src(
+    mesh: Mesh, pair: RingBlockedPair, x: jax.Array,
+    wire_dtype: Optional[jnp.dtype] = None, pf: int = 1,
+) -> jax.Array:
+    """The 2D-mesh twin of :func:`dist_ring_blocked_gather_dst_from_src`:
+    ``[Pv*vp, f]`` (vertex x feature)-sharded -> aggregated, hand-paired
+    with the reverse ring over the transposed tables. With ``pf == 1``
+    (a ``(Pv, 1)`` mesh) this is bit-for-bit the 1D schedule — the
+    partitioner's degenerate layout IS the existing ring."""
+
+    @jax.custom_vjp
+    def apply(x):
+        return _ring2d_apply(mesh, pair.fwd, x, wire_dtype, pf)
+
+    def apply_fwd(x):
+        return apply(x), None
+
+    def apply_bwd(_, g):
+        return (_ring2d_apply(mesh, pair.bwd, g, wire_dtype, pf),)
 
     apply.defvjp(apply_fwd, apply_bwd)
     return apply(x)
@@ -459,9 +523,12 @@ def measure_overlap(
     mesh: Optional[Mesh] = None,
     wire_dtype: Optional[jnp.dtype] = None,
     repeats: int = 3,
+    axes: tuple = (PARTITION_AXIS, None),
 ) -> dict:
     """Measured ring overlap efficiency: how much of the hop (exchange)
-    time hides under the blocked-kernel compute.
+    time hides under the blocked-kernel compute. ``axes`` selects the
+    mesh axes exactly as in ``_ring_blocked_apply`` (a 2D-mesh caller
+    passes the partitioner's (vertex, feature) pair).
 
     Times three warm programs over the same input — the production
     overlapped body, its compute-only half (identical table work, no
@@ -487,7 +554,7 @@ def measure_overlap(
         if mesh is not None:
             fn = jax.jit(
                 lambda a: _ring_blocked_apply(mesh, rbe, a, wire_dtype,
-                                              mode=mode)
+                                              mode=mode, axes=axes)
             )
         else:
             fn = jax.jit(
@@ -520,22 +587,37 @@ def measure_overlap(
     }
 
 
-def ring_wire_plan(rbe: RingBlockedEll, widths, itemsize: int) -> dict:
+def ring_wire_plan(rbe: RingBlockedEll, widths, itemsize: int,
+                   pf: int = 1) -> dict:
     """Static per-epoch wire facts for obs/report consumers: one entry per
     rotation hop (the transfer that delivers the shard step s consumes),
-    each shipping [vp, width] per layer exchange. ``sum(bytes)`` over the
+    each shipping [vp, slab_width(width, pf)] per layer exchange (the 1D
+    mesh is pf=1: the slab IS the full width). ``sum(bytes)`` over the
     plan equals tools/wire_accounting.exchange_rows_per_device *
-    sum(widths) * itemsize when no suffix is skipped."""
-    per_hop = rbe.vp * sum(widths) * itemsize
+    sum(slabs) * itemsize when no suffix is skipped; ``slab_cols`` (the
+    feature-slab columns each hop carries across all layer exchanges)
+    rides every ring_step record so the 2D layout is reconstructable
+    from the stream."""
+    from neutronstarlite_tpu.parallel.partitioner import slab_width
+
+    slabs = [slab_width(w, pf) for w in widths]
+    per_hop = rbe.vp * sum(slabs) * itemsize
     skipped = set(rbe.skipped_steps())
     return {
         "transfers": rbe.n_transfers(),
         "work_steps": rbe.work_steps(),
         "skipped_steps": sorted(skipped),
         "rows_per_transfer": rbe.vp,
+        "slab_widths": slabs,
+        "slab_cols": sum(slabs),
         "steps": [
-            {"step": s, "bytes": per_hop, "skipped": s in skipped}
+            {"step": s, "bytes": per_hop, "skipped": s in skipped,
+             "slab_cols": sum(slabs)}
             for s in range(1, rbe.n_transfers() + 1)
         ],
-        "peak_resident_rows": 2 * rbe.vp,
+        "peak_resident_rows": min(2, rbe.partitions) * rbe.vp,
+        "peak_resident_feature_bytes": (
+            min(2, rbe.partitions) * rbe.vp
+            * (max(slabs) if slabs else 0) * itemsize
+        ),
     }

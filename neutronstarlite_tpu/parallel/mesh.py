@@ -24,6 +24,11 @@ from jax.sharding import Mesh
 from neutronstarlite_tpu.utils.logging import get_logger
 
 PARTITION_AXIS = "p"
+# the 2D (vertex x feature) mesh axes (parallel/partitioner.py): the
+# vertex ring rotates over VERTEX_AXIS, feature slabs shard over
+# FEATURE_AXIS (its all-reduce fires where the blocked kernels contract)
+VERTEX_AXIS = "v"
+FEATURE_AXIS = "f"
 log = get_logger("mesh")
 _dist_initialized = False
 
@@ -131,3 +136,66 @@ def make_mesh(partitions: Optional[int] = None) -> Mesh:
             chosen.extend(by_proc[pid][:per])
         return Mesh(np.asarray(chosen), (PARTITION_AXIS,))
     return Mesh(np.asarray(devices[:n]), (PARTITION_AXIS,))
+
+
+def validate_mesh_request(pv: int, pf: int) -> None:
+    """Loud mesh-shape validation at the lifecycle funnel: a requested
+    ``Pv x Pf`` that exceeds the visible device count dies HERE with a
+    one-line error naming both numbers, instead of a deep shard_map trace
+    later. Sim meshes honor ``jax_num_cpu_devices`` /
+    ``--xla_force_host_platform_device_count`` (utils/platform.py): the
+    count checked is whatever ``jax.devices()`` reports on this rig."""
+    if pv < 1 or pf < 1:
+        raise ValueError(
+            f"MESH:{pv},{pf} is not a mesh: both axes must be >= 1"
+        )
+    n = pv * pf
+    have = len(jax.devices())
+    if n > have:
+        raise ValueError(
+            f"MESH:{pv},{pf} needs {n} devices but only {have} are "
+            f"visible on this rig (grow a sim mesh with "
+            f"jax_num_cpu_devices / --xla_force_host_platform_device_count"
+            f", or shrink the mesh)"
+        )
+
+
+def make_mesh2d(pv: int, pf: int) -> Mesh:
+    """2D ``(vertex, feature)`` mesh over ``pv * pf`` devices, ICI/DCN-
+    aware for multi-host: the FEATURE axis stays intra-host (its
+    all-reduce blocks every layer's contraction, so it must ride ICI)
+    while the VERTEX axis spans hosts — the ring hop it carries is
+    overlapped with compute (dist_ring_blocked) and tolerates DCN
+    latency, the T5X ``create_hybrid_device_mesh`` assignment
+    (SNIPPETS.md [1]-[2]) with (vertex, feature) in the (data, model)
+    roles. Single-host: a host-major reshape of the device list (the
+    degenerate hybrid mesh)."""
+    validate_mesh_request(pv, pf)
+    devices = _host_major(jax.devices())
+    n = pv * pf
+    procs = jax.process_count()
+    if procs > 1:
+        if n != len(devices) or pv % procs != 0:
+            raise ValueError(
+                f"multi-host MESH:{pv},{pf} must span all {len(devices)} "
+                f"global devices with the vertex axis a multiple of the "
+                f"process count {procs} (each host contributes whole "
+                "vertex-partition rows; the feature axis never crosses "
+                "DCN)"
+            )
+        try:
+            from jax.experimental import mesh_utils
+
+            dm = mesh_utils.create_hybrid_device_mesh(
+                (pv // procs, pf), (procs, 1), devices=devices
+            )
+            return Mesh(dm, (VERTEX_AXIS, FEATURE_AXIS))
+        except Exception as e:  # pragma: no cover - topology-dependent
+            log.warning(
+                "create_hybrid_device_mesh failed (%s); falling back to "
+                "the host-major reshape (feature axis may cross DCN)", e,
+            )
+    return Mesh(
+        np.asarray(devices[:n]).reshape(pv, pf),
+        (VERTEX_AXIS, FEATURE_AXIS),
+    )

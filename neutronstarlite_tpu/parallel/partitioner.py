@@ -1,0 +1,473 @@
+"""2D (vertex x feature) mesh partitioner: logical axis rules over a
+``(Pv, Pf)`` device mesh.
+
+Every distributed path before this module sharded vertices over one mesh
+axis and fully REPLICATED the feature/hidden dimension: per-device
+feature memory scaled with ``f`` and the ring's hop granularity was
+fixed at whole ``[vp, f]`` shards — the structural limit NeutronStar
+inherited from its chunk-per-source-partition design. This module adopts
+the T5X partitioner pattern (SNIPPETS.md [1]-[3]: logical axis rules,
+``create_hybrid_device_mesh``, NamedSharding) for the (vertex x feature)
+plane:
+
+- **logical axis rules** map array-semantic axes (``vertex``,
+  ``feature``/``hidden``, ``replicated``) onto the physical mesh axes
+  (:data:`~neutronstarlite_tpu.parallel.mesh.VERTEX_AXIS` /
+  :data:`~neutronstarlite_tpu.parallel.mesh.FEATURE_AXIS`), so trainers
+  request placements by meaning, not by mesh coordinates;
+- **the ring becomes one emitted layout**: ``(Pv, 1)`` is exactly the
+  existing ``ring_blocked`` schedule (bitwise — the same shard_map body
+  runs, the feature axis just has size 1); ``Pf > 1`` runs the SAME
+  vertex ring over ``f/Pf``-wide feature slabs (each device's resident
+  slab is ``[vp, f/Pf]``; the hop ships a slab, not the full width), and
+  the feature axis is reduced ONLY where the blocked kernels contract —
+  the ``agg @ W`` matmul, where XLA inserts the feature-axis all-reduce
+  (VersaGNN's intra-feature parallelism, PAPERS.md);
+- **a collective-free sim twin** (the ``ring_blocked_sim`` pattern): the
+  aggregation is feature-column-independent, so the full-width sim ring
+  is bitwise-equal to the slab-sharded collective ring; the one place 2D
+  changes the math — the contraction's partial-sum-then-psum order — is
+  mirrored by :meth:`Partitioner.contract`'s slab-partial summation, so
+  the 1-core rig validates the 2D numerics end to end.
+
+Feature widths that do not divide ``Pf`` are zero-padded to the next
+multiple (``padded_width``): the input feature slab gains zero columns
+and the first layer's feature-dim parameters gain zero rows
+(:func:`pad_params_feature_dim`) — both provably stay zero through
+training (zero inputs x zero weights give zero activations, gradients,
+and Adam updates), so the padded model computes the unpadded math.
+
+Config: ``MESH:Pv,Pf`` (or ``PvxPf``) / ``MESH:auto`` (the tune/
+autotuner chooses among the factorizations of PARTITIONS), env override
+``NTS_MESH`` folded in at the lifecycle funnel
+(:func:`fold_mesh_env`). Memory math and the when-does-Pf-win argument:
+docs/PERF.md "2D (vertex x feature) mesh".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from neutronstarlite_tpu.parallel.mesh import (
+    FEATURE_AXIS,
+    VERTEX_AXIS,
+    make_mesh2d,
+)
+from neutronstarlite_tpu.utils.logging import get_logger
+
+log = get_logger("partitioner")
+
+# T5X-style logical axis rules: (logical axis name -> mesh axis | None).
+# First match wins; None = replicated. Trainers name MEANING ("vertex",
+# "feature"), the rules own the physical assignment — re-pointing
+# "hidden" at a third mesh axis is a one-line change here, not a sweep
+# over every trainer.
+LOGICAL_AXIS_RULES: Tuple[Tuple[str, Optional[str]], ...] = (
+    ("vertex", VERTEX_AXIS),
+    ("feature", FEATURE_AXIS),
+    ("hidden", FEATURE_AXIS),
+    ("embed", FEATURE_AXIS),
+    ("replicated", None),
+)
+
+
+def logical_to_mesh_axes(
+    logical_axes: Sequence[Optional[str]],
+    rules: Sequence[Tuple[str, Optional[str]]] = LOGICAL_AXIS_RULES,
+) -> Tuple[Optional[str], ...]:
+    """Map logical axis names to mesh axis names through ``rules`` (the
+    T5X ``logical_to_mesh_axes`` contract, first match wins; ``None``
+    stays unsharded). Unknown names refuse loudly — a typo'd logical
+    axis silently replicating is the mis-benchmark the funnel forbids."""
+    table = dict(rules)
+    out = []
+    for name in logical_axes:
+        if name is None:
+            out.append(None)
+            continue
+        if name not in table:
+            raise ValueError(
+                f"unknown logical axis {name!r}; known: "
+                f"{sorted(table)} (extend LOGICAL_AXIS_RULES)"
+            )
+        out.append(table[name])
+    return tuple(out)
+
+
+def slab_width(width: int, pf: int) -> int:
+    """Per-device feature-slab columns for a ``width``-wide exchange on a
+    ``Pf``-way feature axis: ``ceil(width / pf)`` — THE one definition
+    shared by the trainer's live wire gauges, ``ring_wire_plan``, and
+    ``tools/wire_accounting.predict_mesh``, so prediction and telemetry
+    can never disagree."""
+    pf = max(int(pf), 1)
+    return -(-int(width) // pf)
+
+
+def padded_width(width: int, pf: int) -> int:
+    """``width`` rounded up to a multiple of ``pf`` (the zero-padded
+    feature width the 2D layout actually ships/stores)."""
+    return slab_width(width, pf) * max(int(pf), 1)
+
+
+# ---- MESH cfg value ---------------------------------------------------------
+
+_MESH_RE = re.compile(r"^(\d+)\s*[x,]\s*(\d+)$")
+
+
+def normalize_mesh_value(value: str) -> str:
+    """Canonicalize a MESH cfg/env value: '' | 'auto' | 'Pv,Pf' (the
+    'PvxPf' spelling collapses to the comma form). Anything else refuses
+    loudly at parse time — the PRECISION-typo lesson."""
+    v = (value or "").strip().lower()
+    if v in ("", "auto"):
+        return v
+    m = _MESH_RE.match(v)
+    if not m:
+        raise ValueError(
+            f"MESH must be 'Pv,Pf' (or 'PvxPf'), 'auto', or empty, "
+            f"got {value!r}"
+        )
+    pv, pf = int(m.group(1)), int(m.group(2))
+    if pv < 1 or pf < 1:
+        raise ValueError(
+            f"MESH:{value} is not a mesh: both axes must be >= 1"
+        )
+    return f"{pv},{pf}"
+
+
+def fold_mesh_env(cfg) -> None:
+    """``NTS_MESH`` env override (launcher parity, the NTS_WIRE_DTYPE
+    pattern) folded INTO ``cfg.mesh`` at the head of the lifecycle
+    funnel, so the env spelling flows through the same auto-resolution
+    and validity checks the cfg key gets and can never bypass them.
+    Folds ONCE per cfg object: the funnel runs twice (init_graph +
+    _finalize_datum), and re-folding ``NTS_MESH=auto`` would clobber
+    the concrete value the tuner resolved on the first pass — a second
+    (cached) decision per run."""
+    if getattr(cfg, "_nts_mesh_folded", False):
+        return
+    raw = os.environ.get("NTS_MESH", "")
+    if raw.strip():
+        cfg.mesh = normalize_mesh_value(raw)
+    cfg._nts_mesh_folded = True
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """One concrete 2D mesh shape: ``pv`` vertex partitions x ``pf``
+    feature slabs."""
+
+    pv: int
+    pf: int
+
+    @property
+    def devices(self) -> int:
+        return self.pv * self.pf
+
+    def label(self) -> str:
+        """Human/report spelling, e.g. ``2x2`` (the mesh.shape gauge)."""
+        return f"{self.pv}x{self.pf}"
+
+    def cfg_value(self) -> str:
+        """The canonical cfg spelling, e.g. ``2,2``."""
+        return f"{self.pv},{self.pf}"
+
+    @staticmethod
+    def parse(value: str) -> "MeshSpec":
+        v = normalize_mesh_value(value)
+        if v in ("", "auto"):
+            raise ValueError(
+                f"MESH value {value!r} is not a concrete shape "
+                "(auto must resolve through the tuner first)"
+            )
+        pv, pf = (int(t) for t in v.split(","))
+        return MeshSpec(pv=pv, pf=pf)
+
+
+def mesh_spec_of(cfg) -> Optional[MeshSpec]:
+    """The concrete MeshSpec a cfg requests, or None (legacy 1D). An
+    unresolved ``auto`` here means the tuner never ran — refuse loudly
+    (the tune/select off-mode contract already catches this earlier;
+    this is the backstop)."""
+    v = normalize_mesh_value(getattr(cfg, "mesh", "") or "")
+    if not v:
+        return None
+    if v == "auto":
+        raise ValueError(
+            "MESH:auto reached build_model unresolved: set NTS_TUNE="
+            "cached or NTS_TUNE=measure so the autotuner can choose the "
+            "shape, or pin MESH:Pv,Pf"
+        )
+    return MeshSpec.parse(v)
+
+
+def check_mesh_cfg(cfg) -> None:
+    """Mesh-vs-knob consistency at the lifecycle funnel (probed by the
+    tune space too, so the tuner can never propose what this refuses):
+    a concrete MESH rides the ring-pipelined layout only, and PARTITIONS
+    (when set) must agree with ``Pv * Pf``."""
+    spec = mesh_spec_of(cfg)
+    if spec is None:
+        return
+    dist_path = getattr(cfg, "dist_path", "")
+    if dist_path not in ("", "auto", "ring_blocked", "ring_blocked_sim"):
+        raise ValueError(
+            f"MESH:{spec.cfg_value()} rides the ring-pipelined layout "
+            f"(parallel/partitioner.py) and cannot combine with "
+            f"DIST_PATH:{dist_path}: the {dist_path} family replicates "
+            "the feature axis"
+        )
+    if getattr(cfg, "optim_kernel", False):
+        raise ValueError(
+            f"MESH:{spec.cfg_value()} cannot combine with OPTIM_KERNEL:1 "
+            "(the all_gather ELL family materializes every [vp, f] shard "
+            "full-width); drop one"
+        )
+    comm = getattr(cfg, "comm_layer", "auto")
+    if comm not in ("", "auto", "ring"):
+        raise ValueError(
+            f"MESH:{spec.cfg_value()} cannot combine with "
+            f"COMM_LAYER:{comm}: the mirror/ell exchanges ship full-width "
+            "feature rows; the 2D layout is ring-only"
+        )
+    parts = int(getattr(cfg, "partitions", 0) or 0)
+    if parts and parts != spec.devices:
+        raise ValueError(
+            f"MESH:{spec.cfg_value()} needs Pv*Pf = {spec.devices} "
+            f"devices but PARTITIONS:{parts} disagrees — set "
+            f"PARTITIONS:{spec.devices} or drop it (0 = derive from the "
+            "mesh)"
+        )
+
+
+# ---- the partitioner --------------------------------------------------------
+
+
+class Partitioner:
+    """Placement + contraction rules for one resolved mesh.
+
+    ``mesh`` is a 2D ``(v, f)`` jax Mesh, or None for the collective-free
+    sim twin (single-core CI: logical host-backed arrays, the
+    ``ring_blocked_sim`` placement convention). Everything a trainer
+    needs from the 2D layout funnels through here: NamedShardings by
+    LOGICAL axis name, the ``agg @ W`` contraction (slab-partial in sim,
+    plain matmul + XLA's feature-axis all-reduce on a real mesh), and
+    the activation re-shard constraint after each layer."""
+
+    def __init__(self, spec: MeshSpec, mesh=None):
+        self.spec = spec
+        self.mesh = mesh
+
+    @property
+    def pv(self) -> int:
+        return self.spec.pv
+
+    @property
+    def pf(self) -> int:
+        return self.spec.pf
+
+    @staticmethod
+    def build(spec: MeshSpec, simulate: bool) -> "Partitioner":
+        if simulate:
+            return Partitioner(spec, mesh=None)
+        return Partitioner(spec, mesh=make_mesh2d(spec.pv, spec.pf))
+
+    # ---- placements by logical axis name ---------------------------------
+    def sharding(self, *logical_axes: Optional[str]):
+        """NamedSharding for an array whose axes carry the given LOGICAL
+        names (None = replicated axis); no-axes = fully replicated. Only
+        meaningful on a real mesh."""
+        from jax.sharding import NamedSharding, PartitionSpec as PS
+
+        if self.mesh is None:
+            raise ValueError("sim partitioner has no device mesh")
+        return NamedSharding(
+            self.mesh, PS(*logical_to_mesh_axes(logical_axes))
+        )
+
+    def constrain(self, x):
+        """Re-shard an activation to (vertex, feature) — the per-layer
+        layout pin after each contraction, so the next exchange starts
+        from slab-resident activations instead of whatever GSPMD chose.
+        Widths that do not divide ``Pf`` stay feature-replicated (they
+        are the narrow hidden/logit tails; the wide slabs are the ones
+        that matter). No-op in sim."""
+        import jax
+
+        if self.mesh is None or self.pf == 1:
+            return x
+        if x.ndim < 2 or x.shape[-1] % self.pf != 0:
+            return jax.lax.with_sharding_constraint(
+                x, self.sharding("vertex")
+            )
+        return jax.lax.with_sharding_constraint(
+            x, self.sharding("vertex", "feature")
+        )
+
+    # ---- the feature-axis contraction ------------------------------------
+    def contract(self, a, w):
+        """``a @ w`` where ``a``'s last axis is the (possibly zero-
+        padded) feature axis. Pads ``w`` with zero ROWS when the model
+        parameter is narrower than the padded activation (the padded
+        model computes the unpadded math — see module docstring). On a
+        real mesh this is a plain matmul: XLA contracts the
+        feature-sharded axis with an all-reduce over FEATURE_AXIS,
+        exactly where the blocked kernels contract. In sim it mirrors
+        that schedule explicitly: one partial matmul per feature slab,
+        summed in slab order (the psum's reduction tree, made
+        deterministic), so the 1-core rig exercises the 2D partial-sum
+        numerics the collective path would produce."""
+        import jax.numpy as jnp
+
+        fin = a.shape[-1]
+        if w.shape[0] != fin:
+            if w.shape[0] > fin:
+                raise ValueError(
+                    f"contract: activation width {fin} < parameter rows "
+                    f"{w.shape[0]} (mesh padding never shrinks)"
+                )
+            w = jnp.pad(
+                w, ((0, fin - w.shape[0]),) + ((0, 0),) * (w.ndim - 1)
+            )
+        if self.mesh is not None or self.pf == 1:
+            return a @ w
+        ws = slab_width(fin, self.pf)
+        acc = None
+        for q in range(self.pf):
+            lo = q * ws
+            hi = min(lo + ws, fin)
+            if lo >= hi:
+                break
+            part = a[..., lo:hi] @ w[lo:hi]
+            acc = part if acc is None else acc + part
+        return acc
+
+
+def pad_feature_cols(a: np.ndarray, pf: int) -> np.ndarray:
+    """Zero-pad a host ``[N, f]`` feature array to ``[N, padded_width(f,
+    pf)]`` so the feature axis divides the mesh (shard_map and
+    NamedSharding both require even division; the zero columns provably
+    stay zero — module docstring)."""
+    pf = max(int(pf), 1)
+    f = a.shape[-1]
+    fp = padded_width(f, pf)
+    if fp == f:
+        return a
+    return np.pad(a, [(0, 0)] * (a.ndim - 1) + [(0, fp - f)])
+
+
+def pad_params_feature_dim(params, pad_keys: Sequence[str], fin: int,
+                           pf: int):
+    """Zero-pad the INPUT-feature dimension of layer 0's parameters to
+    ``padded_width(fin, pf)``: every array under a ``pad_keys`` entry of
+    ``params[0]`` whose leading dim equals ``fin`` gains zero rows.
+    ``pad_keys`` is the trainer's explicit list (``mesh_pad_keys``) — no
+    shape guessing, so a hidden width that happens to equal ``fin``
+    cannot be corrupted. Zero rows meet zero input columns: activations,
+    gradients, and Adam updates on the padding are identically zero, so
+    the padded model trains the unpadded math bit-for-bit on the real
+    coordinates."""
+    import jax
+    import jax.numpy as jnp
+
+    fp = padded_width(fin, pf)
+    if fp == int(fin) or not params:
+        return params
+
+    def pad(a):
+        if getattr(a, "ndim", 0) >= 1 and a.shape[0] == int(fin):
+            return jnp.pad(
+                jnp.asarray(a),
+                ((0, fp - int(fin)),) + ((0, 0),) * (a.ndim - 1),
+            )
+        return a
+
+    out = list(params)
+    layer0 = dict(out[0])
+    for key in pad_keys:
+        if key in layer0:
+            layer0[key] = jax.tree.map(pad, layer0[key])
+    out[0] = layer0
+    return out
+
+
+def unpad_params_feature_dim(params, pad_keys: Sequence[str], fin: int,
+                             pf: int):
+    """Inverse of :func:`pad_params_feature_dim`: slice layer 0's
+    ``pad_keys`` arrays back to ``fin`` leading rows. Checkpoints store
+    the UNPADDED (canonical) shapes, so a 2D run's checkpoint restores
+    into any layout — the 1D path, a different Pf, or the reshaped mesh
+    an elastic replan emits (the padded rows are identically zero, so
+    nothing is lost)."""
+    fp = padded_width(fin, pf)
+    if fp == int(fin) or not params:
+        return params
+
+    def unpad(a):
+        if getattr(a, "ndim", 0) >= 1 and a.shape[0] == fp:
+            return a[: int(fin)]
+        return a
+
+    import jax
+
+    out = list(params)
+    layer0 = dict(out[0])
+    for key in pad_keys:
+        if key in layer0:
+            layer0[key] = jax.tree.map(unpad, layer0[key])
+    out[0] = layer0
+    return out
+
+
+# ---- mesh-shape choice (tune prior / elastic reshape) -----------------------
+
+
+def factor_shapes(total: int) -> List[MeshSpec]:
+    """Every (pv, pf) factorization of ``total`` devices, widest vertex
+    axis first — the candidate shapes MESH:auto enumerates and the
+    elastic reshape chooses among."""
+    total = max(int(total), 1)
+    out = []
+    for pf in range(1, total + 1):
+        if total % pf == 0:
+            out.append(MeshSpec(pv=total // pf, pf=pf))
+    return out
+
+
+def choose_mesh_shape(host_graph, total: int, widths: Sequence[int],
+                      itemsize: int = 4,
+                      out_widths: Optional[Sequence[int]] = None
+                      ) -> MeshSpec:
+    """The analytically-best (pv, pf) for ``total`` devices: minimal
+    (ring exchange + feature all-reduce + peak resident slab) bytes,
+    priced by ``tools/wire_accounting.predict_mesh`` — the elastic
+    replan's reshape rule when no tune-cache entry covers the survivor
+    count. ``widths`` are the EXCHANGE widths, ``out_widths`` the
+    contraction OUTPUT widths the all-reduce term is priced at (the
+    same split the tune prior passes — leaving it to default to
+    ``widths`` over-weights the all-reduce ~f/h-fold on wide-input
+    stacks). Ties break to the larger vertex axis (the conservative,
+    1D-closest layout)."""
+    from neutronstarlite_tpu.tools.wire_accounting import predict_mesh
+
+    best = None
+    best_score = None
+    for spec in factor_shapes(total):
+        pred = predict_mesh(
+            host_graph, spec.pv, spec.pf, widths, itemsize=itemsize,
+            out_widths=out_widths,
+        )
+        score = (
+            pred["bytes_per_epoch"]
+            + pred["allreduce_bytes_per_epoch"]
+            + pred["peak_resident_feature_bytes"]
+        )
+        if best_score is None or score < best_score:
+            best, best_score = spec, score
+    return best

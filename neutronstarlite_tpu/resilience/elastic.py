@@ -287,60 +287,122 @@ class LivenessMonitor:
 
 
 def replan_survivors(toolkit, lost_partition: int) -> int:
-    """Rebuild ``toolkit``'s distributed plan for P' = P − 1 survivors.
+    """Rebuild ``toolkit``'s distributed plan for the survivors.
 
-    Re-range-partitions the host graph over P' (the lost partition's
-    vertex range is redistributed and every boundary rebalances — the
-    ``moved_vertices`` count in the replan record quantifies it), then
-    runs ``build_model()`` so the DistGraph / RingBlocks / ring skip
-    schedule / padded vertex arrays / jitted step all re-derive for the
-    degraded mesh. Params are NOT touched here — they are partition-
+    1D plan: re-range-partition the host graph over P' = P − 1 (the lost
+    partition's vertex range is redistributed and every boundary
+    rebalances — the ``moved_vertices`` count in the replan record
+    quantifies it). 2D plan (a MESH:Pv,Pf partitioner,
+    parallel/partitioner.py): the replan is a MESH RESHAPE — losing a
+    device shrinks the budget to Pv*Pf − 1 and the best (Pv', Pf') is
+    re-emitted for that count: a tuner-owned mesh (MESH:auto) re-consults
+    the decision cache through ``reconsult_for_replan`` (warm P' entry =
+    cached replay; cold = analytic prior — never a measurement
+    mid-recovery), while a pinned mesh falls back to the analytic
+    ``choose_mesh_shape`` (the pinned shape cannot exist on fewer
+    devices — a loudly-logged forced reshape). Either way
+    ``build_model()`` re-derives the DistGraph / ring skip schedule /
+    slab layout / padded vertex arrays / jitted step, and the replan
+    record carries ``from_mesh``/``to_mesh`` next to the partition
+    counts. Params are NOT touched here — they are partition-
     independent, and the supervisor restores them from the last-good
-    checkpoint over the rebuilt plan. Returns the new partition count."""
+    checkpoint over the rebuilt plan. Returns the new vertex-partition
+    count.
+
+    2D caveat: a mesh reshape renumbers EVERY vertex partition (Pv' is
+    not generally Pv − 1), so the chaos dead-set translation
+    (:func:`renumber_after_loss`) is exact only for the 1D path; a
+    second pre-registered sim death keeps missing heartbeats on the
+    reshaped plan and is re-detected there."""
     from neutronstarlite_tpu.parallel.vertex_space import reassigned_vertices
 
+    spec = getattr(toolkit, "mesh_spec", None)
     dist = getattr(toolkit, "dist", None)
     old_p = dist.partitions if dist is not None else (
         toolkit.cfg.partitions or 2
     )
-    new_p = old_p - 1
-    if new_p < 1:
+    old_total = spec.devices if spec is not None else old_p
+    new_total = old_total - 1
+    if new_total < 1:
         raise ValueError(
-            f"cannot replan a {old_p}-partition plan: no survivors"
+            f"cannot replan a {old_total}-device plan: no survivors"
         )
     old_offsets = dist.offsets.copy() if dist is not None else None
     t0 = time.perf_counter()
-    toolkit.cfg.partitions = new_p
+    toolkit.cfg.partitions = new_total
+    if spec is not None:
+        autos = getattr(toolkit, "_tune_autos", None) or set()
+        # a tuner-owned shape needs nothing here: reconsult_for_replan
+        # below restores every _tune_autos axis (mesh included) to
+        # "auto" and re-enumerates the shrunk budget's factorizations
+        # (cache hit for P' or analytic prior)
+        if "mesh" not in autos:
+            from neutronstarlite_tpu.models.gcn_dist import exchange_widths
+            from neutronstarlite_tpu.parallel.partitioner import (
+                choose_mesh_shape,
+            )
+
+            sizes = toolkit.cfg.layer_sizes()
+            if len(sizes) > 1:
+                widths = exchange_widths(
+                    getattr(type(toolkit), "eager", False), sizes
+                )
+                outs = sizes[1:]
+            else:
+                widths = sizes or [1]
+                outs = None
+            new_spec = choose_mesh_shape(
+                toolkit.host_graph, new_total, widths, out_widths=outs
+            )
+            toolkit.cfg.mesh = new_spec.cfg_value()
+            log.warning(
+                "mesh reshape: pinned MESH:%s cannot survive on %d "
+                "devices; analytic reshape -> MESH:%s",
+                spec.label(), new_total, new_spec.label(),
+            )
     # survivors renumber to 0..P'-1; a partition that ALSO died before
     # this detection stays dead under the new numbering and is detected
     # (and replanned away) on the retry
     renumber_after_loss(int(lost_partition))
-    # a trainer whose knobs were tuner-resolved (DIST_PATH:auto etc.,
-    # tune/select) re-consults the decision cache for P' BEFORE the plan
-    # rebuilds: a cached P' entry is a hit, otherwise the analytic prior
-    # decides (decision_source=prior in the tune_decision record) — the
-    # recovery path never runs measurements, a degraded cluster
-    # mid-rollback is the wrong place to benchmark
+    # a trainer whose knobs were tuner-resolved (DIST_PATH:auto / MESH:
+    # auto etc., tune/select) re-consults the decision cache for the
+    # survivor count BEFORE the plan rebuilds: a cached entry is a hit,
+    # otherwise the analytic prior decides (decision_source=prior in the
+    # tune_decision record) — the recovery path never runs measurements,
+    # a degraded cluster mid-rollback is the wrong place to benchmark
     from neutronstarlite_tpu.tune import select as tune_select
 
     tune_select.reconsult_for_replan(toolkit)
     toolkit.build_model()
     seconds = time.perf_counter() - t0
-    moved = None
     new_dist = getattr(toolkit, "dist", None)
+    new_p = new_dist.partitions if new_dist is not None else new_total
+    new_spec_built = getattr(toolkit, "mesh_spec", None)
+    moved = None
     if old_offsets is not None and new_dist is not None:
         moved = reassigned_vertices(old_offsets, new_dist.offsets)
+    mesh_fields = {}
+    if spec is not None:
+        mesh_fields["from_mesh"] = spec.label()
+        mesh_fields["to_mesh"] = (
+            new_spec_built.label() if new_spec_built is not None
+            else f"{new_p}x1"
+        )
     events.emit(
         "replan",
         from_partitions=int(old_p), to_partitions=int(new_p),
         lost=int(lost_partition), seconds=float(seconds),
         **({"moved_vertices": int(moved)} if moved is not None else {}),
+        **mesh_fields,
     )
     log.warning(
-        "survivor replan: %d -> %d partitions (lost partition %d, %s "
+        "survivor replan: %d -> %d partitions%s (lost partition %d, %s "
         "vertices re-owned, plan rebuilt in %.2fs); restoring params from "
         "the last-good checkpoint",
-        old_p, new_p, lost_partition,
+        old_p, new_p,
+        (f" (mesh {mesh_fields['from_mesh']} -> {mesh_fields['to_mesh']})"
+         if mesh_fields else ""),
+        lost_partition,
         moved if moved is not None else "?", seconds,
     )
     return new_p

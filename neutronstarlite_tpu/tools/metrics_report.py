@@ -289,6 +289,21 @@ def render_ring(events: List[Dict[str, Any]],
             f"#ring_hop_time_total={sum(timed) * 1000:.3f}(ms) over "
             f"{len(timed)} measured hops"
         )
+    # 2D (vertex x feature) mesh gauges (parallel/partitioner.py): the
+    # resolved shape and the feature-slab width each hop carried
+    shape = gauges.get("mesh.shape")
+    if shape is not None:
+        lines.append(
+            f"#mesh_shape={shape} (Pv={gauges.get('mesh.pv')}, "
+            f"Pf={gauges.get('mesh.pf')}, slab_cols="
+            f"{gauges.get('mesh.slab_cols')})"
+        )
+        feat_bytes = gauges.get("wire.peak_resident_feature_bytes")
+        if feat_bytes is not None:
+            lines.append(
+                f"#mesh_peak_resident_feature_bytes={int(feat_bytes)} "
+                "(O(vp*f/Pf): the slab-resident double buffer)"
+            )
     return lines
 
 
@@ -687,7 +702,7 @@ def _micro_metrics(obj) -> Dict[str, Any]:
         ms = rec.get("ms")
         if ms is None:
             continue
-        for suf in ("_eager", "_fused"):
+        for suf in ("_eager", "_fused", "_1d", "_2d"):
             if name.endswith(suf):
                 name = name[: -len(suf)]
                 break
@@ -698,8 +713,9 @@ def _micro_metrics(obj) -> Dict[str, Any]:
             # mix; keep the first and say so loudly instead
             print(
                 f"diff: duplicate canonical metric {key} in micro_bench "
-                "side (both _eager and _fused present?) — keeping the "
-                "first; produce each side with an --ops family filter",
+                "side (both variants — _eager/_fused or _1d/_2d — "
+                "present?) — keeping the first; produce each side with "
+                "an --ops family filter (or comm_bench --side)",
                 file=sys.stderr,
             )
             continue

@@ -80,7 +80,56 @@ def peak_resident_rows(kind: str, P: int, vp: int, mb: int = 0) -> int:
     return P * vp
 
 
-def predict_all(g, P: int, f: int, widths=None, itemsize: int = 4) -> dict:
+def predict_mesh(g, pv: int, pf: int, widths, itemsize: int = 4,
+                 out_widths=None) -> dict:
+    """Exact per-device wire/memory prediction for the 2D (vertex x
+    feature) mesh layout (parallel/partitioner.py) on one graph:
+
+    - ``bytes_per_epoch``: the vertex RING exchange — (pv-1) hops per
+      layer, each shipping a ``[vp, slab_width(w, pf)]`` feature slab.
+      This is the quantity the live ``wire.bytes_fwd`` counter carries
+      (same ``slab_width`` definition, so live == predicted whenever no
+      skip suffix trims the rotation);
+    - ``allreduce_bytes_per_epoch``: the feature-axis all-reduce XLA
+      inserts where the blocked kernels contract (``agg @ W``): a ring
+      all-reduce ships ~``2*(pf-1)/pf`` of each ``[vp, w_out]`` product
+      per device per layer. Analytic only — GSPMD owns the collective,
+      so no live counter mirrors it; the tune prior prices it so a
+      degenerate ``(1, P)`` mesh cannot masquerade as wire-free;
+    - ``peak_resident_feature_bytes``: the double-buffered exchange
+      residency at slab width — ``min(2, pv) * vp * max(slab) *
+      itemsize``, the O(vp*f/Pf) memory claim as a number (the
+      ``wire.peak_resident_feature_bytes`` obs gauge).
+    """
+    from neutronstarlite_tpu.graph.storage import partition_offsets
+    from neutronstarlite_tpu.parallel.partitioner import slab_width
+    from neutronstarlite_tpu.parallel.vertex_space import round_up
+
+    pv, pf = max(int(pv), 1), max(int(pf), 1)
+    offsets = partition_offsets(g.v_num, g.in_degree, pv)
+    vp = round_up(int(np.diff(offsets).max()), 8)  # DistGraph.build's rule
+    widths = [int(w) for w in widths]
+    outs = [int(w) for w in (out_widths if out_widths else widths)]
+    slabs = [slab_width(w, pf) for w in widths]
+    rows = (pv - 1) * vp
+    peak_rows = min(2, pv) * vp
+    return {
+        "pv": pv, "pf": pf, "vp": int(vp),
+        "slab_widths": slabs,
+        "exchange_rows": int(rows),
+        "bytes_per_epoch": int(rows * sum(slabs) * itemsize),
+        "allreduce_bytes_per_epoch": int(
+            sum(2 * (pf - 1) * vp * w // pf for w in outs) * itemsize
+        ),
+        "peak_resident_rows": int(peak_rows),
+        "peak_resident_feature_bytes": int(
+            peak_rows * (max(slabs) if slabs else 0) * itemsize
+        ),
+    }
+
+
+def predict_all(g, P: int, f: int, widths=None, itemsize: int = 4,
+                mesh=None) -> dict:
     """Machine-readable per-strategy prediction for one (graph, P, f):
     exchange rows, peak resident rows, and bytes per epoch — the
     autotuner's analytic prior (neutronstarlite_tpu/tune/runner.py) and
@@ -92,7 +141,10 @@ def predict_all(g, P: int, f: int, widths=None, itemsize: int = 4) -> dict:
     the SAME :func:`exchange_rows_per_device` /
     :func:`peak_resident_rows` formulas the live obs counters use, so the
     prior, the offline report, and the run-time telemetry can never
-    disagree.
+    disagree. ``mesh=(pv, pf)`` additionally prices the 2D
+    (vertex x feature) layout as strategy ``ring2d`` via
+    :func:`predict_mesh` (same single-definition slab math as the live
+    ``mesh.*`` gauges).
     """
     from neutronstarlite_tpu.parallel.mirror import MirrorGraph, SplitMirror
 
@@ -112,6 +164,11 @@ def predict_all(g, P: int, f: int, widths=None, itemsize: int = 4) -> dict:
             "bytes_per_epoch": int(rows * sum(widths) * itemsize),
             "peak_resident_bytes": int(peak * max(widths) * itemsize),
         }
+    if mesh is not None:
+        pv, pf = (int(x) for x in mesh)
+        strategies["ring2d"] = predict_mesh(
+            g, pv, pf, widths, itemsize=itemsize
+        )
     return {
         "P": int(P), "f": int(f), "vp": int(vp), "mb": int(mb),
         "mb_uniform": int(mb_uni), "widths": widths,
@@ -231,6 +288,11 @@ def main(argv=None) -> int:
     ap.add_argument("--refresh", type=int, default=3)
     ap.add_argument("--budget-mib", type=int, default=256)
     ap.add_argument(
+        "--mesh", default="",
+        help="Pv,Pf — also price the 2D (vertex x feature) mesh layout "
+        "(strategy 'ring2d' in the --json payload; predict_mesh)",
+    )
+    ap.add_argument(
         "--json", action="store_true",
         help="machine-readable mode: print the predict_all() per-strategy "
         "prediction (exchange rows, peak resident rows, bytes/epoch) as "
@@ -254,8 +316,15 @@ def main(argv=None) -> int:
         g, _, _ = load_cached_graph(d)
         name = f"reddit_synth_x{args.scale:g}"
 
+    mesh = None
+    if args.mesh:
+        from neutronstarlite_tpu.parallel.partitioner import MeshSpec
+
+        spec = MeshSpec.parse(args.mesh)
+        mesh = (spec.pv, spec.pf)
+
     if args.json:
-        out = predict_all(g, args.partitions, args.feature)
+        out = predict_all(g, args.partitions, args.feature, mesh=mesh)
         out["graph"] = name
         print(json.dumps(out))
         return 0

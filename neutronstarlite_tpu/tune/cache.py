@@ -38,7 +38,10 @@ from neutronstarlite_tpu.utils.logging import get_logger
 
 log = get_logger("tune")
 
-TUNE_SCHEMA_VERSION = 1
+# v2: the candidate tuple gained the 5th axis (MESH — the 2D vertex x
+# feature partitioner); v1 entries carry 4-part labels that can never be
+# half-parsed against the new space, so they are warned misses (re-tune)
+TUNE_SCHEMA_VERSION = 2
 
 _MODES = ("off", "cached", "measure")
 

@@ -88,11 +88,11 @@ def analytic_priors(host_graph, P: int, sizes: List[int], family: str,
     estimate), so the prior can never disagree with the telemetry the
     decision is later judged against.
     """
+    from neutronstarlite_tpu.models.gcn_dist import exchange_widths
     from neutronstarlite_tpu.tools.wire_accounting import predict_all
 
     sizes = [int(s) for s in sizes] or [1]
-    widths = sizes[1:] if eager_widths else sizes[:-1]
-    widths = widths or [sizes[0]]
+    widths = exchange_widths(eager_widths, sizes) or [sizes[0]]
     hidden = sizes[1:] or [sizes[0]]
     base_item = 2 if precision == "bfloat16" else 4
     # ONE predict_all pass at itemsize=1 (its row/peak math is itemsize-
@@ -106,11 +106,33 @@ def analytic_priors(host_graph, P: int, sizes: List[int], family: str,
             widths=(hidden if family == "edge_dist" else widths),
             itemsize=1,
         )["strategies"]
+    mesh_units: Dict[str, dict] = {}
     out: Dict[str, int] = {}
     for cand in candidates:
         item = 2 if _bf16(cand.wire_dtype) else base_item
         score = 0
-        if family == "dist_dense":
+        if family == "dist_dense" and cand.mesh:
+            # 2D (vertex x feature) mesh: the ring exchange at slab
+            # width + the feature-axis all-reduce XLA inserts at each
+            # contraction + the slab-resident double buffer — all from
+            # predict_mesh, the same single-definition math the live
+            # mesh.* gauges carry. The all-reduce term is what keeps a
+            # degenerate (1, P) shape from masquerading as wire-free.
+            from neutronstarlite_tpu.tools.wire_accounting import (
+                predict_mesh,
+            )
+
+            if cand.mesh not in mesh_units:
+                pv, pf = (int(t) for t in cand.mesh.split(","))
+                mesh_units[cand.mesh] = predict_mesh(
+                    host_graph, pv, pf, widths, itemsize=1,
+                    out_widths=hidden,
+                )
+            pred = mesh_units[cand.mesh]
+            score = item * pred["bytes_per_epoch"] + base_item * pred[
+                "allreduce_bytes_per_epoch"
+            ] + item * pred["peak_resident_feature_bytes"]
+        elif family == "dist_dense":
             kind = (
                 "ell" if cand.dist_path == "all_gather" else "ring_blocked"
             )
@@ -202,15 +224,124 @@ def measure_candidates(
         from neutronstarlite_tpu.tune.space import mesh_reachable
 
         f = sizes[0]  # the dominant (input-width) exchange
-        dist = DistGraph.build(host_graph, P, edge_chunk=edge_chunk or None)
-        xh = dist.pad_vertex_array(
-            rng.standard_normal((host_graph.v_num, f)).astype(np.float32)
-        )
+        # the P-partition 1D rig, built lazily: a space whose every
+        # candidate carries a mesh value never partitions over P at all
+        _base: list = []
+
+        def base_rig():
+            if not _base:
+                d = DistGraph.build(
+                    host_graph, P, edge_chunk=edge_chunk or None
+                )
+                _base.append(d)
+                _base.append(d.pad_vertex_array(
+                    rng.standard_normal(
+                        (host_graph.v_num, f)
+                    ).astype(np.float32)
+                ))
+            return _base[0], _base[1]
+
         mesh = None
         ring_pair = None
+        # mesh value -> everything its candidates share (dist, pair,
+        # padded input; the real-mesh triple joins lazily) — wire-dtype
+        # variants of one shape must time the SAME input and reuse the
+        # one O(E) table upload
+        mesh_rigs: Dict[str, dict] = {}
+        # every dist_dense leg is exchange + ONE contraction at the
+        # model's first hidden width: the matmul FLOPs are identical
+        # across candidates (same logical math), but a 2D mesh pays its
+        # feature-axis all-reduce (real mesh: GSPMD inserts it; sim: the
+        # Partitioner.contract slab-partial order) INSIDE the timed leg
+        # — without it the degenerate (1, P) shape measures as a
+        # zero-hop exchange and wins on seconds while training pays an
+        # unmeasured per-layer all-reduce
+        h1 = sizes[1] if len(sizes) > 1 else f
+        W_c = jnp.asarray(
+            rng.standard_normal((f, h1)).astype(np.float32)
+        )
         for cand in candidates:
             label = cand.label()
-            if cand.dist_path == "all_gather":
+            if cand.mesh:
+                # 2D (vertex x feature) candidate: ring over Pv at slab
+                # width. The sim leg times the trainer's own twin (full
+                # width over Pv — the aggregation is feature-column-
+                # independent, so it is the bitwise stand-in); a real
+                # rig times the collective 2D exchange on the actual
+                # (Pv, Pf) mesh.
+                from neutronstarlite_tpu.parallel.dist_ring_blocked import (
+                    dist_ring2d_gather_dst_from_src,
+                )
+                from neutronstarlite_tpu.parallel.partitioner import (
+                    MeshSpec,
+                    Partitioner,
+                    pad_feature_cols,
+                )
+
+                pv, pf = (int(t) for t in cand.mesh.split(","))
+                if cand.mesh not in mesh_rigs:
+                    d2 = DistGraph.build(
+                        host_graph, pv, edge_chunk=edge_chunk or None
+                    )
+                    mesh_rigs[cand.mesh] = {
+                        "dist": d2,
+                        "pair": RingBlockedPair.build(
+                            d2, vt=default_ring_vt(d2.vp, kernel_tile)
+                        ),
+                        "xh": pad_feature_cols(
+                            d2.pad_vertex_array(
+                                rng.standard_normal(
+                                    (host_graph.v_num, f)
+                                ).astype(np.float32)
+                            ),
+                            pf,
+                        ),
+                    }
+                rig = mesh_rigs[cand.mesh]
+                p2, x2h = rig["pair"], rig["xh"]
+                wdt = jnp.bfloat16 if _bf16(cand.wire_dtype) else None
+                if simulate or not mesh_reachable(pv * pf):
+                    con = Partitioner(MeshSpec(pv, pf), mesh=None).contract
+                    fn = lambda v, b=p2, w=wdt, c=con: (  # noqa: E731
+                        c(dist_ring_blocked_gather_simulated(b, v, w), W_c)
+                    )
+                    out[label] = _time_leg(
+                        _grad_leg(fn, jnp.asarray(x2h)), steps
+                    )
+                else:
+                    if "mesh" not in rig:
+                        from jax.sharding import (
+                            NamedSharding,
+                            PartitionSpec as PS,
+                        )
+
+                        from neutronstarlite_tpu.parallel.mesh import (
+                            FEATURE_AXIS,
+                            VERTEX_AXIS,
+                            make_mesh2d,
+                        )
+
+                        rig["mesh"] = make_mesh2d(pv, pf)
+                        rig["blocks"] = p2.shard(
+                            rig["mesh"], axis=VERTEX_AXIS
+                        )
+                        rig["x"] = jax.device_put(
+                            jnp.asarray(x2h),
+                            NamedSharding(
+                                rig["mesh"],
+                                PS(VERTEX_AXIS, FEATURE_AXIS),
+                            ),
+                        )
+                    con = Partitioner(
+                        MeshSpec(pv, pf), mesh=rig["mesh"]
+                    ).contract
+                    fn = lambda v, m=rig["mesh"], b=rig["blocks"], \
+                            w=wdt, q=pf, c=con: (  # noqa: E731
+                        c(dist_ring2d_gather_dst_from_src(m, b, v, w, pf=q),
+                          W_c)
+                    )
+                    out[label] = _time_leg(_grad_leg(fn, rig["x"]), steps)
+            elif cand.dist_path == "all_gather":
                 if simulate or not mesh_reachable(P):
                     out[label] = None  # no sim twin for the gather family
                     continue
@@ -223,12 +354,16 @@ def measure_candidates(
                 )
                 from neutronstarlite_tpu.parallel.mesh import make_mesh
 
+                dist, xh = base_rig()
                 mesh = mesh or make_mesh(P)
                 ell = DistEllPair.build(dist).shard(mesh)
                 x = vertex_sharded(mesh, xh)
-                fn = lambda v: dist_ell_gather_dst_from_src(mesh, ell, v)  # noqa: E731,B023
+                fn = lambda v: (  # noqa: E731,B023
+                    dist_ell_gather_dst_from_src(mesh, ell, v) @ W_c
+                )
                 out[label] = _time_leg(_grad_leg(fn, x), steps)
             elif _norm("dist_path", cand.dist_path) == "ring_blocked":
+                dist, xh = base_rig()
                 if ring_pair is None:
                     ring_pair = RingBlockedPair.build(
                         dist, vt=default_ring_vt(dist.vp, kernel_tile)
@@ -238,6 +373,7 @@ def measure_candidates(
                     blocks, x = ring_pair, jnp.asarray(xh)
                     fn = lambda v, w=wdt: (  # noqa: E731
                         dist_ring_blocked_gather_simulated(blocks, v, w)
+                        @ W_c
                     )
                 else:
                     from neutronstarlite_tpu.parallel.dist_ops import (
@@ -250,6 +386,7 @@ def measure_candidates(
                     x = vertex_sharded(mesh, xh)
                     fn = lambda v, b=blocks, w=wdt: (  # noqa: E731
                         dist_ring_blocked_gather_dst_from_src(mesh, b, v, w)
+                        @ W_c
                     )
                 out[label] = _time_leg(_grad_leg(fn, x), steps)
             else:

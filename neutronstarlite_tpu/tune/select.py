@@ -61,12 +61,19 @@ def _simulate_active(toolkit) -> bool:
 
 
 def _partition_count(toolkit) -> int:
-    """The P the decision is keyed by — what resolve_mesh will give the
-    trainer: cfg PARTITIONS, or all visible devices (sim default 2, the
-    resolve_mesh fallback); 1 for single-chip families."""
+    """The P the decision is keyed by — the trainer's DEVICE budget: a
+    concrete MESH:Pv,Pf pins it at Pv*Pf, else cfg PARTITIONS, else all
+    visible devices (sim default 2, the resolve_mesh fallback); 1 for
+    single-chip families. MESH:auto enumerates the factorizations of
+    this same budget, so the decision stays keyed by one number."""
     fam = space.family_of(type(toolkit))
     if fam not in ("dist_dense", "edge_dist"):
         return 1
+    mesh_v = space._norm("mesh", getattr(toolkit.cfg, "mesh", ""))
+    if mesh_v not in ("", "auto"):
+        from neutronstarlite_tpu.parallel.partitioner import MeshSpec
+
+        return MeshSpec.parse(mesh_v).devices
     cfg_p = int(getattr(toolkit.cfg, "partitions", 0) or 0)
     if cfg_p:
         return cfg_p
@@ -239,6 +246,12 @@ def resolve_auto_knobs(toolkit) -> None:
     checks (called from ToolkitBase._finalize_datum). No-op when nothing
     is auto."""
     cfg = toolkit.cfg
+    # NTS_MESH launcher parity folds in HERE — the head of the funnel —
+    # so the env spelling flows through the same auto-resolution and
+    # validity checks the cfg key gets (parallel/partitioner.py)
+    from neutronstarlite_tpu.parallel.partitioner import fold_mesh_env
+
+    fold_mesh_env(cfg)
     autos = space.auto_axes(cfg)
     if not autos:
         return

@@ -1,7 +1,7 @@
 """The autotuner's typed candidate space.
 
-A candidate is one (dist_path, kernel, ell_levels, wire_dtype) tuple —
-exactly the four auto-capable cfg axes. :func:`enumerate_candidates`
+A candidate is one (dist_path, kernel, ell_levels, wire_dtype, mesh)
+tuple — exactly the five auto-capable cfg axes. :func:`enumerate_candidates`
 yields the tuples that are (a) shaped for the trainer's algorithm family,
 (b) consistent with every axis the user PINNED (a non-auto cfg value is
 a constraint, not a suggestion), and (c) accepted by the SAME
@@ -18,7 +18,11 @@ the refusals key off):
   COMMNETDIST + eager variants) — DIST_PATH all_gather vs ring_blocked,
   WIRE_DTYPE f32 vs bf16 (ring only: the all_gather family ships the
   compute dtype, so proposing bf16 wire there would tune a knob the
-  build warns it ignores). The all_gather family has no collective-free
+  build warns it ignores), and MESH '' (legacy 1D) vs the Pf>1
+  factorizations of the device budget ('2,2', '1,4', ... —
+  parallel/partitioner.py; the (P, 1) spelling is excluded because it
+  is bitwise the '' layout and would pollute the space with a duplicate
+  measurement). The all_gather family has no collective-free
   sim twin, so on a sim rig (NTS_DIST_SIMULATE=1 /
   DIST_PATH:ring_blocked_sim) or a rig with fewer than P devices it is
   not a candidate at all — it could neither be measured nor built.
@@ -44,24 +48,28 @@ from neutronstarlite_tpu.utils.logging import get_logger
 
 log = get_logger("tune")
 
-# the auto-capable cfg axes, in canonical label order
-AXES = ("dist_path", "kernel", "ell_levels", "wire_dtype")
+# the auto-capable cfg axes, in canonical label order ("mesh" appended
+# last so pre-mesh labels extend with a trailing "|-"; the cache schema
+# version was bumped with it, so old persisted labels can never be
+# half-parsed)
+AXES = ("dist_path", "kernel", "ell_levels", "wire_dtype", "mesh")
 
 
 @dataclasses.dataclass(frozen=True)
 class Candidate:
     """One point of the candidate space; empty string = the axis default
     (eager kernel / heuristic dist path / path-default levels / compute-
-    dtype wire)."""
+    dtype wire / legacy 1D mesh)."""
 
     dist_path: str = ""
     kernel: str = ""
     ell_levels: str = ""
     wire_dtype: str = ""
+    mesh: str = ""
 
     def label(self) -> str:
         """Canonical record/cache label: axis values joined by '|', '-'
-        for empty — e.g. ``ring_blocked|-|-|bf16``."""
+        for empty — e.g. ``ring_blocked|-|-|bf16|2,2``."""
         return "|".join(getattr(self, a) or "-" for a in AXES)
 
     def as_dict(self) -> dict:
@@ -95,12 +103,19 @@ def auto_axes(cfg) -> Set[str]:
 
 def _norm(axis: str, value: str) -> str:
     """Axis-value normalization for pinned-axis comparison: the sim
-    spelling of the ring path and the dtype aliases collapse."""
+    spelling of the ring path, the dtype aliases, and the 'PvxPf' mesh
+    spelling collapse."""
     v = (value or "").strip().lower()
     if axis == "dist_path" and v == "ring_blocked_sim":
         return "ring_blocked"
     if axis == "wire_dtype":
         return {"f32": "", "float32": "", "bfloat16": "bf16"}.get(v, v)
+    if axis == "mesh" and v not in ("", "auto"):
+        from neutronstarlite_tpu.parallel.partitioner import (
+            normalize_mesh_value,
+        )
+
+        return normalize_mesh_value(v)
     return v
 
 
@@ -135,12 +150,12 @@ def candidate_valid(trainer_cls, cfg, cand: Candidate,
 
 
 def _axis_values(family: str, axis: str, autos: Set[str], cfg,
-                 include_all_gather: bool) -> List[str]:
+                 include_all_gather: bool, partitions: int = 0) -> List[str]:
     """The values one axis ranges over. A pinned (non-auto) axis is a
     CONSTRAINT: it contributes exactly the user's value (including the
     empty string — '' is a concrete choice: eager kernel, heuristic dist
-    path, compute-dtype wire, path-default ladder). Only an ``auto``
-    axis enumerates."""
+    path, compute-dtype wire, path-default ladder, 1D mesh). Only an
+    ``auto`` axis enumerates."""
     if axis not in autos:
         return [getattr(cfg, axis, "")]
     if family == "dist_dense":
@@ -149,6 +164,14 @@ def _axis_values(family: str, axis: str, autos: Set[str], cfg,
                 ["ring_blocked"]
         if axis == "wire_dtype":
             return ["", "bf16"]
+        if axis == "mesh":
+            # '' is the legacy 1D layout (== the (P, 1) shape bitwise, so
+            # that spelling is excluded as a duplicate); Pf > 1 shapes
+            # factor the same device budget P
+            P = max(int(partitions), 1)
+            return [""] + [
+                f"{P // pf},{pf}" for pf in range(2, P + 1) if P % pf == 0
+            ]
     elif family == "edge_single":
         if axis == "kernel":
             return ["", "fused_edge"]
@@ -165,9 +188,11 @@ def _consistent(family: str, cand: Candidate) -> bool:
     combination must not become a distinct candidate — it would measure
     identically to its base tuple and pollute the space)."""
     if family == "dist_dense" and _norm("wire_dtype", cand.wire_dtype):
-        # WIRE_DTYPE only rides the ring-pipelined exchange; on the
-        # all_gather family it is warned-ignored
-        if _norm("dist_path", cand.dist_path) != "ring_blocked":
+        # WIRE_DTYPE only rides the ring-pipelined exchanges (1D ring or
+        # a 2D mesh, which is ring-only); on the all_gather family it is
+        # warned-ignored
+        if _norm("dist_path", cand.dist_path) != "ring_blocked" and \
+                not cand.mesh:
             return False
     if family == "edge_single" and cand.ell_levels:
         # the level-ladder knob only shapes the fused blocked tables
@@ -193,7 +218,8 @@ def enumerate_candidates(trainer_cls, cfg, partitions: int,
     autos = auto_axes(cfg)
     include_ag = not simulate and mesh_reachable(partitions)
     values = {
-        a: _axis_values(family, a, autos, cfg, include_ag) for a in AXES
+        a: _axis_values(family, a, autos, cfg, include_ag, partitions)
+        for a in AXES
     }
     out = []
     for dp in values["dist_path"]:
@@ -207,10 +233,12 @@ def enumerate_candidates(trainer_cls, cfg, partitions: int,
             )
             for lv in lvs:
                 for wd in values["wire_dtype"]:
-                    cand = Candidate(dist_path=dp, kernel=kn,
-                                     ell_levels=lv, wire_dtype=wd)
-                    if _consistent(family, cand) and candidate_valid(
-                        trainer_cls, cfg, cand, autos
-                    ):
-                        out.append(cand)
+                    for ms in values["mesh"]:
+                        cand = Candidate(dist_path=dp, kernel=kn,
+                                         ell_levels=lv, wire_dtype=wd,
+                                         mesh=ms)
+                        if _consistent(family, cand) and candidate_valid(
+                            trainer_cls, cfg, cand, autos
+                        ):
+                            out.append(cand)
     return out

@@ -133,6 +133,13 @@ class InputInfo:
     # ring-pipelined blocked exchange, parallel/dist_ring_blocked.py —
     # O(2*vp) exchange memory, comm/compute overlap), ring_blocked_sim
     # (its collective-free twin, single-core CI parity)
+    mesh: str = ""  # MESH: 2D (vertex x feature) device-mesh shape for the
+    # fuse-op dist family (parallel/partitioner.py): "" (legacy 1D vertex
+    # sharding), "Pv,Pf" (also accepts "PvxPf"; Pv vertex partitions, each
+    # feature slab split Pf ways — per-device feature memory O(vp*f/Pf)),
+    # or auto (the tune/ autotuner picks the shape from the factorizations
+    # of PARTITIONS). Env override NTS_MESH (launcher parity), folded in at
+    # the lifecycle funnel so it cannot bypass the validity checks.
     wire_dtype: str = ""  # ICI exchange dtype for the ring-pipelined path:
     # "" / f32 / float32 (ship the compute dtype) or bf16 / bfloat16
     # (halve wire bytes; the per-step accumulator stays f32), or auto (let
@@ -316,6 +323,15 @@ class InputInfo:
                     f"ring_blocked_sim, got {value!r}"
                 )
             self.dist_path = v
+        elif key == "MESH":
+            # validated + canonicalized like DIST_PATH: a typo'd shape
+            # would silently train the replicated-feature 1D layout while
+            # the user benchmarks it as the 2D mesh
+            from neutronstarlite_tpu.parallel.partitioner import (
+                normalize_mesh_value,
+            )
+
+            self.mesh = normalize_mesh_value(value)
         elif key == "WIRE_DTYPE":
             v = value.strip().lower()
             if v not in ("", "f32", "float32", "bf16", "bfloat16", "auto"):

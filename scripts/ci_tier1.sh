@@ -266,6 +266,116 @@ else
   echo "TUNE_GATE=OK"
 fi
 
+# ---- 2D-mesh gate (ISSUE 12) -----------------------------------------------
+# STRUCTURAL (hard): run configs/gcn_dist_mesh_smoke.cfg on its (2, 2)
+# sim mesh — exit 0, schema-valid stream, mesh.shape gauge present, live
+# wire counters equal to wire_accounting.predict_mesh's 2D pricing, and
+# per-hop ring_step records carrying the feature-slab width. Then the
+# tune leg: NTS_MESH=auto over one NTS_TUNE_DIR — run 1 (NTS_TUNE=
+# measure) decides a mesh shape with >=1 measured trial; run 2
+# (NTS_TUNE=cached) replays the IDENTICAL decision with zero trials.
+mesh_rc=0
+rm -rf /tmp/_t1_mesh_obs /tmp/_t1_mesh_obs2 /tmp/_t1_mesh_obs3 /tmp/_t1_mesh_cache
+if JAX_PLATFORMS=cpu NTS_METRICS_DIR=/tmp/_t1_mesh_obs \
+    timeout -k 10 600 python -m neutronstarlite_tpu.run \
+    configs/gcn_dist_mesh_smoke.cfg > /tmp/_t1_mesh.log 2>&1
+then
+  JAX_PLATFORMS=cpu python - <<'EOF' || mesh_rc=$?
+import glob, json, os
+
+from neutronstarlite_tpu.graph.storage import build_graph, load_edges
+from neutronstarlite_tpu.obs import schema
+from neutronstarlite_tpu.tools.wire_accounting import predict_mesh
+
+events = []
+for p in sorted(glob.glob("/tmp/_t1_mesh_obs/*.jsonl")):
+    for line in open(p, encoding="utf-8"):
+        line = line.strip()
+        if line:
+            events.append(json.loads(line))
+assert schema.validate_stream(events) == len(events)
+summ = [e for e in events if e["event"] == "run_summary"][-1]
+g_ = summ["gauges"]
+assert g_.get("mesh.shape") == "2x2", f"mesh.shape={g_.get('mesh.shape')!r}"
+assert (g_["mesh.pv"], g_["mesh.pf"]) == (2, 2)
+
+src, dst = load_edges("tests/fixtures/cora/cora.2708.edge.self")
+g = build_graph(src, dst, 2708, weight="gcn_norm")
+widths = [1433, 16]  # standard order ships each layer's INPUT width
+pred = predict_mesh(g, 2, 2, widths, itemsize=4)
+epochs = 2
+# live wire counters == the 2D analytic pricing (single slab_width def)
+assert summ["counters"]["wire.bytes_fwd"] == pred["bytes_per_epoch"] * epochs, (
+    summ["counters"]["wire.bytes_fwd"], pred["bytes_per_epoch"], epochs)
+assert g_["wire.peak_resident_rows"] == pred["peak_resident_rows"]
+assert g_["wire.peak_resident_feature_bytes"] == pred[
+    "peak_resident_feature_bytes"]
+assert g_["mesh.slab_cols"] == sum(pred["slab_widths"])
+hops = [e for e in events if e["event"] == "ring_step"]
+assert hops and all(h.get("slab_cols") == sum(pred["slab_widths"])
+                    for h in hops), "ring_step records missing slab_cols"
+assert sum(h["bytes"] for h in hops) == pred["bytes_per_epoch"] * epochs
+print(
+    f"mesh gate: 2x2 sim mesh OK — wire {summ['counters']['wire.bytes_fwd']}"
+    f" B == predict_mesh x{epochs}, slab_cols {g_['mesh.slab_cols']}, "
+    f"peak resident {g_['wire.peak_resident_feature_bytes']} B"
+)
+EOF
+else
+  mesh_rc=$?
+  tail -30 /tmp/_t1_mesh.log
+fi
+if [ "$mesh_rc" -eq 0 ]; then
+  if JAX_PLATFORMS=cpu NTS_MESH=auto NTS_TUNE=measure \
+      NTS_TUNE_DIR=/tmp/_t1_mesh_cache NTS_METRICS_DIR=/tmp/_t1_mesh_obs2 \
+      timeout -k 10 600 python -m neutronstarlite_tpu.run \
+      configs/gcn_dist_mesh_smoke.cfg > /tmp/_t1_mesh2.log 2>&1 \
+    && JAX_PLATFORMS=cpu NTS_MESH=auto NTS_TUNE=cached \
+      NTS_TUNE_DIR=/tmp/_t1_mesh_cache NTS_METRICS_DIR=/tmp/_t1_mesh_obs3 \
+      timeout -k 10 600 python -m neutronstarlite_tpu.run \
+      configs/gcn_dist_mesh_smoke.cfg > /tmp/_t1_mesh3.log 2>&1
+  then
+    JAX_PLATFORMS=cpu python - <<'EOF' || mesh_rc=$?
+import glob, json
+
+def load(d):
+    evs = []
+    for p in sorted(glob.glob(d + "/*.jsonl")):
+        for line in open(p, encoding="utf-8"):
+            line = line.strip()
+            if line:
+                evs.append(json.loads(line))
+    return evs
+
+run1 = load("/tmp/_t1_mesh_obs2")
+run2 = load("/tmp/_t1_mesh_obs3")
+d1 = [e for e in run1 if e["event"] == "tune_decision"]
+assert len(d1) == 1 and d1[0]["source"] == "measured", d1
+assert "mesh" in (d1[0].get("decision") or {}), d1[0]
+t1 = [e for e in run1 if e["event"] == "tune_trial"]
+assert any(t["seconds"] is not None for t in t1), "run 1 measured nothing"
+t2 = [e for e in run2 if e["event"] == "tune_trial"]
+assert not t2, f"cached run re-measured: {len(t2)} tune_trial records"
+d2 = [e for e in run2 if e["event"] == "tune_decision"]
+assert len(d2) == 1 and d2[0]["source"] == "cached", d2
+assert d2[0]["candidate"] == d1[0]["candidate"], (d1[0], d2[0])
+print(
+    f"mesh tune leg: measured -> {d1[0]['candidate']} "
+    f"(mesh={d1[0]['decision'].get('mesh') or '1D'}) over {len(t1)} "
+    "trial(s); cached replay identical with zero trials"
+)
+EOF
+  else
+    mesh_rc=$?
+    tail -30 /tmp/_t1_mesh2.log /tmp/_t1_mesh3.log 2>/dev/null
+  fi
+fi
+if [ "$mesh_rc" -ne 0 ]; then
+  echo "MESH_GATE=FAIL (rc=$mesh_rc)"
+else
+  echo "MESH_GATE=OK"
+fi
+
 # ---- live telemetry gate (ISSUE 11) ----------------------------------------
 # STRUCTURAL (hard): drive the serve smoke cfg with the exporter + SLO
 # engine armed and inject a fault mid-serve. Requires: a live /metrics
@@ -378,5 +488,6 @@ fi
 [ "$rc" -eq 0 ] && rc=$samp_rc
 [ "$rc" -eq 0 ] && rc=$elastic_rc
 [ "$rc" -eq 0 ] && rc=$tune_rc
+[ "$rc" -eq 0 ] && rc=$mesh_rc
 [ "$rc" -eq 0 ] && rc=$obs_rc
 exit $rc
