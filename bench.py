@@ -235,12 +235,28 @@ def probe_backend(timeout_s: float, attempts: int, backoff_s: float,
     reg = _probe_metrics()
 
     def record(attempt, outcome, t0, platform=None, **extra):
+        seconds = round(time.time() - t0, 3)
         if reg is not None:
             reg.event(
                 "backend_probe", attempt=attempt, outcome=outcome,
-                seconds=round(time.time() - t0, 3), platform=platform,
+                seconds=seconds, platform=platform,
                 timeout_s=timeout_s, **extra,
             )
+        # cross-run perf ledger (NTS_LEDGER_DIR): one kind=probe row per
+        # attempt, INCLUDING timeouts — the probe-failure history that
+        # has been invisible since r05 becomes queryable. Pure-host
+        # append; never initializes the accelerator backend and never
+        # blocks the probe.
+        try:
+            from neutronstarlite_tpu.obs import ledger as obs_ledger
+
+            if obs_ledger.ledger_dir():
+                obs_ledger.append_row(obs_ledger.probe_row(
+                    attempt, outcome, seconds, platform, scale=scale,
+                    error=extra.get("error"),
+                ))
+        except Exception as e:
+            print(f"probe ledger append failed: {e}", file=sys.stderr)
 
     try:
         for attempt in range(1, attempts + 1):
@@ -476,6 +492,10 @@ def worker_main(args) -> int:
     Runs in its own process so a hung compile/backend is killable by the
     supervisor's per-config timeout without losing the whole sweep."""
     os.environ.setdefault("NTS_FINAL_EVAL", "0")  # no second compile per run
+    # a bench worker IS a measurement context: force program-cost capture
+    # so extra.metrics carries the step's XLA numbers even when no
+    # NTS_METRICS_DIR stream is armed (the auto gate would skip it)
+    os.environ.setdefault("NTS_PROGRAM_COST", "1")
     from neutronstarlite_tpu.utils.platform import honor_platform_env
 
     honor_platform_env()

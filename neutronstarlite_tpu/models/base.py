@@ -809,9 +809,49 @@ class ToolkitBase:
                 "acc": result.get("acc"),
                 "avg_epoch_s": result.get("avg_epoch_s"),
             }
+        # prediction-drift audit (tools/drift_audit): the analytic wire
+        # pricing vs the live counters, emitted as typed model_drift
+        # records BEFORE the summary so a drifted run's stream carries
+        # the verdict (NTS_DRIFT_AUDIT=0 disables; never raises)
+        from neutronstarlite_tpu.tools.drift_audit import audit_registry
+
+        audit_registry(self.metrics, len(self.epoch_times))
         self.run_summary_record = self.metrics.run_summary(**fields)
+        self._append_ledger_row()
         self.metrics.close()
         return self.run_summary_record
+
+    def _ledger_graph_digest(self) -> Optional[str]:
+        """The canonical graph digest for the perf-ledger row key —
+        reuses the tuner's cached digest when one exists; computed once
+        otherwise (only when the ledger is armed: the lexsort is O(E))."""
+        digest = getattr(self, "_tune_graph_digest", None)
+        if digest is not None or self.host_graph is None:
+            return digest
+        try:
+            from neutronstarlite_tpu.graph.digest import graph_digest
+
+            digest = graph_digest(self.host_graph)
+            self._tune_graph_digest = digest
+            return digest
+        except Exception as e:
+            log.warning("ledger graph digest unavailable: %s", e)
+            return None
+
+    def _append_ledger_row(self) -> None:
+        """One kind=run row into the cross-run perf ledger
+        (obs/ledger.py, NTS_LEDGER_DIR; disabled = no-op, failure =
+        warning — the ledger never fails a run)."""
+        from neutronstarlite_tpu.obs import ledger as obs_ledger
+
+        if not obs_ledger.ledger_dir():
+            return
+        try:
+            obs_ledger.append_row(obs_ledger.run_row(
+                self.run_summary_record, self._ledger_graph_digest(),
+            ))
+        except Exception as e:
+            log.warning("perf ledger append failed: %s", e)
 
     # ---- run -------------------------------------------------------------
     def run(self):

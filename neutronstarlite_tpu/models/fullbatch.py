@@ -222,6 +222,17 @@ class FullBatchTrainer(ToolkitBase):
 
         self._optim_step = optim_step
 
+        # compiled-program cost attribution (obs/cost): XLA's own
+        # FLOPs/bytes for the exact step program run() will dispatch,
+        # captured from the lowering (one extra trace, no extra compile)
+        from neutronstarlite_tpu.obs.cost import capture_program_cost
+
+        capture_program_cost(
+            self.metrics,
+            f"fullbatch.train_step/{type(self).__name__}",
+            jitted=self._train_step, args=self.aot_args(),
+        )
+
     # score-channel width per output width: GAT's decomposed attention is
     # scalar (C=1); GGCN's per-channel gate overrides with C=f'
     @staticmethod

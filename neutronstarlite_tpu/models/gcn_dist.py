@@ -692,6 +692,49 @@ class DistGCNTrainer(ToolkitBase):
         self._dbg_nn = fwd_nn_only
         self._dbg_grad = fwd_grad
 
+        # compiled-program cost attribution (obs/cost): the whole step
+        # program plus — on the ring path — the ring exchange body as its
+        # own labeled program, so the exchange's FLOPs/bytes sit next to
+        # the analytic wire gauges the drift auditor compares them with.
+        # Both captures read the lowering only (no extra compile).
+        from neutronstarlite_tpu.obs.cost import capture_program_cost
+
+        capture_program_cost(
+            self.metrics, f"dist.train_step/{type(self).__name__}",
+            jitted=self._train_step, args=self.aot_args(),
+        )
+        if layer_kind == "ring_blocked":
+            from neutronstarlite_tpu.parallel.dist_ring_blocked import (
+                dist_ring_blocked_gather_dst_from_src,
+                dist_ring_blocked_gather_simulated,
+            )
+
+            if mesh is None:
+                ring_fn = jax.jit(
+                    lambda pair, v: dist_ring_blocked_gather_simulated(
+                        pair, v, wire_dtype
+                    )
+                )
+                capture_program_cost(
+                    self.metrics, f"ring.body/{type(self).__name__}",
+                    jitted=ring_fn, args=(blocks, self.feature_p),
+                    partitions=int(P), simulated=True,
+                )
+            elif part is None:
+                # the 1D collective ring body; the 2D (Pv, Pf) body is
+                # already inside the captured step program — its shard_map
+                # needs mesh-placed inputs a bare lowering cannot stage
+                ring_fn = jax.jit(
+                    lambda pair, v: dist_ring_blocked_gather_dst_from_src(
+                        mesh, pair, v, wire_dtype
+                    )
+                )
+                capture_program_cost(
+                    self.metrics, f"ring.body/{type(self).__name__}",
+                    jitted=ring_fn, args=(blocks, self.feature_p),
+                    partitions=int(P), simulated=False,
+                )
+
     # ---- checkpoint canonicalization on a 2D mesh ------------------------
     # Checkpoints store the UNPADDED parameter shapes: a 2D run's mesh
     # feature padding (parallel/partitioner.pad_params_feature_dim) is

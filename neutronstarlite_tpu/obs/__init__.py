@@ -24,13 +24,21 @@ volume) and ad-hoc bench prints:
 - :mod:`exporter` — the opt-in HTTP pull endpoint (``NTS_METRICS_PORT``):
   /metrics (Prometheus text), /healthz, /slo;
 - :mod:`flight` — the always-on bounded flight recorder: the last N
-  records at full resolution, dumped on fault/breach/SIGUSR2.
+  records at full resolution, dumped on fault/breach/SIGUSR2;
+- :mod:`cost` — compiled-program cost attribution: per-executable XLA
+  ``cost_analysis()``/``memory_analysis()`` captured once at build time
+  as typed ``program_cost`` records;
+- :mod:`ledger` — the cross-run perf ledger (``NTS_LEDGER_DIR``): one
+  atomically-appended row per run/suite/probe, keyed by graph digest +
+  cfg fingerprint + backend; ``tools/perf_sentinel`` gates new rows
+  against the MAD-scaled trend of their own history.
 
 Every trainer run emits one ``run_summary`` record; ``tools/metrics_report``
 renders one or more streams into the reference-shaped ``#key=value(ms)``
 report and a cross-run comparison table. See docs/OBSERVABILITY.md.
 """
 
+from neutronstarlite_tpu.obs.cost import capture_program_cost
 from neutronstarlite_tpu.obs.hist import LogHistogram
 from neutronstarlite_tpu.obs.registry import (
     MetricsRegistry,
@@ -46,6 +54,7 @@ __all__ = [
     "MetricsRegistry",
     "SCHEMA_VERSION",
     "Tracer",
+    "capture_program_cost",
     "config_fingerprint",
     "metrics_dir",
     "open_run",

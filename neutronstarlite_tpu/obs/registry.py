@@ -132,6 +132,10 @@ class MetricsRegistry:
         self.rotations = 0
         self._reemitting_hists = False
         self.summary: Optional[Dict[str, Any]] = None
+        # compiled-program cost records (obs/cost.capture_program_cost
+        # appends here as well as emitting the typed event) — consolidated
+        # into run_summary so bench.py's extra.metrics carries them
+        self.program_costs: list = []
 
     # ---- metric primitives ----------------------------------------------
     def counter_add(self, name: str, value: float = 1.0) -> None:
@@ -364,6 +368,7 @@ class MetricsRegistry:
             gauges=snap["gauges"],
             timings=snap["timings"],
             hists=snap["hists"],
+            program_costs=list(self.program_costs),
             **fields,
         )
         self.summary = rec

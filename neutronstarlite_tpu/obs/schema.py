@@ -11,7 +11,8 @@ Envelope (all events):
                    fault | recovery | heartbeat | rank_loss | replan |
                    serve_request | batch_flush | shed | serve_summary |
                    tune_trial | tune_decision | span | stream_rotated |
-                   hist | slo_status | backend_probe
+                   hist | slo_status | backend_probe | program_cost |
+                   model_drift
                    (open set)
   run_id: str      "<algo>-<fingerprint>-<pid>"
   schema: int      SCHEMA_VERSION
@@ -158,6 +159,40 @@ backend_probe (bench.py): one accelerator-backend probe attempt — the
   platform: str | null (the answering backend; null on failure),
   devices / error / init_s: open context fields
 
+program_cost (obs/cost.py): one compiled/lowered XLA program's own cost
+  numbers, captured once at build time per executable (train steps, ring
+  bodies, serve AOT buckets, tuner micro-trials) and keyed by a stable
+  program label — real per-executable FLOPs/bytes/memory next to the
+  structural jaxpr pins
+  label: str (non-empty; e.g. serve.bucket_16, fullbatch.train_step),
+  available: bool (false = the backend exposed neither analysis — a
+  degraded-capture record, never a crash),
+  source: str (compiled | lowered | error, open set),
+  flops: number | null, bytes_accessed: number | null,
+  transcendentals: number | null,
+  memory: object | null ({argument_bytes, output_bytes, temp_bytes,
+  alias_bytes, generated_code_bytes, peak_bytes} nullable ints — the
+  Compiled.memory_analysis() buffer allocation; null on the
+  lowering-only capture path and on backends without it),
+  platform: str | null | absent, error: str | absent
+
+model_drift (tools/drift_audit.py): an analytic prediction disagreed
+  with what actually ran beyond the audit threshold — the record that
+  turns the predict_all/predict_mesh priors and the wire gauges from
+  trusted constants into audited models
+  metric: str (non-empty; e.g. wire_bytes_fwd_per_epoch,
+  tune_prior_ranking),
+  predicted: number | null, observed: number | null,
+  drift: number (signed fraction, observed/predicted - 1; for ranking
+  drift, the measured slowdown of the prior's pick vs the measured
+  best), threshold: number,
+  source: str (wire_accounting | tune_prior | program_cost, open set),
+  family / candidate / partitions / graph_digest / backend / layers /
+  episode_run_id: open context fields (the tuning episode's cache-key
+  facts when the stream carries them),
+  flagged_entry: str | absent (the first tune-cache file marked for
+  re-trial), flagged_entries: array | absent (all of them)
+
 run_summary:
   algorithm: str, fingerprint: str,
   counters/gauges/timings: objects (the registry snapshot),
@@ -199,6 +234,8 @@ KNOWN_KINDS = (
     "hist",
     "slo_status",
     "backend_probe",
+    "program_cost",
+    "model_drift",
     "run_summary",
 )
 
@@ -464,6 +501,36 @@ def validate_event(obj: Any) -> None:
         if p is not None and not isinstance(p, str):
             _fail(f"backend_probe.platform must be a string or null, "
                   f"got {p!r}")
+    elif kind == "program_cost":
+        if not isinstance(obj.get("label"), str) or not obj["label"]:
+            _fail("program_cost.label must be a non-empty string")
+        if not isinstance(obj.get("available"), bool):
+            _fail(f"program_cost.available must be a bool, got "
+                  f"{obj.get('available')!r}")
+        if not isinstance(obj.get("source"), str) or not obj["source"]:
+            _fail("program_cost.source must be a non-empty string")
+        for key in ("flops", "bytes_accessed", "transcendentals"):
+            _require_number(obj, key, allow_none=True)
+        mem = obj.get("memory")
+        if mem is not None:
+            if not isinstance(mem, dict):
+                _fail(f"program_cost.memory must be an object or null, "
+                      f"got {mem!r}")
+            for k, v in mem.items():
+                if v is not None and (
+                    not isinstance(v, int) or isinstance(v, bool)
+                ):
+                    _fail(f"program_cost.memory.{k} must be an int or "
+                          f"null, got {v!r}")
+    elif kind == "model_drift":
+        if not isinstance(obj.get("metric"), str) or not obj["metric"]:
+            _fail("model_drift.metric must be a non-empty string")
+        if not isinstance(obj.get("source"), str) or not obj["source"]:
+            _fail("model_drift.source must be a non-empty string")
+        _require_number(obj, "predicted", allow_none=True)
+        _require_number(obj, "observed", allow_none=True)
+        _require_number(obj, "drift")
+        _require_number(obj, "threshold")
     elif kind == "serve_summary":
         for key in ("requests", "shed"):
             if not isinstance(obj.get(key), int) or obj[key] < 0:

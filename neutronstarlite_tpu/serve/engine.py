@@ -275,6 +275,16 @@ class InferenceEngine:
         if self.metrics is not None:
             self.metrics.counter_add(f"serve.compiles.bucket_{bucket}")
             self.metrics.observe("serve.compile", dt)
+            # compiled-program cost attribution (obs/cost): the bucket
+            # executable already exists, so cost AND memory analysis are
+            # free reads — the real per-bucket HBM envelope next to the
+            # ladder's shape math
+            from neutronstarlite_tpu.obs.cost import capture_program_cost
+
+            capture_program_cost(
+                self.metrics, f"serve.bucket_{bucket}", compiled=compiled,
+                bucket=bucket, compile_s=round(dt, 4),
+            )
         log.info("AOT-compiled bucket %d (caps %s) in %.3fs", bucket, caps, dt)
         return compiled
 

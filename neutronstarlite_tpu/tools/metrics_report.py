@@ -24,7 +24,17 @@ each record against the obs schema, and renders:
   ``shed`` records interleaved), and the backend-probe block
   (``backend_probe`` records; probe-only streams — a bench whose backend
   never answered — render as their own small block);
+- per run: the program-cost block (``program_cost`` records, obs/cost —
+  XLA's own FLOPs/bytes/memory per labeled executable) and the
+  prediction-drift block (``model_drift`` records, tools/drift_audit —
+  analytic models caught disagreeing with measured telemetry);
 - across runs: a comparison table keyed by run_id/algorithm/fingerprint.
+
+A metrics dir whose only contents are ``flight/`` dumps renders the
+dumps with a loud note instead of an empty report; a dir carrying both
+streams and dumps renders only the streams (dump records duplicate
+stream records — including both would double-count) and says the dumps
+exist.
 
 Serving percentiles are read from the stream's merged ``hist`` records
 (cumulative snapshots that survive NTS_METRICS_MAX_MB rotation) with the
@@ -75,6 +85,44 @@ def expand_paths(args: List[str]) -> List[str]:
             out.extend(sorted(glob.glob(os.path.join(a, "*.jsonl"))))
         else:
             out.append(a)
+    return out
+
+
+def expand_report_paths(args: List[str]) -> List[str]:
+    """expand_paths with the flight-recorder subdirectory handled
+    explicitly: a metrics dir whose only contents are ``flight/`` dumps
+    (the run crashed before its stream opened, or only the recorder
+    fired) renders the DUMPS with a loud note instead of an empty
+    report; a dir carrying both keeps rendering only the streams — dump
+    records duplicate stream records, so including both would
+    double-count — and says the dumps exist."""
+    out: List[str] = []
+    for a in args:
+        if not os.path.isdir(a):
+            out.append(a)
+            continue
+        top = sorted(glob.glob(os.path.join(a, "*.jsonl")))
+        dumps = sorted(glob.glob(os.path.join(a, "flight", "*.jsonl")))
+        if top:
+            out.extend(top)
+            if dumps:
+                print(
+                    f"{a}: note: {len(dumps)} flight-recorder dump(s) "
+                    f"under {os.path.join(a, 'flight')} are NOT included "
+                    "(dump records duplicate the stream; pass the "
+                    "flight/ directory explicitly to render them)",
+                    file=sys.stderr,
+                )
+        elif dumps:
+            print(
+                f"{a}: no metrics streams, but {len(dumps)} "
+                f"flight-recorder dump(s) under "
+                f"{os.path.join(a, 'flight')} — rendering the dumps "
+                "(each is the last-records ring a trigger snapshotted, "
+                "not a full run)",
+                file=sys.stderr,
+            )
+            out.extend(dumps)
     return out
 
 
@@ -243,6 +291,8 @@ def render_serve(path: str, rec: Dict[str, Any],
             "expired={expired}".format(**cache)
         )
     lines.extend(render_sample(rec))
+    lines.extend(rec.get("_cost") or [])
+    lines.extend(rec.get("_drift") or [])
     lines.extend(rec.get("_hists") or [])
     lines.extend(rec.get("_slo") or [])
     lines.extend(rec.get("_trace") or [])
@@ -442,6 +492,84 @@ def slo_timeline(events: List[Dict[str, Any]]) -> List[str]:
     return lines
 
 
+def render_program_costs(events: List[Dict[str, Any]],
+                         rec: Optional[Dict[str, Any]] = None) -> List[str]:
+    """The compiled-program cost block (obs/cost): XLA's own FLOPs /
+    bytes / memory per labeled executable — from the run_summary's
+    consolidated list when present, the raw ``program_cost`` records
+    otherwise (latest per label wins). Empty for uninstrumented runs."""
+    costs = list((rec or {}).get("program_costs") or [])
+    if not costs:
+        costs = [e for e in events if e["event"] == "program_cost"]
+    if not costs:
+        return []
+    by_label: Dict[str, Dict[str, Any]] = {}
+    for c in costs:
+        if c.get("label"):
+            by_label[c["label"]] = c
+
+    def _n(v):
+        return f"{v:g}" if v is not None else "n/a"
+
+    lines = ["program costs:"]
+    for label, c in sorted(by_label.items()):
+        if not c.get("available"):
+            lines.append(
+                f"#program_cost={label} unavailable "
+                f"({c.get('error') or 'backend exposes no analysis'})"
+            )
+            continue
+        mem = c.get("memory") or {}
+        tail = ""
+        if mem.get("peak_bytes") is not None:
+            tail = (
+                f" peak={mem['peak_bytes']}B (args={_n(mem.get('argument_bytes'))}"
+                f" out={_n(mem.get('output_bytes'))}"
+                f" temp={_n(mem.get('temp_bytes'))})"
+            )
+        lines.append(
+            f"#program_cost={label} flops={_n(c.get('flops'))} "
+            f"bytes_accessed={_n(c.get('bytes_accessed'))}"
+            f"{tail} (source={c.get('source')})"
+        )
+    return lines
+
+
+def render_drift(events: List[Dict[str, Any]]) -> List[str]:
+    """The prediction-drift block (tools/drift_audit): every
+    ``model_drift`` record — an analytic model (wire pricing, tuner
+    prior) caught disagreeing with what actually ran. Empty for
+    drift-free streams."""
+    drifts = [e for e in events if e["event"] == "model_drift"]
+    if not drifts:
+        return []
+
+    def _n(v):
+        return f"{v:g}" if v is not None else "n/a"
+
+    lines = ["prediction drift:"]
+    for d in drifts:
+        extra = ""
+        if d.get("candidate"):
+            extra += (
+                f" prior_pick={d['candidate']}"
+                + (f" measured_best={d['measured_best']}"
+                   if d.get("measured_best") else "")
+            )
+        if d.get("flagged_entry"):
+            extra += f" flagged={d['flagged_entry']}"
+            more = len(d.get("flagged_entries") or []) - 1
+            if more > 0:
+                extra += f" (+{more} more)"
+        lines.append(
+            f"#model_drift={d['metric']} predicted={_n(d.get('predicted'))} "
+            f"observed={_n(d.get('observed'))} "
+            f"({d['drift'] * 100:+.1f}% > {d['threshold'] * 100:.0f}%, "
+            f"source={d.get('source')}){extra}"
+        )
+    return lines
+
+
 def render_probes(events: List[Dict[str, Any]]) -> List[str]:
     """The ``backend_probe`` block (bench.py's subprocess PJRT check) —
     the stale-anchor cause, visible at last. Empty without probes."""
@@ -582,6 +710,8 @@ def render_run(path: str, rec: Dict[str, Any]) -> str:
         lines.append(f"#final_loss={loss}")
     lines.extend(rec.get("_ring") or [])
     lines.extend(rec.get("_tune") or [])
+    lines.extend(rec.get("_cost") or [])
+    lines.extend(rec.get("_drift") or [])
     lines.extend(rec.get("_elastic") or [])
     lines.extend(render_sample(rec))
     lines.extend(rec.get("_hists") or [])
@@ -841,9 +971,10 @@ def main(argv=None) -> int:
     if not args.paths:
         ap.error("paths required (or use --diff A B)")
 
-    paths = expand_paths(args.paths)
+    paths = expand_report_paths(args.paths)
     if not paths:
-        print("no .jsonl inputs found", file=sys.stderr)
+        print("no .jsonl inputs found (a dir holding only a flight/ "
+              "subdirectory would have said so above)", file=sys.stderr)
         return 1
     rows: List[Dict[str, Any]] = []
     failed = False
@@ -889,11 +1020,14 @@ def main(argv=None) -> int:
         trace_lines = timeline_block(events)
         hist_lines = render_hists(events)
         slo_lines = slo_timeline(events)
+        drift_lines = render_drift(events)
         if rec is not None:
             rec["_path"] = p
             rec["_timeline"] = recovery_timeline(events)
             rec["_ring"] = render_ring(events, rec)
             rec["_tune"] = render_tuning(events, rec)
+            rec["_cost"] = render_program_costs(events, rec)
+            rec["_drift"] = drift_lines
             rec["_elastic"] = render_elastic(events, rec)
             rec["_hists"] = hist_lines
             rec["_slo"] = slo_lines
@@ -903,6 +1037,10 @@ def main(argv=None) -> int:
             srec["_path"] = p
             srec["_events"] = events
             srec["_serve"] = True
+            srec["_cost"] = (
+                render_program_costs(events, srec) if rec is None else []
+            )
+            srec["_drift"] = drift_lines if rec is None else []
             srec["_hists"] = hist_lines if rec is None else []
             srec["_slo"] = slo_lines if rec is None else []
             srec["_trace"] = trace_lines if rec is None else []

@@ -28,6 +28,7 @@ real trials instead of inheriting an unmeasured guess.
 from __future__ import annotations
 
 import dataclasses
+import glob
 import hashlib
 import json
 import os
@@ -177,3 +178,74 @@ def store(key: CacheKey, decision: Dict[str, Any],
     os.replace(tmp, path)  # the commit point: readers see all or nothing
     log.info("tune cache: stored %s -> %s", decision.get("candidate"), path)
     return path
+
+
+# ---- drift flagging (tools/drift_audit.py) ----------------------------------
+
+
+def find_entries(directory: Optional[str] = None,
+                 family: Optional[str] = None,
+                 partitions: Optional[int] = None,
+                 graph_digest: Optional[str] = None,
+                 backend: Optional[str] = None,
+                 layers: Optional[str] = None) -> List[str]:
+    """Paths of parseable cache entries matching the given key facts
+    (None = match any). The drift auditor locates the entries a
+    tuner-prior drift implicates through the embedded key; trial records
+    stamped with the full key (tune/select) narrow the match to exactly
+    the implicated entry, while older streams that only carry
+    (family, partitions) still find theirs."""
+    directory = directory or tune_dir()
+    if not directory or not os.path.isdir(directory):
+        return []
+    want = {
+        "family": family, "partitions": partitions,
+        "graph_digest": graph_digest, "backend": backend,
+        "layers": layers,
+    }
+    out: List[str] = []
+    for path in sorted(glob.glob(os.path.join(directory, "tune-*.json"))):
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                entry = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            continue
+        key = entry.get("key") if isinstance(entry, dict) else None
+        if not isinstance(key, dict):
+            continue
+        if any(v is not None and key.get(k) != v for k, v in want.items()):
+            continue
+        out.append(path)
+    return out
+
+
+def flag_for_retrial(path: str, reason: str) -> bool:
+    """Mark one cache entry drift-flagged (atomic rewrite): the next
+    ``NTS_TUNE=measure`` run treats it as a loud miss and re-trials
+    (the fresh store replaces the entry, clearing the flag); cached mode
+    still replays it with a warning — a degraded decision beats measuring
+    inside a path that asked not to. Returns False when the entry is
+    unreadable (warned)."""
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            entry = json.load(fh)
+    except (OSError, json.JSONDecodeError) as e:
+        log.warning("tune cache: cannot flag %s (%s)", path, e)
+        return False
+    if not isinstance(entry, dict):
+        log.warning("tune cache: cannot flag non-object entry %s", path)
+        return False
+    entry["drift_flag"] = {"reason": str(reason), "flagged_ts": time.time()}
+    tmp = f"{path}.tmp-{os.getpid()}"
+    try:
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(entry, fh, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+    except OSError as e:
+        log.warning("tune cache: flagging %s failed (%s)", path, e)
+        return False
+    log.warning(
+        "tune cache: flagged %s for re-trial (%s) — the next "
+        "NTS_TUNE=measure run will re-run real trials", path, reason,
+    )
+    return True

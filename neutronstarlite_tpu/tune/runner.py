@@ -163,17 +163,27 @@ def analytic_priors(host_graph, P: int, sizes: List[int], family: str,
 # ---- stage 2: measured micro-trials ----------------------------------------
 
 
-def _time_leg(fn, steps: int) -> float:
+def _time_leg(fn, steps: int, metrics=None, label: str = "") -> float:
     """Warm-median seconds of ``fn(scale)`` over ``steps`` timed calls
     after one compile call. The scale argument forces a fresh dispatch
     per call (the micro_bench idiom); warm-vs-compile attribution is the
-    existing collector's, so the jit compile never rides the score."""
+    existing collector's, so the jit compile never rides the score.
+    When a registry is passed, the leg's program cost is captured too
+    (obs/cost, label ``tune.trial/<candidate>``) so every trial's XLA
+    numbers sit next to its prior in the stream."""
     import jax
     import jax.numpy as jnp
 
     from neutronstarlite_tpu.obs.collectors import steady_state_stats
 
     jfn = jax.jit(fn)
+    if metrics is not None:
+        from neutronstarlite_tpu.obs.cost import capture_program_cost
+
+        capture_program_cost(
+            metrics, f"tune.trial/{label}", jitted=jfn,
+            args=(jnp.float32(1.0),),
+        )
     times = []
     for i in range(steps + 1):
         s = jnp.float32(1.0 + 1e-6 * i)
@@ -197,7 +207,7 @@ def measure_candidates(
     host_graph, P: int, sizes: List[int], family: str,
     candidates: List[Candidate], simulate: bool,
     kernel_tile: int = 0, edge_chunk: int = 0, score_channels: int = 1,
-    steps: Optional[int] = None, seed: int = 7,
+    steps: Optional[int] = None, seed: int = 7, metrics=None,
 ) -> Dict[str, Optional[float]]:
     """{candidate label: warm seconds | None (unmeasurable on this rig)}.
 
@@ -306,7 +316,8 @@ def measure_candidates(
                         c(dist_ring_blocked_gather_simulated(b, v, w), W_c)
                     )
                     out[label] = _time_leg(
-                        _grad_leg(fn, jnp.asarray(x2h)), steps
+                        _grad_leg(fn, jnp.asarray(x2h)), steps,
+                        metrics=metrics, label=label,
                     )
                 else:
                     if "mesh" not in rig:
@@ -340,7 +351,8 @@ def measure_candidates(
                         c(dist_ring2d_gather_dst_from_src(m, b, v, w, pf=q),
                           W_c)
                     )
-                    out[label] = _time_leg(_grad_leg(fn, rig["x"]), steps)
+                    out[label] = _time_leg(_grad_leg(fn, rig["x"]), steps,
+                                          metrics=metrics, label=label)
             elif cand.dist_path == "all_gather":
                 if simulate or not mesh_reachable(P):
                     out[label] = None  # no sim twin for the gather family
@@ -361,7 +373,8 @@ def measure_candidates(
                 fn = lambda v: (  # noqa: E731,B023
                     dist_ell_gather_dst_from_src(mesh, ell, v) @ W_c
                 )
-                out[label] = _time_leg(_grad_leg(fn, x), steps)
+                out[label] = _time_leg(_grad_leg(fn, x), steps,
+                                      metrics=metrics, label=label)
             elif _norm("dist_path", cand.dist_path) == "ring_blocked":
                 dist, xh = base_rig()
                 if ring_pair is None:
@@ -388,7 +401,8 @@ def measure_candidates(
                         dist_ring_blocked_gather_dst_from_src(mesh, b, v, w)
                         @ W_c
                     )
-                out[label] = _time_leg(_grad_leg(fn, x), steps)
+                out[label] = _time_leg(_grad_leg(fn, x), steps,
+                                      metrics=metrics, label=label)
             else:
                 out[label] = None
         return out
@@ -436,7 +450,8 @@ def measure_candidates(
                     s = edge_softmax(g, score)
                     return aggregate_edge_to_dst_weighted(g, s, x)
 
-            out[label] = _time_leg(_grad_leg(fn, h), steps)
+            out[label] = _time_leg(_grad_leg(fn, h), steps,
+                                      metrics=metrics, label=label)
         return out
 
     if family == "edge_dist":
@@ -474,7 +489,8 @@ def measure_candidates(
                 fn = lambda x, p=pair, a=al, b=ar: (  # noqa: E731
                     dist_fused_edge_aggregate(mesh, p, x, a, b, 0.01)
                 )
-                out[label] = _time_leg(_grad_leg(fn, h), steps)
+                out[label] = _time_leg(_grad_leg(fn, h), steps,
+                                      metrics=metrics, label=label)
             elif C == 1:
                 # the eager mirror chain trial is the GAT-form layer
                 # (models/gat_dist.dist_gat_layer — sim twin when no
@@ -497,7 +513,8 @@ def measure_candidates(
                 fn = lambda x, m=mg, t=tables: (  # noqa: E731
                     dist_gat_layer(mesh, m, t, W, a, x, last=True)
                 )
-                out[label] = _time_leg(_grad_leg(fn, h), steps)
+                out[label] = _time_leg(_grad_leg(fn, h), steps,
+                                      metrics=metrics, label=label)
             else:
                 out[label] = None
         return out
@@ -533,6 +550,7 @@ def score_candidates(
     candidates: List[Candidate], simulate: bool,
     emit: Optional[Callable[..., Any]] = None,
     measure: bool = True, family_label: Optional[str] = None,
+    metrics=None,
     **leg_kwargs,
 ) -> List[Dict[str, Any]]:
     """Prior + (optionally) measured scores for every candidate, emitted
@@ -576,6 +594,7 @@ def score_candidates(
     measured = measure_candidates(
         host_graph, P, sizes, family,
         [c for c in candidates if c.label() in keep], simulate,
+        metrics=metrics,
         **leg_kwargs,
     )
     for row in rows:

@@ -154,7 +154,26 @@ def _decide(toolkit, autos: Set[str], measure_allowed: bool,
     key = _cache_key(toolkit, family, P)
 
     entry = cache.load(key)
+    if entry is not None and entry.get("drift_flag") and measure_allowed \
+            and not in_recovery:
+        # the drift auditor (tools/drift_audit.py) marked this entry's
+        # cost model wrong: in measure mode that is a loud miss — re-run
+        # real trials (the fresh store replaces the entry, clearing the
+        # flag). Cached mode and the recovery path still replay below
+        # (measuring there is worse than a degraded decision).
+        log.warning(
+            "tune cache: entry %s is drift-flagged (%s) — re-trialing "
+            "instead of replaying a decision whose cost model drifted",
+            key.filename(), (entry["drift_flag"] or {}).get("reason"),
+        )
+        entry = None
     if entry is not None:
+        if entry.get("drift_flag"):
+            log.warning(
+                "tune cache: replaying drift-flagged entry %s (%s) — run "
+                "with NTS_TUNE=measure to re-trial it",
+                key.filename(), (entry["drift_flag"] or {}).get("reason"),
+            )
         decision = entry["decision"]
         stored_autos = set(entry.get("autos") or [])
         if not autos <= stored_autos:
@@ -199,11 +218,24 @@ def _decide(toolkit, autos: Set[str], measure_allowed: bool,
         if chan is not None:
             C = int(chan(sizes[1]))
     metrics = getattr(toolkit, "metrics", None)
-    emit = metrics.event if metrics is not None else None
+    # trial records carry the FULL cache-key facts (digest/backend/
+    # layers ride as open fields), so the drift auditor can flag exactly
+    # the implicated entry instead of every (family, P) entry across
+    # graphs and rigs
+    key_ctx = {
+        "graph_digest": key.graph_digest,
+        "backend": key.backend,
+        "layers": key.layers,
+    }
+    emit = (
+        (lambda kind, **f: metrics.event(kind, **dict(key_ctx, **f)))
+        if metrics is not None else None
+    )
     measure = measure_allowed and not in_recovery
     rows = runner.score_candidates(
         toolkit.host_graph, P, sizes, fam_short, candidates,
         simulate=sim, emit=emit, measure=measure, family_label=family,
+        metrics=metrics,
         kernel_tile=cfg.kernel_tile, edge_chunk=cfg.edge_chunk,
         score_channels=C, precision=cfg.precision,
         eager_widths=bool(getattr(cls, "eager", False)),

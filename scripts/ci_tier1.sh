@@ -10,7 +10,26 @@
 # the per-run passed-test count the PROGRESS trajectory tracks. Change the
 # pytest line ONLY together with ROADMAP.md.
 cd "$(dirname "$0")/.." || exit 1
-set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 1200 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+t1_start=$(date +%s)
+set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 1200 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; t1_dots=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); echo DOTS_PASSED=$t1_dots
+
+# ---- suite trajectory (ISSUE 13): the suite's own duration + DOTS_PASSED
+# become one kind=suite row in the cross-run perf ledger, and the sentinel
+# turns the ROADMAP's hand-written "watch the margin" note into a machine
+# check (warns when suite time exceeds 80% of the 1200s timeout; the
+# duration regression gate stays advisory — the rig's noise history sets
+# its tolerance, so it sharpens as the ledger grows). t1_dots is the ONE
+# DOTS_PASSED computation — the printed line and the ledger row can
+# never diverge.
+t1_dur=$(( $(date +%s) - t1_start ))
+t1_ledger="${NTS_LEDGER_DIR:-$PWD/docs/perf_runs/ledger}"
+JAX_PLATFORMS=cpu python -m neutronstarlite_tpu.tools.perf_sentinel \
+  record-suite --ledger "$t1_ledger" --duration "$t1_dur" \
+  --dots "$t1_dots" --rc "$rc" --timeout 1200 \
+|| echo "suite ledger row append failed (advisory)"
+JAX_PLATFORMS=cpu python -m neutronstarlite_tpu.tools.perf_sentinel \
+  check --ledger "$t1_ledger" --kind suite --suite-budget 1200
+echo "SUITE_SENTINEL=rc$? (advisory; warns over 80% of the 1200s timeout)"
 
 # ---- fused-edge regression gates (ISSUE 6) ---------------------------------
 # (1) STRUCTURAL (hard): run the fused smoke cfg and diff its obs stream
@@ -484,10 +503,83 @@ else
   echo "OBS_GATE=OK"
 fi
 
+# ---- perf ledger + sentinel gate (ISSUE 13) --------------------------------
+# STRUCTURAL (hard): run the gcn_cora smoke TWICE into one fresh
+# NTS_LEDGER_DIR. Requires: two kind=run ledger rows with MATCHING keys
+# (graph digest + cfg fingerprint + backend), each carrying the captured
+# program_cost records; the sentinel exits 0 against its own (thin)
+# history; then a synthetically corrupted third row (warm epoch x10)
+# makes the sentinel exit 2 — the exit-2 contract, proven end to end.
+ledger_rc=0
+rm -rf /tmp/_t1_ledger /tmp/_t1_ledger_obs1 /tmp/_t1_ledger_obs2
+if JAX_PLATFORMS=cpu NTS_METRICS_DIR=/tmp/_t1_ledger_obs1 \
+    NTS_LEDGER_DIR=/tmp/_t1_ledger \
+    timeout -k 10 300 python -m neutronstarlite_tpu.run \
+    configs/gcn_cora_smoke.cfg > /tmp/_t1_ledger1.log 2>&1 \
+  && JAX_PLATFORMS=cpu NTS_METRICS_DIR=/tmp/_t1_ledger_obs2 \
+    NTS_LEDGER_DIR=/tmp/_t1_ledger \
+    timeout -k 10 300 python -m neutronstarlite_tpu.run \
+    configs/gcn_cora_smoke.cfg > /tmp/_t1_ledger2.log 2>&1
+then
+  JAX_PLATFORMS=cpu python - <<'EOF' || ledger_rc=$?
+import subprocess, sys
+
+from neutronstarlite_tpu.obs import ledger
+
+D = "/tmp/_t1_ledger"
+rows = ledger.read_rows(directory=D)
+runs = [r for r in rows if r["kind"] == "run"]
+assert len(runs) == 2, f"want 2 run rows, got {len(runs)}"
+k0, k1 = ledger.row_key(runs[0]), ledger.row_key(runs[1])
+assert k0 == k1, f"ledger keys diverged between identical runs:\n  {k0}\n  {k1}"
+assert runs[0]["graph_digest"] and runs[0]["cfg"], runs[0]
+for r in runs:
+    assert r.get("program_costs"), "run row carries no program_cost records"
+    assert r.get("warm_median_epoch_s"), r
+
+def sentinel(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "neutronstarlite_tpu.tools.perf_sentinel",
+         "check", "--ledger", D, *args],
+        capture_output=True, text=True,
+    )
+
+r = sentinel()
+assert r.returncode == 0, (
+    f"sentinel rc={r.returncode} against its own history:\n{r.stdout}\n{r.stderr}"
+)
+# synthetically corrupted third row: 10x warm epoch, same key
+bad = dict(runs[-1])
+bad["warm_median_epoch_s"] = runs[-1]["warm_median_epoch_s"] * 10
+bad["avg_epoch_s"] = (runs[-1].get("avg_epoch_s") or 0) * 10
+ledger.append_row(bad, directory=D)
+r = sentinel()
+assert r.returncode == 2, (
+    f"sentinel rc={r.returncode} on a 10x epoch-time row (want 2):\n"
+    f"{r.stdout}\n{r.stderr}"
+)
+print(
+    "ledger gate: 2 matching run rows (digest "
+    f"{runs[0]['graph_digest'][:12]}, cfg {runs[0]['cfg'][:12]}), "
+    f"{len(runs[0]['program_costs'])} program cost(s)/run; sentinel 0 on "
+    "clean history, 2 on the corrupted row"
+)
+EOF
+else
+  ledger_rc=$?
+  tail -30 /tmp/_t1_ledger1.log /tmp/_t1_ledger2.log 2>/dev/null
+fi
+if [ "$ledger_rc" -ne 0 ]; then
+  echo "LEDGER_GATE=FAIL (rc=$ledger_rc)"
+else
+  echo "LEDGER_GATE=OK"
+fi
+
 [ "$rc" -eq 0 ] && rc=$fused_rc
 [ "$rc" -eq 0 ] && rc=$samp_rc
 [ "$rc" -eq 0 ] && rc=$elastic_rc
 [ "$rc" -eq 0 ] && rc=$tune_rc
 [ "$rc" -eq 0 ] && rc=$mesh_rc
 [ "$rc" -eq 0 ] && rc=$obs_rc
+[ "$rc" -eq 0 ] && rc=$ledger_rc
 exit $rc

@@ -1,0 +1,340 @@
+"""Prediction-drift auditor (tools/drift_audit) + tune-cache flagging.
+
+The ISSUE 13 acceptance: a deliberately mispriced wire prediction is
+flagged as ``model_drift``, and the matching tune-cache entry is marked
+for re-trial so the next measure-mode run measures again instead of
+replaying a decision whose cost model was wrong.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from neutronstarlite_tpu.obs import registry, schema
+from neutronstarlite_tpu.tools import drift_audit
+from neutronstarlite_tpu.tune import cache
+
+FAMILY = "dist_dense/DistGCNTrainer"
+
+
+def _trial(reg, candidate, seconds, predicted, partitions=4):
+    reg.event(
+        "tune_trial", family=FAMILY, candidate=candidate,
+        source="measured", seconds=seconds, predicted_bytes=predicted,
+        partitions=partitions,
+    )
+
+
+def _summary(reg, predicted, observed_total, epochs=2):
+    reg.event(
+        "run_summary", algorithm="GCNDIST", fingerprint="f",
+        counters={"wire.bytes_fwd": observed_total},
+        gauges={"wire.bytes_per_epoch_fwd": predicted},
+        timings={}, epochs=epochs,
+        epoch_time={"first_s": 1.0, "warm_median_s": 0.5,
+                    "compile_overhead_s": 0.5},
+        phases={}, memory={"available": False, "bytes_in_use": None,
+                           "peak_bytes_in_use": None, "devices": []},
+    )
+
+
+# ---- wire pair --------------------------------------------------------------
+
+
+def test_wire_drift_within_tolerance_is_silent():
+    assert drift_audit.wire_drift(
+        {"wire.bytes_fwd": 2100}, {"wire.bytes_per_epoch_fwd": 1000},
+        epochs=2, threshold=0.1,
+    ) == []
+
+
+def test_wire_drift_beyond_threshold_reports():
+    (d,) = drift_audit.wire_drift(
+        {"wire.bytes_fwd": 4000}, {"wire.bytes_per_epoch_fwd": 1000},
+        epochs=2, threshold=0.1,
+    )
+    assert d["metric"] == "wire_bytes_fwd_per_epoch"
+    assert d["predicted"] == 1000 and d["observed"] == 2000
+    assert d["drift"] == pytest.approx(1.0)
+
+
+def test_wire_drift_is_two_sided():
+    """Shipping LESS than predicted is drift too — the model is wrong in
+    either direction."""
+    (d,) = drift_audit.wire_drift(
+        {"wire.bytes_fwd": 1000}, {"wire.bytes_per_epoch_fwd": 1000},
+        epochs=2, threshold=0.1,
+    )
+    assert d["drift"] == pytest.approx(-0.5)
+
+
+# ---- tuner prior ranking ----------------------------------------------------
+
+
+def _events_with_inverted_prior(tmp_path):
+    reg = registry.MetricsRegistry(
+        "r1", algorithm="GCNDIST", fingerprint="f",
+        path=str(tmp_path / "s.jsonl"),
+    )
+    # the prior prefers all_gather (100 B) but measurement says ring is
+    # 2x faster — the deliberately mispriced prediction
+    _trial(reg, "all_gather|-|-|-", seconds=0.080, predicted=100)
+    _trial(reg, "ring_blocked|-|-|bf16", seconds=0.040, predicted=200)
+    reg.close()
+    return [json.loads(l) for l in open(tmp_path / "s.jsonl")
+            if l.strip()]
+
+
+def test_prior_inversion_detected(tmp_path):
+    events = _events_with_inverted_prior(tmp_path)
+    drifts = drift_audit.tune_prior_drift(events, threshold=0.1)
+    assert len(drifts) == 1
+    d = drifts[0]
+    assert d["metric"] == "tune_prior_ranking"
+    assert d["candidate"] == "all_gather|-|-|-"  # the prior's bad pick
+    assert d["measured_best"] == "ring_blocked|-|-|bf16"
+    assert d["drift"] == pytest.approx(1.0)
+    assert d["family"] == FAMILY and d["partitions"] == 4
+
+
+def test_correct_prior_ranking_is_silent(tmp_path):
+    reg = registry.MetricsRegistry("r2", algorithm="G", fingerprint="f",
+                                   path=str(tmp_path / "s.jsonl"))
+    _trial(reg, "a", seconds=0.040, predicted=100)
+    _trial(reg, "b", seconds=0.080, predicted=200)
+    reg.close()
+    events = [json.loads(l) for l in open(tmp_path / "s.jsonl")
+              if l.strip()]
+    assert drift_audit.tune_prior_drift(events, threshold=0.1) == []
+
+
+def test_trials_from_different_runs_never_cross_rank(tmp_path):
+    """Two runs' trials of the SAME candidates land in separate episode
+    groups (run_id keys the group): the rig's run-to-run swing must not
+    read as prior drift when each run's prior picked its own measured
+    winner."""
+    paths = []
+    for i, (fast, slow) in enumerate(((0.040, 0.080), (0.030, 0.060))):
+        p = tmp_path / f"s{i}.jsonl"
+        reg = registry.MetricsRegistry(f"run-{i}", algorithm="G",
+                                       fingerprint="f", path=str(p))
+        # prior ordering CORRECT within each run (fewer bytes = faster)
+        _trial(reg, "a", seconds=fast, predicted=100)
+        _trial(reg, "b", seconds=slow, predicted=200)
+        reg.close()
+        paths.append(p)
+    events = [json.loads(l) for p in paths for l in open(p) if l.strip()]
+    # merged naively, run 0's "a" (0.040) would lose to run 1's "a"
+    # (0.030) and fabricate a 33% "drift"; the run_id key prevents it
+    assert drift_audit.tune_prior_drift(events, threshold=0.1) == []
+
+
+def test_single_measured_trial_cannot_rank(tmp_path):
+    reg = registry.MetricsRegistry("r3", algorithm="G", fingerprint="f",
+                                   path=str(tmp_path / "s.jsonl"))
+    _trial(reg, "a", seconds=0.040, predicted=999)
+    reg.event("tune_trial", family=FAMILY, candidate="b", source="prior",
+              seconds=None, predicted_bytes=1, partitions=4)
+    reg.close()
+    events = [json.loads(l) for l in open(tmp_path / "s.jsonl")
+              if l.strip()]
+    assert drift_audit.tune_prior_drift(events, threshold=0.1) == []
+
+
+# ---- cache flagging ---------------------------------------------------------
+
+
+def _store_entry(tmp_path, partitions=4):
+    key = cache.CacheKey(
+        graph_digest="g", family=FAMILY, partitions=partitions,
+        layers="16-8-4", backend="b",
+    )
+    return key, cache.store(
+        key, {"candidate": "all_gather|-|-|-"}, directory=str(tmp_path),
+        autos=["dist_path"],
+    )
+
+
+def test_flag_for_retrial_marks_entry_atomically(tmp_path):
+    _, path = _store_entry(tmp_path)
+    assert cache.flag_for_retrial(path, "prior drifted")
+    entry = json.load(open(path))
+    assert entry["drift_flag"]["reason"] == "prior drifted"
+    # the key and decision survive the rewrite intact
+    assert entry["decision"]["candidate"] == "all_gather|-|-|-"
+
+
+def test_find_entries_matches_by_family_and_partitions(tmp_path):
+    _, path = _store_entry(tmp_path, partitions=4)
+    _store_entry(tmp_path, partitions=3)
+    hit = cache.find_entries(str(tmp_path), family=FAMILY, partitions=4)
+    assert hit == [path]
+    assert cache.find_entries(str(tmp_path), family="other/F") == []
+
+
+def test_find_entries_narrows_by_digest_and_backend(tmp_path):
+    """Key facts beyond (family, P) narrow the match: one graph's drift
+    on one rig must not implicate another rig's entry."""
+    _, path = _store_entry(tmp_path, partitions=4)  # digest=g, backend=b
+    assert cache.find_entries(str(tmp_path), family=FAMILY, partitions=4,
+                              graph_digest="g", backend="b") == [path]
+    assert cache.find_entries(str(tmp_path), family=FAMILY, partitions=4,
+                              graph_digest="OTHER") == []
+    assert cache.find_entries(str(tmp_path), family=FAMILY, partitions=4,
+                              backend="tpu-v5e") == []
+    # None facts match anything (pre-stamping streams)
+    assert cache.find_entries(str(tmp_path), family=FAMILY, partitions=4,
+                              graph_digest=None) == [path]
+
+
+def test_audit_flags_the_mispriced_entry(tmp_path):
+    """The acceptance path: mispriced prior -> model_drift + the cache
+    entry marked for re-trial."""
+    obs_dir = tmp_path / "obs"
+    obs_dir.mkdir()
+    events = _events_with_inverted_prior(obs_dir)
+    _, entry_path = _store_entry(tmp_path)
+    drifts = drift_audit.audit_events(events, threshold=0.1)
+    flagged = drift_audit.flag_tune_cache(drifts, str(tmp_path))
+    assert flagged == [entry_path]
+    assert json.load(open(entry_path)).get("drift_flag")
+    # the drift entry names EVERY entry it flagged (report cross-link)
+    d = [x for x in drifts if x["source"] == "tune_prior"][0]
+    assert d["flagged_entry"] == os.path.basename(entry_path)
+    assert d["flagged_entries"] == [os.path.basename(entry_path)]
+
+
+def test_flagged_entry_retrials_in_measure_mode(tmp_path, monkeypatch):
+    """tune/select honors the flag: measure mode treats a flagged entry
+    as a loud miss (fresh trials, fresh store clears the flag); cached
+    mode still replays it."""
+    import numpy as np
+
+    from neutronstarlite_tpu.models import get_algorithm
+    from tests.test_models import _planted_data  # the tune-test rig's data
+    from neutronstarlite_tpu.graph.storage import build_graph
+    from neutronstarlite_tpu.utils.config import InputInfo
+
+    cache_dir = tmp_path / "cache"
+    monkeypatch.setenv("NTS_TUNE", "measure")
+    monkeypatch.setenv("NTS_TUNE_DIR", str(cache_dir))
+    monkeypatch.setenv("NTS_DIST_SIMULATE", "1")
+    monkeypatch.delenv("NTS_METRICS_DIR", raising=False)
+
+    def cfg():
+        c = InputInfo()
+        c.algorithm = "GCNDIST"
+        c.vertices = 120
+        c.layer_string = "8-8-3"
+        c.epochs = 1
+        c.decay_epoch = -1
+        c.drop_rate = 0.0
+        c.partitions = 4
+        c.kernel_tile = 16
+        c.dist_path = "auto"
+        c.wire_dtype = "auto"
+        return c
+
+    src, dst, datum = _planted_data(v_num=120, classes=3, f=8, seed=3)
+    g = build_graph(src, dst, 120, weight="gcn_norm")
+    cls = get_algorithm("GCNDIST")
+
+    t1 = cls.from_arrays(cfg(), src, dst, datum, host_graph=g)
+    files = list(cache_dir.glob("tune-*.json"))
+    assert len(files) == 1
+    assert t1.metrics.snapshot()["gauges"]["tune.decision_source"] == \
+        "measured"
+
+    # flag it, then a cached-mode construction still replays (warned)
+    assert cache.flag_for_retrial(str(files[0]), "test drift")
+    monkeypatch.setenv("NTS_TUNE", "cached")
+    t2 = cls.from_arrays(cfg(), src, dst, datum, host_graph=g)
+    assert t2.metrics.snapshot()["gauges"]["tune.decision_source"] == \
+        "cached"
+    assert json.load(open(files[0])).get("drift_flag")  # flag intact
+
+    # measure mode re-trials and the fresh store clears the flag
+    monkeypatch.setenv("NTS_TUNE", "measure")
+    t3 = cls.from_arrays(cfg(), src, dst, datum, host_graph=g)
+    assert t3.metrics.snapshot()["gauges"]["tune.decision_source"] == \
+        "measured"
+    entry = json.load(open(files[0]))
+    assert not entry.get("drift_flag")
+    assert np.isfinite(float(t3.cfg.partitions))  # rig stayed intact
+
+
+# ---- CLI + runtime hook -----------------------------------------------------
+
+
+def test_cli_exit_codes_and_emission(tmp_path, capsys):
+    obs_dir = tmp_path / "obs"
+    obs_dir.mkdir()
+    _events_with_inverted_prior(obs_dir)
+    rc = drift_audit.main([str(obs_dir), "--no-flag", "--emit", "--json"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 3
+    assert [d["metric"] for d in out["drift"]] == ["tune_prior_ranking"]
+    # --emit left a schema-valid model_drift stream next to the audited one
+    drift_streams = [p for p in os.listdir(obs_dir) if "driftaudit" in p]
+    assert len(drift_streams) == 1
+    recs = [json.loads(l)
+            for l in open(obs_dir / drift_streams[0]) if l.strip()]
+    assert schema.validate_stream(recs) == len(recs)
+    assert recs[-1]["event"] == "model_drift"
+
+    # a clean stream exits 0
+    clean = tmp_path / "clean"
+    clean.mkdir()
+    reg = registry.MetricsRegistry("rc", algorithm="G", fingerprint="f",
+                                   path=str(clean / "s.jsonl"))
+    _summary(reg, predicted=1000, observed_total=2000, epochs=2)
+    reg.close()
+    assert drift_audit.main([str(clean), "--no-flag"]) == 0
+
+
+def test_runtime_hook_emits_into_the_run_stream(tmp_path):
+    reg = registry.MetricsRegistry("rr", algorithm="G", fingerprint="f",
+                                   path=str(tmp_path / "s.jsonl"))
+    reg.gauge_set("wire.bytes_per_epoch_fwd", 1000)
+    reg.counter_add("wire.bytes_fwd", 4000)  # 2 epochs -> 2x predicted
+    drifts = drift_audit.audit_registry(reg, epochs=2)
+    reg.close()
+    assert len(drifts) == 1
+    events = [json.loads(l) for l in open(tmp_path / "s.jsonl")
+              if l.strip()]
+    assert schema.validate_stream(events) == len(events)
+    assert events[-1]["event"] == "model_drift"
+    assert events[-1]["drift"] == pytest.approx(1.0)
+
+
+def test_runtime_hook_disabled_and_silent_on_agreement(tmp_path,
+                                                       monkeypatch):
+    reg = registry.MetricsRegistry("rr2", algorithm="G", fingerprint="f")
+    reg.gauge_set("wire.bytes_per_epoch_fwd", 1000)
+    reg.counter_add("wire.bytes_fwd", 2000)
+    assert drift_audit.audit_registry(reg, epochs=2) == []  # agreement
+    reg.counter_add("wire.bytes_fwd", 2000)  # now 2x
+    monkeypatch.setenv("NTS_DRIFT_AUDIT", "0")
+    assert drift_audit.audit_registry(reg, epochs=2) == []  # disabled
+
+
+def test_report_renders_drift_block(tmp_path, capsys):
+    reg = registry.MetricsRegistry("rd", algorithm="G", fingerprint="f",
+                                   path=str(tmp_path / "s.jsonl"))
+    reg.event("epoch", epoch=0, seconds=0.5, loss=1.0)
+    reg.event(
+        "model_drift", metric="wire_bytes_fwd_per_epoch",
+        source="wire_accounting", predicted=1000.0, observed=2000.0,
+        drift=1.0, threshold=0.1,
+    )
+    reg.close()
+    from neutronstarlite_tpu.tools.metrics_report import main as report_main
+
+    rc = report_main([str(tmp_path / "s.jsonl")])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "prediction drift:" in out
+    assert "#model_drift=wire_bytes_fwd_per_epoch" in out

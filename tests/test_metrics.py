@@ -257,3 +257,64 @@ def test_metrics_report_renders_recovery_timeline(tmp_path, capsys):
     assert "recovery timeline:" in out
     assert "fault" in out and "kind=nonfinite_loss" in out
     assert "recovery" in out and "action=rollback" in out
+
+
+def _write_flight_dump(flight_dir, name="flight_x-fault.jsonl"):
+    """A minimal schema-valid flight dump (what obs/flight snapshots)."""
+    flight_dir.mkdir(parents=True, exist_ok=True)
+    reg = registry.MetricsRegistry(
+        "run-fl", algorithm="GCN", fingerprint="f",
+        path=str(flight_dir / name),
+    )
+    reg.epoch_event(0, 0.5, loss=1.0)
+    reg.event("fault", kind="nonfinite_loss", epoch=1, injected=True)
+    reg.close()
+
+
+def test_metrics_report_flight_only_dir_renders_dumps_with_hint(
+    tmp_path, capsys
+):
+    """ISSUE 13 fix: a metrics dir whose ONLY contents are flight/ dumps
+    used to exit 1 with a bare 'no .jsonl inputs found' — now the dumps
+    render and stderr says what they are."""
+    from neutronstarlite_tpu.tools import metrics_report
+
+    _write_flight_dump(tmp_path / "flight")
+    rc = metrics_report.main([str(tmp_path)])
+    captured = capsys.readouterr()
+    assert rc == 0
+    assert "flight-recorder dump" in captured.err
+    assert "rendering the dumps" in captured.err
+    # the dump rendered as an ordinary (synthesized) stream
+    assert "finish algorithm !" in captured.out
+    assert "kind=nonfinite_loss" in captured.out
+
+
+def test_metrics_report_never_double_counts_stream_plus_dump(
+    tmp_path, capsys
+):
+    """A dir carrying BOTH a stream and flight dumps renders only the
+    stream (dump records duplicate stream records) and notes the dumps
+    exist."""
+    from neutronstarlite_tpu.tools import metrics_report
+
+    reg = registry.MetricsRegistry(
+        "run-main", algorithm="GCN", fingerprint="f",
+        path=str(tmp_path / "s.jsonl"),
+    )
+    reg.epoch_event(0, 0.5, loss=1.0)
+    reg.epoch_event(1, 0.4, loss=0.9)
+    reg.close()
+    _write_flight_dump(tmp_path / "flight")
+
+    rc = metrics_report.main([str(tmp_path)])
+    captured = capsys.readouterr()
+    assert rc == 0
+    # exactly ONE run block: the stream; the dump did not double-render
+    assert captured.out.count("finish algorithm !") == 1
+    assert "run-main" in captured.out and "run-fl" not in captured.out
+    assert "NOT included" in captured.err
+    # the dumps are still reachable by passing flight/ explicitly
+    rc = metrics_report.main([str(tmp_path / "flight")])
+    out2 = capsys.readouterr().out
+    assert rc == 0 and "run-fl" in out2

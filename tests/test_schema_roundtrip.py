@@ -83,6 +83,22 @@ def _emit_all(reg: registry.MetricsRegistry) -> None:
         platform=None, timeout_s=120.0, error="backend init hang",
     )
     reg.event(
+        "program_cost", label="serve.bucket_16", available=True,
+        source="compiled", flops=528383.0, bytes_accessed=65580.0,
+        transcendentals=None,
+        memory={"argument_bytes": 16384, "output_bytes": 4,
+                "temp_bytes": 16400, "alias_bytes": 0,
+                "generated_code_bytes": None, "peak_bytes": 32788},
+        platform="cpu",
+    )
+    reg.event(
+        "model_drift", metric="tune_prior_ranking", source="tune_prior",
+        predicted=0.040, observed=0.080, drift=1.0, threshold=0.1,
+        family="dist_dense/DistGCNTrainer", partitions=4,
+        candidate="all_gather|-|-|-", measured_best="ring_blocked|-|-|bf16",
+        flagged_entry="tune-cafecafecafecafe.json",
+    )
+    reg.event(
         "run_summary", algorithm="GCNDIST", fingerprint="cafecafecafe",
         counters={"wire.bytes_fwd": 4096}, gauges={}, timings={},
         epochs=1,
@@ -117,6 +133,8 @@ RENDER_MARKERS = {
     "hist": "#hist_serve.latency_ms=",
     "slo_status": "slo timeline:",
     "backend_probe": "#backend_probe=",
+    "program_cost": "#program_cost=serve.bucket_16",
+    "model_drift": "prediction drift:",
     "run_summary": "finish algorithm !",
 }
 
@@ -186,6 +204,8 @@ def test_validator_rejects_mutations_per_kind(tmp_path):
         "hist": {"buckets": [[340, 0]]},
         "slo_status": {"state": ""},
         "backend_probe": {"attempt": 0},
+        "program_cost": {"label": ""},
+        "model_drift": {"drift": "lots"},
         "run_summary": {"epoch_time": None},
     }
     assert set(mutations) == set(schema.KNOWN_KINDS)

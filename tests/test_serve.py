@@ -278,6 +278,18 @@ def test_serve_smoke_end_to_end(tmp_path, monkeypatch, capsys):
     kinds = {e["event"] for e in events}
     assert {"serve_request", "batch_flush", "serve_summary"} <= kinds
     assert "run_summary" in kinds  # the training run rode the same dir
+    # ISSUE 13: every AOT bucket executable left a typed program_cost
+    # record — on the CPU rig with its real cost/memory analysis
+    costs = [e for e in events if e["event"] == "program_cost"]
+    bucket_costs = [c for c in costs
+                    if c["label"].startswith("serve.bucket_")]
+    assert bucket_costs, "no serve.bucket_* program_cost records"
+    assert {f"serve.bucket_{b}" for b in extra["compile_counts"]} <= {
+        c["label"] for c in bucket_costs
+    }
+    for c in bucket_costs:
+        assert c["available"] is True and c["source"] == "compiled"
+        assert (c["memory"] or {}).get("peak_bytes", 0) > 0
 
     # the report CLI renders both the training and the serving block
     rc = metrics_report.main([str(metrics_dir)])

@@ -297,6 +297,12 @@ def test_auto_resolution_measure_end_to_end(tmp_path, monkeypatch):
     assert len(trials) == 2  # ring f32 + ring bf16 (sim rig: no all_gather)
     measured = [t for t in trials if t["seconds"] is not None]
     assert measured, "no candidate was actually measured"
+    # ISSUE 13: every measured micro-trial captured its program cost
+    trial_costs = [e for e in _of(evs, "program_cost")
+                   if e["label"].startswith("tune.trial/")]
+    assert {f"tune.trial/{t['candidate']}" for t in measured} <= {
+        c["label"] for c in trial_costs
+    }
     # the winner's measured score is <= every other trialed candidate's
     assert d["candidate"] in {t["candidate"] for t in measured}
     assert d["seconds"] <= min(t["seconds"] for t in measured) + 1e-12
