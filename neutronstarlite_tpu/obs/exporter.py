@@ -1,9 +1,9 @@
 """Opt-in pull-based HTTP telemetry endpoint (``NTS_METRICS_PORT``).
 
-Serves three paths from a lock-light snapshot of the live registry —
-scrapes copy the metric dicts under the registry lock (microseconds) and
-format OUTSIDE it, so a scrape can never block a serve flush or a ring
-step:
+Serves three paths from lock-light snapshots of one or MANY live
+registries — scrapes copy the metric dicts under each registry's lock
+(microseconds) and format OUTSIDE it, so a scrape can never block a serve
+flush or a ring step:
 
 - ``/metrics`` — Prometheus text exposition: counters, numeric gauges,
   timing summaries (``_count``/``_sum``), and every LogHistogram as a
@@ -14,12 +14,22 @@ step:
 - ``/slo`` — the SLO engine's current objective verdicts as JSON (404
   when no engine is armed).
 
+**Replica labels (the serve fleet).** One process can serve N replicas
+(serve/fleet.py), each with its own registry + SLO engine — and
+latest-registry-wins would make them clobber each other's ``/metrics``.
+``maybe_start(registry, slo, replica="r0")`` instead registers a LABELED
+surface: every replica's families merge under the one port with a
+``replica="rK"`` label per sample (ONE ``# TYPE`` line per family — the
+Prometheus single-declaration rule), ``/healthz`` reports per-replica
+payloads plus the fleet aggregate, and ``/slo`` maps replica → verdicts.
+An unlabeled ``maybe_start`` keeps the legacy single-surface
+latest-wins semantics (train-then-serve handoffs) and REPLACES any
+labeled fleet — the newest run owns the port either way.
+
 ``NTS_METRICS_PORT=0`` binds an ephemeral port (``exporter.port`` reports
 it — tests and in-process drivers use this); the listener binds
 ``NTS_METRICS_HOST`` (default 127.0.0.1 — expose deliberately, not by
-default). One exporter per process: :func:`maybe_start` is a singleton
-that REBINDS to the newest registry (train-then-serve runs hand off the
-same stream; the latest-wins convention of resilience/events.set_sink).
+default).
 """
 
 from __future__ import annotations
@@ -28,8 +38,9 @@ import json
 import os
 import threading
 import time
+from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 from neutronstarlite_tpu.obs.hist import PROM_EDGES_MS
 from neutronstarlite_tpu.utils.logging import get_logger
@@ -42,8 +53,17 @@ def _prom_name(name: str) -> str:
     return f"nts_{out}"
 
 
-def prometheus_text(registry, slo=None) -> str:
-    """Render one Prometheus text-format snapshot of the registry.
+# one sample for the merged renderer: (family, prom type or None, name
+# suffix, label dict, preformatted value string)
+_Sample = Tuple[str, Optional[str], str, Dict[str, str], str]
+
+
+def _fmt(v) -> str:
+    return f"{float(v):g}"
+
+
+def _surface_samples(registry, slo=None) -> Iterator[_Sample]:
+    """One registry's Prometheus samples, typed per family.
 
     A name can exist as BOTH a scalar and a histogram (sample.stall_ms
     is a cumulative counter and a distribution; sample.queue_depth a
@@ -53,44 +73,74 @@ def prometheus_text(registry, slo=None) -> str:
     and the histogram keeps the bare family."""
     snap = registry.snapshot(include_hists=False)
     hists = registry.hists()
-    lines: List[str] = []
     for name, v in sorted(snap["counters"].items()):
-        pn = _prom_name(name + "_total" if name in hists else name)
-        lines.append(f"# TYPE {pn} counter")
-        lines.append(f"{pn} {float(v):g}")
+        fam = _prom_name(name + "_total" if name in hists else name)
+        yield (fam, "counter", "", {}, _fmt(v))
     for name, v in sorted(snap["gauges"].items()):
         if isinstance(v, bool) or not isinstance(v, (int, float)):
             continue  # non-numeric gauges (strings) have no Prom encoding
-        pn = _prom_name(name + "_peak" if name in hists else name)
-        lines.append(f"# TYPE {pn} gauge")
-        lines.append(f"{pn} {float(v):g}")
+        fam = _prom_name(name + "_peak" if name in hists else name)
+        yield (fam, "gauge", "", {}, _fmt(v))
     for name, t in sorted(snap["timings"].items()):
-        pn = _prom_name(name + "_seconds")
-        lines.append(f"# TYPE {pn} summary")
-        lines.append(f"{pn}_count {int(t['count'])}")
-        lines.append(f"{pn}_sum {float(t['total_s']):g}")
+        fam = _prom_name(name + "_seconds")
+        yield (fam, "summary", "_count", {}, str(int(t["count"])))
+        yield (fam, "summary", "_sum", {}, _fmt(t["total_s"]))
     for name, h in sorted(hists.items()):
-        pn = _prom_name(name)
-        lines.append(f"# TYPE {pn} histogram")
-        cumulative = 0
+        fam = _prom_name(name)
         for edge in PROM_EDGES_MS:
-            cumulative = h.count_le(edge)
-            lines.append(f'{pn}_bucket{{le="{edge:g}"}} {cumulative}')
-        lines.append(f'{pn}_bucket{{le="+Inf"}} {h.count}')
-        lines.append(f"{pn}_sum {h.sum:g}")
-        lines.append(f"{pn}_count {h.count}")
+            yield (fam, "histogram", "_bucket", {"le": f"{edge:g}"},
+                   str(h.count_le(edge)))
+        yield (fam, "histogram", "_bucket", {"le": "+Inf"}, str(h.count))
+        yield (fam, "histogram", "_sum", {}, _fmt(h.sum))
+        yield (fam, "histogram", "_count", {}, str(h.count))
     if slo is not None:
         for v in slo.verdicts():
-            pn = _prom_name("slo_burn_rate")
-            lines.append(
-                f'{pn}{{objective="{v["objective"]}"}} '
-                f'{v["burn_rate"] if v["burn_rate"] is not None else "NaN"}'
+            burn = v["burn_rate"]
+            yield ("nts_slo_burn_rate", None, "",
+                   {"objective": str(v["objective"])},
+                   _fmt(burn) if burn is not None else "NaN")
+            yield ("nts_slo_breached", None, "",
+                   {"objective": str(v["objective"])},
+                   "1" if v["state"] == "breach" else "0")
+
+
+def prometheus_text_multi(
+    surfaces: "OrderedDict[str, Tuple[Any, Any]]"
+) -> str:
+    """Render every labeled surface into ONE exposition: families merge
+    across replicas (single TYPE line), samples carry ``replica=`` when
+    their surface is labeled."""
+    fam_type: Dict[str, Optional[str]] = {}
+    fam_samples: "OrderedDict[str, List[Tuple[str, Dict[str, str], str]]]" \
+        = OrderedDict()
+    for label, (registry, slo) in surfaces.items():
+        for fam, typ, suffix, labels, value in _surface_samples(
+            registry, slo
+        ):
+            if label:
+                merged = OrderedDict()
+                merged["replica"] = label
+                merged.update(labels)
+                labels = merged
+            fam_type.setdefault(fam, typ)
+            fam_samples.setdefault(fam, []).append((suffix, labels, value))
+    lines: List[str] = []
+    for fam, samples in fam_samples.items():
+        typ = fam_type.get(fam)
+        if typ:
+            lines.append(f"# TYPE {fam} {typ}")
+        for suffix, labels, value in samples:
+            lab = (
+                "{" + ",".join(f'{k}="{v}"' for k, v in labels.items()) + "}"
+                if labels else ""
             )
-            lines.append(
-                f'nts_slo_breached{{objective="{v["objective"]}"}} '
-                f'{1 if v["state"] == "breach" else 0}'
-            )
+            lines.append(f"{fam}{suffix}{lab} {value}")
     return "\n".join(lines) + "\n"
+
+
+def prometheus_text(registry, slo=None) -> str:
+    """Single-surface rendering (the legacy entry point)."""
+    return prometheus_text_multi(OrderedDict([("", (registry, slo))]))
 
 
 def health_payload(registry, started_at: float) -> Dict[str, Any]:
@@ -98,8 +148,9 @@ def health_payload(registry, started_at: float) -> Dict[str, Any]:
     counters = snap["counters"]
     gauges = snap["gauges"]
     gave_up = bool(gauges.get("resilience.gave_up"))
-    return {
-        "ok": not gave_up,
+    beating = gauges.get("serve.beating")  # fleet replicas pin this
+    out = {
+        "ok": not gave_up and beating is not False,
         "run_id": registry.run_id,
         "algorithm": registry.algorithm,
         "uptime_s": round(time.time() - started_at, 3),
@@ -115,16 +166,49 @@ def health_payload(registry, started_at: float) -> Dict[str, Any]:
             "last_event_ts": registry.last_event_ts,
         },
     }
+    if gauges.get("serve.replica") is not None or beating is not None:
+        out["serve"] = {
+            "replica": gauges.get("serve.replica"),
+            "beating": beating,
+            "requests": counters.get("serve.requests", 0),
+            "shed": counters.get("serve.shed", 0),
+        }
+    return out
+
+
+def fleet_health_payload(
+    surfaces: "OrderedDict[str, Tuple[Any, Any]]", started_at: float
+) -> Dict[str, Any]:
+    """Labeled surfaces -> per-replica payloads + the fleet aggregate;
+    a single unlabeled surface keeps the legacy flat payload."""
+    if list(surfaces) == [""]:
+        return health_payload(surfaces[""][0], started_at)
+    replicas = {
+        label: health_payload(reg, started_at)
+        for label, (reg, _slo) in surfaces.items()
+    }
+    ok = all(p["ok"] for p in replicas.values())
+    return {
+        "ok": ok,
+        "fleet": {
+            "replicas": len(replicas),
+            "ok_count": sum(1 for p in replicas.values() if p["ok"]),
+        },
+        "replicas": replicas,
+    }
 
 
 class MetricsExporter:
-    """The HTTP listener; ``registry``/``slo`` are rebindable live."""
+    """The HTTP listener; its surfaces are rebindable live."""
 
     def __init__(self, registry, port: int, host: str = "127.0.0.1",
-                 slo=None):
+                 slo=None, replica: Optional[str] = None):
+        self._surface_lock = threading.Lock()
+        self._surfaces: "OrderedDict[str, Tuple[Any, Any]]" = OrderedDict()
         self.registry = registry
         self.slo = slo
         self.started_at = time.time()
+        self.rebind(registry, slo, replica=replica)
         exporter = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -141,33 +225,45 @@ class MetricsExporter:
             def do_GET(self):  # noqa: N802 (http.server API)
                 try:
                     path = self.path.split("?", 1)[0]
+                    surfaces = exporter.surfaces()
                     if path == "/metrics":
-                        body = prometheus_text(
-                            exporter.registry, exporter.slo
-                        ).encode()
+                        body = prometheus_text_multi(surfaces).encode()
                         self._send(
                             200, body,
                             "text/plain; version=0.0.4; charset=utf-8",
                         )
                     elif path == "/healthz":
-                        body = json.dumps(health_payload(
-                            exporter.registry, exporter.started_at
+                        body = json.dumps(fleet_health_payload(
+                            surfaces, exporter.started_at
                         )).encode()
                         self._send(200, body, "application/json")
                     elif path == "/slo":
-                        if exporter.slo is None:
+                        armed = OrderedDict(
+                            (label, slo_) for label, (_reg, slo_)
+                            in surfaces.items() if slo_ is not None
+                        )
+                        if not armed:
                             self._send(
                                 404,
                                 b'{"error": "no SLO engine armed '
                                 b'(NTS_SLO_SPEC unset)"}',
                                 "application/json",
                             )
-                        else:
-                            exporter.slo.tick()
+                        elif list(armed) == [""]:
+                            armed[""].tick()
                             body = json.dumps(
-                                exporter.slo.verdicts()
+                                armed[""].verdicts()
                             ).encode()
                             self._send(200, body, "application/json")
+                        else:  # labeled fleet: replica -> verdicts
+                            out = {}
+                            for label, slo_ in armed.items():
+                                slo_.tick()
+                                out[label] = slo_.verdicts()
+                            self._send(
+                                200, json.dumps(out).encode(),
+                                "application/json",
+                            )
                     else:
                         self._send(404, b"not found\n", "text/plain")
                 except Exception as e:  # a bad scrape must not kill serving
@@ -190,12 +286,26 @@ class MetricsExporter:
         log.info("metrics exporter listening on http://%s:%d "
                  "(/metrics /healthz /slo)", host, self.port)
 
-    def rebind(self, registry, slo=None) -> None:
-        """Latest surface wins for BOTH fields: keeping a previous run's
-        SLO engine (bound to its closed registry) would serve stale /slo
-        verdicts next to the new registry's /metrics."""
-        self.registry = registry
-        self.slo = slo
+    def surfaces(self) -> "OrderedDict[str, Tuple[Any, Any]]":
+        with self._surface_lock:
+            return OrderedDict(self._surfaces)
+
+    def rebind(self, registry, slo=None,
+               replica: Optional[str] = None) -> None:
+        """Latest surface wins. Unlabeled: REPLACE everything (keeping a
+        previous run's SLO engine — bound to its closed registry — would
+        serve stale /slo verdicts next to the new registry's /metrics).
+        Labeled (``replica=``): register/replace that replica's surface,
+        dropping any unlabeled leftover — a fleet owns the whole port."""
+        with self._surface_lock:
+            if replica is None:
+                self._surfaces = OrderedDict([("", (registry, slo))])
+            else:
+                self._surfaces.pop("", None)
+                self._surfaces[str(replica)] = (registry, slo)
+            # legacy attributes track the newest surface
+            self.registry = registry
+            self.slo = slo
 
     def close(self) -> None:
         try:
@@ -210,17 +320,19 @@ _singleton: Optional[MetricsExporter] = None
 _singleton_lock = threading.Lock()
 
 
-def maybe_start(registry, slo=None) -> Optional[MetricsExporter]:
+def maybe_start(registry, slo=None,
+                replica: Optional[str] = None) -> Optional[MetricsExporter]:
     """Start (or rebind) the process's exporter when ``NTS_METRICS_PORT``
-    is set; None otherwise. Never raises — a taken port degrades to a
-    warning, not a dead trainer."""
+    is set; None otherwise. ``replica`` registers a labeled fleet
+    surface (see the module docstring). Never raises — a taken port
+    degrades to a warning, not a dead trainer."""
     global _singleton
     raw = os.environ.get("NTS_METRICS_PORT", "")
     if not raw:
         return None
     with _singleton_lock:
         if _singleton is not None:
-            _singleton.rebind(registry, slo)
+            _singleton.rebind(registry, slo, replica=replica)
             return _singleton
         try:
             port = int(raw)
@@ -230,7 +342,8 @@ def maybe_start(registry, slo=None) -> Optional[MetricsExporter]:
             return None
         host = os.environ.get("NTS_METRICS_HOST", "127.0.0.1")
         try:
-            _singleton = MetricsExporter(registry, port, host=host, slo=slo)
+            _singleton = MetricsExporter(registry, port, host=host, slo=slo,
+                                         replica=replica)
         except OSError as e:
             log.warning("metrics exporter could not bind %s:%s (%s); "
                         "exporter off", host, port, e)

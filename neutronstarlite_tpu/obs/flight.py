@@ -24,8 +24,14 @@ spans reconstruct the causal timeline of the failure). Knobs:
   which duplicate stream records at full resolution, never double-count
   when a consumer globs the metrics dir; with neither set, triggers log
   a warning and skip);
-- ``NTS_FLIGHT_MAX_DUMPS`` — per-recorder dump cap (default 16, bounded
-  disk under a fault storm).
+- ``NTS_FLIGHT_MAX_DUMPS`` — dump cap (default 16, bounded disk under a
+  fault storm). The budget is counted PER DUMP DIRECTORY across every
+  recorder in the process — a serve fleet's N replica recorders share
+  one NTS_FLIGHT_DIR, and N x 16 dumps from one fault storm is exactly
+  the unbounded-disk failure the cap exists to prevent. Fleet replicas
+  additionally prefix their dump filenames with the replica id
+  (``recorder.tag``) so concurrent dumps never collide on a name and a
+  postmortem knows whose ring it is reading.
 """
 
 from __future__ import annotations
@@ -62,10 +68,26 @@ def flight_capacity() -> int:
 _TRIGGER_KINDS = ("fault", "rank_loss")
 
 
+# the fleet-wide (per dump directory) dump budget: every recorder in the
+# process draws from the same count for a given directory, so N replica
+# recorders sharing NTS_FLIGHT_DIR cannot multiply the disk bound by N
+_budget_lock = threading.Lock()
+_dir_dump_counts: Dict[str, int] = {}
+
+
+def reset_dump_budget() -> None:
+    """Forget the per-directory dump counts (tests)."""
+    with _budget_lock:
+        _dir_dump_counts.clear()
+
+
 class FlightRecorder:
     """Bounded ring of recent records + the trigger/dump policy."""
 
-    def __init__(self, capacity: Optional[int] = None):
+    def __init__(self, capacity: Optional[int] = None, tag: str = ""):
+        # the replica id for fleet recorders (serve/fleet.py): prefixes
+        # dump filenames so concurrent replica dumps can't collide
+        self.tag = tag
         self.capacity = capacity if capacity is not None else flight_capacity()
         self._ring: deque = deque(maxlen=self.capacity)
         self._dump_lock = threading.Lock()
@@ -120,17 +142,24 @@ class FlightRecorder:
                 "NTS_METRICS_DIR is set; skipping the dump", trigger,
             )
             return None
+        budget_key = os.path.abspath(d)
         with self._dump_lock:
-            if len(self.dumps) >= self.max_dumps:
-                self.dropped_triggers += 1
-                return None
+            # the budget is fleet-wide per directory: N replica recorders
+            # sharing one NTS_FLIGHT_DIR draw from ONE count
+            with _budget_lock:
+                used = _dir_dump_counts.get(budget_key, 0)
+                if used >= self.max_dumps:
+                    self.dropped_triggers += 1
+                    return None
+                _dir_dump_counts[budget_key] = used + 1
             records = list(self._ring)  # consistent snapshot of the ring
             safe = "".join(
                 c if c.isalnum() or c in "-_" else "_" for c in trigger
             ) or "trigger"
+            prefix = f"flight_{self.tag}-" if self.tag else "flight_"
             fname = (
-                f"flight_{time.strftime('%Y%m%d-%H%M%S')}-{safe}"
-                f"-p{process_index()}-{os.getpid()}-{len(self.dumps)}.jsonl"
+                f"{prefix}{time.strftime('%Y%m%d-%H%M%S')}-{safe}"
+                f"-p{process_index()}-{os.getpid()}-{used}.jsonl"
             )
             path = os.path.join(d, fname)
             try:
@@ -140,6 +169,10 @@ class FlightRecorder:
                         fh.write(json.dumps(rec, default=str) + "\n")
             except OSError as e:  # telemetry must never escalate a fault
                 log.warning("flight dump to %s failed (%s)", path, e)
+                with _budget_lock:  # a failed write spends no budget
+                    _dir_dump_counts[budget_key] = max(
+                        _dir_dump_counts.get(budget_key, 1) - 1, 0
+                    )
                 return None
             self.dumps.append(path)
         log.warning(

@@ -17,7 +17,12 @@ program costs), keyed by what makes two rows comparable:
 Row kinds: ``run`` (a trainer finished — models/base.finalize_metrics),
 ``suite`` (one tier-1 suite execution — scripts/ci_tier1.sh), ``probe``
 (one bench.py backend-probe attempt, INCLUDING timeouts — the probe
-history that was invisible since BENCH_r05 becomes queryable).
+history that was invisible since BENCH_r05 becomes queryable), ``serve``
+(one tools/serve_bench execution: tail latency + shed rate keyed by cfg
+fingerprint PLUS the load shape — mode/replicas/continuous-batching —
+so the sentinel trend-gates serve p99 the way it gates epoch time
+without ever comparing a 3-replica open-loop run against a 1-replica
+closed-loop one).
 
 Appends are ATOMIC via the checkpoint tmp+replace pattern: the new state
 (existing rows + the new row, trimmed to ``NTS_LEDGER_KEEP``) is written
@@ -259,6 +264,50 @@ def suite_row(duration_s: float, dots_passed: int, rc: int,
         "dots_passed": int(dots_passed),
         "rc": int(rc),
         "timeout_s": float(timeout_s),
+    }
+
+
+def serve_row(
+    latency_ms: Dict[str, Any],
+    shed_rate: Optional[float],
+    throughput_rps: Optional[float],
+    requests: int,
+    cfg_fingerprint: str,
+    graph_digest: Optional[str],
+    mode: str,
+    replicas: int,
+    continuous_batching: bool,
+    delta_rate: float = 0.0,
+    deltas_applied: int = 0,
+    extra: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """One ``kind=serve`` row from a tools/serve_bench execution. The
+    cfg key embeds the LOAD SHAPE (mode, replica count, continuous
+    batching) so only like-for-like runs sit on one trajectory; the
+    graph digest keys the workload like run rows do. The p50/p95/p99 +
+    shed_rate scalars are what perf_sentinel gates (GATED_METRICS)."""
+    lat = latency_ms or {}
+    return {
+        "kind": "serve",
+        "ts": time.time(),
+        "cfg": (
+            f"{cfg_fingerprint}|{mode}|r{int(replicas)}"
+            f"|cb{int(bool(continuous_batching))}"
+        ),
+        "graph_digest": graph_digest or "unknown",
+        "backend": backend_fingerprint(),
+        "p50_ms": as_number(lat.get("p50")),
+        "p95_ms": as_number(lat.get("p95")),
+        "p99_ms": as_number(lat.get("p99")),
+        "shed_rate": as_number(shed_rate),
+        "throughput_rps": as_number(throughput_rps),
+        "requests": int(requests),
+        "replicas": int(replicas),
+        "continuous_batching": bool(continuous_batching),
+        "mode": mode,
+        "delta_rate": float(delta_rate),
+        "deltas_applied": int(deltas_applied),
+        **(extra or {}),
     }
 
 

@@ -10,9 +10,9 @@ Envelope (all events):
   event: str       one of run_start | epoch | ring_step | run_summary |
                    fault | recovery | heartbeat | rank_loss | replan |
                    serve_request | batch_flush | shed | serve_summary |
-                   tune_trial | tune_decision | span | stream_rotated |
-                   hist | slo_status | backend_probe | program_cost |
-                   model_drift
+                   graph_delta | tune_trial | tune_decision | span |
+                   stream_rotated | hist | slo_status | backend_probe |
+                   program_cost | model_drift
                    (open set)
   run_id: str      "<algo>-<fingerprint>-<pid>"
   schema: int      SCHEMA_VERSION
@@ -85,6 +85,22 @@ serve_summary (serve/): consolidated end-of-serving record (the serving
   throughput_rps: number | null,
   counters: object (the registry snapshot: serve.* counters incl.
   per-bucket compile counts)
+
+graph_delta (serve/delta.py): one live-graph update batch applied to a
+  serving engine between flushes — the incremental-invalidation receipt
+  (what changed, what was invalidated, the new digest the tuner/ledger
+  keying now sees)
+  added_edges / removed_edges / added_vertices: int >= 0,
+  graph_digest: str (non-empty; the POST-delta canonical digest,
+  graph/digest.py),
+  cache_invalidated: int | absent (embedding-cache entries dropped —
+  only the dirty out-closure, never the whole cache),
+  rows_patched: int | absent (device neighbor-table rows rewritten;
+  V on a shape-forced full rebuild),
+  dirty_predictions: int | absent (vertices whose served logits may
+  have changed),
+  seconds: number | null (plan + apply wall time),
+  replica: str | absent (the fleet replica this record's stream serves)
 
 tune_trial (tune/runner.py): one autotuner candidate scored — a timed
   micro-trial (source=measured), an analytic-prior-only entry
@@ -227,6 +243,7 @@ KNOWN_KINDS = (
     "batch_flush",
     "shed",
     "serve_summary",
+    "graph_delta",
     "tune_trial",
     "tune_decision",
     "span",
@@ -405,6 +422,24 @@ def validate_event(obj: Any) -> None:
             _fail("shed.reason must be a non-empty string")
         if "queue_depth" in obj and not isinstance(obj["queue_depth"], int):
             _fail("shed.queue_depth must be an int when present")
+    elif kind == "graph_delta":
+        for key in ("added_edges", "removed_edges", "added_vertices"):
+            v = obj.get(key)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                _fail(f"graph_delta.{key} must be a non-negative int, "
+                      f"got {v!r}")
+        gd = obj.get("graph_digest")
+        if not isinstance(gd, str) or not gd:
+            _fail("graph_delta.graph_digest must be a non-empty string")
+        for key in ("cache_invalidated", "rows_patched",
+                    "dirty_predictions"):
+            if key in obj and obj[key] is not None and (
+                not isinstance(obj[key], int) or isinstance(obj[key], bool)
+            ):
+                _fail(f"graph_delta.{key} must be an int when present")
+        _require_number(obj, "seconds", allow_none=True)
+        if "replica" in obj and not isinstance(obj["replica"], str):
+            _fail("graph_delta.replica must be a string when present")
     elif kind in ("tune_trial", "tune_decision"):
         for key in ("candidate", "family", "source"):
             if not isinstance(obj.get(key), str) or not obj[key]:

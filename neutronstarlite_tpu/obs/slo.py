@@ -392,6 +392,22 @@ class SloEngine:
         with self._lock:
             return [o.verdict() for o in self.objectives]
 
+    def route_state(self) -> Tuple[bool, float]:
+        """(draining, burn): whether any *sheddable* objective is in
+        breach, and the worst sheddable burn rate — the serve fleet's
+        in-process consumption of the /slo surface (least-burn routing
+        + drain-on-breach, serve/fleet.py). Call after a ``tick()``."""
+        with self._lock:
+            burn = 0.0
+            draining = False
+            for o in self.objectives:
+                if not o.sheddable:
+                    continue
+                burn = max(burn, o.burn or 0.0)
+                if o.state == "breach":
+                    draining = True
+            return draining, burn
+
     def shed_advice(self, queue_depth: int, max_queue: int,
                     now: Optional[float] = None) -> Optional[str]:
         """The burn-rate admission gate (serve/batcher.py's FIRST gate):

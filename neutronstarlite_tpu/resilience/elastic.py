@@ -262,6 +262,20 @@ class LivenessMonitor:
                 partition=None, epoch=epoch, reason="collective_timeout",
             )
 
+    def missed(self, partition: int) -> int:
+        """Consecutive missed beats for one partition — the serve fleet's
+        monitor consumes this directly (its guards are never armed, so
+        detection cannot rely on the RankLossError raise)."""
+        return self._missed.get(int(partition), 0)
+
+    def clear(self, partition: int) -> None:
+        """Forget a partition's miss count and trip latch — called after
+        a supervised replica restart (serve/fleet.py): the fresh replica
+        is a new liveness subject, and a SECOND death must re-detect
+        (and re-record) rather than being swallowed by the latch."""
+        self._missed[int(partition)] = 0
+        self._tripped.discard(int(partition))
+
     def _trip(self, msg: str, partition: Optional[int], epoch: int,
               reason: str, missed: Optional[int] = None) -> None:
         key = -1 if partition is None else partition

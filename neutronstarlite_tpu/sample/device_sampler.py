@@ -132,6 +132,59 @@ class DeviceUniformSampler:
         nbr[dst, within] = src.astype(np.int32)
         return cls(nbr, eff.astype(np.int32), D, thinned)
 
+    def apply_delta(self, graph: CSCGraph, rows, seed: int = 0) -> int:
+        """Patch ONLY the neighbor-table rows a graph delta touched
+        (serve/delta.py ``dirty_rows`` — vertices whose in-neighbor SET
+        changed): each dirty row is regathered from the post-delta host
+        CSC and scattered into the resident table in place, so an
+        edge-level delta never re-uploads the [V, D] table. Falls back to
+        a full rebuild (logged) only when the table's SHAPE must change —
+        appended vertices (new V), a dirty vertex outgrowing the current
+        width while the width sits below the NTS_SAMPLE_DEVICE_MAX_DEG
+        cap, or a dirty row that needs PRE-THINNING (deg > width: the
+        build-time thin draws from one global priority stream, and a
+        per-row re-draw would diverge from what a fresh table holds —
+        the bitwise fresh-engine oracle demands the rebuilt form).
+        Returns the number of rows written (V on a rebuild)."""
+        rows = np.unique(np.asarray(rows, dtype=np.int64))
+        cap = default_max_width()
+        max_deg = int(graph.in_degree.max()) if graph.v_num else 1
+        needed = int(min(max(max_deg, 1), cap))
+        rows_over = (
+            len(rows) > 0
+            and int(graph.in_degree[rows].max()) > self.width
+        )
+        # a table holding ANY pre-thinned rows rebuilds too: their kept
+        # neighbor subsets came from the PRE-delta global priority stream
+        # (positions shift with the edge layout), so an in-place patch of
+        # other rows would leave them diverged from what a fresh build
+        # over the post-delta graph holds — only full shapes patch
+        if (graph.v_num != int(self.nbr.shape[0]) or needed > self.width
+                or rows_over or self.thinned > 0):
+            log.warning(
+                "device sampler: delta changed the table shape or "
+                "touched a pre-thinned row (V %d -> %d, width %d -> %d); "
+                "rebuilding the full neighbor table",
+                int(self.nbr.shape[0]), graph.v_num, self.width, needed,
+            )
+            fresh = DeviceUniformSampler.from_host(graph, seed=seed)
+            self.nbr, self.eff_deg = fresh.nbr, fresh.eff_deg
+            self.width, self.thinned = fresh.width, fresh.thinned
+            return graph.v_num
+        if len(rows) == 0:
+            return 0
+        D = self.width
+        patch = np.zeros((len(rows), D), dtype=np.int32)
+        eff = graph.in_degree[rows].astype(np.int32)  # all <= D here
+        for j, v in enumerate(rows.tolist()):
+            start = int(graph.column_offset[v])
+            d = int(graph.in_degree[v])
+            patch[j, :d] = graph.row_indices[start:start + d]
+        idx = jnp.asarray(rows, dtype=jnp.int32)
+        self.nbr = self.nbr.at[idx].set(jnp.asarray(patch))
+        self.eff_deg = self.eff_deg.at[idx].set(jnp.asarray(eff))
+        return int(len(rows))
+
     def sample_neighbors(
         self,
         dsts: np.ndarray,

@@ -8,9 +8,16 @@ shedding (batcher.py), fresh-node fan-outs reuse the training sampler with
 an LRU inference embedding cache on top (sampling.py), and every serving
 event is a typed obs/ record (server.py) rendered by tools/metrics_report.
 
+Fleet scale: fleet.py runs SERVE_REPLICAS SLO-routed replicas (least-burn
+with hysteresis, drain-on-breach, fleet-shed only on all-breach,
+heartbeat-supervised restart) behind one submit(); SERVE_CB adds
+continuous batching; delta.py applies live graph deltas between flushes
+with incremental invalidation and a graph-digest bump.
+
 Entry points:
   python -m neutronstarlite_tpu.serve.server <cfg> [<ckpt_dir>]
-  python -m neutronstarlite_tpu.tools.serve_bench <cfg> [--train] ...
+  python -m neutronstarlite_tpu.tools.serve_bench <cfg> [--train]
+      [--replicas N] [--cb 0|1] [--delta-rate R] ...
 """
 
 import importlib
@@ -29,6 +36,13 @@ _EXPORTS = {
     "EmbeddingCache": "sampling",
     "ServeSampler": "sampling",
     "InferenceServer": "server",
+    "FleetOptions": "fleet",
+    "Replica": "fleet",
+    "ReplicaSet": "fleet",
+    "choose_replica": "fleet",
+    "DeltaPlan": "delta",
+    "GraphDelta": "delta",
+    "plan_delta": "delta",
 }
 
 __all__ = sorted(_EXPORTS)

@@ -86,6 +86,12 @@ class ServeOptions:
     # flusher samples + stages H2D while a separate executor thread runs
     # the previous flush on the device — serve/server.py two-stage flush),
     # device (pipelined + the on-device uniform hop sampler)
+    continuous_batching: bool = False  # SERVE_CB / NTS_SERVE_CB: run the
+    # two-stage flush even with sync sampling — the batcher admits and
+    # PRODUCES the next bucket (cache pass + sample + H2D staging) while
+    # the executor runs the current one, so sustained open-loop load never
+    # serializes on flush-wait (p99 under load is what this buys; the
+    # sample draws and results are identical to sync — same thread order)
 
     @classmethod
     def from_cfg(cls, cfg: Any = None) -> "ServeOptions":
@@ -105,6 +111,9 @@ class ServeOptions:
             )
             o.hot_threshold = int(
                 getattr(cfg, "serve_hot_threshold", o.hot_threshold)
+            )
+            o.continuous_batching = bool(
+                int(getattr(cfg, "serve_cb", o.continuous_batching))
             )
         o.max_batch = _env_override("NTS_SERVE_MAX_BATCH", int, o.max_batch)
         o.max_wait_ms = _env_override(
@@ -126,6 +135,13 @@ class ServeOptions:
         o.hot_threshold = _env_override(
             "NTS_SERVE_HOT_THRESHOLD", int, o.hot_threshold
         )
+        raw_cb = os.environ.get("NTS_SERVE_CB", "")
+        if raw_cb:
+            if raw_cb not in ("0", "1"):
+                log.warning("NTS_SERVE_CB=%r is not 0|1; keeping %r",
+                            raw_cb, o.continuous_batching)
+            else:
+                o.continuous_batching = raw_cb == "1"
         # ONE grammar for the selector (env-wins, alias map, validation):
         # sample.pipeline.resolve_sample_pipeline — imported lazily so
         # this module stays importable without jax (metrics_report pulls
@@ -248,6 +264,7 @@ class MicroBatcher:
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._closed = False
+        self._aborted = False
         self.shed_count = 0
         self._thread = threading.Thread(
             target=self._loop, name="serve-batcher", daemon=True
@@ -278,7 +295,7 @@ class MicroBatcher:
             )
         if reason is None:
             with self._cond:
-                if self._closed:
+                if self._closed or self._aborted:
                     reason = "server_closed"
                 elif len(self._pending) >= self.opts.max_queue:
                     reason = f"queue_full (depth {len(self._pending)})"
@@ -304,11 +321,57 @@ class MicroBatcher:
                 status="shed", total_ms=req.total_ms, req_id=req.req_id,
             )
 
+    # ---- fleet-side surface (serve/fleet.py) -----------------------------
+    @property
+    def depth(self) -> int:
+        """Current pending-request count (advisory read — the router's
+        queue-depth signal)."""
+        return len(self._pending)
+
+    def alive(self) -> bool:
+        """Is the flusher thread still running? False after close() drains
+        or after an injected death (``abort``)."""
+        return self._thread.is_alive()
+
+    def requeue(self, req: ServeRequest) -> None:
+        """Re-enqueue a request stolen from a dead replica (fleet
+        re-route): size validation already passed at the original submit,
+        so only the bound and liveness gates apply; ``t_submit`` is kept,
+        so the recorded latency honestly includes the dead time."""
+        with self._cond:
+            if self._closed or self._aborted:
+                reason = "server_closed"
+            elif len(self._pending) >= self.opts.max_queue:
+                reason = f"queue_full (depth {len(self._pending)}, requeue)"
+            else:
+                self._pending.append(req)
+                self._cond.notify()
+                return
+        self._shed(req, reason)
+
+    def steal_pending(self) -> List[ServeRequest]:
+        """Take every pending request (the fleet re-routes them after a
+        replica death — in-flight work is re-routed, never dropped)."""
+        with self._cond:
+            out = self._pending
+            self._pending = []
+        return out
+
+    def abort(self) -> None:
+        """Chaos hook: kill the flusher thread WITHOUT draining — the
+        simulated dead replica. Pending requests stay queued for
+        ``steal_pending``; new submits shed with server_closed."""
+        with self._cond:
+            self._aborted = True
+            self._cond.notify_all()
+
     # ---- flusher thread --------------------------------------------------
     def _take_batch(self) -> Tuple[List[ServeRequest], str]:
         """Block until a flush condition holds; pop one batch under lock."""
         with self._cond:
             while True:
+                if self._aborted:
+                    return [], "abort"
                 if self._pending:
                     n_seeds = sum(len(r.node_ids) for r in self._pending)
                     deadline = (

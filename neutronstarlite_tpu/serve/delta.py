@@ -1,0 +1,377 @@
+"""Live graph-delta ingestion: node/edge updates applied between flushes.
+
+A serving fleet over a FROZEN graph answers yesterday's structure; this
+module lets served predictions track a live graph. A :class:`GraphDelta`
+(edge inserts/removes, vertex appends with their feature rows) is turned
+into a :class:`DeltaPlan` — the post-delta host graph plus the exact
+incremental damage — and applied to one or many engines/servers between
+flushes:
+
+- **Host graph rebuild, deterministically.** The edge list is extracted
+  from the live CSC (which preserves within-destination order), edited,
+  and rebuilt through the NumPy ``build_graph`` path. Because a stable
+  dst-sort of an already-sorted list is the identity, the rebuilt CSC is
+  BITWISE what a fresh build over the same edited edge list produces —
+  the before/after oracle (tests/test_delta.py) compares served
+  predictions against a genuinely fresh engine and demands equality.
+  Removing an edge that does not exist raises (the loudness contract);
+  removal drops EVERY occurrence of a listed (src, dst) pair.
+
+- **Incremental invalidation, not a flush-the-world.** The plan computes
+  two dirty sets. ``dirty_rows`` — vertices whose in-neighbor SET
+  changed — are the only device neighbor-table rows patched in place
+  (sample/device_sampler.py ``apply_delta``). ``dirty`` — vertices whose
+  served logits can differ post-delta — is the out-edge closure (over
+  the union of the old and new graphs, L−1 hops) of every vertex whose
+  aggregation input changed: destinations of touched edges (their
+  in-edge weights renormalize with the in-degree) plus out-neighbors of
+  touched sources (their edge weights renormalize with the source
+  out-degree). Only those embedding-cache entries are invalidated; every
+  other cached row keeps hitting (the hit-rate assertion in the tests).
+
+- **Digest bump.** The plan carries the post-delta canonical
+  ``graph_digest`` (graph/digest.py); applying it updates the toolkit's
+  cached digest, so the tune-cache key and the perf-ledger row key both
+  see a DIFFERENT graph — a stale pre-delta tune decision becomes a miss
+  on the next measure run instead of silently replaying.
+
+Staleness contract: a delta takes effect for every flush PRODUCED after
+``apply`` returns (the per-replica graph gate serializes against the
+produce stage); flushes already prepared/in flight serve the pre-delta
+view, and their results are not re-inserted into the embedding cache
+(the server's graph-version check). Vertex-appending deltas additionally
+invalidate the AOT bucket ladder — the feature operand's shape changed —
+so the next flush per bucket pays one recompile (logged loudly);
+edge-only deltas never recompile anything.
+
+Every application emits one typed ``graph_delta`` obs record per server
+(counts, dirty sizes, the new digest) rendered by tools/metrics_report.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import time
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from neutronstarlite_tpu.graph.digest import graph_digest
+from neutronstarlite_tpu.graph.storage import CSCGraph, build_graph
+from neutronstarlite_tpu.utils.logging import get_logger
+
+log = get_logger("serve")
+
+
+def _ids(v) -> np.ndarray:
+    return np.asarray(v, dtype=np.int64).reshape(-1)
+
+
+@dataclasses.dataclass
+class GraphDelta:
+    """One batch of live-graph updates (all fields optional/empty)."""
+
+    add_src: np.ndarray = dataclasses.field(
+        default_factory=lambda: np.empty(0, np.int64))
+    add_dst: np.ndarray = dataclasses.field(
+        default_factory=lambda: np.empty(0, np.int64))
+    remove_src: np.ndarray = dataclasses.field(
+        default_factory=lambda: np.empty(0, np.int64))
+    remove_dst: np.ndarray = dataclasses.field(
+        default_factory=lambda: np.empty(0, np.int64))
+    add_vertices: int = 0
+    # feature rows for the appended vertices ([add_vertices, f]); required
+    # whenever add_vertices > 0 — a vertex without features cannot serve
+    add_features: Optional[np.ndarray] = None
+
+    def __post_init__(self):
+        self.add_src = _ids(self.add_src)
+        self.add_dst = _ids(self.add_dst)
+        self.remove_src = _ids(self.remove_src)
+        self.remove_dst = _ids(self.remove_dst)
+        if len(self.add_src) != len(self.add_dst):
+            raise ValueError("add_src/add_dst length mismatch")
+        if len(self.remove_src) != len(self.remove_dst):
+            raise ValueError("remove_src/remove_dst length mismatch")
+        if self.add_vertices < 0:
+            raise ValueError("add_vertices must be >= 0")
+        if self.add_vertices and self.add_features is None:
+            raise ValueError(
+                "add_vertices > 0 needs add_features rows — an appended "
+                "vertex without features cannot be served"
+            )
+
+    @classmethod
+    def edges(cls, add: Iterable[Tuple[int, int]] = (),
+              remove: Iterable[Tuple[int, int]] = (),
+              add_vertices: int = 0,
+              add_features: Optional[np.ndarray] = None) -> "GraphDelta":
+        """Convenience constructor from (src, dst) pair lists."""
+        add = list(add)
+        remove = list(remove)
+        return cls(
+            add_src=np.array([e[0] for e in add], np.int64),
+            add_dst=np.array([e[1] for e in add], np.int64),
+            remove_src=np.array([e[0] for e in remove], np.int64),
+            remove_dst=np.array([e[1] for e in remove], np.int64),
+            add_vertices=add_vertices,
+            add_features=add_features,
+        )
+
+    @property
+    def empty(self) -> bool:
+        return (len(self.add_src) == 0 and len(self.remove_src) == 0
+                and self.add_vertices == 0)
+
+
+@dataclasses.dataclass
+class DeltaPlan:
+    """The post-delta graph plus the exact incremental damage."""
+
+    src: np.ndarray  # the edited edge list (CSC order — dst-sorted)
+    dst: np.ndarray
+    v_num: int
+    graph: CSCGraph  # rebuilt via the deterministic NumPy path
+    digest: str  # canonical post-delta graph digest
+    dirty_rows: np.ndarray  # in-neighbor SET changed -> device-table rows
+    dirty: np.ndarray  # predictions possibly changed -> cache invalidation
+    added_edges: int
+    removed_edges: int
+    added_vertices: int
+    add_features: Optional[np.ndarray]
+    hops: int
+    rows_patched: int = 0  # filled by apply_to_engines
+
+
+def _edge_keys(src: np.ndarray, dst: np.ndarray) -> np.ndarray:
+    # vertex ids are < 2**32 (uint32 storage), so one int64 packs a pair
+    return (src.astype(np.int64) << 32) | dst.astype(np.int64)
+
+
+def _out_neighbors(g: CSCGraph, vs: np.ndarray) -> np.ndarray:
+    """Unique destinations of the out-edges of ``vs`` (CSR walk); ids
+    beyond the graph (appended vertices walked on the OLD graph) are
+    skipped."""
+    vs = np.unique(vs)
+    vs = vs[(vs >= 0) & (vs < g.v_num)]
+    if len(vs) == 0:
+        return np.empty(0, np.int64)
+    deg = g.out_degree[vs].astype(np.int64)
+    total = int(deg.sum())
+    if total == 0:
+        return np.empty(0, np.int64)
+    starts = g.row_offset[vs].astype(np.int64)
+    within = np.arange(total) - np.repeat(np.cumsum(deg) - deg, deg)
+    idx = np.repeat(starts, deg) + within
+    return np.unique(g.column_indices[idx].astype(np.int64))
+
+
+def plan_delta(graph: CSCGraph, delta: GraphDelta, hops: int) -> DeltaPlan:
+    """Turn a delta into the post-delta graph + dirty sets (pure)."""
+    old_src = graph.row_indices.astype(np.int64)
+    old_dst = graph.dst_of_edge.astype(np.int64)
+    new_v = graph.v_num + int(delta.add_vertices)
+
+    for name, arr in (("add_src", delta.add_src), ("add_dst", delta.add_dst),
+                      ("remove_src", delta.remove_src),
+                      ("remove_dst", delta.remove_dst)):
+        if len(arr) and (int(arr.min()) < 0 or int(arr.max()) >= new_v):
+            raise ValueError(
+                f"graph delta {name} references vertex "
+                f"{int(arr.max() if arr.max() >= new_v else arr.min())} "
+                f"outside 0..{new_v - 1}"
+            )
+
+    mask = np.ones(len(old_src), dtype=bool)
+    removed = 0
+    if len(delta.remove_src):
+        keys = _edge_keys(old_src, old_dst)
+        rm_keys = np.unique(_edge_keys(delta.remove_src, delta.remove_dst))
+        present = np.isin(rm_keys, keys)
+        if not present.all():
+            missing = rm_keys[~present][:5]
+            pairs = [(int(k >> 32), int(k & 0xFFFFFFFF)) for k in missing]
+            raise ValueError(
+                f"graph delta removes edge(s) that do not exist: {pairs}"
+                + (" ..." if (~present).sum() > 5 else "")
+            )
+        mask = ~np.isin(keys, rm_keys)
+        removed = int((~mask).sum())
+
+    src = np.concatenate([old_src[mask], delta.add_src])
+    dst = np.concatenate([old_dst[mask], delta.add_dst])
+    # the NumPy path: a stable dst-sort of this (already mostly sorted)
+    # list — deterministic, so a fresh build over the same edited list is
+    # bitwise identical (the oracle's ground)
+    g2 = build_graph(
+        src.astype(np.uint32), dst.astype(np.uint32), new_v,
+        weight="gcn_norm", use_native=False,
+    )
+
+    changed_dst = np.unique(np.concatenate([delta.remove_dst, delta.add_dst]))
+    changed_src = np.unique(np.concatenate([delta.remove_src, delta.add_src]))
+    # aggregation inputs that changed: touched destinations (in-degree
+    # renormalizes every in-edge weight) + out-neighbors of touched
+    # sources (out-degree renormalizes every out-edge weight) — walked on
+    # BOTH graphs so removed reach still counts
+    seed = np.unique(np.concatenate([
+        changed_dst,
+        _out_neighbors(graph, changed_src),
+        _out_neighbors(g2, changed_src),
+    ])).astype(np.int64)
+    dirty = seed
+    frontier = seed
+    for _ in range(max(int(hops) - 1, 0)):
+        nxt = np.union1d(
+            _out_neighbors(graph, frontier), _out_neighbors(g2, frontier)
+        )
+        fresh = np.setdiff1d(nxt, dirty, assume_unique=False)
+        if len(fresh) == 0:
+            break
+        dirty = np.union1d(dirty, fresh)
+        frontier = fresh
+
+    return DeltaPlan(
+        src=src, dst=dst, v_num=new_v, graph=g2, digest=graph_digest(g2),
+        dirty_rows=changed_dst.astype(np.int64), dirty=dirty,
+        added_edges=int(len(delta.add_src)), removed_edges=removed,
+        added_vertices=int(delta.add_vertices),
+        add_features=delta.add_features, hops=int(hops),
+    )
+
+
+# ---------------------------------------------------------------------------
+# application
+# ---------------------------------------------------------------------------
+
+
+def apply_to_engines(engines: Sequence, delta: GraphDelta,
+                     plan: Optional[DeltaPlan] = None) -> DeltaPlan:
+    """Swap the post-delta graph into every engine (no server state).
+
+    Engines cloned from one template share the toolkit, the device hop
+    sampler and the AOT ladder — the shared pieces are patched exactly
+    once; per-engine samplers each get the new graph reference. Returns
+    the plan (``plan.rows_patched`` set)."""
+    import jax.numpy as jnp
+
+    base = engines[0]
+    if plan is None:
+        plan = plan_delta(base.sampler.graph, delta,
+                          hops=len(base.fanouts))
+    g = plan.graph
+
+    rows_patched = 0
+    hop_samplers = set()
+    hop = getattr(base.sampler, "hop_sampler", None)
+    if hop is not None:
+        rows_patched = hop.apply_delta(g, plan.dirty_rows)
+        hop_samplers.add(id(hop))
+    new_feature = None
+    if plan.added_vertices:
+        feat = base.feature
+        rows = np.asarray(plan.add_features)
+        if rows.ndim != 2 or rows.shape[0] != plan.added_vertices \
+                or rows.shape[1] != feat.shape[1]:
+            raise ValueError(
+                f"add_features must be [{plan.added_vertices}, "
+                f"{feat.shape[1]}], got {rows.shape}"
+            )
+        new_feature = jnp.concatenate(
+            [feat, jnp.asarray(rows, dtype=feat.dtype)], axis=0
+        )
+
+    toolkits = set()
+    ladders = set()
+    for eng in engines:
+        h = getattr(eng.sampler, "hop_sampler", None)
+        if h is not None and id(h) not in hop_samplers:
+            rows_patched += h.apply_delta(g, plan.dirty_rows)
+            hop_samplers.add(id(h))
+        eng.sampler.set_graph(g)
+        tk = eng.toolkit
+        if id(tk) not in toolkits:
+            tk.host_graph = g
+            # the tuner/ledger keying follows the live graph: the old
+            # cached digest would keep keying decisions to a graph that
+            # no longer exists
+            tk._tune_graph_digest = plan.digest
+            toolkits.add(id(tk))
+        if new_feature is not None:
+            eng.feature = new_feature
+            if id(eng._compiled) not in ladders:
+                ladders.add(id(eng._compiled))
+                if eng._compiled:
+                    log.warning(
+                        "graph delta appended %d vertices: the feature "
+                        "operand changed shape, invalidating %d AOT bucket "
+                        "executable(s) — the next flush per bucket "
+                        "recompiles once", plan.added_vertices,
+                        len(eng._compiled),
+                    )
+                eng._compiled.clear()
+    plan.rows_patched = rows_patched
+    return plan
+
+
+def apply_to_servers(servers: Sequence, delta: GraphDelta,
+                     extra_engines: Sequence = ()) -> DeltaPlan:
+    """The full between-flushes application over one or many servers
+    (the fleet path): compute the plan once, take every server's graph
+    gate (no flush is mid-produce while the graph swaps), swap engines,
+    invalidate only the dirty embedding-cache entries, refresh hot
+    masks, bump graph versions, and emit one ``graph_delta`` record per
+    server stream."""
+    if not servers:
+        raise ValueError("apply_to_servers needs at least one server")
+    t0 = time.perf_counter()
+    base = servers[0].engine
+    plan = plan_delta(base.sampler.graph, delta, hops=len(base.fanouts))
+    engines: List = []
+    seen = set()
+    for eng in [s.engine for s in servers] + list(extra_engines):
+        if id(eng) not in seen:
+            seen.add(id(eng))
+            engines.append(eng)
+    with contextlib.ExitStack() as stack:
+        for s in servers:
+            stack.enter_context(s._graph_gate)
+        apply_to_engines(engines, delta, plan=plan)
+        rows_patched = plan.rows_patched
+        seconds = time.perf_counter() - t0
+        for s in servers:
+            n_inv = s.cache.invalidate(plan.dirty)
+            if s.opts.hot_threshold > 0:
+                from neutronstarlite_tpu.parallel.feature_cache import (
+                    hot_vertex_mask,
+                )
+
+                s.cache.hot_mask = hot_vertex_mask(
+                    plan.graph, s.opts.hot_threshold
+                )
+            s._graph_version += 1
+            if s.metrics is not None:
+                s.metrics.counter_add("serve.graph_deltas")
+                s.metrics.gauge_set("graph.digest", plan.digest)
+                fields = dict(
+                    added_edges=plan.added_edges,
+                    removed_edges=plan.removed_edges,
+                    added_vertices=plan.added_vertices,
+                    graph_digest=plan.digest,
+                    cache_invalidated=int(n_inv),
+                    rows_patched=int(rows_patched),
+                    dirty_predictions=int(len(plan.dirty)),
+                    seconds=float(seconds),
+                )
+                if getattr(s, "replica", None):
+                    fields["replica"] = s.replica
+                s.metrics.event("graph_delta", **fields)
+    log.info(
+        "graph delta applied: +%de -%de +%dv, %d dirty prediction(s), "
+        "%d device row(s) patched, digest %s (%.1f ms)",
+        plan.added_edges, plan.removed_edges, plan.added_vertices,
+        len(plan.dirty), rows_patched, plan.digest[:12],
+        (time.perf_counter() - t0) * 1000.0,
+    )
+    return plan

@@ -30,6 +30,7 @@ Turns a trained sampled-GCN toolkit into an online scorer:
 from __future__ import annotations
 
 import os
+import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -143,6 +144,63 @@ class InferenceEngine:
         self.buckets = self.sampler.buckets
         self._compiled: Dict[int, Any] = {}
         self.compile_counts: Dict[int, int] = {}
+        # shared across clones (serve/fleet.py): two replica executors
+        # racing a cold bucket must still compile it exactly once
+        self._compile_lock = threading.Lock()
+
+    def clone(self, metrics: Any = None,
+              rng: Optional[np.random.Generator] = None) -> "InferenceEngine":
+        """A warm replica engine over the SAME toolkit/params/graph.
+
+        The serve fleet's replica N+1 startup path: the clone shares the
+        checkpoint-restored params, the feature slab, the device hop
+        sampler table, and — crucially — the AOT bucket ladder
+        (``_compiled``/``compile_counts`` are the same dicts), so a new
+        replica serves its first request with ZERO recompiles; and since
+        the toolkit (with its tune-resolved knobs and cached graph
+        digest) is shared, nothing is ever re-measured (the PR 9
+        decision cache did that work once). Only the ServeSampler is
+        fresh: numpy Generators are not thread-safe, so each replica
+        draws from its own."""
+        new = object.__new__(InferenceEngine)
+        new.toolkit = self.toolkit
+        new.cfg = self.cfg
+        new.opts = self.opts
+        new.metrics = metrics if metrics is not None else self.metrics
+        new.params = self.params
+        new.feature = self.feature
+        new.fanouts = list(self.fanouts)
+        new.compute_dtype = self.compute_dtype
+        new.ckpt_step = self.ckpt_step
+        new.sampler = ServeSampler(
+            self.sampler.graph, self.fanouts, self.opts.ladder(), rng=rng,
+            hop_sampler=self.sampler.hop_sampler,
+        )
+        new.buckets = new.sampler.buckets
+        new._compiled = self._compiled
+        new.compile_counts = self.compile_counts
+        new._compile_lock = self._compile_lock
+        return new
+
+    def graph_digest(self) -> str:
+        """The canonical digest of the graph this engine serves — the
+        tune-cache/perf-ledger keying fact a graph delta bumps
+        (serve/delta.py updates the toolkit's cached copy)."""
+        digest = getattr(self.toolkit, "_tune_graph_digest", None)
+        if digest is None:
+            from neutronstarlite_tpu.graph.digest import graph_digest
+
+            digest = graph_digest(self.sampler.graph)
+            self.toolkit._tune_graph_digest = digest
+        return digest
+
+    def apply_delta(self, delta) -> Any:
+        """Engine-level delta application (no cache/batcher state — the
+        server/fleet paths add those; serve/delta.py has the
+        semantics)."""
+        from neutronstarlite_tpu.serve import delta as delta_mod
+
+        return delta_mod.apply_to_engines([self], delta)
 
     # ---- construction ----------------------------------------------------
     @classmethod
@@ -257,13 +315,29 @@ class InferenceEngine:
         compiled = self._compiled.get(bucket)
         if compiled is not None:
             return compiled
+        with self._compile_lock:
+            return self._compile_bucket(bucket)
+
+    def _compile_bucket(self, bucket: int):
+        compiled = self._compiled.get(bucket)  # a racing clone got here first
+        if compiled is not None:
+            return compiled
         caps = self.sampler.node_caps(bucket)
         forward = _eval_forward_fn(caps, self.compute_dtype)
         # one host-side sample supplies shape-representative args: padded
-        # capacities are static per bucket, so any seed set works
-        rep = self.sampler.sample(
-            bucket, np.zeros(1, np.int64)
-        )
+        # capacities are static per bucket, so any seed set works. The
+        # draw must be RNG-NEUTRAL (state saved + restored): otherwise a
+        # warm engine (cloned AOT ladder, zero compiles) and a cold one
+        # consume different rng streams and the "one seed replays the
+        # serving trace bit-identically" contract breaks between them —
+        # the delta oracle compares exactly such a warm/cold pair
+        rng_state = self.sampler.rng.bit_generator.state
+        try:
+            rep = self.sampler.sample(
+                bucket, np.zeros(1, np.int64)
+            )
+        finally:
+            self.sampler.rng.bit_generator.state = rng_state
         nodes, hops = batch_device_args(rep)
         t0 = time.perf_counter()
         compiled = jax.jit(forward).lower(

@@ -45,6 +45,7 @@ class ServeSampler:
     ):
         self.graph = graph
         self.fanouts = list(fanouts)
+        self.hop_sampler = hop_sampler
         self.rng = np.random.default_rng(seed) if rng is None else rng
         # buckets share the injected Generator: draws interleave in request
         # order, so a serving trace replays bit-identically from one seed.
@@ -75,6 +76,14 @@ class ServeSampler:
     def sample(self, bucket: int, seed_ids: np.ndarray) -> SampledBatch:
         return self._samplers[int(bucket)].sample_batch(seed_ids)
 
+    def set_graph(self, graph: CSCGraph) -> None:
+        """Swap in a post-delta host graph (serve/delta.py): every bucket
+        Sampler re-points at the new structure; capacities/fanouts/rng
+        are graph-independent and keep their state."""
+        self.graph = graph
+        for s in self._samplers.values():
+            s.graph = graph
+
 
 class EmbeddingCache:
     """Bounded LRU of per-vertex inference outputs with a staleness TTL.
@@ -102,6 +111,7 @@ class EmbeddingCache:
         self.hits = 0
         self.misses = 0
         self.expired = 0
+        self.invalidated = 0
 
     @classmethod
     def for_graph(cls, graph: CSCGraph, capacity: int, max_age_s: float,
@@ -149,6 +159,20 @@ class EmbeddingCache:
                 self._rows.popitem(last=False)
         return inserted
 
+    def invalidate(self, vids) -> int:
+        """Drop the cached rows for exactly ``vids`` (the graph-delta
+        dirty set, serve/delta.py) — entries for untouched vertices keep
+        hitting; returns how many entries were actually dropped."""
+        if self.capacity <= 0:
+            return 0
+        n = 0
+        with self._lock:
+            for vid in np.asarray(vids, dtype=np.int64).tolist():
+                if self._rows.pop(int(vid), None) is not None:
+                    n += 1
+            self.invalidated += n
+        return n
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._rows)
@@ -160,4 +184,5 @@ class EmbeddingCache:
                 "hits": self.hits,
                 "misses": self.misses,
                 "expired": self.expired,
+                "invalidated": self.invalidated,
             }

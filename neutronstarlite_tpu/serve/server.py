@@ -55,10 +55,19 @@ class InferenceServer:
     """Micro-batched, cache-fronted serving over one InferenceEngine."""
 
     def __init__(self, engine: InferenceEngine,
-                 options: Optional[ServeOptions] = None):
+                 options: Optional[ServeOptions] = None,
+                 replica: Optional[str] = None):
         self.engine = engine
         self.opts = options or engine.opts
         self.metrics = engine.metrics
+        # fleet identity (serve/fleet.py): stamps the exporter surface
+        # label, the flight-dump filename prefix, and the graph_delta
+        # records; None for a standalone server
+        self.replica = replica
+        if self.metrics is not None and replica:
+            self.metrics.gauge_set("serve.replica", replica)
+            if self.metrics.flight is not None:
+                self.metrics.flight.tag = replica
         self.cache = EmbeddingCache.for_graph(
             engine.toolkit.host_graph,
             self.opts.cache_cap,
@@ -85,7 +94,9 @@ class InferenceServer:
             SloEngine.from_env(self.metrics, scope="serve")
             if self.metrics is not None else None
         )
-        self.exporter = obs_exporter.maybe_start(self.metrics, slo=self.slo)
+        self.exporter = obs_exporter.maybe_start(
+            self.metrics, slo=self.slo, replica=replica
+        )
         # SAMPLE_PIPELINE:pipelined/device — two-stage flush: the batcher's
         # flusher thread becomes the PRODUCER (cache pass + per-request
         # fan-out sampling + async H2D staging) and a dedicated executor
@@ -94,7 +105,19 @@ class InferenceServer:
         # the batch_flush critical path. The queue is bounded: a stalled
         # executor backpressures the producer, which backs up the batcher,
         # which sheds — overload policy unchanged.
-        self.pipelined = self.opts.sample_pipeline in ("pipelined", "device")
+        # continuous batching (SERVE_CB / NTS_SERVE_CB) rides the same
+        # two-stage machinery with synchronous sampling: the produce
+        # stage of bucket i+1 overlaps the execute of bucket i
+        self.pipelined = (
+            self.opts.continuous_batching
+            or self.opts.sample_pipeline in ("pipelined", "device")
+        )
+        # serializes the flush PRODUCE stage against live graph-delta
+        # application (serve/delta.py): a delta lands between flushes,
+        # never inside one; the version guards cache re-insertion of
+        # pre-delta logits by in-flight prepared flushes
+        self._graph_gate = threading.RLock()
+        self._graph_version = 0
         self._prep_q: Optional[queue_mod.Queue] = None
         self._exec_thread: Optional[threading.Thread] = None
         self._producing = False
@@ -132,6 +155,52 @@ class InferenceServer:
         """Blocking convenience wrapper: logits [n, n_classes]."""
         return self.submit(node_ids).result(timeout)
 
+    # ---- live graph deltas (serve/delta.py) ------------------------------
+    def apply_delta(self, delta):
+        """Apply a GraphDelta between flushes: post-delta graph swapped
+        in under the graph gate, only the touched embedding-cache
+        entries invalidated, device neighbor-table rows patched, digest
+        bumped, one typed ``graph_delta`` record emitted. Returns the
+        DeltaPlan."""
+        from neutronstarlite_tpu.serve import delta as delta_mod
+
+        return delta_mod.apply_to_servers([self], delta)
+
+    # ---- fleet-side surface (serve/fleet.py) -----------------------------
+    def beating(self) -> bool:
+        """Replica liveness: the flusher (and, pipelined, the executor)
+        thread still running and the server not closed — what the fleet
+        heartbeat monitor consumes each tick."""
+        if self._closed:
+            return False
+        alive = self.batcher.alive()
+        if self._exec_thread is not None:
+            alive = alive and self._exec_thread.is_alive()
+        return alive
+
+    def inject_death(self) -> None:
+        """Chaos hook: kill the flusher thread without draining — the
+        fleet's heartbeat monitor must detect the silence, restart the
+        replica supervised, and re-route the stolen pending requests."""
+        self.batcher.abort()
+
+    def steal_inflight(self) -> List[ServeRequest]:
+        """Every request this (dead) server still owes an answer:
+        batcher-pending plus any prepared-but-unexecuted flushes. The
+        fleet re-routes them — in-flight requests are re-routed, never
+        dropped."""
+        out = self.batcher.steal_pending()
+        if self._prep_q is not None:
+            while True:
+                try:
+                    item = self._prep_q.get_nowait()
+                except queue_mod.Empty:
+                    break
+                if item is None:
+                    continue
+                out.extend(item[0])
+        return [r for r in out if not r.done()]
+
     # ---- the flush path (batcher thread) ---------------------------------
     def _flush(self, requests: List[ServeRequest], reason: str) -> None:
         if self.pipelined:
@@ -159,6 +228,12 @@ class InferenceServer:
 
     def _flush_body(self, requests: List[ServeRequest], t0: float,
                     flush_id: int, batch_span):
+        with self._graph_gate:  # a graph delta lands between flushes
+            return self._flush_body_locked(requests, t0, flush_id,
+                                           batch_span)
+
+    def _flush_body_locked(self, requests: List[ServeRequest], t0: float,
+                           flush_id: int, batch_span):
         # cache pass: per requested id, a fresh cached row or a compute slot
         all_ids, cached_rows = self._cache_pass(requests)
         t_cache = time.perf_counter()
@@ -230,20 +305,35 @@ class InferenceServer:
         flush_id = next(_FLUSH_IDS)
         self._producing = True
         try:
-            all_ids, cached_rows = self._cache_pass(requests)
-            t_cache = time.perf_counter()
-            bucket = None
-            prepared = None
-            uniq = None
-            t_sample = t_cache
-            t_h2d = t_cache
-            if all_ids:
-                uniq = np.asarray(all_ids, dtype=np.int64)
-                bucket = self.engine.sampler.bucket_for(len(uniq))
-                batch = self.engine.sampler.sample(bucket, uniq)
-                t_sample = time.perf_counter()
-                prepared = self.engine.prepare_batch(batch)
-                t_h2d = time.perf_counter()
+            with self._graph_gate:  # delta lands between produce stages
+                version = self._graph_version
+                all_ids, cached_rows = self._cache_pass(requests)
+                t_cache = time.perf_counter()
+                bucket = None
+                prepared = None
+                uniq = None
+                t_sample = t_cache
+                t_h2d = t_cache
+                exec_ctx = None
+                if all_ids:
+                    uniq = np.asarray(all_ids, dtype=np.int64)
+                    bucket = self.engine.sampler.bucket_for(len(uniq))
+                    batch = self.engine.sampler.sample(bucket, uniq)
+                    t_sample = time.perf_counter()
+                    prepared = self.engine.prepare_batch(batch)
+                    # snapshot the executable + operands UNDER the gate:
+                    # a vertex-appending delta swaps engine.feature and
+                    # clears the AOT ladder, and an in-flight prepared
+                    # flush must answer with the PRE-delta view — not
+                    # crash on a shape-mismatched operand (the staleness
+                    # contract). Compiling here (cold bucket) also keeps
+                    # compile out of the executor's steady-state path.
+                    exec_ctx = (
+                        self.engine._ensure_compiled(bucket),
+                        self.engine.params,
+                        self.engine.feature,
+                    )
+                    t_h2d = time.perf_counter()
             for name, a, b in (
                 ("cache_lookup", t0, t_cache),
                 ("sample", t_cache, t_sample),
@@ -261,7 +351,7 @@ class InferenceServer:
         # flows to the batcher queue, whose bound sheds — policy unchanged)
         self._prep_q.put(
             (requests, reason, flush_id, t0, t_h2d, bucket, uniq,
-             cached_rows, prepared)
+             cached_rows, prepared, version, exec_ctx)
         )
         depth = self._prep_q.qsize()
         if self.metrics is not None:
@@ -291,11 +381,11 @@ class InferenceServer:
                     "sample_wait", dur_s=wait, t0=t_idle, cat="sample",
                 )
             (requests, reason, flush_id, t0, t_h2d, bucket, uniq,
-             cached_rows, prepared) = item
+             cached_rows, prepared, version, exec_ctx) = item
             try:
                 self._execute_prepared(
                     requests, reason, flush_id, t0, t_h2d, bucket, uniq,
-                    cached_rows, prepared,
+                    cached_rows, prepared, version, exec_ctx,
                 )
             except BaseException as e:  # mirror MicroBatcher._loop
                 log.warning(
@@ -311,7 +401,8 @@ class InferenceServer:
                         r._complete(None, "error", e)
 
     def _execute_prepared(self, requests, reason, flush_id, t0, t_h2d,
-                          bucket, uniq, cached_rows, prepared) -> None:
+                          bucket, uniq, cached_rows, prepared,
+                          version: int = 0, exec_ctx=None) -> None:
         t_exec0 = time.perf_counter()
         # the producer->executor queue wait: without this stage the serve
         # critical path's stage sum would silently undershoot the recorded
@@ -323,10 +414,23 @@ class InferenceServer:
         rows: Dict[int, np.ndarray] = dict(cached_rows)
         if prepared is not None:
             nodes, hops = prepared
-            logits = self.engine.execute_prepared(nodes, hops, bucket)
+            if exec_ctx is not None:
+                # the produce-time snapshot: executable + params + feature
+                # captured under the graph gate, so a delta that swapped
+                # engine.feature / cleared the AOT ladder mid-flight
+                # cannot hand this flush a shape-mismatched operand
+                executable, params, feature = exec_ctx
+                logits = np.asarray(executable(params, feature, nodes, hops))
+            else:
+                logits = self.engine.execute_prepared(nodes, hops, bucket)
             for i, vid in enumerate(uniq.tolist()):
                 rows[vid] = logits[i]
-            self.cache.insert(uniq, logits[: len(uniq)])
+            # the version check + insert run UNDER the gate: a delta
+            # between an unlocked check and the insert would let
+            # pre-delta logits re-poison the freshly invalidated cache
+            with self._graph_gate:
+                if version == self._graph_version:
+                    self.cache.insert(uniq, logits[: len(uniq)])
         t_exec = time.perf_counter()
         exec_ms = (t_exec - t0) * 1000.0
         for r in requests:

@@ -291,6 +291,7 @@ def render_serve(path: str, rec: Dict[str, Any],
             "expired={expired}".format(**cache)
         )
     lines.extend(render_sample(rec))
+    lines.extend(rec.get("_deltas") or [])
     lines.extend(rec.get("_cost") or [])
     lines.extend(rec.get("_drift") or [])
     lines.extend(rec.get("_hists") or [])
@@ -570,6 +571,38 @@ def render_drift(events: List[Dict[str, Any]]) -> List[str]:
     return lines
 
 
+_MAX_DELTA_LINES = 20
+
+
+def render_deltas(events: List[Dict[str, Any]]) -> List[str]:
+    """The live graph-delta block (serve/delta.py): every ``graph_delta``
+    application with its incremental-invalidation receipt and the digest
+    the tuner/ledger keying now sees. Empty for frozen-graph streams."""
+    deltas = [e for e in events if e["event"] == "graph_delta"]
+    if not deltas:
+        return []
+    lines = ["graph deltas:"]
+    for i, d in enumerate(deltas):
+        if i >= _MAX_DELTA_LINES:
+            lines.append(
+                f"  ... and {len(deltas) - _MAX_DELTA_LINES} more "
+                "delta(s) (full detail in the stream)"
+            )
+            break
+        secs = d.get("seconds")
+        lines.append(
+            f"#graph_delta=+{d['added_edges']}e -{d['removed_edges']}e "
+            f"+{d['added_vertices']}v "
+            f"invalidated={d.get('cache_invalidated', 0)} "
+            f"rows_patched={d.get('rows_patched', 0)} "
+            f"dirty={d.get('dirty_predictions', 0)} "
+            f"digest={str(d['graph_digest'])[:12]}"
+            + (f" ({secs * 1000:.1f} ms)" if secs is not None else "")
+            + (f" [{d['replica']}]" if d.get("replica") else "")
+        )
+    return lines
+
+
 def render_probes(events: List[Dict[str, Any]]) -> List[str]:
     """The ``backend_probe`` block (bench.py's subprocess PJRT check) —
     the stale-anchor cause, visible at last. Empty without probes."""
@@ -710,6 +743,7 @@ def render_run(path: str, rec: Dict[str, Any]) -> str:
         lines.append(f"#final_loss={loss}")
     lines.extend(rec.get("_ring") or [])
     lines.extend(rec.get("_tune") or [])
+    lines.extend(rec.get("_deltas") or [])
     lines.extend(rec.get("_cost") or [])
     lines.extend(rec.get("_drift") or [])
     lines.extend(rec.get("_elastic") or [])
@@ -1021,11 +1055,13 @@ def main(argv=None) -> int:
         hist_lines = render_hists(events)
         slo_lines = slo_timeline(events)
         drift_lines = render_drift(events)
+        delta_lines = render_deltas(events)
         if rec is not None:
             rec["_path"] = p
             rec["_timeline"] = recovery_timeline(events)
             rec["_ring"] = render_ring(events, rec)
             rec["_tune"] = render_tuning(events, rec)
+            rec["_deltas"] = delta_lines
             rec["_cost"] = render_program_costs(events, rec)
             rec["_drift"] = drift_lines
             rec["_elastic"] = render_elastic(events, rec)
@@ -1037,6 +1073,7 @@ def main(argv=None) -> int:
             srec["_path"] = p
             srec["_events"] = events
             srec["_serve"] = True
+            srec["_deltas"] = delta_lines if rec is None else []
             srec["_cost"] = (
                 render_program_costs(events, srec) if rec is None else []
             )

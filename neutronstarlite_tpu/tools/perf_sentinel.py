@@ -70,6 +70,10 @@ GATED_METRICS = {
     ),
     "suite": ("suite_duration_s",),
     "probe": ("seconds",),
+    # serve rows (tools/serve_bench --> obs/ledger.serve_row): tail
+    # latency + shed rate trend-gate exactly like epoch time — the key
+    # embeds mode/replicas/CB so trajectories never mix load shapes
+    "serve": ("p50_ms", "p95_ms", "p99_ms", "shed_rate"),
 }
 
 SUITE_MARGIN_FRAC = 0.8  # the ROADMAP "watch the margin" note as a number

@@ -1,14 +1,18 @@
 """Serving load generator: closed/open-loop SLO measurement.
 
 ``python -m neutronstarlite_tpu.tools.serve_bench <cfg> [<ckpt_dir>]
-[--train] [--mode closed|open] [--clients C | --rps R] [--requests N]``
+[--train] [--mode closed|open] [--clients C | --rps R] [--requests N]
+[--replicas N] [--cb 0|1] [--delta-rate R]``
 
-Drives the in-process serving stack (serve/server.py) and reports tail
-latency + throughput **from the obs records**: the serving run writes its
-typed JSONL stream (serve_request / batch_flush / shed / serve_summary)
-under NTS_METRICS_DIR (a temp dir when unset), and the percentiles printed
-here are computed by re-reading that stream — the measurement artifact is
-the same one tools/metrics_report renders, not a private side channel.
+Drives the in-process serving stack (serve/server.py — or the
+multi-replica fleet, serve/fleet.py, with ``--replicas N``) and reports
+tail latency + throughput **from the obs records**: the serving run
+writes its typed JSONL stream(s) (serve_request / batch_flush / shed /
+serve_summary; one stream per replica in fleet mode, merged here through
+the mergeable ``hist`` records) under NTS_METRICS_DIR (a temp dir when
+unset), and the percentiles printed here are computed by re-reading
+those streams — the measurement artifact is the same one
+tools/metrics_report renders, not a private side channel.
 
 Two load models:
 - **closed** (default): C concurrent clients, each submits its next
@@ -18,6 +22,14 @@ Two load models:
   measures behavior under offered load, including the shedding path once
   R exceeds capacity.
 
+Fleet/live-graph legs:
+- ``--replicas N`` serves through a ReplicaSet (SLO-routed, supervised);
+- ``--cb 0|1`` pins continuous batching (SERVE_CB) for the run;
+- ``--delta-rate R`` applies R live graph-delta batches per second
+  (``--delta-edges`` random edge inserts each, the previous batch
+  removed) DURING the load — the open-loop "predictions track a live
+  graph" leg.
+
 ``--train`` first runs the cfg's training loop (with CHECKPOINT_DIR set
 to the serving checkpoint dir) when no checkpoint exists yet — the
 zero-to-serving path for smoke configs.
@@ -25,6 +37,12 @@ zero-to-serving path for smoke configs.
 Prints ONE BENCH_*-compatible JSON line:
   {"metric": "serve_p99_latency_ms", "value": ..., "unit": "ms",
    "vs_baseline": null, "extra": {p50/p95/p99, throughput, sheds, ...}}
+
+When ``NTS_LEDGER_DIR`` is set, one ``kind=serve`` row (p50/p95/p99,
+shed rate, replica count, delta rate — keyed by cfg fingerprint + load
+shape + graph digest) is appended to the cross-run perf ledger, so
+``tools/perf_sentinel check --kind serve`` trend-gates serve latency the
+way it already gates epoch time.
 """
 
 from __future__ import annotations
@@ -131,29 +149,33 @@ def run_open_loop(server, v_num: int, n_requests: int, rps: float,
     return errors
 
 
-def percentiles_from_stream(path: str) -> Dict[str, Any]:
-    """Recompute the SLO numbers from the serving obs JSONL records.
+def percentiles_from_streams(paths) -> Dict[str, Any]:
+    """Recompute the SLO numbers from one or many serving obs streams
+    (fleet mode: one stream per replica + the front door).
 
-    Quantiles come from the stream's merged ``hist`` records (obs/hist:
+    Quantiles come from the streams' merged ``hist`` records (obs/hist:
     cumulative snapshots, fixed memory, survive NTS_METRICS_MAX_MB
-    rotation); the raw full-sort of every serve_request line — O(N) memory
-    and blind to rotated-away requests — is only the fallback for
-    pre-histogram streams. A rotated ``<path>.1`` chunk is read first so
-    counts cover the whole run where it survived."""
+    rotation, and MERGE across replicas — the fleet p99 is exact); the
+    raw full-sort of every serve_request line — O(N) memory and blind to
+    rotated-away requests — is only the fallback for pre-histogram
+    streams. A rotated ``<path>.1`` chunk is read first so counts cover
+    the whole run where it survived."""
     from neutronstarlite_tpu.obs import schema
     from neutronstarlite_tpu.obs.hist import latest_hists
 
     events = []
-    rotated = path + ".1"
-    for chunk in ([rotated, path] if os.path.exists(rotated) else [path]):
-        with open(chunk, "r", encoding="utf-8") as fh:
-            for raw in fh:
-                raw = raw.strip()
-                if not raw:
-                    continue
-                obj = json.loads(raw)
-                schema.validate_event(obj)
-                events.append(obj)
+    for path in paths:
+        rotated = path + ".1"
+        chunks = [rotated, path] if os.path.exists(rotated) else [path]
+        for chunk in chunks:
+            with open(chunk, "r", encoding="utf-8") as fh:
+                for raw in fh:
+                    raw = raw.strip()
+                    if not raw:
+                        continue
+                    obj = json.loads(raw)
+                    schema.validate_event(obj)
+                    events.append(obj)
     reqs = [e for e in events if e["event"] == "serve_request"]
     served = [
         e for e in reqs
@@ -190,6 +212,56 @@ def percentiles_from_stream(path: str) -> Dict[str, Any]:
     return out
 
 
+def percentiles_from_stream(path: str) -> Dict[str, Any]:
+    """Single-stream wrapper (the pre-fleet entry point)."""
+    return percentiles_from_streams([path])
+
+
+def run_delta_loop(target, rate: float, edges_per_delta: int, seed: int,
+                   stop: threading.Event, counts: Dict[str, int]) -> None:
+    """Apply live graph-delta batches at ``rate``/s while the load runs:
+    each batch inserts ``edges_per_delta`` random NOVEL edges and removes
+    the previous batch's — the graph keeps changing, its size stays
+    bounded, and the base graph is never damaged. Novelty matters:
+    removal drops EVERY occurrence of a listed pair, so a random insert
+    that collided with a pre-existing edge would take the original down
+    with it on the next round — candidates are filtered against the
+    current edge set (one O(E) key build per batch; bench scale).
+    ``target`` is an InferenceServer or ReplicaSet (both expose
+    apply_delta)."""
+    from neutronstarlite_tpu.serve.delta import GraphDelta, _edge_keys
+
+    rng = np.random.default_rng(seed + 31337)
+    interval = 1.0 / max(rate, 1e-6)
+    last: list = []
+    while not stop.wait(interval):
+        g = target.engine.sampler.graph
+        v = g.v_num
+        existing = set(_edge_keys(
+            g.row_indices.astype(np.int64), g.dst_of_edge.astype(np.int64)
+        ).tolist())
+        add: list = []
+        chosen = set()
+        for _ in range(20 * max(edges_per_delta, 1)):  # bounded tries
+            if len(add) >= max(edges_per_delta, 1):
+                break
+            u, w = int(rng.integers(0, v)), int(rng.integers(0, v))
+            key = (u << 32) | w
+            if key in existing or key in chosen:
+                continue
+            chosen.add(key)
+            add.append((u, w))
+        if not add:
+            continue
+        try:
+            target.apply_delta(GraphDelta.edges(add=add, remove=last))
+        except Exception as e:  # the load must finish; deltas are the leg
+            log.warning("delta application failed (%s); stopping deltas", e)
+            return
+        last = add
+        counts["applied"] += 1
+
+
 def main(argv=None) -> int:
     from neutronstarlite_tpu.utils.platform import honor_platform_env
 
@@ -212,7 +284,24 @@ def main(argv=None) -> int:
     ap.add_argument("--requests", type=int, default=200)
     ap.add_argument("--seeds-per-request", type=int, default=1)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--replicas", type=int, default=None,
+                    help="serve through an N-replica ReplicaSet "
+                    "(default: cfg SERVE_REPLICAS / NTS_SERVE_REPLICAS)")
+    ap.add_argument("--route", choices=("least_burn", "round_robin"),
+                    default=None, help="fleet routing policy override")
+    ap.add_argument("--cb", choices=("0", "1"), default=None,
+                    help="pin continuous batching (SERVE_CB) for the run")
+    ap.add_argument("--delta-rate", type=float, default=0.0,
+                    help="apply this many live graph-delta batches per "
+                    "second during the load (0 = frozen graph)")
+    ap.add_argument("--delta-edges", type=int, default=4,
+                    help="edge inserts per delta batch (the previous "
+                    "batch is removed)")
     args = ap.parse_args(argv)
+    if args.cb is not None:
+        os.environ["NTS_SERVE_CB"] = args.cb
+    if args.route is not None:
+        os.environ["NTS_SERVE_ROUTE"] = args.route
 
     from neutronstarlite_tpu.utils.config import InputInfo
 
@@ -250,11 +339,41 @@ def main(argv=None) -> int:
         )
     except ServeSetupError as e:
         raise SystemExit(f"serve_bench: {e}")
+    from neutronstarlite_tpu.serve.fleet import FleetOptions, ReplicaSet
+
     t0 = time.perf_counter()
     engine.warmup()
     warmup_s = time.perf_counter() - t0
-    server = InferenceServer(engine)
+    replicas = (
+        args.replicas if args.replicas is not None
+        else FleetOptions.from_cfg(cfg).replicas
+    )
+    if replicas > 1:
+        server = ReplicaSet.from_engine(
+            engine, replicas, seed=args.seed
+        )
+        stream_paths = server.stream_paths()
+    else:
+        server = InferenceServer(engine)
+        stream_paths = [engine.metrics.path] if engine.metrics.path else []
     v_num = engine.toolkit.host_graph.v_num
+    # the PRE-delta digest is the run's workload identity: the ledger row
+    # must key on it, or two --delta-rate runs (whose applied-delta count
+    # depends on wall-clock timing) would never share a trajectory and
+    # the serve sentinel would silently never gate them
+    initial_digest = engine.graph_digest()
+
+    delta_stop = threading.Event()
+    delta_counts = {"applied": 0}
+    delta_thread = None
+    if args.delta_rate > 0:
+        delta_thread = threading.Thread(
+            target=run_delta_loop,
+            args=(server, args.delta_rate, args.delta_edges, args.seed,
+                  delta_stop, delta_counts),
+            daemon=True,
+        )
+        delta_thread.start()
 
     t0 = time.perf_counter()
     if args.mode == "closed":
@@ -268,17 +387,35 @@ def main(argv=None) -> int:
             args.seeds_per_request, args.seed,
         )
     wall_s = time.perf_counter() - t0
+    delta_stop.set()
+    if delta_thread is not None:
+        delta_thread.join(timeout=30.0)
+    # the graph digest the run ENDED on (deltas bump it) — the ledger key
+    graph_digest = engine.graph_digest()
     stats = server.close()
+    if replicas > 1:
+        # normalize the fleet stats onto the single-server report shape:
+        # the AOT ladder is SHARED across replicas (clone warm start), so
+        # r0's compile counts are the fleet's; cache stats sum
+        per = stats.get("per_replica") or {}
+        first = per.get("r0") or {}
+        stats["compile_counts"] = first.get("compile_counts", {})
+        agg: Dict[str, int] = {}
+        for s in per.values():
+            for k, v in (s.get("cache") or {}).items():
+                agg[k] = agg.get(k, 0) + int(v)
+        stats["cache"] = agg
 
-    stream_path = engine.metrics.path
-    if stream_path and os.path.exists(stream_path):
-        obs_view = percentiles_from_stream(stream_path)
+    stream_paths = [p for p in stream_paths if p and os.path.exists(p)]
+    if stream_paths:
+        obs_view = percentiles_from_streams(stream_paths)
     else:  # metrics dir unusable: fall back to the in-memory view
         obs_view = {
             "served": stats["requests"], "shed": stats["shed"],
             "batches": None, "latency_ms": stats["latency_ms"],
             "throughput_rps": stats["throughput_rps"], "summary": None,
         }
+    stream_path = stream_paths[0] if stream_paths else None
     lat = obs_view["latency_ms"]
     # the serving-side sampling-pipeline telemetry (SAMPLE_PIPELINE:
     # pipelined/device): queue depth + residual stall ride the
@@ -315,10 +452,43 @@ def main(argv=None) -> int:
             "sample_pipeline": engine.opts.sample_pipeline,
             "sample_queue_depth": s_gauges.get("sample.queue_depth"),
             "sample_stall_ms": s_counters.get("sample.stall_ms"),
+            "continuous_batching": engine.opts.continuous_batching,
+            "replicas": replicas,
+            "fleet_shed": stats.get("fleet_shed"),
+            "restarts": stats.get("restarts"),
+            "delta_rate": args.delta_rate,
+            "deltas_applied": delta_counts["applied"],
+            "graph_digest": graph_digest,
             "wall_s": wall_s,
             "metrics_stream": stream_path,
         },
     }
+    # one kind=serve row into the cross-run perf ledger (NTS_LEDGER_DIR):
+    # perf_sentinel check --kind serve trend-gates these the way it
+    # gates epoch time (key embeds mode/replicas/CB — no mixed shapes)
+    from neutronstarlite_tpu.obs import config_fingerprint, ledger
+
+    if ledger.ledger_dir():
+        served = obs_view["served"]
+        shed = obs_view["shed"]
+        total = served + shed
+        ledger.append_row(ledger.serve_row(
+            latency_ms=lat,
+            shed_rate=(shed / total) if total > 0 else None,
+            throughput_rps=obs_view["throughput_rps"],
+            requests=args.requests,
+            cfg_fingerprint=config_fingerprint(cfg),
+            graph_digest=initial_digest,
+            mode=args.mode,
+            replicas=replicas,
+            continuous_batching=engine.opts.continuous_batching,
+            delta_rate=args.delta_rate,
+            deltas_applied=delta_counts["applied"],
+            extra={
+                "clients": args.clients if args.mode == "closed" else None,
+                "rps_offered": args.rps if args.mode == "open" else None,
+            },
+        ))
     print(json.dumps(result))
     return 0
 

@@ -187,6 +187,10 @@ class InputInfo:
     serve_cache_cap: int = 0  # inference embedding cache entries (0 = off)
     serve_cache_max_age_s: float = 60.0  # cache staleness bound (seconds)
     serve_hot_threshold: int = 0  # out-degree >= threshold => cacheable
+    serve_replicas: int = 1  # serve-fleet size (serve/fleet.py ReplicaSet)
+    serve_route: str = ""  # fleet routing policy: least_burn | round_robin
+    serve_cb: int = 0  # continuous batching: produce next bucket while
+    # the current one executes (SERVE_CB:1; serve/batcher.py)
     # ("hot", the feature_cache hot/cold split rule); 0 = every vertex
     sample_pipeline: str = ""  # SAMPLE_PIPELINE: sampling execution mode
     # for the sampled path (training gcn_sample + serve/): "" / sync (the
@@ -369,6 +373,12 @@ class InputInfo:
             self.serve_cache_max_age_s = float(value)
         elif key == "SERVE_HOT_THRESHOLD":
             self.serve_hot_threshold = int(value)
+        elif key == "SERVE_REPLICAS":
+            self.serve_replicas = int(value)
+        elif key == "SERVE_ROUTE":
+            self.serve_route = value
+        elif key == "SERVE_CB":
+            self.serve_cb = int(value)
         elif key == "SAMPLE_PIPELINE":
             v = value.strip().lower()
             # validated like DIST_PATH/KERNEL: a typo'd value would

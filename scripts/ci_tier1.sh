@@ -575,6 +575,187 @@ else
   echo "LEDGER_GATE=OK"
 fi
 
+# ---- serve-fleet gate (ISSUE 14) -------------------------------------------
+# STRUCTURAL (hard): 3-replica fleet over the serve_fleet_smoke cfg.
+# (1) inject a single-replica SLO breach -> every request routes AROUND
+# it with ZERO fleet-level sheds; (2) kill a replica -> the heartbeat
+# monitor detects it (rank_loss record), restarts it supervised
+# (recovery action=restart) and serving continues -> exit 0; (3) apply a
+# graph delta -> post-delta predictions match a FRESH engine built on
+# the post-delta edge list bitwise, with only the touched embedding-
+# cache entries invalidated. NTS_NO_NATIVE=1 pins the fresh-build edge
+# order (the delta rebuild is numpy-canonical).
+fleet_rc=0
+rm -rf /tmp/_t1_fleet
+if JAX_PLATFORMS=cpu NTS_METRICS_DIR=/tmp/_t1_fleet NTS_NO_NATIVE=1 \
+    NTS_SAMPLE_WORKERS=0 NTS_SLO_SPEC='serve_p99_ms<=5000@30s' \
+    NTS_SERVE_HEARTBEAT_S=0.1 NTS_HEARTBEAT_MISS_K=2 \
+    timeout -k 10 600 python - <<'EOF' > /tmp/_t1_fleet.log 2>&1
+import glob, json, os, tempfile, time
+
+import numpy as np
+
+from neutronstarlite_tpu.utils.platform import honor_platform_env
+
+honor_platform_env()
+from neutronstarlite_tpu.serve.delta import GraphDelta, plan_delta
+from neutronstarlite_tpu.serve.engine import InferenceEngine
+from neutronstarlite_tpu.serve.fleet import ReplicaSet
+from neutronstarlite_tpu.tools.serve_bench import ensure_checkpoint
+from neutronstarlite_tpu.utils.config import InputInfo
+
+cfg_path = "configs/serve_fleet_smoke.cfg"
+cfg = InputInfo.read_from_cfg_file(cfg_path)
+base_dir = os.path.dirname(os.path.abspath(cfg_path))
+ckpt = tempfile.mkdtemp(prefix="fleet_gate_ckpt_")
+cfg.checkpoint_dir = ckpt
+ensure_checkpoint(cfg, base_dir, ckpt, train=True)
+engine = InferenceEngine.from_config(
+    cfg, base_dir=base_dir, ckpt_dir=ckpt, rng=np.random.default_rng(0)
+)
+engine.warmup()
+fleet = ReplicaSet.from_engine(engine, 3, seed=0)
+assert len(fleet.replicas) == 3
+v = engine.toolkit.host_graph.v_num
+rng = np.random.default_rng(1)
+
+# ---- leg 1: single-replica breach -> route around, zero fleet sheds
+bad = fleet.replicas[1]
+for _ in range(30):
+    bad.server.metrics.hist_observe("serve.latency_ms", 1e6)
+bad.server.slo.tick(force=True)
+assert bad.route_state()["draining"] is True, "injected breach not seen"
+reqs = [fleet.submit(rng.integers(0, v, 1)) for _ in range(30)]
+for r in reqs:
+    r.result(timeout=60.0)
+assert fleet.shed_count == 0, f"fleet shed {fleet.shed_count} request(s)"
+assert bad.server.request_count == 0, "requests routed INTO the breach"
+
+# ---- leg 2: replica kill -> supervised restart, serving continues
+victim = fleet.replicas[0]
+fleet.inject_replica_death(0)
+deadline = time.time() + 20.0
+while time.time() < deadline:
+    if fleet.replicas[0] is not victim and fleet.replicas[0].beating():
+        break
+    time.sleep(0.1)
+assert fleet.replicas[0] is not victim, "dead replica never restarted"
+assert fleet.replicas[0].restarts == 1
+reqs = [fleet.submit(rng.integers(0, v, 1)) for _ in range(10)]
+for r in reqs:
+    r.result(timeout=60.0)
+assert fleet.shed_count == 0
+
+# ---- leg 3: graph delta -> fresh-engine oracle + incremental cache
+g = engine.sampler.graph
+u, d0 = int(g.row_indices[0]), int(g.dst_of_edge[0])
+delta = GraphDelta.edges(
+    add=[(5, 17), (1200, 17), (17, 421)], remove=[(u, d0)]
+)
+preview = plan_delta(g, delta, hops=len(engine.fanouts))
+clean_vid = next(i for i in range(v) if i not in set(preview.dirty.tolist()))
+dirty_vid = int(preview.dirty[0])
+r0 = fleet.replicas[0].server
+r0.predict([dirty_vid], timeout=60.0)
+r0.predict([clean_vid], timeout=60.0)
+assert r0.cache.lookup(dirty_vid) is not None
+plan = fleet.apply_delta(delta)
+assert r0.cache.lookup(dirty_vid) is None, "dirty entry survived the delta"
+assert r0.cache.lookup(clean_vid) is not None, "clean entry was invalidated"
+
+edge_file = tempfile.mktemp(suffix=".edge.txt")
+with open(edge_file, "w") as fh:
+    for s_, t_ in zip(plan.src.tolist(), plan.dst.tolist()):
+        fh.write(f"{s_} {t_}\n")
+cfg2 = InputInfo.read_from_cfg_file(cfg_path)
+cfg2.edge_file = edge_file
+cfg2.checkpoint_dir = ckpt
+fresh = InferenceEngine.from_config(
+    cfg2, base_dir=base_dir, ckpt_dir=ckpt, rng=np.random.default_rng(777)
+)
+probe = engine.clone(rng=np.random.default_rng(777))
+for _ in range(4):
+    seeds = rng.integers(0, v, size=int(rng.integers(1, 8)))
+    a, b = probe.predict(seeds), fresh.predict(seeds)
+    assert np.array_equal(a, b), f"delta oracle diverged on {seeds}"
+
+stats = fleet.close()
+assert stats["fleet_shed"] == 0 and stats["restarts"] == 1
+
+from neutronstarlite_tpu.obs import schema
+
+evs = []
+for p in sorted(glob.glob("/tmp/_t1_fleet/*.jsonl")):
+    for line in open(p, encoding="utf-8"):
+        if line.strip():
+            evs.append(json.loads(line))
+assert schema.validate_stream(evs) == len(evs)
+kinds = {e["event"] for e in evs}
+assert "rank_loss" in kinds, "kill left no rank_loss record"
+assert any(e["event"] == "recovery" and e.get("action") == "restart"
+           for e in evs), "no supervised-restart recovery record"
+deltas = [e for e in evs if e["event"] == "graph_delta"]
+assert len(deltas) == 3, f"want one graph_delta per replica, got {len(deltas)}"
+assert all(e["graph_digest"] == plan.digest for e in deltas)
+print(
+    f"fleet gate: routed around the breach (30 req, 0 fleet sheds, "
+    f"breaching replica served 0); kill -> restart #1 -> 10 more served; "
+    f"delta oracle bitwise over 4 batches, cache kept {clean_vid} "
+    f"dropped {dirty_vid}; digest {plan.digest[:12]}"
+)
+EOF
+then
+  grep "fleet gate:" /tmp/_t1_fleet.log
+else
+  fleet_rc=$?
+  tail -30 /tmp/_t1_fleet.log
+fi
+if [ "$fleet_rc" -ne 0 ]; then
+  echo "FLEET_GATE=FAIL (rc=$fleet_rc)"
+else
+  echo "FLEET_GATE=OK"
+fi
+
+# TIMING (advisory on the CPU rig): continuous batching vs single-flush
+# on the same open-loop load, both rows into the perf ledger (kind=serve,
+# keyed by load shape) so the sentinel trend-gates serve p99 across runs;
+# the pairwise CB-vs-sync comparison prints here and only fails the build
+# when NTS_CI_MICRO_FATAL=1 (a 1-core rig cannot overlap produce with
+# execute, so wall-clock wins are not guaranteed there).
+if [ "$fleet_rc" -eq 0 ]; then
+  fleet_ckpt=$(ls -dt /tmp/fleet_gate_ckpt_* 2>/dev/null | head -1)
+  cb_rc=0
+  JAX_PLATFORMS=cpu NTS_SAMPLE_WORKERS=0 NTS_NO_NATIVE=1 \
+    NTS_LEDGER_DIR="$t1_ledger" NTS_METRICS_DIR=/tmp/_t1_fleet_cb0 \
+    timeout -k 10 300 python -m neutronstarlite_tpu.tools.serve_bench \
+    configs/serve_fleet_smoke.cfg "$fleet_ckpt" --mode open --rps 150 \
+    --requests 120 --replicas 1 --cb 0 > /tmp/_t1_cb0.json 2>/dev/null \
+  && JAX_PLATFORMS=cpu NTS_SAMPLE_WORKERS=0 NTS_NO_NATIVE=1 \
+    NTS_LEDGER_DIR="$t1_ledger" NTS_METRICS_DIR=/tmp/_t1_fleet_cb1 \
+    timeout -k 10 300 python -m neutronstarlite_tpu.tools.serve_bench \
+    configs/serve_fleet_smoke.cfg "$fleet_ckpt" --mode open --rps 150 \
+    --requests 120 --replicas 1 --cb 1 > /tmp/_t1_cb1.json 2>/dev/null \
+  && python - <<'EOF' || cb_rc=$?
+import json
+
+def p99(path):
+    for line in open(path):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)["extra"]["p99_ms"]
+    raise SystemExit(f"no JSON line in {path}")
+
+a, b = p99("/tmp/_t1_cb0.json"), p99("/tmp/_t1_cb1.json")
+print(f"continuous batching leg: p99 sync={a:.2f}ms cb={b:.2f}ms "
+      f"({(b - a) / a * 100:+.1f}%)")
+raise SystemExit(0 if b <= a * 1.05 else 2)
+EOF
+  echo "FLEET_CB_GATE=rc$cb_rc (advisory unless NTS_CI_MICRO_FATAL=1)"
+  if [ "${NTS_CI_MICRO_FATAL:-0}" = "1" ] && [ "$cb_rc" -ne 0 ]; then
+    fleet_rc=$cb_rc
+  fi
+fi
+
 [ "$rc" -eq 0 ] && rc=$fused_rc
 [ "$rc" -eq 0 ] && rc=$samp_rc
 [ "$rc" -eq 0 ] && rc=$elastic_rc
@@ -582,4 +763,5 @@ fi
 [ "$rc" -eq 0 ] && rc=$mesh_rc
 [ "$rc" -eq 0 ] && rc=$obs_rc
 [ "$rc" -eq 0 ] && rc=$ledger_rc
+[ "$rc" -eq 0 ] && rc=$fleet_rc
 exit $rc

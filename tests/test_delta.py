@@ -1,0 +1,311 @@
+"""serve/delta: live graph-delta ingestion — deterministic rebuild, dirty
+sets, device-table row patching, the before/after prediction oracle
+against a fresh engine, incremental cache invalidation (hit-rate), and
+the delta -> digest -> tuner-keying interplay (ISSUE 14)."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from neutronstarlite_tpu.graph.digest import graph_digest
+from neutronstarlite_tpu.graph.storage import build_graph
+from neutronstarlite_tpu.models.gcn_sample import GCNSampleTrainer
+from neutronstarlite_tpu.serve.batcher import ServeOptions
+from neutronstarlite_tpu.serve.delta import GraphDelta, plan_delta
+from neutronstarlite_tpu.serve.engine import InferenceEngine
+from neutronstarlite_tpu.serve.server import InferenceServer
+from tests.test_models import _planted_data
+from tests.test_serve import _serve_cfg
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _ring_graph(v=8):
+    src = np.arange(v, dtype=np.uint32)
+    dst = np.roll(src, -1)
+    return build_graph(src, dst, v, use_native=False)
+
+
+# ---- plan: deterministic rebuild + dirty sets -------------------------------
+
+
+def test_delta_rebuild_is_bitwise_fresh_build():
+    """The oracle's ground: the delta-edited graph must be BITWISE what a
+    fresh NumPy build over the same edited edge list produces."""
+    rng = np.random.default_rng(0)
+    src = rng.integers(0, 50, 300).astype(np.uint32)
+    dst = rng.integers(0, 50, 300).astype(np.uint32)
+    g = build_graph(src, dst, 50, use_native=False)
+    d = GraphDelta.edges(
+        add=[(3, 7), (49, 0), (10, 10)],
+        remove=[(int(src[0]), int(dst[0])), (int(src[5]), int(dst[5]))],
+    )
+    plan = plan_delta(g, d, hops=2)
+    fresh = build_graph(plan.src.astype(np.uint32),
+                        plan.dst.astype(np.uint32), plan.v_num,
+                        use_native=False)
+    for field in ("column_offset", "row_indices", "dst_of_edge",
+                  "edge_weight_forward", "row_offset", "column_indices",
+                  "src_of_edge", "edge_weight_backward", "out_degree",
+                  "in_degree"):
+        np.testing.assert_array_equal(
+            getattr(plan.graph, field), getattr(fresh, field), err_msg=field
+        )
+    assert plan.digest == graph_digest(fresh)
+    assert plan.digest != graph_digest(g)  # the digest BUMPED
+
+
+def test_delta_dirty_sets_ring():
+    """On a directed ring 0->1->...->7->0, adding (4, 1): the dirty rows
+    are {1} (its in-set changed); dirty predictions are the out-closure:
+    hop-1 = {1, 5} (1's in-set + 4's out-neighbor weight renorm), then
+    +1 hop = {2, 6}."""
+    g = _ring_graph(8)
+    plan = plan_delta(g, GraphDelta.edges(add=[(4, 1)]), hops=2)
+    assert plan.dirty_rows.tolist() == [1]
+    assert sorted(plan.dirty.tolist()) == [1, 2, 5, 6]
+    # hops=1: no expansion beyond the direct damage
+    plan1 = plan_delta(g, GraphDelta.edges(add=[(4, 1)]), hops=1)
+    assert sorted(plan1.dirty.tolist()) == [1, 5]
+
+
+def test_delta_validation_is_loud():
+    g = _ring_graph(4)
+    with pytest.raises(ValueError, match="do not exist"):
+        plan_delta(g, GraphDelta.edges(remove=[(2, 0)]), hops=2)
+    with pytest.raises(ValueError, match="outside"):
+        plan_delta(g, GraphDelta.edges(add=[(0, 99)]), hops=2)
+    with pytest.raises(ValueError, match="add_features"):
+        GraphDelta(add_vertices=1)
+    with pytest.raises(ValueError, match="length mismatch"):
+        GraphDelta(add_src=np.array([1]), add_dst=np.array([1, 2]))
+    # removal drops EVERY occurrence of a listed pair
+    src = np.array([0, 0, 1], np.uint32)
+    dst = np.array([1, 1, 2], np.uint32)
+    g2 = build_graph(src, dst, 3, use_native=False)
+    plan = plan_delta(g2, GraphDelta.edges(remove=[(0, 1)]), hops=1)
+    assert plan.removed_edges == 2 and plan.graph.e_num == 1
+
+
+# ---- device neighbor-table row patching -------------------------------------
+
+
+def test_device_sampler_patches_only_dirty_rows():
+    from neutronstarlite_tpu.sample.device_sampler import (
+        DeviceUniformSampler,
+    )
+
+    # ring + 3 extra edges into vertex 0, so the table is 4 wide and an
+    # edge delta into vertex 1 fits without a shape change
+    src = np.array([0, 1, 2, 3, 4, 5, 6, 7, 2, 4, 6], np.uint32)
+    dst = np.array([1, 2, 3, 4, 5, 6, 7, 0, 0, 0, 0], np.uint32)
+    g = build_graph(src, dst, 8, use_native=False)
+    samp = DeviceUniformSampler.from_host(g)
+    assert samp.width == 4
+    nbr_before = np.asarray(samp.nbr).copy()
+    plan = plan_delta(
+        g, GraphDelta.edges(add=[(4, 1), (5, 1)], remove=[(0, 1)]), hops=2
+    )
+    n = samp.apply_delta(plan.graph, plan.dirty_rows)
+    assert n == 1  # only row 1's in-set changed
+    fresh = DeviceUniformSampler.from_host(plan.graph)
+    np.testing.assert_array_equal(
+        np.asarray(samp.eff_deg), np.asarray(fresh.eff_deg)
+    )
+    # the dirty row matches a fresh table (in-neighbor set {4, 5})...
+    assert sorted(np.asarray(samp.nbr)[1][:2].tolist()) == [4, 5]
+    # ...and every untouched row was not rewritten
+    for v in range(2, 8):
+        np.testing.assert_array_equal(
+            np.asarray(samp.nbr)[v], nbr_before[v]
+        )
+
+
+def test_device_sampler_rebuilds_on_shape_change():
+    from neutronstarlite_tpu.sample.device_sampler import (
+        DeviceUniformSampler,
+    )
+
+    g = _ring_graph(4)
+    samp = DeviceUniformSampler.from_host(g)
+    assert samp.width == 1
+    # vertex append forces a full rebuild (new V)
+    plan = plan_delta(
+        g,
+        GraphDelta.edges(add=[(0, 4)], add_vertices=1,
+                         add_features=np.zeros((1, 2), np.float32)),
+        hops=1,
+    )
+    n = samp.apply_delta(plan.graph, plan.dirty_rows)
+    assert n == plan.graph.v_num and int(samp.nbr.shape[0]) == 5
+    # width growth (a vertex outgrowing the table) also rebuilds
+    plan2 = plan_delta(
+        plan.graph, GraphDelta.edges(add=[(1, 0), (2, 0)]), hops=1
+    )
+    n2 = samp.apply_delta(plan2.graph, plan2.dirty_rows)
+    assert n2 == plan2.graph.v_num and samp.width == 3
+
+
+# ---- engine/server application ----------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def trained(tmp_path_factory):
+    os.environ["NTS_SAMPLE_WORKERS"] = "0"
+    try:
+        cfg = _serve_cfg()
+        cfg.serve_max_batch = 8
+        cfg.checkpoint_dir = str(tmp_path_factory.mktemp("delta") / "ckpt")
+        src, dst, datum = _planted_data(v_num=300, seed=11)
+        toolkit = GCNSampleTrainer.from_arrays(cfg, src, dst, datum)
+        toolkit.run()
+    finally:
+        os.environ.pop("NTS_SAMPLE_WORKERS", None)
+    return toolkit, cfg, datum
+
+
+_DELTA = [
+    ("add", (5, 17)), ("add", (200, 17)), ("add", (17, 42)),
+]
+
+
+def _mk_delta(graph):
+    """A mixed delta against the fixture graph: 3 inserts + 1 removal of
+    a real existing edge."""
+    u = int(graph.row_indices[0])
+    v = int(graph.dst_of_edge[0])
+    return GraphDelta.edges(add=[p for _k, p in _DELTA], remove=[(u, v)])
+
+
+def test_predictions_track_live_graph_bitwise_oracle(trained):
+    """THE delta acceptance oracle: after applying a delta, served
+    predictions are BITWISE what a fresh engine built on the post-delta
+    graph serves (same rng seed, same request sequence)."""
+    toolkit, cfg, datum = trained
+    os.environ["NTS_SAMPLE_WORKERS"] = "0"
+    try:
+        eng1 = InferenceEngine(toolkit, cfg.checkpoint_dir,
+                               rng=np.random.default_rng(123))
+        delta = _mk_delta(eng1.sampler.graph)
+        plan = eng1.apply_delta(delta)
+        assert eng1.graph_digest() == plan.digest
+
+        # the FRESH side: a new toolkit over the post-delta edge list,
+        # restored from the same checkpoint
+        fresh_g = build_graph(
+            plan.src.astype(np.uint32), plan.dst.astype(np.uint32),
+            plan.v_num, use_native=False,
+        )
+        t2 = GCNSampleTrainer.from_arrays(
+            cfg, plan.src.astype(np.uint32), plan.dst.astype(np.uint32),
+            datum, host_graph=fresh_g,
+        )  # from_arrays finalizes the model (init_nn would re-read files)
+        eng2 = InferenceEngine(t2, cfg.checkpoint_dir,
+                               rng=np.random.default_rng(123))
+    finally:
+        os.environ.pop("NTS_SAMPLE_WORKERS", None)
+
+    rng = np.random.default_rng(9)
+    for _ in range(4):
+        seeds = rng.integers(0, 300, size=int(rng.integers(1, 8)))
+        np.testing.assert_array_equal(
+            eng1.predict(seeds), eng2.predict(seeds)
+        )
+
+
+def test_cache_invalidation_is_incremental_hit_rate(trained):
+    """Only the dirty out-closure's cache entries drop; untouched
+    entries keep hitting (the hit-rate assertion)."""
+    toolkit, cfg, _datum = trained
+    opts = ServeOptions(max_batch=8, max_wait_ms=1.0, cache_cap=256,
+                        cache_max_age_s=3600.0)
+    engine = InferenceEngine(toolkit, cfg.checkpoint_dir, options=opts,
+                             rng=np.random.default_rng(5))
+    server = InferenceServer(engine)
+    try:
+        delta = _mk_delta(engine.sampler.graph)
+        plan_preview = plan_delta(engine.sampler.graph, delta,
+                                  hops=len(engine.fanouts))
+        dirty = set(plan_preview.dirty.tolist())
+        dirty_vid = int(plan_preview.dirty[0])
+        clean_vid = next(
+            v for v in range(300) if v not in dirty
+        )
+        server.predict([dirty_vid], timeout=60.0)
+        server.predict([clean_vid], timeout=60.0)
+        assert server.cache.lookup(dirty_vid) is not None
+        clean_row = server.cache.lookup(clean_vid)
+        assert clean_row is not None
+
+        plan = server.apply_delta(delta)
+        assert server.cache.lookup(dirty_vid) is None  # invalidated
+        np.testing.assert_array_equal(  # untouched entry still HITS
+            server.cache.lookup(clean_vid), clean_row
+        )
+        stats = server.cache.stats()
+        assert stats["invalidated"] >= 1
+        assert plan.digest == engine.graph_digest()
+        # the typed graph_delta record + counter landed
+        snap = server.metrics.snapshot()
+        assert snap["counters"].get("serve.graph_deltas") == 1
+        assert snap["gauges"].get("graph.digest") == plan.digest
+    finally:
+        server.close()
+
+
+def test_vertex_append_grows_features_and_invalidates_aot(trained):
+    toolkit, cfg, _datum = trained
+    engine = InferenceEngine(toolkit, cfg.checkpoint_dir,
+                             rng=np.random.default_rng(6))
+    engine.warmup()
+    assert engine._compiled
+    f = int(engine.feature.shape[1])
+    v0 = engine.sampler.graph.v_num
+    delta = GraphDelta.edges(
+        add=[(3, v0), (v0, 7)], add_vertices=1,
+        add_features=np.ones((1, f), np.float32),
+    )
+    engine.apply_delta(delta)
+    assert engine.sampler.graph.v_num == v0 + 1
+    assert int(engine.feature.shape[0]) == v0 + 1
+    assert not engine._compiled, "AOT ladder must invalidate on new V"
+    out = engine.predict(np.array([v0]))  # recompiles, serves the new id
+    assert out.shape[0] == 1 and np.isfinite(out).all()
+
+
+def test_delta_digest_is_a_tune_cache_miss(trained, tmp_path, monkeypatch):
+    """The delta -> digest -> tuner interplay: a pre-delta measured
+    decision keys to the OLD digest; after the delta the lookup key
+    carries the new digest, so the old entry can never silently replay —
+    the next measure run re-trials."""
+    from neutronstarlite_tpu.tune import cache as tune_cache
+
+    toolkit, cfg, _datum = trained
+    monkeypatch.setenv("NTS_TUNE_DIR", str(tmp_path / "tune"))
+    engine = InferenceEngine(toolkit, cfg.checkpoint_dir,
+                             rng=np.random.default_rng(8))
+    old_digest = engine.graph_digest()
+
+    def key(digest):
+        return tune_cache.CacheKey(
+            graph_digest=digest, family="edge_single/Fake", partitions=1,
+            layers="16-24-4", backend=tune_cache.backend_fingerprint(),
+        )
+
+    tune_cache.store(
+        key(old_digest),
+        {"candidate": "-|fused_edge|binned|-", "source": "measured"},
+        autos=["kernel"],
+    )
+    assert tune_cache.load(key(old_digest)) is not None
+
+    plan = engine.apply_delta(_mk_delta(engine.sampler.graph))
+    new_digest = engine.graph_digest()
+    assert new_digest == plan.digest != old_digest
+    assert toolkit._tune_graph_digest == new_digest  # keying follows
+    # the new key misses (re-tune); the old entry is untouched history
+    assert tune_cache.load(key(new_digest)) is None
+    assert tune_cache.load(key(old_digest)) is not None

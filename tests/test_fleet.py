@@ -1,0 +1,388 @@
+"""serve/fleet: SLO-routed replicas, supervised restart, continuous
+batching, and the replica-labeled telemetry satellites (exporter merge,
+flight-dump prefix + fleet-wide cap) — the ISSUE 14 fleet acceptance
+paths."""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from neutronstarlite_tpu.models.gcn_sample import GCNSampleTrainer
+from neutronstarlite_tpu.serve.batcher import RequestShedError, ServeOptions
+from neutronstarlite_tpu.serve.engine import InferenceEngine
+from neutronstarlite_tpu.serve.fleet import (
+    FleetOptions,
+    ReplicaSet,
+    choose_replica,
+)
+from neutronstarlite_tpu.utils.config import InputInfo
+from tests.test_models import _planted_data
+from tests.test_serve import _serve_cfg
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---- options / pure routing policy ------------------------------------------
+
+
+def test_fleet_options_cfg_and_env(monkeypatch):
+    cfg = InputInfo()
+    cfg.serve_replicas = 3
+    cfg.serve_route = "round_robin"
+    o = FleetOptions.from_cfg(cfg)
+    assert o.replicas == 3 and o.route == "round_robin"
+    monkeypatch.setenv("NTS_SERVE_REPLICAS", "2")
+    monkeypatch.setenv("NTS_SERVE_ROUTE", "least_burn")
+    monkeypatch.setenv("NTS_SERVE_ROUTE_HYST", "0.5")
+    o = FleetOptions.from_cfg(cfg)
+    assert o.replicas == 2 and o.route == "least_burn"
+    assert o.hysteresis == 0.5
+    monkeypatch.setenv("NTS_SERVE_ROUTE", "teleport")
+    with pytest.raises(ValueError, match="SERVE_ROUTE"):
+        FleetOptions.from_cfg(cfg)
+    monkeypatch.setenv("NTS_SERVE_ROUTE", "least_burn")
+    monkeypatch.setenv("NTS_SERVE_REPLICAS", "0")
+    with pytest.raises(ValueError, match="SERVE_REPLICAS"):
+        FleetOptions.from_cfg(cfg)
+
+
+def _state(idx, beating=True, draining=False, burn=0.0, depth=0):
+    return {"idx": idx, "beating": beating, "draining": draining,
+            "burn": burn, "depth": depth, "max_queue": 100}
+
+
+def test_choose_replica_least_burn_and_drain():
+    # lowest burn wins
+    idx, reason = choose_replica(
+        [_state(0, burn=2.0), _state(1, burn=0.1), _state(2, burn=0.5)]
+    )
+    assert (idx, reason) == (1, None)
+    # drain-on-breach: a draining replica gets nothing while others live
+    idx, _ = choose_replica([_state(0, draining=True), _state(1)])
+    assert idx == 1
+    # dead replicas never route
+    idx, _ = choose_replica([_state(0, beating=False), _state(1)])
+    assert idx == 1
+    # fleet-level shed ONLY when all live replicas breach
+    idx, reason = choose_replica(
+        [_state(0, draining=True), _state(1, draining=True)]
+    )
+    assert idx is None and "fleet_breach" in reason
+    idx, reason = choose_replica([_state(0, beating=False)])
+    assert idx is None and "fleet_down" in reason
+
+
+def test_choose_replica_hysteresis_no_flap():
+    """Equal replicas: the sticky previous choice is kept — the route
+    must not flap on score noise below the hysteresis margin."""
+    states = [_state(0), _state(1), _state(2)]
+    assert choose_replica(states, sticky=2, hysteresis=0.25)[0] == 2
+    # a rival within the margin still doesn't steal the route
+    states[0]["depth"] = 0
+    states[2]["depth"] = 10  # score 0.1 vs 0.0: inside 0.25 hysteresis
+    assert choose_replica(states, sticky=2, hysteresis=0.25)[0] == 2
+    # beyond the margin the route moves
+    states[2]["burn"] = 1.0
+    assert choose_replica(states, sticky=2, hysteresis=0.25)[0] == 0
+    # a draining sticky is abandoned immediately
+    states = [_state(0), _state(1, draining=True)]
+    assert choose_replica(states, sticky=1, hysteresis=10.0)[0] == 0
+
+
+# ---- fleet over a real engine ----------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def base_engine(tmp_path_factory):
+    """One trained toolkit + one AOT-warmed engine for every fleet test
+    (clones share the compiled ladder, so per-test engines are free)."""
+    os.environ["NTS_SAMPLE_WORKERS"] = "0"
+    try:
+        cfg = _serve_cfg()
+        cfg.serve_max_batch = 8
+        cfg.checkpoint_dir = str(tmp_path_factory.mktemp("fleet") / "ckpt")
+        src, dst, datum = _planted_data(v_num=300, seed=11)
+        toolkit = GCNSampleTrainer.from_arrays(cfg, src, dst, datum)
+        toolkit.run()
+        opts = ServeOptions(max_batch=8, max_wait_ms=1.0)
+        engine = InferenceEngine(toolkit, cfg.checkpoint_dir, options=opts,
+                                 rng=np.random.default_rng(0))
+        engine.warmup()
+    finally:
+        os.environ.pop("NTS_SAMPLE_WORKERS", None)
+    return engine
+
+
+def _mk_fleet(base_engine, n, monkeypatch, tmp_path, opts=None, **env):
+    monkeypatch.setenv("NTS_METRICS_DIR", str(tmp_path / "metrics"))
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    engine = base_engine.clone(rng=np.random.default_rng(1))
+    return ReplicaSet.from_engine(
+        engine, n, options=opts or base_engine.opts, seed=0
+    )
+
+
+def _load_events(tmp_path):
+    events = []
+    for p in sorted(glob.glob(str(tmp_path / "metrics" / "*.jsonl"))):
+        for line in open(p, encoding="utf-8"):
+            if line.strip():
+                events.append(json.loads(line))
+    return events
+
+
+def test_fleet_serves_with_shared_ladder_zero_recompiles(
+    base_engine, monkeypatch, tmp_path
+):
+    """Replica N+1 starts warm: the clones share the AOT ladder, so a
+    2-replica fleet serving real traffic never compiles anything beyond
+    the template's one compilation per bucket."""
+    monkeypatch.delenv("NTS_SLO_SPEC", raising=False)
+    monkeypatch.setenv("NTS_SERVE_HEARTBEAT_S", "0.05")
+    fleet = _mk_fleet(base_engine, 2, monkeypatch, tmp_path)
+    try:
+        rng = np.random.default_rng(3)
+        reqs = [fleet.submit(rng.integers(0, 300, 1)) for _ in range(20)]
+        for r in reqs:
+            r.result(timeout=60.0)
+        time.sleep(0.2)  # let the heartbeat monitor tick at least once
+    finally:
+        stats = fleet.close()
+    assert stats["requests"] == 20 and stats["shed"] == 0
+    assert stats["replicas"] == 2
+    assert stats["latency_ms"]["p99"] is not None  # merged histogram
+    # the warm-start contract: still exactly one compile per bucket
+    assert base_engine.compile_counts == {b: 1 for b in base_engine.buckets}
+    for rep_stats in stats["per_replica"].values():
+        assert rep_stats["compile_counts"] == base_engine.compile_counts
+    # the fleet stream carries heartbeats (the elastic pattern, reused)
+    events = _load_events(tmp_path)
+    assert any(e["event"] == "heartbeat" for e in events)
+    from neutronstarlite_tpu.obs import schema
+
+    assert schema.validate_stream(events) == len(events)
+
+
+def test_route_around_breaching_replica_zero_fleet_sheds(
+    base_engine, monkeypatch, tmp_path
+):
+    """The FLEET_GATE pin: one replica in SLO breach drains; every
+    request routes around it and NONE is fleet-shed."""
+    monkeypatch.setenv("NTS_SLO_SPEC", "serve_p99_ms<=5000@10s")
+    fleet = _mk_fleet(base_engine, 3, monkeypatch, tmp_path,
+                      NTS_SERVE_HEARTBEAT_S="0")
+    try:
+        bad = fleet.replicas[1]
+        assert bad.server.slo is not None
+        for _ in range(30):
+            bad.server.metrics.hist_observe("serve.latency_ms", 100000.0)
+        bad.server.slo.tick(force=True)
+        assert bad.route_state()["draining"] is True
+        rng = np.random.default_rng(4)
+        reqs = [fleet.submit(rng.integers(0, 300, 1)) for _ in range(12)]
+        for r in reqs:
+            r.result(timeout=60.0)
+    finally:
+        stats = fleet.close()
+    assert stats["fleet_shed"] == 0 and stats["shed"] == 0
+    assert stats["requests"] == 12
+    assert stats["per_replica"]["r1"]["requests"] == 0, (
+        "requests were routed INTO the breaching replica"
+    )
+
+
+def test_all_replicas_breaching_sheds_at_fleet_level(
+    base_engine, monkeypatch, tmp_path
+):
+    monkeypatch.setenv("NTS_SLO_SPEC", "serve_p99_ms<=5000@10s")
+    fleet = _mk_fleet(base_engine, 2, monkeypatch, tmp_path,
+                      NTS_SERVE_HEARTBEAT_S="0")
+    try:
+        for rep in fleet.replicas:
+            for _ in range(30):
+                rep.server.metrics.hist_observe(
+                    "serve.latency_ms", 100000.0
+                )
+            rep.server.slo.tick(force=True)
+        req = fleet.submit([5])
+        assert req.status == "shed"
+        with pytest.raises(RequestShedError, match="fleet_breach"):
+            req.result(timeout=1.0)
+        assert fleet.shed_count == 1
+    finally:
+        fleet.close()
+    events = _load_events(tmp_path)
+    sheds = [e for e in events if e["event"] == "shed"]
+    assert any("fleet_breach" in e["reason"] for e in sheds)
+
+
+def test_replica_death_detected_restarted_inflight_rerouted(
+    base_engine, monkeypatch, tmp_path
+):
+    """The supervised-restart path: a dead flusher misses heartbeats,
+    trips a rank_loss record, the replica restarts warm, and every
+    request it still owed completes — re-routed, not dropped."""
+    monkeypatch.delenv("NTS_SLO_SPEC", raising=False)
+    # long deadline + big batch keep submissions PENDING on the victim
+    opts = ServeOptions(max_batch=8, max_wait_ms=60000.0)
+    fleet = _mk_fleet(
+        base_engine, 2, monkeypatch, tmp_path, opts=opts,
+        NTS_SERVE_HEARTBEAT_S="0.05", NTS_HEARTBEAT_MISS_K="2",
+    )
+    try:
+        victim, _reason = fleet._route()
+        victim_idx = victim.idx
+        reqs = [fleet.submit([i]) for i in range(3)]  # all stick to victim
+        assert victim.server.batcher.depth == 3
+        # stand-in for work the victim served before dying: the restart
+        # must CARRY these counts, not reset the replica's history
+        victim.server.request_count += 7
+        fleet.inject_replica_death(victim_idx)
+        deadline = time.time() + 10.0
+        while time.time() < deadline:
+            if fleet.replicas[victim_idx] is not victim and \
+                    fleet.replicas[victim_idx].beating():
+                break
+            time.sleep(0.05)
+        fresh = fleet.replicas[victim_idx]
+        assert fresh is not victim and fresh.beating(), (
+            "dead replica was never restarted"
+        )
+        assert fresh.restarts == 1
+    finally:
+        stats = fleet.close()  # drain flushes the re-routed requests
+    for r in reqs:
+        out = r.result(timeout=10.0)  # completes — re-routed, not dropped
+        assert out.shape[0] == 1
+    assert stats["restarts"] == 1
+    # the dead incarnation's served count survives into the fleet stats
+    assert stats["per_replica"][f"r{victim_idx}"]["requests"] >= 7
+    assert stats["requests"] >= 10  # 7 carried + 3 re-routed
+    events = _load_events(tmp_path)
+    kinds = {e["event"] for e in events}
+    assert "rank_loss" in kinds, "death left no rank_loss record"
+    recs = [e for e in events if e["event"] == "recovery"]
+    assert any(e["action"] == "restart" for e in recs)
+    # still zero recompiles: the restarted server reuses the warm ladder
+    assert base_engine.compile_counts == {b: 1 for b in base_engine.buckets}
+
+
+def test_continuous_batching_two_stage_flush(base_engine, monkeypatch):
+    """SERVE_CB=1 runs the two-stage flush with sync sampling: requests
+    complete correctly and the executor thread exists (produce of flush
+    i+1 can overlap execute of flush i)."""
+    monkeypatch.delenv("NTS_SLO_SPEC", raising=False)
+    from neutronstarlite_tpu.serve.server import InferenceServer
+
+    opts = ServeOptions(max_batch=8, max_wait_ms=1.0,
+                        continuous_batching=True)
+    engine = base_engine.clone(rng=np.random.default_rng(7))
+    server = InferenceServer(engine, options=opts)
+    try:
+        assert server.pipelined and server._exec_thread is not None
+        rng = np.random.default_rng(8)
+        reqs = [server.submit(rng.integers(0, 300, 1)) for _ in range(15)]
+        for r in reqs:
+            assert r.result(timeout=60.0).shape[0] == 1
+    finally:
+        stats = server.close()
+    assert stats["requests"] == 15 and stats["shed"] == 0
+
+    # and the cfg/env grammar reaches ServeOptions
+    cfg = InputInfo()
+    cfg.serve_cb = 1
+    assert ServeOptions.from_cfg(cfg).continuous_batching is True
+    monkeypatch.setenv("NTS_SERVE_CB", "0")
+    assert ServeOptions.from_cfg(cfg).continuous_batching is False
+
+
+def test_exporter_merges_replica_labels_one_port(
+    base_engine, monkeypatch, tmp_path
+):
+    """The multi-registry exporter satellite: N replicas under ONE port,
+    families merged with replica= labels (single TYPE line per family),
+    /healthz per-replica + fleet aggregate, /slo labeled."""
+    import neutronstarlite_tpu.obs.exporter as exp_mod
+
+    monkeypatch.setattr(exp_mod, "_singleton", None)
+    monkeypatch.setenv("NTS_METRICS_PORT", "0")
+    monkeypatch.setenv("NTS_SLO_SPEC", "serve_p99_ms<=5000@10s")
+    fleet = _mk_fleet(base_engine, 2, monkeypatch, tmp_path,
+                      NTS_SERVE_HEARTBEAT_S="0")
+    exp = None
+    try:
+        exp = fleet.replicas[0].server.exporter
+        assert exp is not None
+        for r in fleet.replicas:
+            r.server.predict([3], timeout=60.0)
+
+        def get(path):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{exp.port}{path}", timeout=10
+            ) as resp:
+                return resp.read().decode()
+
+        txt = get("/metrics")
+        assert 'nts_serve_requests{replica="r0"} 1' in txt
+        assert 'nts_serve_requests{replica="r1"} 1' in txt
+        assert 'nts_serve_latency_ms_bucket{replica="r0",le="+Inf"}' in txt
+        types = [l for l in txt.splitlines() if l.startswith("# TYPE")]
+        assert len(types) == len(set(types)), f"duplicate TYPE: {types}"
+        for line in txt.splitlines():
+            if not line.startswith("#"):
+                float(line.rsplit(" ", 1)[1])  # every sample parses
+        hz = json.loads(get("/healthz"))
+        assert hz["ok"] is True
+        assert hz["fleet"]["replicas"] == 2
+        assert set(hz["replicas"]) == {"r0", "r1"}
+        assert hz["replicas"]["r0"]["serve"]["replica"] == "r0"
+        slo = json.loads(get("/slo"))
+        assert set(slo) == {"r0", "r1"}
+        assert slo["r0"][0]["objective"].startswith("serve_p99_ms")
+    finally:
+        fleet.close()
+        if exp is not None:
+            exp.close()
+        monkeypatch.setattr(exp_mod, "_singleton", None)
+
+
+def test_flight_dumps_replica_prefixed_and_fleet_capped(
+    monkeypatch, tmp_path
+):
+    """The flight satellite: replica-tagged dump filenames, and the
+    NTS_FLIGHT_MAX_DUMPS budget counted across every recorder sharing
+    one dump dir — N replicas cannot multiply the disk bound by N."""
+    from neutronstarlite_tpu.obs import flight
+
+    monkeypatch.setenv("NTS_FLIGHT_DIR", str(tmp_path / "fl"))
+    monkeypatch.setenv("NTS_FLIGHT_MAX_DUMPS", "3")
+    flight.reset_dump_budget()
+    try:
+        r0 = flight.FlightRecorder(capacity=16, tag="r0")
+        r1 = flight.FlightRecorder(capacity=16, tag="r1")
+        for rec in (r0, r1):
+            rec.record({"event": "epoch", "run_id": "x", "schema": 1,
+                        "ts": 0.0, "seq": 0, "epoch": 0, "seconds": 0.1,
+                        "loss": 1.0})
+        assert r0.dump("breach") is not None
+        assert r0.dump("breach") is not None
+        assert r1.dump("breach") is not None  # 3rd dump: budget spent
+        assert r1.dump("breach") is None  # fleet-wide cap, not per recorder
+        assert r1.dropped_triggers == 1
+        names = sorted(
+            os.path.basename(p)
+            for p in glob.glob(str(tmp_path / "fl" / "*.jsonl"))
+        )
+        assert len(names) == 3
+        assert sum(1 for n in names if n.startswith("flight_r0-")) == 2
+        assert sum(1 for n in names if n.startswith("flight_r1-")) == 1
+    finally:
+        flight.reset_dump_budget()

@@ -313,3 +313,43 @@ def test_record_suite_cli_roundtrip(tmp_path):
     assert len(rows) == 1 and rows[0]["kind"] == "suite"
     assert rows[0]["suite_duration_s"] == 612.0
     assert rows[0]["dots_passed"] == 431
+
+
+# ---- serve rows (ISSUE 14: serve_bench -> ledger -> sentinel) ---------------
+
+
+def _serve_ledger_row(p99, **over):
+    row = ledger.serve_row(
+        latency_ms={"p50": p99 * 0.4, "p95": p99 * 0.8, "p99": p99},
+        shed_rate=0.0, throughput_rps=100.0, requests=200,
+        cfg_fingerprint="cfgfp", graph_digest="digest",
+        mode="open", replicas=3, continuous_batching=True,
+        delta_rate=2.0, deltas_applied=10,
+    )
+    row["backend"] = "cpu-test"  # pin: the real fingerprint varies per rig
+    row.update(over)
+    return row
+
+
+def test_serve_row_key_embeds_load_shape(tmp_path):
+    """A 3-replica CB open-loop row must never baseline a 1-replica
+    closed-loop one — the load shape rides the cfg key."""
+    a = _serve_ledger_row(40.0)
+    b = _serve_ledger_row(40.0, mode="closed")
+    b["cfg"] = b["cfg"].replace("open", "closed")
+    assert ledger.row_key(a) != ledger.row_key(b)
+    assert a["cfg"] == "cfgfp|open|r3|cb1"
+    assert a["p99_ms"] == 40.0 and a["replicas"] == 3
+
+
+def test_sentinel_gates_serve_p99_trend(tmp_path):
+    """The serve trajectory gate: noise-band history passes, a 2x p99
+    jump exits 2 — serve latency trend-gated like epoch time."""
+    d = str(tmp_path)
+    for mult in NOISE:
+        ledger.append_row(_serve_ledger_row(40.0 * mult), directory=d)
+    rc = perf_sentinel.main(["check", "--ledger", d, "--kind", "serve"])
+    assert rc == 0
+    ledger.append_row(_serve_ledger_row(80.0), directory=d)
+    rc = perf_sentinel.main(["check", "--ledger", d, "--kind", "serve"])
+    assert rc == 2

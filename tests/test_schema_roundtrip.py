@@ -55,6 +55,11 @@ def _emit_all(reg: registry.MetricsRegistry) -> None:
                   "ell_levels": "", "wire_dtype": "bf16"},
     )
     reg.event(
+        "graph_delta", added_edges=3, removed_edges=1, added_vertices=0,
+        graph_digest="cafe" * 16, cache_invalidated=4, rows_patched=2,
+        dirty_predictions=9, seconds=0.012, replica="r0",
+    )
+    reg.event(
         "serve_summary", requests=1, shed=1,
         latency_ms={"p50": 3.5, "p95": 3.5, "p99": None},
         throughput_rps=10.0, counters={"serve.requests": 1},
@@ -126,6 +131,7 @@ RENDER_MARKERS = {
     "batch_flush": "#batches=",
     "shed": "#shed=",
     "serve_summary": "#p99_latency=",
+    "graph_delta": "#graph_delta=",
     "tune_trial": "#tune_trials=",
     "tune_decision": "#tune_decision=",
     "span": "span timeline:",
@@ -197,6 +203,7 @@ def test_validator_rejects_mutations_per_kind(tmp_path):
         "batch_flush": {"reason": ""},
         "shed": {"reason": ""},
         "serve_summary": {"latency_ms": "fast"},
+        "graph_delta": {"graph_digest": ""},
         "tune_trial": {"candidate": ""},
         "tune_decision": {"partitions": 0},
         "span": {"dur_s": -1.0},
