@@ -763,6 +763,36 @@ class ToolkitBase:
         res_guards.epoch_check(self, epoch, seconds, loss)
         return rec
 
+    # ---- numerics plane (obs/numerics) -----------------------------------
+    # Trainers that fuse the tensor-stat tree-reduce into their step
+    # program (NTS_NUMERICS=1) hand the step's stats output here each
+    # epoch; the host fetch — the only per-epoch cost — happens every
+    # NTS_NUMERICS_EVERY epochs. Called BEFORE emit_epoch so a failing
+    # epoch's stats are in the stream before its guard trips.
+    def maybe_emit_numerics(self, epoch: int, stats_dev) -> None:
+        if stats_dev is None:
+            return
+        from neutronstarlite_tpu.obs import numerics
+
+        if epoch % numerics.numerics_every() != 0:
+            return
+        try:
+            numerics.emit_stats(self.metrics, jax.device_get(stats_dev),
+                                epoch)
+        except Exception as e:  # telemetry must never kill a run
+            log.warning("numerics emission failed at epoch %d: %s",
+                        epoch, e)
+
+    def numerics_replay(self, epoch: int):
+        """Ordered ``(layer, op, label, array)`` eager intermediates of
+        the failing step's forward, for the non-finite provenance
+        bisection (obs/numerics.capture_provenance). None = this trainer
+        has no replay hook; provenance degrades to an unattributed
+        record. Implementations apply ``numerics.poison_hook`` inside
+        the forward so the ``nan_loss@layer=k`` chaos poison lands
+        mid-layer."""
+        return None
+
     def record_epoch_wire(self, epoch: int, seconds: float, loss,
                           bytes_fwd: int, exchanges: int, **extra):
         """Epoch event + live wire counters in one step — the shared tail

@@ -52,6 +52,14 @@ class FullBatchTrainer(ToolkitBase):
         trainer-specific tables (GAT adds attention slot maps)."""
         return compute_graph
 
+    def forward_taped(self, params, graph, x, key, tap, train=True):
+        """Numerics-plane hook: model_forward with a per-layer
+        ``tap(i, x) -> x`` threaded through (models/gcn.py implements it
+        for the GCN family). None = this model exposes no layer taps —
+        the stats step falls back to params/grads/logits groups and the
+        provenance replay degrades to an unattributed record."""
+        return None
+
     # trainers whose model_forward consumes cfg.precision (GCN family);
     # the single-chip edge-chain models (GAT/GGCN/GIN/CommNet) run f32 —
     # their op bodies are dtype-polymorphic but the accumulate-wide audit
@@ -222,6 +230,55 @@ class FullBatchTrainer(ToolkitBase):
 
         self._optim_step = optim_step
 
+        # numerics plane (obs/numerics, NTS_NUMERICS=1): a SECOND jitted
+        # step that is the default body plus the tensor-stat tree-reduce
+        # as one extra (tiny, all-scalar) output. The default _train_step
+        # above is never touched — with numerics off the program that
+        # runs is byte-identical to the pre-numerics one (structurally
+        # pinned in tests/test_numerics.py), and the stats variant's
+        # extra output changes no training math (bitwise loss-curve
+        # parity is pinned too).
+        from neutronstarlite_tpu.obs import numerics
+
+        self._numerics_on = numerics.numerics_enabled()
+        self._train_step_stats = None
+        if self._numerics_on:
+            has_tap = (
+                type(self).forward_taped is not FullBatchTrainer.forward_taped
+            )
+            forward_taped = self.forward_taped
+
+            @jax.jit
+            def train_step_stats(params, opt_state, graph, feature, label,
+                                 train01, key):
+                def loss_fn(p):
+                    # the taps ride the aux output (a closure list would
+                    # leak grad-trace tracers out of value_and_grad)
+                    acts = []
+
+                    def tap(i, h):
+                        acts.append(h)
+                        return h
+
+                    if has_tap:
+                        logits = forward_taped(p, graph, feature, key, tap)
+                    else:
+                        logits = model_forward(p, graph, feature, key, True)
+                    return masked_nll(logits, label, train01), (logits, acts)
+
+                (loss, (logits, acts)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True
+                )(params)
+                params, opt_state = adam_update(
+                    params, grads, opt_state, adam_cfg
+                )
+                stats = numerics.step_stats(
+                    params=params, grads=grads, acts=acts, logits=logits,
+                )
+                return params, opt_state, loss, logits, stats
+
+            self._train_step_stats = train_step_stats
+
         # compiled-program cost attribution (obs/cost): XLA's own
         # FLOPs/bytes for the exact step program run() will dispatch,
         # captured from the lowering (one extra trace, no extra compile)
@@ -309,6 +366,34 @@ class FullBatchTrainer(ToolkitBase):
         ]
         return "\n".join(lines)
 
+    def numerics_replay(self, epoch: int):
+        """The non-finite provenance replay (obs/numerics): re-run the
+        failing epoch's forward EAGERLY layer by layer through
+        forward_taped — same inputs, same fold_in key — recording each
+        layer's output and applying the chaos poison mid-layer
+        (``poison_hook``). None when the model exposes no layer taps."""
+        from neutronstarlite_tpu.obs import numerics
+
+        if type(self).forward_taped is FullBatchTrainer.forward_taped:
+            return None
+        key = jax.random.fold_in(
+            jax.random.PRNGKey(self.seed + 1), epoch
+        )
+        entries = []
+
+        def tap(i, h):
+            h = numerics.poison_hook(h, i)
+            entries.append((i, "activation", f"acts/l{i}", h))
+            return h
+
+        logits = self.forward_taped(
+            self.params, self.compute_graph, self.feature, key, tap
+        )
+        if logits is None:
+            return None
+        entries.append((None, "logits", "logits", logits))
+        return entries
+
     def aot_args(self):
         """The exact argument tuple run() passes to the jitted train step —
         the uniform hook tools/aot_check uses to lower any registered model
@@ -340,6 +425,16 @@ class FullBatchTrainer(ToolkitBase):
         # it is opt-in. The fused path still attributes dispatch vs device
         # wait (the host-observable split of an async XLA step).
         split_step = os.environ.get("NTS_TRACE_STEP", "0") == "1"
+        if split_step and self._train_step_stats is not None:
+            # loud, not silent (the WIRE_DTYPE-off-ring lesson): the
+            # split-epoch programs have no stats-fused variant, so a
+            # user arming both knobs must know no tensor_stats will land
+            log.warning(
+                "NTS_TRACE_STEP=1 runs the split two-program epochs, "
+                "which carry no fused numerics output — NTS_NUMERICS=1 "
+                "emits NO tensor_stats this run (drop one of the two "
+                "knobs)"
+            )
         for epoch in range(start_epoch, cfg.epochs):
             if epoch == trace_from and epoch < cfg.epochs:
                 trace_cm = maybe_trace(type(self).__name__)
@@ -363,16 +458,31 @@ class FullBatchTrainer(ToolkitBase):
                     "optim": get_time() - t_fb,
                 }
             else:
-                self.params, self.opt_state, loss, logits = self._train_step(
-                    self.params, self.opt_state, self.compute_graph,
-                    self.feature, self.label, self._train_mask01, ekey,
-                )
+                stats_dev = None
+                if self._train_step_stats is not None:
+                    # NTS_NUMERICS=1: the stats-fused variant — same
+                    # math, one extra all-scalar output (fetched every
+                    # NTS_NUMERICS_EVERY epochs in maybe_emit_numerics)
+                    (self.params, self.opt_state, loss, logits,
+                     stats_dev) = self._train_step_stats(
+                        self.params, self.opt_state, self.compute_graph,
+                        self.feature, self.label, self._train_mask01, ekey,
+                    )
+                else:
+                    self.params, self.opt_state, loss, logits = (
+                        self._train_step(
+                            self.params, self.opt_state, self.compute_graph,
+                            self.feature, self.label, self._train_mask01,
+                            ekey,
+                        )
+                    )
                 t_disp = get_time()
                 jax.block_until_ready(loss)
                 stages = {
                     "step_dispatch": t_disp - t0,
                     "step_device": get_time() - t_disp,
                 }
+                self.maybe_emit_numerics(epoch, stats_dev)
             # chaos hook (NTS_FAULT_SPEC): nan_loss/stall/crash fire here,
             # before the loss reaches history, guards, or a checkpoint
             loss = fault_point("epoch_loss", epoch=epoch, value=loss)

@@ -54,8 +54,17 @@ def gcn_forward(
     eager: bool = False,
     compute_dtype=None,
     sublinear: bool = False,
+    tap=None,
 ):
     """Logits for all vertices. ``eager`` swaps aggregate/NN order.
+
+    ``tap``: optional per-layer hook ``tap(i, x) -> x`` applied to each
+    layer's output (outside any jax.checkpoint rematerialization). The
+    numerics plane (obs/numerics) uses it twice: the stats-fused step
+    variant collects per-layer activations through it inside jit, and
+    the non-finite provenance replay walks (and chaos-poisons) the layer
+    chain through it eagerly. ``tap=None`` — every pre-existing caller —
+    leaves the traced program byte-identical.
 
     ``compute_dtype=jnp.bfloat16`` runs aggregation + matmuls in bf16 (the
     TPU-native precision: halves HBM traffic for the edge-bound aggregation
@@ -97,6 +106,8 @@ def gcn_forward(
             x = jax.checkpoint(layer_step)(x)
         else:
             x = layer_step(x)
+        if tap is not None:
+            x = tap(i, x)
     return x.astype(jnp.float32)
 
 
@@ -117,6 +128,18 @@ class GCNTrainer(FullBatchTrainer):
             graph, params, x, key,
             self.cfg.drop_rate if train else 0.0, train, eager=self.eager,
             compute_dtype=dtype, sublinear=self.cfg.sublinear,
+        )
+
+    def forward_taped(self, params, graph, x, key, tap, train=True):
+        """The numerics-plane hook (models/fullbatch.py): the SAME
+        forward as model_forward with the per-layer tap threaded — the
+        stats-fused step collects activations through it, the provenance
+        replay bisects through it."""
+        dtype = jnp.bfloat16 if self.cfg.precision == "bfloat16" else None
+        return gcn_forward(
+            graph, params, x, key,
+            self.cfg.drop_rate if train else 0.0, train, eager=self.eager,
+            compute_dtype=dtype, sublinear=self.cfg.sublinear, tap=tap,
         )
 
 

@@ -92,6 +92,7 @@ def dist_gcn_forward(
     compute_dtype=None,
     wire_dtype=None,
     partitioner=None,
+    tap=None,
 ):
     """``blocks`` selects the exchange: the [P, P, Eb] 3-tuple is the
     ppermute ring, a DistEllPair is the OPTIM_KERNEL gather-only path, a
@@ -110,7 +111,14 @@ def dist_gcn_forward(
     distributed toolkit, GCN_CPU_EAGER.hpp:200-206): every exchange — wire
     traffic AND aggregation — then runs at the post-matmul width, 602->128
     on the Reddit layer stack, the bandwidth-right order for a TPU mesh when
-    d_out < d_in."""
+    d_out < d_in.
+
+    ``tap``: optional per-layer hook ``tap(i, x) -> x`` applied to each
+    layer's output — the numerics plane's seam (obs/numerics): the
+    stats-fused step collects activations through it inside jit, the
+    non-finite provenance replay walks and chaos-poisons the chain
+    through it eagerly. ``tap=None`` (every pre-existing caller) leaves
+    the traced program byte-identical."""
     from neutronstarlite_tpu.parallel.dist_blocked import (
         DistBlockedEllPair,
         dist_blocked_gather_dst_from_src,
@@ -208,6 +216,8 @@ def dist_gcn_forward(
                          contract=contract)
         if partitioner is not None and mesh is not None and i < n_layers - 1:
             x = partitioner.constrain(x)
+        if tap is not None:
+            x = tap(i, x)
     return x.astype(jnp.float32)
 
 
@@ -661,6 +671,67 @@ class DistGCNTrainer(ToolkitBase):
         self._train_step = train_step
         self._eval_logits = eval_logits
 
+        # numerics plane (obs/numerics, NTS_NUMERICS=1): the stats-fused
+        # step variant — the default _train_step above stays untouched
+        # (byte-identical program with numerics off; pinned structurally
+        # in tests/test_numerics.py). Per-layer activations come through
+        # dist_gcn_forward's tap seam; on a narrowed ring the layer-0
+        # wire payload's stats + measured quantization error ride along.
+        from neutronstarlite_tpu.obs import numerics
+
+        self._numerics_on = numerics.numerics_enabled()
+        self._train_step_stats = None
+        if self._numerics_on:
+            @jax.jit
+            def train_step_stats(params, opt_state, blocks, feature, label,
+                                 train01, valid, key):
+                def loss_fn(p):
+                    # taps ride the aux output (a closure list would
+                    # leak grad-trace tracers out of value_and_grad)
+                    acts = []
+
+                    def tap(i, h):
+                        acts.append(h)
+                        return h
+
+                    logits = dist_gcn_forward(
+                        mesh, dist, blocks, p, feature, valid, key,
+                        drop_rate, True, layer_nn, eager,
+                        compute_dtype=compute_dtype, wire_dtype=wire_dtype,
+                        partitioner=part, tap=tap,
+                    )
+                    return masked_nll(logits, label, train01), (logits, acts)
+
+                (loss, (logits, acts)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True
+                )(params)
+                new_params, new_opt = adam_update(
+                    params, grads, opt_state, adam_cfg
+                )
+                stats = numerics.step_stats(
+                    params=new_params, grads=grads, acts=acts,
+                    logits=logits,
+                    wire=feature if wire_dtype is not None else None,
+                    wire_dtype=wire_dtype,
+                )
+                return new_params, new_opt, loss, logits, stats
+
+            self._train_step_stats = train_step_stats
+
+        # NTS_QUANT_PROBE=1 on a narrowed ring: the per-epoch wire
+        # quantization-error probe (the NTS_OVERLAP_PROBE pattern) —
+        # one tiny jitted program over the layer-0 ring payload, run()
+        # emits its verdict each epoch as the wire.quant_rel_err gauge
+        # plus a tensor_stats record (tools/drift_audit's numerics leg
+        # audits the gauge against NTS_QUANT_TOL)
+        self._quant_probe_fn = None
+        if self.wire_dtype is not None and numerics.quant_probe_enabled():
+            from neutronstarlite_tpu.parallel.ring_schedule import (
+                payload_quant_probe,
+            )
+
+            self._quant_probe_fn = payload_quant_probe(self.wire_dtype)
+
         # DEBUGINFO programs (models/debuginfo.py): forward loss, the same
         # forward with the exchange disabled (nn-only), and forward+grad
         def _loss(params, blocks, feature, label, train01, valid, key,
@@ -880,6 +951,56 @@ class DistGCNTrainer(ToolkitBase):
             else "n/a",
         )
 
+    def _emit_quant_probe(self, epoch: int) -> None:
+        """One NTS_QUANT_PROBE verdict per epoch: the measured relative
+        RMS error of the layer-0 ring payload at the wire dtype vs its
+        f32 master, as wire.quant_rel_err + a tensor_stats record. The
+        layer-0 payload (the feature slab) is STATIC across epochs, so
+        the device measurement runs once and the per-epoch cadence
+        re-emits the cached verdict — a Reddit-scale feature matrix must
+        not pay a full cast+reduce+fetch per epoch to recompute a
+        constant. Best-effort (a probe must never kill the run)."""
+        from neutronstarlite_tpu.obs import numerics
+
+        try:
+            stats = getattr(self, "_quant_probe_stats", None)
+            if stats is None:
+                stats = jax.device_get(self._quant_probe_fn(self.feature_p))
+                self._quant_probe_stats = stats
+            numerics.emit_payload_stats(
+                self.metrics, stats, epoch, name="wire.payload/l0"
+            )
+        except Exception as e:
+            log.warning("wire quant probe failed at epoch %d: %s", epoch, e)
+
+    def numerics_replay(self, epoch: int):
+        """The non-finite provenance replay (obs/numerics): the failing
+        epoch's forward re-run EAGERLY through dist_gcn_forward's tap
+        seam — same inputs, same fold_in key, chaos poison applied
+        mid-layer (``poison_hook``)."""
+        from neutronstarlite_tpu.obs import numerics
+
+        key = jax.random.fold_in(jax.random.PRNGKey(self.seed + 1), epoch)
+        entries = []
+
+        def tap(i, h):
+            h = numerics.poison_hook(h, i)
+            entries.append((i, "activation", f"acts/l{i}", h))
+            return h
+
+        compute_dtype = (
+            jnp.bfloat16 if self.cfg.precision == "bfloat16" else None
+        )
+        logits = dist_gcn_forward(
+            self.mesh, self.dist, self.blocks, self.params, self.feature_p,
+            self.valid_p, key, self.cfg.drop_rate, True,
+            type(self).layer_nn, type(self).eager,
+            compute_dtype=compute_dtype, wire_dtype=self.wire_dtype,
+            partitioner=self.partitioner, tap=tap,
+        )
+        entries.append((None, "logits", "logits", logits))
+        return entries
+
     def run(self) -> Dict[str, Any]:
         cfg = self.cfg
         key = jax.random.PRNGKey(self.seed + 1)
@@ -918,7 +1039,7 @@ class DistGCNTrainer(ToolkitBase):
                 trace_cm.__enter__()
             ekey = jax.random.fold_in(key, epoch)
             t0 = get_time()
-            self.params, self.opt_state, loss, _ = self._train_step(
+            step_args = (
                 self.params,
                 self.opt_state,
                 self.blocks,
@@ -928,9 +1049,21 @@ class DistGCNTrainer(ToolkitBase):
                 self.valid_p,
                 ekey,
             )
+            stats_dev = None
+            if self._train_step_stats is not None:
+                # NTS_NUMERICS=1: same math, one extra all-scalar output
+                (self.params, self.opt_state, loss, _,
+                 stats_dev) = self._train_step_stats(*step_args)
+            else:
+                self.params, self.opt_state, loss, _ = self._train_step(
+                    *step_args
+                )
             t_disp = get_time()
             jax.block_until_ready(loss)
             t_wait = get_time()
+            self.maybe_emit_numerics(epoch, stats_dev)
+            if self._quant_probe_fn is not None:
+                self._emit_quant_probe(epoch)
             # chaos hook (NTS_FAULT_SPEC): nan_loss/stall/crash fire here,
             # before the loss reaches history, guards, or a checkpoint
             loss = fault_point("epoch_loss", epoch=epoch, value=loss)

@@ -197,6 +197,33 @@ class GCNSampleTrainer(ToolkitBase):
         self._train_step = train_batch  # uniform tools/aot_check hook name
         self._eval_batch = eval_batch
 
+        # numerics plane (obs/numerics, NTS_NUMERICS=1): the stats-fused
+        # per-batch variant (params/grads groups + the global grad norm;
+        # the default train_batch above stays byte-identical). run()
+        # keeps the LAST batch's stats output per epoch and fetches it
+        # on the NTS_NUMERICS_EVERY cadence.
+        from neutronstarlite_tpu.obs import numerics
+
+        self._numerics_on = numerics.numerics_enabled()
+        self._train_batch_stats = None
+        if self._numerics_on:
+            @jax.jit
+            def train_batch_stats(params, opt_state, feature, label, nodes,
+                                  hops, seed_mask, seeds, key):
+                loss, grads = jax.value_and_grad(batch_loss)(
+                    params, feature, label, nodes, hops, seed_mask, seeds,
+                    key,
+                )
+                new_params, new_opt = adam_update(
+                    params, grads, opt_state, adam_cfg
+                )
+                stats = numerics.step_stats(
+                    params=new_params, grads=grads
+                )
+                return new_params, new_opt, loss, stats
+
+            self._train_batch_stats = train_batch_stats
+
         # live wire counters (obs): the minibatch path's data movement is
         # the host->device gather of the padded input-node feature rows
         # (capacity, not realized rows — the shape actually shipped).
@@ -294,20 +321,34 @@ class GCNSampleTrainer(ToolkitBase):
                 t0 = get_time()
                 losses = []
                 dispatch_s = 0.0
+                stats_dev = None
                 for bi, (nodes, hops, seed_mask, seeds) in enumerate(
                     self._epoch_batches(epoch, pipeline)
                 ):
                     bkey = jax.random.fold_in(key, epoch * 100003 + bi)
                     td = get_time()
-                    self.params, self.opt_state, loss = self._train_batch(
-                        self.params, self.opt_state, self.feature, self.label,
-                        nodes, hops, seed_mask, seeds, bkey,
-                    )
+                    if self._train_batch_stats is not None:
+                        # NTS_NUMERICS=1: same math, one extra scalar
+                        # output — the epoch keeps the LAST batch's stats
+                        (self.params, self.opt_state, loss,
+                         stats_dev) = self._train_batch_stats(
+                            self.params, self.opt_state, self.feature,
+                            self.label, nodes, hops, seed_mask, seeds, bkey,
+                        )
+                    else:
+                        self.params, self.opt_state, loss = (
+                            self._train_batch(
+                                self.params, self.opt_state, self.feature,
+                                self.label, nodes, hops, seed_mask, seeds,
+                                bkey,
+                            )
+                        )
                     dispatch_s += get_time() - td
                     losses.append(loss)
                 t_wait = get_time()
                 jax.block_until_ready(loss)
                 device_s = get_time() - t_wait
+                self.maybe_emit_numerics(epoch, stats_dev)
                 # chaos hook (NTS_FAULT_SPEC): nan_loss/stall/crash fire
                 # here, before the loss reaches history or the guards
                 epoch_loss = fault_point(

@@ -31,7 +31,13 @@ volume) and ad-hoc bench prints:
 - :mod:`ledger` — the cross-run perf ledger (``NTS_LEDGER_DIR``): one
   atomically-appended row per run/suite/probe, keyed by graph digest +
   cfg fingerprint + backend; ``tools/perf_sentinel`` gates new rows
-  against the MAD-scaled trend of their own history.
+  against the MAD-scaled trend of their own history;
+- :mod:`numerics` — the numerics health plane (``NTS_NUMERICS``):
+  stats-fused step outputs as typed ``tensor_stats`` records, the
+  one-shot non-finite provenance replay (``nonfinite_provenance``),
+  the batched whole-tree finiteness check the guards use, and the
+  measured wire quantization error (``NTS_QUANT_PROBE`` /
+  ``NTS_QUANT_TOL``, audited by tools/drift_audit).
 
 Every trainer run emits one ``run_summary`` record; ``tools/metrics_report``
 renders one or more streams into the reference-shaped ``#key=value(ms)``

@@ -90,6 +90,11 @@ class FlightRecorder:
         self.tag = tag
         self.capacity = capacity if capacity is not None else flight_capacity()
         self._ring: deque = deque(maxlen=self.capacity)
+        # pinned last-known records (obs/numerics tensor_stats etc.):
+        # re-written at the head of EVERY dump even after the ring has
+        # rotated them out — a postmortem always sees the last numerics
+        # state, however long ago the last fetch epoch was
+        self.pinned: Dict[str, Dict[str, Any]] = {}
         self._dump_lock = threading.Lock()
         self.dumps: List[str] = []
         raw = os.environ.get("NTS_FLIGHT_MAX_DUMPS", "")
@@ -105,6 +110,15 @@ class FlightRecorder:
     def record(self, rec: Dict[str, Any]) -> None:
         """One deque append; deque(maxlen=...) is thread-safe and O(1)."""
         self._ring.append(rec)
+
+    def pin(self, key: str, rec: Dict[str, Any]) -> None:
+        """Keep ``rec`` as the last-known record under ``key`` (latest
+        wins): dumps prepend pinned records the ring no longer holds.
+        Shares the dump lock: a pin landing mid-dump must not mutate
+        the dict dump() is iterating (telemetry crashing on exactly the
+        fault path would be the worst possible failure mode)."""
+        with self._dump_lock:
+            self.pinned[key] = rec
 
     def consider(self, rec: Dict[str, Any]) -> Optional[str]:
         """Dump when ``rec`` is a trigger record; returns the dump path."""
@@ -153,6 +167,15 @@ class FlightRecorder:
                     return None
                 _dir_dump_counts[budget_key] = used + 1
             records = list(self._ring)  # consistent snapshot of the ring
+            # pinned last-known records not already in the ring ride the
+            # head of the dump (dedup by (run_id, seq) so a recent
+            # tensor_stats batch never writes twice)
+            in_ring = {(r.get("run_id"), r.get("seq")) for r in records}
+            pinned = [
+                r for _, r in sorted(self.pinned.items())
+                if (r.get("run_id"), r.get("seq")) not in in_ring
+            ]
+            records = pinned + records
             safe = "".join(
                 c if c.isalnum() or c in "-_" else "_" for c in trigger
             ) or "trigger"

@@ -238,6 +238,13 @@ def run_row(
         "edge_hbm_bytes_per_epoch": gauges.get(
             "kernel.edge_hbm_bytes_per_epoch"
         ),
+        # numerics plane (obs/numerics): the run's final grad-norm
+        # trajectory point (perf_sentinel's ADVISORY two-sided leg — a
+        # norm drifting off its own history in either direction is an
+        # optimization-health signal, not a perf regression) and the
+        # measured wire quantization error (lower-is-better, gated)
+        "grad_global_norm": gauges.get("numerics.grad_global_norm"),
+        "wire_quant_rel_err": gauges.get("wire.quant_rel_err"),
         "peak_hbm_bytes": (summary.get("memory") or {}).get(
             "peak_bytes_in_use"
         ),

@@ -1,0 +1,544 @@
+"""Numerics health plane: what is INSIDE the tensors, as telemetry.
+
+Four observability layers (PRs 1, 5, 11, 13) cover time, cost, and
+faults — but a training failure still surfaces only as ``nonfinite_loss``
+with no attribution, and the tuner's bf16 wire narrowing ships payloads
+whose actual quantization error had never been measured. This module is
+the missing oracle, in three parts:
+
+1. **Tensor-stat telemetry** (``NTS_NUMERICS=1``): a jitted tree-reduce
+   computing {finite_fraction, absmax, rms, zero_fraction} per layer for
+   params / grads / activations / wire payloads, plus the global gradient
+   norm — FUSED into the existing step program as one small extra output
+   (``step_stats`` runs inside the trainer's stats-variant jit), fetched
+   only every ``NTS_NUMERICS_EVERY`` epochs (``maybe_emit`` — the device
+   computes the scalars every step, the host copy is the only gated
+   cost). ``NTS_NUMERICS`` unset/0 leaves the original step program
+   byte-identical: the stats variant is a SECOND jitted program, the
+   default one is never touched (pinned structurally by
+   tests/test_numerics.py, the no-[Ep,f] contract). Emitted as typed
+   ``tensor_stats`` records + ``numerics.*`` gauges (the exporter's
+   /metrics picks the gauges up for free), pinned into the flight
+   recorder so every dump carries the last-known numerics state.
+
+2. **Non-finite provenance** (``capture_provenance``): when a resilience
+   guard trips ``nonfinite_loss``/``nonfinite_params``, a ONE-SHOT
+   layer-by-layer eager replay of the failing step (the trainer's
+   ``numerics_replay`` hook, built on the same forwards the parity
+   oracles use) bisects to the FIRST layer/op producing a non-finite
+   value and emits a typed ``nonfinite_provenance`` record — "loss is
+   NaN" becomes "activation layer 2 went non-finite". Chaos-testable
+   end-to-end via the ``nan_loss@layer=k`` fault arg (resilience/faults):
+   the injected poison is applied mid-layer inside the replayed forward
+   (``poison_hook``), so provenance must name layer k exactly.
+
+3. **Wire/quantization error** (``quant_rel_err`` + the ring trainers'
+   ``NTS_QUANT_PROBE=1`` per-epoch probe): the measured relative RMS
+   error of the bf16 ring payload against f32, as the ``wire.quant_rel_err``
+   gauge + ``tensor_stats`` records. ``tools/drift_audit`` compares it
+   against ``NTS_QUANT_TOL`` and flags tune-cache bf16 decisions whose
+   measured error exceeds it — the acceptance harness the compressed
+   feature store (ROADMAP) will reuse.
+
+Also home of the BATCHED non-finite leaf check ``nonfinite_leaf_names``
+(one jitted reduce + ONE host fetch for the whole tree) that
+``resilience/guards.nonfinite_leaves`` delegates to — the per-leaf
+device-round-trip version it replaces cost one sync per parameter.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from neutronstarlite_tpu.utils.logging import get_logger
+
+log = get_logger("obs")
+
+
+# ---- knobs ------------------------------------------------------------------
+
+
+def numerics_enabled() -> bool:
+    """``NTS_NUMERICS=1`` arms the stats-fused step variant; unset/0 runs
+    the untouched default program (zero overhead, byte-identical jaxpr)."""
+    return os.environ.get("NTS_NUMERICS", "0") == "1"
+
+
+def numerics_every() -> int:
+    """``NTS_NUMERICS_EVERY``: fetch/emit cadence in epochs (default 1;
+    the stats are computed on-device every step either way — this gates
+    only the small device->host copy)."""
+    raw = os.environ.get("NTS_NUMERICS_EVERY", "")
+    try:
+        n = int(raw) if raw else 1
+    except ValueError:
+        log.warning("NTS_NUMERICS_EVERY=%r is not an int; using 1", raw)
+        n = 1
+    return max(n, 1)
+
+
+def quant_probe_enabled() -> bool:
+    """``NTS_QUANT_PROBE=1``: the opt-in per-epoch wire quantization-error
+    probe on ring trainers (the NTS_OVERLAP_PROBE pattern — one extra
+    tiny jitted program, gated rather than taxed on every run)."""
+    return os.environ.get("NTS_QUANT_PROBE", "0") == "1"
+
+
+DEFAULT_QUANT_TOL = 0.01
+
+
+def quant_tol() -> float:
+    """``NTS_QUANT_TOL``: the measured wire quantization error above which
+    the drift auditor flags a bf16 tune-cache decision for re-trial
+    (default 0.01 — comfortably above bf16's ~4e-3 per-element RMS)."""
+    raw = os.environ.get("NTS_QUANT_TOL", "")
+    if not raw:
+        return DEFAULT_QUANT_TOL
+    try:
+        return float(raw)
+    except ValueError:
+        log.warning("bad NTS_QUANT_TOL=%r; using %g", raw, DEFAULT_QUANT_TOL)
+        return DEFAULT_QUANT_TOL
+
+
+# ---- in-jit stat reductions -------------------------------------------------
+# Everything below this banner is jnp-traceable: the trainers call these
+# INSIDE their stats-variant jitted step, so the stats ride the step
+# program as a handful of extra scalar outputs (no second forward, no
+# extra dispatch).
+
+
+def _float_leaves(tree) -> List[Any]:
+    import jax
+    import jax.numpy as jnp
+
+    out = []
+    for leaf in jax.tree_util.tree_leaves(tree):
+        dt = getattr(leaf, "dtype", None)
+        if dt is not None and jnp.issubdtype(dt, jnp.floating):
+            out.append(leaf)
+    return out
+
+
+def array_stats(x) -> Dict[str, Any]:
+    """One array's stat reduce (0-d jnp scalars; traceable): exact
+    nonfinite/zero/element counts + absmax/rms — ``_stat_fields`` turns
+    the counts into the record's fractions host-side. absmax/rms are
+    computed over the raw values, so a NaN/inf poisons them to
+    non-finite — the host emitter renders those as null, the
+    finite_fraction says why."""
+    return group_stats([x])
+
+
+def group_stats(tree) -> Optional[Dict[str, Any]]:
+    """The stat reduce over every floating leaf of ``tree`` (None when
+    it has no floating leaves). The finite/zero tallies stay INTEGER
+    (i32 — exact to 2^31 elements per group) and ride out as counts;
+    the fractions are computed host-side in f64 by ``_stat_fields``. An
+    in-jit f32 fraction would round a handful of NaNs in a Reddit-scale
+    activation (~1.4e8 elements) back to exactly 1.0 — silencing the
+    one signal this plane exists to carry. absmax/rms accumulate f32."""
+    import jax.numpy as jnp
+
+    leaves = _float_leaves(tree)
+    if not leaves:
+        return None
+    n = sum(int(np.prod(l.shape)) if l.shape else 1 for l in leaves)
+    nonfinite = sum(
+        jnp.sum(~jnp.isfinite(l)).astype(jnp.int32) for l in leaves
+    )
+    zero = sum(jnp.sum(l == 0).astype(jnp.int32) for l in leaves)
+    absmax = None
+    sumsq = jnp.float32(0.0)
+    for l in leaves:
+        l32 = l.astype(jnp.float32)
+        m = jnp.max(jnp.abs(l32))
+        absmax = m if absmax is None else jnp.maximum(absmax, m)
+        sumsq = sumsq + jnp.sum(jnp.square(l32))
+    return {
+        "nonfinite_count": nonfinite,
+        "zero_count": zero,
+        "count": jnp.int32(n),
+        "absmax": absmax,
+        "rms": jnp.sqrt(sumsq / n),
+    }
+
+
+def grad_global_norm(grads) -> Optional[Any]:
+    """Global L2 norm over every floating grad leaf (f32 accumulate) —
+    the trajectory scalar the perf ledger rows carry."""
+    import jax.numpy as jnp
+
+    leaves = _float_leaves(grads)
+    if not leaves:
+        return None
+    sumsq = jnp.float32(0.0)
+    for l in leaves:
+        sumsq = sumsq + jnp.sum(jnp.square(l.astype(jnp.float32)))
+    return jnp.sqrt(sumsq)
+
+
+def quant_rel_err(x, wire_dtype) -> Any:
+    """Relative RMS error of shipping ``x`` at ``wire_dtype`` instead of
+    f32: ||cast(x) - x|| / ||x|| (RMS over all elements). This is the
+    MEASURED counterpart of the WIRE_DTYPE:bf16 tuner decision — exactly
+    reproducible host-side (round-to-nearest-even cast both ways), which
+    the parity test pins to 1e-6."""
+    import jax.numpy as jnp
+
+    x32 = x.astype(jnp.float32)
+    q = x32.astype(wire_dtype).astype(jnp.float32)
+    num = jnp.sqrt(jnp.mean(jnp.square(q - x32)))
+    den = jnp.sqrt(jnp.mean(jnp.square(x32)))
+    return num / jnp.maximum(den, jnp.float32(1e-30))
+
+
+def _layered(tag: str, tree) -> List[Tuple[str, Any]]:
+    """Per-layer (name, stats) groups: a list/tuple-structured tree (the
+    per-layer params/grads convention) splits per index; anything else is
+    one group under the bare tag."""
+    if isinstance(tree, (list, tuple)):
+        out = []
+        for i, sub in enumerate(tree):
+            st = group_stats(sub)
+            if st is not None:
+                out.append((f"{tag}/l{i}", st))
+        if out:
+            return out
+    st = group_stats(tree)
+    return [(tag, st)] if st is not None else []
+
+
+def step_stats(
+    params=None,
+    grads=None,
+    acts: Optional[Sequence[Any]] = None,
+    logits=None,
+    wire=None,
+    wire_dtype=None,
+) -> Dict[str, Any]:
+    """The full per-step stat pytree (traceable; the trainers return it
+    as the stats-variant step's extra output): per-layer groups for
+    params/grads/activations, the logits group, the global grad norm,
+    and — when a wire dtype narrows the exchange — the layer-0 ring
+    payload's stats at the wire dtype plus its quantization error."""
+    groups: Dict[str, Dict[str, Any]] = {}
+    if params is not None:
+        groups.update(_layered("params", params))
+    if grads is not None:
+        groups.update(_layered("grads", grads))
+    for i, a in enumerate(acts or []):
+        st = group_stats(a)
+        if st is not None:
+            groups[f"acts/l{i}"] = st
+    if logits is not None:
+        st = group_stats(logits)
+        if st is not None:
+            groups["logits"] = st
+    out: Dict[str, Any] = {"groups": groups}
+    if grads is not None:
+        gn = grad_global_norm(grads)
+        if gn is not None:
+            out["grad_global_norm"] = gn
+    if wire is not None and wire_dtype is not None:
+        st = group_stats(wire.astype(wire_dtype))
+        if st is not None:
+            st["quant_rel_err"] = quant_rel_err(wire, wire_dtype)
+            groups["wire/l0"] = st
+    return out
+
+
+# ---- host-side emission -----------------------------------------------------
+
+
+def _f(v) -> Optional[float]:
+    """Host float, with non-finite collapsed to None (the JSONL records
+    stay strict-JSON; finite_fraction already says when values went bad)."""
+    if v is None:
+        return None
+    v = float(v)
+    return v if math.isfinite(v) else None
+
+
+def _stat_fields(st: Dict[str, Any]) -> Dict[str, Any]:
+    # fractions from the EXACT integer tallies, divided host-side in
+    # f64 — one NaN in 1.4e8 elements must read < 1.0, never 1.0
+    n = max(int(st["count"]), 1)
+    fields = {
+        "finite_fraction": 1.0 - int(st["nonfinite_count"]) / n,
+        "absmax": _f(st.get("absmax")),
+        "rms": _f(st.get("rms")),
+        "zero_fraction": int(st["zero_count"]) / n,
+    }
+    if "quant_rel_err" in st:
+        fields["quant_rel_err"] = _f(st["quant_rel_err"])
+    return fields
+
+
+def emit_stats(metrics, stats: Dict[str, Any], epoch: int) -> List[dict]:
+    """One ``tensor_stats`` record per group (host-fetched ``step_stats``
+    output) + the ``numerics.*`` gauges, each record pinned into the
+    flight recorder so the last-known numerics state rides every dump.
+    Returns the emitted records."""
+    if metrics is None or not stats:
+        return []
+    recs: List[dict] = []
+    ff_min = None
+    absmax_max = None
+    for name, st in sorted((stats.get("groups") or {}).items()):
+        fields = _stat_fields(st)
+        rec = metrics.event("tensor_stats", name=name, epoch=int(epoch),
+                            **fields)
+        recs.append(rec)
+        _pin(metrics, f"tensor_stats/{name}", rec)
+        ff = fields["finite_fraction"]
+        ff_min = ff if ff_min is None else min(ff_min, ff)
+        am = fields["absmax"]
+        if am is not None:
+            absmax_max = am if absmax_max is None else max(absmax_max, am)
+        if fields.get("quant_rel_err") is not None:
+            metrics.gauge_set("wire.quant_rel_err", fields["quant_rel_err"])
+    if ff_min is not None:
+        metrics.gauge_set("numerics.finite_fraction_min", ff_min)
+    if absmax_max is not None:
+        metrics.gauge_set("numerics.absmax_max", absmax_max)
+    gn = _f(stats.get("grad_global_norm"))
+    if gn is not None:
+        metrics.gauge_set("numerics.grad_global_norm", gn)
+        # the norm rides its OWN field; absmax/rms stay null — the
+        # global L2 norm is neither, and a reader comparing this row
+        # against the per-layer grads/l* rms rows must not be misled
+        rec = metrics.event(
+            "tensor_stats", name="grads/global", epoch=int(epoch),
+            finite_fraction=1.0,
+            absmax=None, rms=None, zero_fraction=0.0, grad_global_norm=gn,
+        )
+        recs.append(rec)
+        _pin(metrics, "tensor_stats/grads/global", rec)
+    elif "grad_global_norm" in stats:
+        # a NaN/inf grad norm: keep the gauge numeric-free but say so
+        metrics.gauge_set("numerics.grad_global_norm_finite", 0)
+    return recs
+
+
+def emit_payload_stats(metrics, stats: Dict[str, Any], epoch: int,
+                       name: str = "wire.payload/l0") -> Optional[dict]:
+    """One probe ``tensor_stats`` record for a ring payload (the
+    NTS_QUANT_PROBE per-epoch leg) + the ``wire.quant_rel_err`` gauge."""
+    if metrics is None or not stats:
+        return None
+    fields = _stat_fields(stats)
+    rec = metrics.event("tensor_stats", name=name, epoch=int(epoch),
+                        **fields)
+    _pin(metrics, f"tensor_stats/{name}", rec)
+    if fields.get("quant_rel_err") is not None:
+        metrics.gauge_set("wire.quant_rel_err", fields["quant_rel_err"])
+    return rec
+
+
+def _pin(metrics, key: str, rec: dict) -> None:
+    flight = getattr(metrics, "flight", None)
+    if flight is not None:
+        flight.pin(key, rec)
+
+
+def observe_serve_batch(metrics, logits: np.ndarray, bucket: int) -> None:
+    """Engine-side numerics on one executed request batch (host numpy —
+    the logits are already fetched for the reply, so this costs no extra
+    device sync): the finite-fraction/absmax gauges always, a LOUD
+    ``tensor_stats`` record only when a batch actually carries a
+    non-finite logit."""
+    if metrics is None:
+        return
+    try:
+        arr = np.asarray(logits, dtype=np.float32)
+        n = arr.size or 1
+        finite = float(np.isfinite(arr).sum()) / n
+        with np.errstate(invalid="ignore"):
+            absmax = float(np.max(np.abs(arr))) if arr.size else 0.0
+        metrics.gauge_set("numerics.serve_logits_finite_fraction", finite)
+        if math.isfinite(absmax):
+            metrics.gauge_set("numerics.serve_logits_absmax", absmax)
+        if finite < 1.0:
+            metrics.counter_add("numerics.serve_nonfinite_batches")
+            rec = metrics.event(
+                "tensor_stats", name=f"serve/logits/bucket_{int(bucket)}",
+                finite_fraction=finite,
+                absmax=absmax if math.isfinite(absmax) else None,
+                rms=None,
+                zero_fraction=float((arr == 0).sum()) / n,
+            )
+            _pin(metrics, "tensor_stats/serve/logits", rec)
+    except Exception as e:  # telemetry must never fail a reply
+        log.warning("serve batch numerics failed: %s", e)
+
+
+# ---- batched non-finite leaf check ------------------------------------------
+
+# the single host fetch of the per-leaf flags — module-level so the
+# call-count test can pin "one fetch per tree, not one per leaf"
+_fetch = np.asarray
+
+
+def nonfinite_leaf_names(tree) -> List[str]:
+    """Key paths of floating leaves containing NaN/inf — ONE jitted
+    tree-reduce returning every leaf's flag, ONE host fetch (the
+    per-leaf ``bool(jnp.all(...))`` it replaces paid a device round trip
+    per parameter). Non-array leaves are skipped like before."""
+    import jax
+    import jax.numpy as jnp
+
+    names: List[str] = []
+    leaves: List[Any] = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        try:
+            arr = jnp.asarray(leaf)
+        except TypeError:
+            continue
+        if not jnp.issubdtype(arr.dtype, jnp.floating):
+            continue
+        names.append(jax.tree_util.keystr(path))
+        leaves.append(arr)
+    if not leaves:
+        return []
+    flags = _finite_flags(tuple(leaves))
+    flags_host = _fetch(flags)
+    return [n for n, ok in zip(names, flags_host) if not bool(ok)]
+
+
+# the ONE persistent jit wrapper for the flag reduce: jax.jit keys its
+# cache on the wrapper object, so the wrapper must outlive the call —
+# a per-call closure would retrace + recompile on EVERY guarded epoch,
+# inverting the one-fetch optimization into a per-epoch XLA compile
+_finite_flags_jit = None
+
+
+def _finite_flags(leaves: tuple):
+    """[len(leaves)] bool — all-finite per leaf, one program, cached per
+    (tree structure, leaf shapes) across calls."""
+    global _finite_flags_jit
+    import jax
+    import jax.numpy as jnp
+
+    if _finite_flags_jit is None:
+        @jax.jit
+        def flags(ls):
+            return jnp.stack([jnp.all(jnp.isfinite(l)) for l in ls])
+
+        _finite_flags_jit = flags
+    return _finite_flags_jit(leaves)
+
+
+# ---- non-finite provenance --------------------------------------------------
+
+
+def poison_hook(h, layer: int):
+    """The chaos seam of the provenance replay: multiplies the layer's
+    activation by NaN when a ``nan_loss@layer=k`` fault armed a pending
+    poison for this layer (resilience/faults) — applied mid-layer INSIDE
+    the replayed forward, so the bisection must find exactly layer k.
+    Identity otherwise (and always identity under jit tracing: the
+    pending poison is only armed between a fault firing and the one-shot
+    replay that consumes it)."""
+    from neutronstarlite_tpu.resilience import faults
+
+    if faults.pending_layer_poison() == layer:
+        log.warning(
+            "provenance replay: applying injected nan_loss poison at "
+            "layer %d", layer,
+        )
+        return h * float("nan")
+    return h
+
+
+def _finite_fraction_host(arr) -> float:
+    a = np.asarray(arr, dtype=np.float32)
+    return float(np.isfinite(a).sum()) / (a.size or 1)
+
+
+def capture_provenance(toolkit, epoch: Optional[int],
+                       fault_kind: str) -> Optional[dict]:
+    """The guard->provenance handoff (resilience/guards calls this right
+    before raising a non-finite HealthError): one-shot per toolkit —
+    walk params layer by layer, then eagerly replay the failing step's
+    forward through the trainer's ``numerics_replay`` hook, and emit a
+    typed ``nonfinite_provenance`` record naming the FIRST layer/op that
+    produced a non-finite value. Best-effort: any failure degrades to a
+    warning (telemetry must never turn a recoverable fault fatal).
+    Returns the record (or None)."""
+    from neutronstarlite_tpu.resilience import faults
+
+    metrics = getattr(toolkit, "metrics", None)
+    if metrics is None or getattr(toolkit, "_nonfinite_replayed", False):
+        # the early exits still CONSUME a pending poison: a stale
+        # process-global poison would falsely mark the next organic
+        # fault's replay as injected (and poison its layer)
+        faults.clear_layer_poison()
+        return None
+    toolkit._nonfinite_replayed = True
+    injected = faults.pending_layer_poison() is not None
+    layer = op = name = None
+    frac: Optional[float] = None
+    checked = 0
+    try:
+        # params first, WITHOUT the replay: a poisoned weight layer is
+        # attributable from the leaves the guard already proved bad,
+        # and an eager forward over corrupted state is both pointless
+        # and the likeliest thing to crash — it only runs when the
+        # params walk comes back clean
+        params = getattr(toolkit, "params", None)
+        param_entries: List[Tuple[Optional[int], str, str, Any]] = []
+        if isinstance(params, (list, tuple)):
+            for i, sub in enumerate(params):
+                param_entries.append((i, "params", f"params/l{i}", sub))
+        elif params is not None:
+            param_entries.append((None, "params", "params", params))
+        for lyr, op_name, label, value in param_entries:
+            checked += 1
+            if nonfinite_leaf_names(value):
+                layer, op, name = lyr, op_name, label
+                break
+        if op is None:
+            replay = None
+            replay_fn = getattr(toolkit, "numerics_replay", None)
+            if replay_fn is not None:
+                replay = replay_fn(epoch if epoch is not None else 0)
+            if replay is None:
+                log.warning(
+                    "non-finite provenance: trainer %s has no replay "
+                    "hook; emitting an unattributed record",
+                    type(toolkit).__name__,
+                )
+            for lyr, op_name, label, value in (replay or []):
+                checked += 1
+                f = _finite_fraction_host(value)
+                if f < 1.0:
+                    layer, op, name, frac = lyr, op_name, label, f
+                    break
+    except Exception as e:
+        log.warning("non-finite provenance replay failed: %s", e)
+    finally:
+        faults.clear_layer_poison()
+    rec = metrics.event(
+        "nonfinite_provenance",
+        fault_kind=fault_kind,
+        epoch=int(epoch) if epoch is not None else None,
+        layer=int(layer) if layer is not None else None,
+        op=op,
+        name=name,
+        finite_fraction=frac,
+        checked=checked,
+        injected=bool(injected),
+    )
+    _pin(metrics, "nonfinite_provenance", rec)
+    if layer is not None or op is not None:
+        log.warning(
+            "non-finite provenance: %s bisected to %s (layer %s, "
+            "finite_fraction=%s) after %d checks",
+            fault_kind, name, layer, frac, checked,
+        )
+    return rec

@@ -12,7 +12,8 @@ Envelope (all events):
                    serve_request | batch_flush | shed | serve_summary |
                    graph_delta | tune_trial | tune_decision | span |
                    stream_rotated | hist | slo_status | backend_probe |
-                   program_cost | model_drift
+                   program_cost | model_drift | tensor_stats |
+                   nonfinite_provenance
                    (open set)
   run_id: str      "<algo>-<fingerprint>-<pid>"
   schema: int      SCHEMA_VERSION
@@ -192,6 +193,37 @@ program_cost (obs/cost.py): one compiled/lowered XLA program's own cost
   lowering-only capture path and on backends without it),
   platform: str | null | absent, error: str | absent
 
+tensor_stats (obs/numerics.py): one tensor group's numerics snapshot —
+  the stats-fused step output (params/grads/activations per layer, the
+  global grad norm, wire payloads), fetched every NTS_NUMERICS_EVERY
+  epochs under NTS_NUMERICS=1, or a NTS_QUANT_PROBE ring-payload probe,
+  or a serve engine's non-finite-batch alarm
+  name: str (non-empty; e.g. params/l0, grads/global, acts/l1,
+  wire/l0, wire.payload/l0, serve/logits/bucket_16),
+  finite_fraction: number in [0, 1],
+  zero_fraction: number in [0, 1],
+  absmax: number | null (null when the group itself went non-finite —
+  finite_fraction says why),
+  rms: number | null,
+  epoch: int | absent,
+  quant_rel_err: number | null | absent (wire payload groups only: the
+  measured relative RMS error of the wire-dtype cast vs f32 — what
+  tools/drift_audit compares against NTS_QUANT_TOL),
+  grad_global_norm: number | null | absent (the grads/global group)
+
+nonfinite_provenance (obs/numerics.py): the one-shot layer-by-layer
+  eager replay's verdict after a nonfinite_loss/nonfinite_params guard
+  trip — the FIRST layer/op that produced a non-finite value
+  fault_kind: str (non-empty; nonfinite_loss | nonfinite_params),
+  layer: int >= 0 | null (null: unattributed — no replay hook, or the
+  non-finite value appeared only at the loss),
+  op: str | null (params | activation | logits | loss, open set),
+  name: str | null (the offending tap label, e.g. acts/l2),
+  finite_fraction: number | null (of the offending tensor),
+  checked: int >= 0 (taps examined before the verdict),
+  epoch: int | null | absent, injected: bool | absent (a
+  nan_loss@layer=k chaos poison was pending when the replay ran)
+
 model_drift (tools/drift_audit.py): an analytic prediction disagreed
   with what actually ran beyond the audit threshold — the record that
   turns the predict_all/predict_mesh priors and the wire gauges from
@@ -253,6 +285,8 @@ KNOWN_KINDS = (
     "backend_probe",
     "program_cost",
     "model_drift",
+    "tensor_stats",
+    "nonfinite_provenance",
     "run_summary",
 )
 
@@ -557,6 +591,51 @@ def validate_event(obj: Any) -> None:
                 ):
                     _fail(f"program_cost.memory.{k} must be an int or "
                           f"null, got {v!r}")
+    elif kind == "tensor_stats":
+        if not isinstance(obj.get("name"), str) or not obj["name"]:
+            _fail("tensor_stats.name must be a non-empty string")
+        for key in ("finite_fraction", "zero_fraction"):
+            _require_number(obj, key)
+            if not (0.0 <= obj[key] <= 1.0):
+                _fail(f"tensor_stats.{key} must be in [0, 1], got "
+                      f"{obj[key]!r}")
+        _require_number(obj, "absmax", allow_none=True)
+        _require_number(obj, "rms", allow_none=True)
+        if "epoch" in obj and obj["epoch"] is not None and not isinstance(
+            obj["epoch"], int
+        ):
+            _fail("tensor_stats.epoch must be an int when present")
+        for key in ("quant_rel_err", "grad_global_norm"):
+            if key in obj:
+                _require_number(obj, key, allow_none=True)
+    elif kind == "nonfinite_provenance":
+        fk = obj.get("fault_kind")
+        if not isinstance(fk, str) or not fk:
+            _fail("nonfinite_provenance.fault_kind must be a non-empty "
+                  "string")
+        lyr = obj.get("layer")
+        if lyr is not None and (
+            not isinstance(lyr, int) or isinstance(lyr, bool) or lyr < 0
+        ):
+            _fail(f"nonfinite_provenance.layer must be a non-negative int "
+                  f"or null, got {lyr!r}")
+        for key in ("op", "name"):
+            v = obj.get(key)
+            if v is not None and not isinstance(v, str):
+                _fail(f"nonfinite_provenance.{key} must be a string or "
+                      f"null, got {v!r}")
+        _require_number(obj, "finite_fraction", allow_none=True)
+        ck = obj.get("checked")
+        if not isinstance(ck, int) or isinstance(ck, bool) or ck < 0:
+            _fail(f"nonfinite_provenance.checked must be a non-negative "
+                  f"int, got {ck!r}")
+        if "epoch" in obj and obj["epoch"] is not None and not isinstance(
+            obj["epoch"], int
+        ):
+            _fail("nonfinite_provenance.epoch must be an int when present")
+        if "injected" in obj and not isinstance(obj["injected"], bool):
+            _fail("nonfinite_provenance.injected must be a bool when "
+                  "present")
     elif kind == "model_drift":
         if not isinstance(obj.get("metric"), str) or not obj["metric"]:
             _fail("model_drift.metric must be a non-empty string")

@@ -62,6 +62,27 @@ def resolve_wire_dtype(cfg_value: str = "") -> Optional[jnp.dtype]:
     return jnp.dtype(name) if name else None
 
 
+def payload_quant_probe(wire_dtype):
+    """One jitted probe over a ring payload (NTS_QUANT_PROBE, the
+    numerics plane): the payload's stats AT THE WIRE DTYPE plus the
+    measured relative RMS error of shipping it narrowed instead of f32
+    (obs/numerics.quant_rel_err — the number tools/drift_audit audits
+    against NTS_QUANT_TOL). Lives here because this module owns what
+    rides the wire; the dist trainers call it once per epoch when the
+    probe is armed."""
+    import jax
+
+    from neutronstarlite_tpu.obs import numerics
+
+    @jax.jit
+    def probe(x):
+        st = numerics.array_stats(x.astype(wire_dtype))
+        st["quant_rel_err"] = numerics.quant_rel_err(x, wire_dtype)
+        return st
+
+    return probe
+
+
 def trim_transfers(work_steps: List[int]) -> int:
     """Rotation hops actually needed: shards only travel far enough to
     reach the LAST step with compute — a skipped suffix (empty partition

@@ -13,7 +13,17 @@ the specs fire. Each entry is ``kind`` or ``kind@key=value,key=value``:
 ========== ============================ =======================================
 kind       args                         effect at its fault point
 ========== ============================ =======================================
-nan_loss   epoch (optional)             replaces the epoch loss with NaN
+nan_loss   epoch, layer (optional)      replaces the epoch loss with NaN.
+                                        With ``layer=k`` it ALSO arms a
+                                        pending layer poison that the
+                                        non-finite provenance replay
+                                        (obs/numerics) applies mid-layer
+                                        INSIDE the replayed forward
+                                        (``poison_hook`` at layer k), so
+                                        ``nonfinite_provenance`` must
+                                        name layer k exactly — the
+                                        end-to-end chaos oracle for the
+                                        numerics plane
 crash      epoch, rank (optional)       hard process death (os._exit) — the
                                         simulated preemption / OOM kill
 stall      epoch, ms (default 1000)     sleeps ms inside the epoch — the
@@ -109,6 +119,8 @@ class FaultSpec:
     save: Optional[int] = None  # ckpt_corrupt: 1-based save counter
     ms: float = 1000.0  # stall: sleep duration
     partition: Optional[int] = None  # rank_loss: sim partition to kill
+    layer: Optional[int] = None  # nan_loss: poison the provenance
+    # replay's forward at this layer (obs/numerics.poison_hook)
     times: int = 1  # max firings (one-shot by default)
     point: Optional[str] = None  # fire at this named fault point
     # (default: the kind's classic point, DEFAULT_POINTS)
@@ -118,7 +130,7 @@ class FaultSpec:
         return self.fired >= self.times
 
 
-_INT_ARGS = ("epoch", "rank", "save", "times", "partition")
+_INT_ARGS = ("epoch", "rank", "save", "times", "partition", "layer")
 _ALLOWED_ARGS = frozenset(_INT_ARGS) | {"ms", "point"}
 
 
@@ -178,13 +190,31 @@ _plan: Optional[List[FaultSpec]] = None
 _plan_src: Optional[str] = None
 _save_count = 0
 
+# the pending layer poison a ``nan_loss@layer=k`` firing arms: consumed
+# (one-shot) by the non-finite provenance replay — obs/numerics applies
+# it mid-layer inside the replayed forward via ``poison_hook`` and clears
+# it when the replay finishes. Process-global like the plan itself.
+_layer_poison: Optional[int] = None
+
+
+def pending_layer_poison() -> Optional[int]:
+    """The layer index a fired ``nan_loss@layer=k`` spec armed, or None."""
+    return _layer_poison
+
+
+def clear_layer_poison() -> None:
+    """Consume the pending poison (the provenance replay's one-shot)."""
+    global _layer_poison
+    _layer_poison = None
+
 
 def reset() -> None:
     """Forget the parsed plan and all fired/save counters (tests)."""
-    global _plan, _plan_src, _save_count
+    global _plan, _plan_src, _save_count, _layer_poison
     _plan = None
     _plan_src = None
     _save_count = 0
+    _layer_poison = None
 
 
 def active_plan() -> List[FaultSpec]:
@@ -248,7 +278,20 @@ def fault_point(point: str, *, epoch: Optional[int] = None, value=None,
             if not _epoch_matches(spec, epoch):
                 continue
             spec.fired += 1
-            log.warning("injecting nan_loss at epoch %s", epoch)
+            if spec.layer is not None:
+                # the numerics chaos oracle: poison the epoch loss (so
+                # the guard trips exactly like the plain kind) AND arm
+                # the pending layer poison the provenance replay applies
+                # mid-layer inside its forward — provenance must then
+                # bisect to exactly this layer
+                global _layer_poison
+                _layer_poison = spec.layer
+                log.warning(
+                    "injecting nan_loss at epoch %s (provenance poison "
+                    "armed for layer %d)", epoch, spec.layer,
+                )
+            else:
+                log.warning("injecting nan_loss at epoch %s", epoch)
             value = float("nan")
         elif spec.kind == "stall":
             if not _epoch_matches(spec, epoch):

@@ -111,19 +111,13 @@ def _env_float(name: str, default: float) -> float:
 
 
 def nonfinite_leaves(tree) -> List[str]:
-    """Key paths of floating leaves containing NaN/inf."""
-    import jax.numpy as jnp
+    """Key paths of floating leaves containing NaN/inf — ONE jitted
+    tree-reduce and ONE host fetch for the whole tree (obs/numerics).
+    The per-leaf ``bool(jnp.all(jnp.isfinite(leaf)))`` this replaces
+    paid a device round trip PER PARAMETER, every guarded epoch."""
+    from neutronstarlite_tpu.obs import numerics
 
-    bad: List[str] = []
-    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
-        try:
-            if not jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating):
-                continue
-            if not bool(jnp.all(jnp.isfinite(leaf))):
-                bad.append(jax.tree_util.keystr(path))
-        except TypeError:  # non-array leaf
-            continue
-    return bad
+    return numerics.nonfinite_leaf_names(tree)
 
 
 def _state(toolkit) -> dict:
@@ -155,10 +149,21 @@ def epoch_check(toolkit, epoch: int, seconds: float,
             "wrap with resilience.supervised_run or NTS_GUARDS=1 to recover)",
             loss, epoch,
         )
+        # an unarmed run never replays, so a nan_loss@layer=k poison
+        # armed this epoch must be consumed here — left pending it would
+        # corrupt the NEXT provenance replay in this process
+        from neutronstarlite_tpu.resilience import faults as res_faults
+
+        res_faults.clear_layer_poison()
     if not guards_armed():
         return
 
     if loss is not None and not finite:
+        # the guard->provenance handoff (obs/numerics): a one-shot eager
+        # layer-by-layer replay bisects to the first non-finite layer/op
+        # and leaves a typed nonfinite_provenance record BEFORE the raise
+        # — best-effort, never escalates the fault
+        _capture_provenance(toolkit, epoch, "nonfinite_loss")
         raise NonFiniteLossError(
             f"non-finite loss {loss!r} at epoch {epoch}", epoch=epoch
         )
@@ -199,12 +204,24 @@ def epoch_check(toolkit, epoch: int, seconds: float,
     if every > 0 and params is not None and epoch % every == 0:
         bad = nonfinite_leaves(params)
         if bad:
+            _capture_provenance(toolkit, epoch, "nonfinite_params")
             raise NonFiniteParamsError(
                 f"non-finite parameters at epoch {epoch}: "
                 f"{', '.join(bad[:8])}"
                 + (f" (+{len(bad) - 8} more)" if len(bad) > 8 else ""),
                 epoch=epoch,
             )
+
+
+def _capture_provenance(toolkit, epoch: int, fault_kind: str) -> None:
+    """Best-effort wrapper: provenance must never turn a recoverable
+    non-finite fault into an unrecoverable one."""
+    try:
+        from neutronstarlite_tpu.obs import numerics
+
+        numerics.capture_provenance(toolkit, epoch, fault_kind)
+    except Exception as e:
+        log.warning("non-finite provenance capture failed: %s", e)
 
 
 # ---- asynchronous watchdog -------------------------------------------------

@@ -376,7 +376,18 @@ class InferenceEngine:
         """Run the bucket's AOT executable over already-device-resident
         batch arrays (the executor stage)."""
         compiled = self._ensure_compiled(int(bucket))
-        return np.asarray(compiled(self.params, self.feature, nodes, hops))
+        out = np.asarray(compiled(self.params, self.feature, nodes, hops))
+        if self.metrics is not None:
+            # numerics plane (NTS_NUMERICS=1): engine stats on every
+            # executed request batch — host numpy over the logits the
+            # reply already fetched (no extra device sync); a non-finite
+            # batch leaves a LOUD tensor_stats record, the gauges track
+            # the last batch either way
+            from neutronstarlite_tpu.obs import numerics
+
+            if numerics.numerics_enabled():
+                numerics.observe_serve_batch(self.metrics, out, bucket)
+        return out
 
     def forward_batch(self, batch: SampledBatch,
                       bucket: Optional[int] = None) -> np.ndarray:

@@ -20,7 +20,14 @@ over any obs stream (the CLI), it compares:
   the prior's ranking held — the prior's byte-argmin candidate vs the
   measured-seconds argmin. Drift = how much slower the prior's pick
   actually ran than the measured best. This is the one that matters on
-  a cached-mode miss, where the prior decides alone.
+  a cached-mode miss, where the prior decides alone;
+- **wire_quant** (the numerics leg, obs/numerics): the MEASURED relative
+  RMS error of the narrowed (bf16) ring payload — the
+  ``wire.quant_rel_err`` gauge / ``tensor_stats`` records — against
+  ``NTS_QUANT_TOL``. A ``WIRE_DTYPE:bf16`` tuner decision whose measured
+  error exceeds the tolerance gets its tune-cache entry flagged for
+  re-trial exactly like a mispriced prior: the decision traded accuracy
+  for bytes on a payload where the trade measurably does not hold.
 
 Drift beyond ``--threshold`` (``NTS_DRIFT_TOL``, default 0.1) emits one
 typed ``model_drift`` record per disagreement (rendered by
@@ -155,10 +162,92 @@ def tune_prior_drift(events: List[Dict[str, Any]],
     return out
 
 
+_NARROW_WIRE = ("bf16", "bfloat16")
+
+
+def _run_quant_errors(events: List[Dict[str, Any]]) -> Dict[str, float]:
+    """{run_id: max measured wire quant error} — from ``tensor_stats``
+    records carrying ``quant_rel_err`` (the NTS_QUANT_PROBE / NTS_NUMERICS
+    wire groups), with the run_summary ``wire.quant_rel_err`` gauge as
+    the records-rotated-away fallback."""
+    out: Dict[str, float] = {}
+    for e in events:
+        err = None
+        if e.get("event") == "tensor_stats":
+            err = _num(e.get("quant_rel_err"))
+        elif e.get("event") == "run_summary":
+            err = _num((e.get("gauges") or {}).get("wire.quant_rel_err"))
+        if err is None:
+            continue
+        rid = e.get("run_id")
+        out[rid] = max(out.get(rid, 0.0), err)
+    return out
+
+
+def wire_quant_drift(events: List[Dict[str, Any]],
+                     quant_threshold: Optional[float] = None
+                     ) -> List[Dict[str, Any]]:
+    """The numerics leg: per run, the MEASURED relative RMS error of the
+    narrowed ring payload (obs/numerics — wire.quant_rel_err) against
+    ``NTS_QUANT_TOL``. A breach emits one drift entry; when the stream
+    shows the bf16 wire came from a TUNER decision, the entry carries
+    the decision's full cache-key facts so ``flag_tune_cache`` marks
+    exactly the implicated entry for re-trial — the same loud-miss
+    contract mispriced priors get. An explicitly-pinned WIRE_DTYPE:bf16
+    run still gets the record (the user deserves the audit), just with
+    nothing to flag."""
+    if quant_threshold is None:
+        from neutronstarlite_tpu.obs.numerics import quant_tol
+
+        quant_threshold = quant_tol()
+    errors = _run_quant_errors(events)
+    if not errors:
+        return []
+    decisions: Dict[str, Dict[str, Any]] = {}
+    for e in events:
+        if e.get("event") != "tune_decision":
+            continue
+        wd = str((e.get("decision") or {}).get("wire_dtype", "")).lower()
+        if wd in _NARROW_WIRE or "bf16" in (e.get("candidate") or ""):
+            decisions[e.get("run_id")] = e
+    out: List[Dict[str, Any]] = []
+    for rid, err in sorted(errors.items(), key=lambda kv: str(kv[0])):
+        if err <= quant_threshold:
+            continue
+        entry: Dict[str, Any] = {
+            "metric": "wire_quant_rel_err",
+            "source": "wire_quant",
+            "predicted": quant_threshold,
+            "observed": err,
+            # NTS_QUANT_TOL=0 is the legitimate "flag ANY measured
+            # error" setting — the drift is then the raw error, not a
+            # ratio against zero
+            "drift": (err / quant_threshold - 1.0) if quant_threshold > 0
+            else err,
+            "threshold": quant_threshold,
+            "episode_run_id": rid,
+        }
+        d = decisions.get(rid)
+        if d is not None:
+            entry.update({
+                "family": d.get("family"),
+                "partitions": d.get("partitions"),
+                "candidate": d.get("candidate"),
+                "graph_digest": d.get("graph_digest"),
+                "backend": d.get("backend"),
+                "layers": d.get("layers"),
+            })
+        out.append(entry)
+    return out
+
+
 def audit_events(events: List[Dict[str, Any]],
-                 threshold: Optional[float] = None) -> List[Dict[str, Any]]:
+                 threshold: Optional[float] = None,
+                 quant_threshold: Optional[float] = None
+                 ) -> List[Dict[str, Any]]:
     """Every drift entry one stream's records support (run_summary wire
-    pairs + tune episodes). Pure: no records emitted, nothing flagged."""
+    pairs + tune episodes + measured wire quant errors). Pure: no
+    records emitted, nothing flagged."""
     threshold = threshold if threshold is not None else drift_threshold()
     out: List[Dict[str, Any]] = []
     for e in events:
@@ -168,6 +257,7 @@ def audit_events(events: List[Dict[str, Any]],
                 int(e.get("epochs") or 0), threshold,
             ))
     out.extend(tune_prior_drift(events, threshold))
+    out.extend(wire_quant_drift(events, quant_threshold))
     return out
 
 
@@ -188,7 +278,12 @@ def flag_tune_cache(drifts: List[Dict[str, Any]],
         return []
     flagged: List[str] = []
     for d in drifts:
-        if d.get("source") != "tune_prior":
+        if d.get("source") not in ("tune_prior", "wire_quant"):
+            continue
+        if d.get("source") == "wire_quant" and d.get("family") is None:
+            # a pinned-cfg bf16 run: measured error, but no tuner
+            # decision to flag (and a fact-free find_entries would
+            # match EVERY entry in the cache)
             continue
         for path in cache.find_entries(
             directory, family=d.get("family"),
@@ -197,11 +292,18 @@ def flag_tune_cache(drifts: List[Dict[str, Any]],
             backend=d.get("backend"),
             layers=d.get("layers"),
         ):
-            reason = (
-                f"prior ranking drift {d['drift'] * 100:+.1f}% "
-                f"(prior pick {d.get('candidate')} vs measured best "
-                f"{d.get('measured_best')})"
-            )
+            if d.get("source") == "wire_quant":
+                reason = (
+                    f"measured wire quant error {d['observed']:.3g} > "
+                    f"NTS_QUANT_TOL {d['threshold']:g} "
+                    f"(decision {d.get('candidate')})"
+                )
+            else:
+                reason = (
+                    f"prior ranking drift {d['drift'] * 100:+.1f}% "
+                    f"(prior pick {d.get('candidate')} vs measured best "
+                    f"{d.get('measured_best')})"
+                )
             if cache.flag_for_retrial(path, reason):
                 flagged.append(path)
                 names = d.setdefault("flagged_entries", [])
@@ -223,6 +325,22 @@ def audit_registry(metrics, epochs: int,
         drifts = wire_drift(
             snap["counters"], snap["gauges"], epochs, threshold
         )
+        # the numerics leg, in-process: a measured wire quant error over
+        # NTS_QUANT_TOL leaves its model_drift record in the stream
+        # (flagging stays with the offline CLI, which has the tune facts)
+        from neutronstarlite_tpu.obs.numerics import quant_tol
+
+        qtol = quant_tol()
+        qerr = _num(snap["gauges"].get("wire.quant_rel_err"))
+        if qerr is not None and qerr > qtol:
+            drifts.append({
+                "metric": "wire_quant_rel_err",
+                "source": "wire_quant",
+                "predicted": qtol,
+                "observed": qerr,
+                "drift": (qerr / qtol - 1.0) if qtol > 0 else qerr,
+                "threshold": qtol,
+            })
         for d in drifts:
             metrics.event("model_drift", **d)
         return drifts

@@ -294,6 +294,7 @@ def render_serve(path: str, rec: Dict[str, Any],
     lines.extend(rec.get("_deltas") or [])
     lines.extend(rec.get("_cost") or [])
     lines.extend(rec.get("_drift") or [])
+    lines.extend(rec.get("_numerics") or [])
     lines.extend(rec.get("_hists") or [])
     lines.extend(rec.get("_slo") or [])
     lines.extend(rec.get("_trace") or [])
@@ -603,6 +604,55 @@ def render_deltas(events: List[Dict[str, Any]]) -> List[str]:
     return lines
 
 
+def render_numerics(events: List[Dict[str, Any]],
+                    rec: Dict[str, Any]) -> List[str]:
+    """The numerics-health block (obs/numerics, NTS_NUMERICS=1): the
+    LAST ``tensor_stats`` snapshot per tensor group (within a stream the
+    latest per name supersedes), the global grad norm / wire quant-error
+    gauges, and every ``nonfinite_provenance`` verdict. Empty for
+    uninstrumented streams."""
+    stats: Dict[str, Dict[str, Any]] = {}
+    for e in events:
+        if e["event"] == "tensor_stats":
+            stats[e["name"]] = e
+    provs = [e for e in events if e["event"] == "nonfinite_provenance"]
+    gauges = rec.get("gauges") or {}
+    if not (stats or provs):
+        return []
+
+    def _n(v):
+        return f"{v:.4g}" if v is not None else "n/a"
+
+    lines = ["numerics:"]
+    for name, e in sorted(stats.items()):
+        tail = ""
+        if e.get("quant_rel_err") is not None:
+            tail = f" quant_rel_err={e['quant_rel_err']:.3g}"
+        lines.append(
+            f"#numerics_{name}=finite={e['finite_fraction']:.4f} "
+            f"absmax={_n(e.get('absmax'))} rms={_n(e.get('rms'))} "
+            f"zero={e['zero_fraction']:.4f}{tail}"
+            + (f" (epoch {e['epoch']})" if e.get("epoch") is not None
+               else "")
+        )
+    gn = gauges.get("numerics.grad_global_norm")
+    if gn is not None:
+        lines.append(f"#grad_global_norm={gn:g}")
+    qe = gauges.get("wire.quant_rel_err")
+    if qe is not None:
+        lines.append(f"#wire_quant_rel_err={qe:g}")
+    for e in provs:
+        lines.append(
+            f"#nonfinite_provenance="
+            f"layer {e['layer'] if e.get('layer') is not None else '?'} "
+            f"op={e.get('op') or '?'} name={e.get('name') or '?'} "
+            f"({e['fault_kind']} at epoch {e.get('epoch')}, "
+            f"{e.get('checked', 0)} taps checked"
+            + (", injected)" if e.get("injected") else ")")
+        )
+    return lines
+
+
 def render_probes(events: List[Dict[str, Any]]) -> List[str]:
     """The ``backend_probe`` block (bench.py's subprocess PJRT check) —
     the stale-anchor cause, visible at last. Empty without probes."""
@@ -638,7 +688,7 @@ def recovery_timeline(events: List[Dict[str, Any]]) -> List[str]:
     lines: List[str] = []
     for e in events:
         if e["event"] not in ("fault", "recovery", "rank_loss", "replan",
-                              "stream_rotated"):
+                              "stream_rotated", "nonfinite_provenance"):
             continue
         detail = " ".join(
             f"{k}={e[k]}" for k in sorted(e)
@@ -746,6 +796,7 @@ def render_run(path: str, rec: Dict[str, Any]) -> str:
     lines.extend(rec.get("_deltas") or [])
     lines.extend(rec.get("_cost") or [])
     lines.extend(rec.get("_drift") or [])
+    lines.extend(rec.get("_numerics") or [])
     lines.extend(rec.get("_elastic") or [])
     lines.extend(render_sample(rec))
     lines.extend(rec.get("_hists") or [])
@@ -845,6 +896,14 @@ def _diff_metrics(rec, srec):
         out["sample_stall_ms_per_epoch"] = (
             stall / n_epochs if stall is not None and n_epochs > 0 else None
         )
+        # numerics plane (obs/numerics, NTS_NUMERICS=1 / NTS_QUANT_PROBE):
+        # the final grad-norm trajectory point and the measured wire
+        # quantization error — both carry tolerance floors (_TOL_FLOORS):
+        # grad norms swing with seeds/shuffling well beyond timing noise,
+        # and the quant error of one payload jitters only at float
+        # granularity, so a tight floor still catches a dtype regression
+        out["grad_global_norm"] = gauges.get("numerics.grad_global_norm")
+        out["wire_quant_rel_err"] = gauges.get("wire.quant_rel_err")
     if srec is not None:
         answered = srec.get("requests", 0)
         shed = srec.get("shed", 0)
@@ -914,8 +973,19 @@ def _side_metrics(path: str) -> Dict[str, Any]:
 # (obs/hist, bounded relative quantile error ~1% per side), so two
 # identical distributions can legitimately differ by up to ~2% between
 # sides — a --tol below that would flag quantization noise as regression.
+# grad_global_norm varies run to run with seeds/dropout far beyond
+# timing noise (25% floor: catch a blow-up, not a reshuffle — the
+# one-sided growth check here is deliberate and complements
+# perf_sentinel's two-sided ADVISORY trajectory leg, which also
+# catches the collapse-toward-zero direction);
+# wire_quant_rel_err on one payload is near-deterministic (5% floor:
+# a dtype/rounding regression doubles it, float jitter does not).
 # The floor is implicit: the effective tolerance is max(--tol, floor).
-_TOL_FLOORS = {"serve_p99_ms": 0.0202}
+_TOL_FLOORS = {
+    "serve_p99_ms": 0.0202,
+    "grad_global_norm": 0.25,
+    "wire_quant_rel_err": 0.05,
+}
 
 
 def run_diff(a_path: str, b_path: str, tol: float,
@@ -1056,6 +1126,7 @@ def main(argv=None) -> int:
         slo_lines = slo_timeline(events)
         drift_lines = render_drift(events)
         delta_lines = render_deltas(events)
+        numerics_lines = render_numerics(events, rec or {})
         if rec is not None:
             rec["_path"] = p
             rec["_timeline"] = recovery_timeline(events)
@@ -1064,6 +1135,7 @@ def main(argv=None) -> int:
             rec["_deltas"] = delta_lines
             rec["_cost"] = render_program_costs(events, rec)
             rec["_drift"] = drift_lines
+            rec["_numerics"] = numerics_lines
             rec["_elastic"] = render_elastic(events, rec)
             rec["_hists"] = hist_lines
             rec["_slo"] = slo_lines
@@ -1078,6 +1150,7 @@ def main(argv=None) -> int:
                 render_program_costs(events, srec) if rec is None else []
             )
             srec["_drift"] = drift_lines if rec is None else []
+            srec["_numerics"] = numerics_lines if rec is None else []
             srec["_hists"] = hist_lines if rec is None else []
             srec["_slo"] = slo_lines if rec is None else []
             srec["_trace"] = trace_lines if rec is None else []

@@ -67,6 +67,11 @@ GATED_METRICS = {
         "sample_stall_ms_per_epoch",
         "edge_hbm_bytes_per_epoch",
         "peak_hbm_bytes",
+        # measured wire quantization error (obs/numerics): a dtype or
+        # rounding regression grows it; the MAD window absorbs float
+        # jitter. grad_global_norm is NOT here — it is not
+        # lower-is-better; see the advisory two-sided leg in check()
+        "wire_quant_rel_err",
     ),
     "suite": ("suite_duration_s",),
     "probe": ("seconds",),
@@ -172,6 +177,31 @@ def check(rows: List[Dict[str, Any]], kind: str, k: int, min_baseline: int,
         }
         if regressed:
             out["regressed"].append(m)
+
+    if kind == "run":
+        # ADVISORY grad-norm trajectory leg (obs/numerics): the final
+        # grad_global_norm checked TWO-SIDED against its own history —
+        # a norm blowing up OR collapsing to ~0 is an optimization-
+        # health drift, but neither direction is "better", so it warns
+        # instead of gating (the ISSUE 15 sentinel contract)
+        gn = _num(cand.get("grad_global_norm"))
+        base_gn = [
+            v for v in (_num(r.get("grad_global_norm")) for r in window)
+            if v is not None
+        ]
+        if gn is not None and len(base_gn) >= min_baseline:
+            stats = baseline_stats(base_gn)
+            med = stats["median"]
+            tol = effective_tolerance(med, stats["mad"], nsigma, floor,
+                                      max_tol)
+            if med > 0 and abs(gn - med) > med * tol:
+                out["warnings"].append(
+                    f"grad_global_norm: {gn:g} vs baseline median "
+                    f"{med:g} ({(gn - med) / med * 100:+.1f}%, beyond "
+                    f"±{tol:.0%}) — gradient-scale drift (advisory; "
+                    "check the numerics block / tensor_stats records)"
+                )
+                out["grad_norm_drift"] = True
 
     if kind == "suite":
         budget = suite_budget if suite_budget is not None else _num(

@@ -129,6 +129,12 @@ def _emit_decision(toolkit, family: str, P: int,
     metrics = getattr(toolkit, "metrics", None)
     if metrics is None:
         return
+    # the decision record carries the FULL cache-key facts like the
+    # trial records do (digest/backend/layers as open fields): the drift
+    # auditor's numerics leg (tools/drift_audit.wire_quant_drift) must be
+    # able to flag exactly the implicated entry from a CACHED-mode stream
+    # too, which has zero tune_trial records to borrow the key from
+    key = _cache_key(toolkit, family, P)
     metrics.event(
         "tune_decision",
         family=family,
@@ -138,6 +144,9 @@ def _emit_decision(toolkit, family: str, P: int,
         seconds=decision.get("seconds"),
         predicted_bytes=decision.get("predicted_bytes"),
         decision={a: decision.get(a, "") for a in space.AXES},
+        graph_digest=key.graph_digest,
+        backend=key.backend,
+        layers=key.layers,
     )
     metrics.gauge_set("tune.decision", decision["candidate"])
     metrics.gauge_set("tune.decision_source", source)

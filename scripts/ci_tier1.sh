@@ -756,6 +756,100 @@ EOF
   fi
 fi
 
+# ---- numerics health-plane gate (ISSUE 15) ---------------------------------
+# STRUCTURAL (hard), two legs:
+# (1) the chaos oracle — the fullbatch smoke under supervision with
+#     nan_loss@epoch=1,layer=1 and NTS_NUMERICS=1 must exit 0 (supervised
+#     recovery), leaving a schema-valid stream that carries tensor_stats
+#     records AND a nonfinite_provenance record naming layer 1 exactly;
+# (2) the quant leg — the bf16 sim-ring smoke with NTS_QUANT_PROBE=1 must
+#     leave the wire.quant_rel_err gauge + per-epoch wire.payload/l0
+#     records (the measurement tools/drift_audit audits vs NTS_QUANT_TOL).
+numerics_rc=0
+rm -rf /tmp/_t1_num_prov /tmp/_t1_num_quant /tmp/_t1_num_off /tmp/_t1_num_on
+if JAX_PLATFORMS=cpu NTS_METRICS_DIR=/tmp/_t1_num_prov NTS_NUMERICS=1 \
+    NTS_FAULT_SPEC='nan_loss@epoch=1,layer=1' NTS_MAX_RESTARTS=2 \
+    NTS_BACKOFF_BASE_S=0 timeout -k 10 300 python -m neutronstarlite_tpu.run \
+    configs/gcn_cora_smoke.cfg > /tmp/_t1_num_prov.log 2>&1 \
+  && JAX_PLATFORMS=cpu NTS_METRICS_DIR=/tmp/_t1_num_quant NTS_NUMERICS=1 \
+    NTS_QUANT_PROBE=1 NTS_WIRE_DTYPE=bf16 NTS_DIST_SIMULATE=1 \
+    NTS_LEDGER_DIR="$t1_ledger" timeout -k 10 300 \
+    python -m neutronstarlite_tpu.run \
+    configs/gcn_dist_ring_smoke.cfg > /tmp/_t1_num_quant.log 2>&1
+then
+  JAX_PLATFORMS=cpu python - <<'EOF' || numerics_rc=$?
+import glob, json
+
+from neutronstarlite_tpu.obs import schema
+
+def load(d):
+    evs = []
+    for p in sorted(glob.glob(d + "/*.jsonl")):
+        for line in open(p, encoding="utf-8"):
+            if line.strip():
+                evs.append(json.loads(line))
+    assert schema.validate_stream(evs) == len(evs)
+    return evs
+
+# leg 1: recovered chaos run with provenance naming layer 1
+evs = load("/tmp/_t1_num_prov")
+stats = [e for e in evs if e["event"] == "tensor_stats"]
+assert stats, "no tensor_stats records in the numerics smoke stream"
+prov = [e for e in evs if e["event"] == "nonfinite_provenance"]
+assert prov, "no nonfinite_provenance record after the injected nan_loss"
+assert prov[-1]["layer"] == 1, f"provenance named {prov[-1]['layer']}, want 1"
+assert prov[-1]["injected"] is True
+
+# leg 2: measured wire quant error on the bf16 ring
+evs = load("/tmp/_t1_num_quant")
+payloads = [e for e in evs if e["event"] == "tensor_stats"
+            and e["name"] == "wire.payload/l0"]
+assert payloads, "no wire.payload/l0 probe records on the bf16 ring smoke"
+summ = [e for e in evs if e["event"] == "run_summary"][-1]
+err = summ["gauges"].get("wire.quant_rel_err")
+assert err is not None and 0 < err < 0.01, f"wire.quant_rel_err={err!r}"
+print(
+    f"numerics gate: provenance named layer {prov[-1]['layer']} "
+    f"(op={prov[-1]['op']}), {len(stats)} tensor_stats records; "
+    f"bf16 ring quant_rel_err={err:.2e} over {len(payloads)} epochs"
+)
+EOF
+else
+  numerics_rc=$?
+  tail -30 /tmp/_t1_num_prov.log /tmp/_t1_num_quant.log
+fi
+if [ "$numerics_rc" -ne 0 ]; then
+  echo "NUMERICS_GATE=FAIL (rc=$numerics_rc)"
+else
+  echo "NUMERICS_GATE=OK"
+fi
+
+# TIMING (advisory on the CPU rig): the overhead pin's wall-clock half —
+# the same smoke with stats off vs fused-stats on through --diff; the
+# jaxpr byte-identity half is a tier-1 test (tests/test_numerics.py).
+# Plus the grad-norm sentinel leg: the quant run's kind=run ledger row
+# carries grad_global_norm, and perf_sentinel's two-sided advisory check
+# warns when it drifts off its own history (never gates).
+if [ "$numerics_rc" -eq 0 ]; then
+  num_t_rc=0
+  JAX_PLATFORMS=cpu NTS_METRICS_DIR=/tmp/_t1_num_off timeout -k 10 300 \
+    python -m neutronstarlite_tpu.run configs/gcn_cora_smoke.cfg \
+    > /tmp/_t1_num_off.log 2>&1 \
+  && JAX_PLATFORMS=cpu NTS_METRICS_DIR=/tmp/_t1_num_on NTS_NUMERICS=1 \
+    timeout -k 10 300 python -m neutronstarlite_tpu.run \
+    configs/gcn_cora_smoke.cfg > /tmp/_t1_num_on.log 2>&1 \
+  && JAX_PLATFORMS=cpu python -m neutronstarlite_tpu.tools.metrics_report \
+    --diff /tmp/_t1_num_off /tmp/_t1_num_on --tol 1.0 \
+  || num_t_rc=$?
+  echo "NUMERICS_TIMING_GATE=rc$num_t_rc (advisory unless NTS_CI_MICRO_FATAL=1)"
+  if [ "${NTS_CI_MICRO_FATAL:-0}" = "1" ] && [ "$num_t_rc" -ne 0 ]; then
+    numerics_rc=$num_t_rc
+  fi
+  JAX_PLATFORMS=cpu python -m neutronstarlite_tpu.tools.perf_sentinel \
+    check --ledger "$t1_ledger" --kind run || true
+  echo "NUMERICS_GRAD_SENTINEL=advisory (two-sided grad_global_norm warning only)"
+fi
+
 [ "$rc" -eq 0 ] && rc=$fused_rc
 [ "$rc" -eq 0 ] && rc=$samp_rc
 [ "$rc" -eq 0 ] && rc=$elastic_rc
@@ -764,4 +858,5 @@ fi
 [ "$rc" -eq 0 ] && rc=$obs_rc
 [ "$rc" -eq 0 ] && rc=$ledger_rc
 [ "$rc" -eq 0 ] && rc=$fleet_rc
+[ "$rc" -eq 0 ] && rc=$numerics_rc
 exit $rc

@@ -97,6 +97,19 @@ def _emit_all(reg: registry.MetricsRegistry) -> None:
         platform="cpu",
     )
     reg.event(
+        "tensor_stats", name="grads/l0", epoch=2, finite_fraction=1.0,
+        absmax=0.125, rms=0.004, zero_fraction=0.25,
+    )
+    reg.event(
+        "tensor_stats", name="wire/l0", epoch=2, finite_fraction=1.0,
+        absmax=2.5, rms=0.9, zero_fraction=0.0, quant_rel_err=0.0016,
+    )
+    reg.event(
+        "nonfinite_provenance", fault_kind="nonfinite_loss", epoch=2,
+        layer=1, op="activation", name="acts/l1", finite_fraction=0.0,
+        checked=4, injected=True,
+    )
+    reg.event(
         "model_drift", metric="tune_prior_ranking", source="tune_prior",
         predicted=0.040, observed=0.080, drift=1.0, threshold=0.1,
         family="dist_dense/DistGCNTrainer", partitions=4,
@@ -141,6 +154,8 @@ RENDER_MARKERS = {
     "backend_probe": "#backend_probe=",
     "program_cost": "#program_cost=serve.bucket_16",
     "model_drift": "prediction drift:",
+    "tensor_stats": "numerics:",
+    "nonfinite_provenance": "#nonfinite_provenance=",
     "run_summary": "finish algorithm !",
 }
 
@@ -213,6 +228,8 @@ def test_validator_rejects_mutations_per_kind(tmp_path):
         "backend_probe": {"attempt": 0},
         "program_cost": {"label": ""},
         "model_drift": {"drift": "lots"},
+        "tensor_stats": {"finite_fraction": 1.5},
+        "nonfinite_provenance": {"checked": -1},
         "run_summary": {"epoch_time": None},
     }
     assert set(mutations) == set(schema.KNOWN_KINDS)
