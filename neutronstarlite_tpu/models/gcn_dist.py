@@ -28,6 +28,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as PS
 
 from neutronstarlite_tpu.models.base import ToolkitBase, register_algorithm
+from neutronstarlite_tpu.obs import skew
 from neutronstarlite_tpu.resilience import elastic
 from neutronstarlite_tpu.resilience.faults import fault_point
 from neutronstarlite_tpu.models.gcn import init_gcn_params
@@ -1018,6 +1019,17 @@ class DistGCNTrainer(ToolkitBase):
             elastic.LivenessMonitor(self.dist.partitions)
             if elastic.elastic_enabled() else None
         )
+        # straggler analytics (obs/skew): per-partition epoch timings ->
+        # advisory ``straggler`` records. Follows the elastic arming by
+        # default; NTS_STRAGGLER=1/0 forces it either way
+        self._straggler = (
+            skew.StragglerDetector(
+                self.dist.partitions, registry=self.metrics,
+                on_straggler=elastic.note_straggler,
+            )
+            if skew.straggler_enabled(default=self._liveness is not None)
+            else None
+        )
         if self._ring_plan is not None and os.environ.get(
             "NTS_OVERLAP_PROBE", "0"
         ) == "1":
@@ -1096,6 +1108,22 @@ class DistGCNTrainer(ToolkitBase):
                         slab_cols=int(hop["slab_cols"]),
                         epoch_span=espan.span_id if espan else None,
                     )
+            part_seconds = None
+            if self._liveness is not None or self._straggler is not None:
+                # per-partition step attribution: the sim twin executes
+                # every partition inside ONE fused XLA step, so each
+                # partition's share of the epoch is the epoch time itself
+                # plus whatever its ``partition_step`` fault point added
+                # (slow_rank's injected sleep lands HERE, in exactly one
+                # partition's measured time — the straggler chaos oracle)
+                alive_now = elastic.alive_partitions(self.dist.partitions)
+                part_seconds = {}
+                for p in alive_now:
+                    tp = get_time()
+                    fault_point("partition_step", epoch=epoch, partition=p)
+                    part_seconds[p] = dt + (get_time() - tp)
+                if self._straggler is not None:
+                    self._straggler.observe_epoch(epoch, part_seconds)
             if self._liveness is not None:
                 # per-partition heartbeats into the obs stream + miss-K /
                 # collective-timeout detection — after the epoch's
@@ -1107,6 +1135,7 @@ class DistGCNTrainer(ToolkitBase):
                     epoch,
                     alive=elastic.alive_partitions(self.dist.partitions),
                     step_seconds=t_wait - t_disp,
+                    partition_seconds=part_seconds,
                 )
             self.ckpt_epoch_end(epoch)
             if epoch % max(1, cfg.epochs // 20) == 0 or epoch == cfg.epochs - 1:

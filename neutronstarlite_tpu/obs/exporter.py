@@ -7,12 +7,25 @@ flush or a ring step:
 
 - ``/metrics`` — Prometheus text exposition: counters, numeric gauges,
   timing summaries (``_count``/``_sum``), and every LogHistogram as a
-  cumulative-bucket histogram over the fixed ``le`` ladder
-  (obs/hist.PROM_EDGES_MS) plus ``_sum``/``_count``;
+  cumulative-bucket histogram over the ``le`` ladder
+  (obs/hist.prom_edges — NTS_METRICS_LADDER-configurable, default
+  PROM_EDGES_MS) plus ``_sum``/``_count``. The ladder is LOSSY: a
+  ladder-derived quantile snaps to an edge, so remote aggregation must
+  not reconstruct distributions from it — that is what /telemetry is
+  for;
 - ``/healthz`` — JSON liveness: run identity, uptime, fault/restart
   counters, the supervisor state gauge, elastic partition count;
 - ``/slo`` — the SLO engine's current objective verdicts as JSON (404
-  when no engine is armed).
+  when no engine is armed);
+- ``/telemetry`` — the FULL-RESOLUTION schema-valid JSONL snapshot: per
+  surface one typed ``telemetry`` record (counters/gauges/timings +
+  the /healthz liveness facts + run identity), one cumulative ``hist``
+  record per histogram with its NATIVE 1.02-growth buckets, and one
+  ``slo_status`` record per objective verdict. This is the wire format
+  obs/hub.py polls: native buckets merge by the exact LogHistogram
+  merge law, so fleet p50/p95/p99 over N hosts equals what one process
+  would have measured (within the documented ~1% bucket bound).
+  ``?replica=rK`` filters to one labeled fleet surface.
 
 **Replica labels (the serve fleet).** One process can serve N replicas
 (serve/fleet.py), each with its own registry + SLO engine — and
@@ -42,7 +55,10 @@ from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
-from neutronstarlite_tpu.obs.hist import PROM_EDGES_MS
+from urllib.parse import parse_qs
+
+from neutronstarlite_tpu.obs.hist import PROM_EDGES_MS, prom_edges  # noqa: F401 (PROM_EDGES_MS re-exported for callers pinned to the canonical ladder)
+from neutronstarlite_tpu.obs.schema import SCHEMA_VERSION
 from neutronstarlite_tpu.utils.logging import get_logger
 
 log = get_logger("obs")
@@ -85,9 +101,10 @@ def _surface_samples(registry, slo=None) -> Iterator[_Sample]:
         fam = _prom_name(name + "_seconds")
         yield (fam, "summary", "_count", {}, str(int(t["count"])))
         yield (fam, "summary", "_sum", {}, _fmt(t["total_s"]))
+    edges = prom_edges()
     for name, h in sorted(hists.items()):
         fam = _prom_name(name)
-        for edge in PROM_EDGES_MS:
+        for edge in edges:
             yield (fam, "histogram", "_bucket", {"le": f"{edge:g}"},
                    str(h.count_le(edge)))
         yield (fam, "histogram", "_bucket", {"le": "+Inf"}, str(h.count))
@@ -173,6 +190,21 @@ def health_payload(registry, started_at: float) -> Dict[str, Any]:
             "requests": counters.get("serve.requests", 0),
             "shed": counters.get("serve.shed", 0),
         }
+    # a telemetry hub's surface (obs/hub.py): degraded-but-alive while at
+    # least one polled target answers; ok flips only when the WHOLE fleet
+    # is unreachable (or the hub itself gave up)
+    targets = gauges.get("hub.targets")
+    if targets is not None:
+        ok_targets = int(gauges.get("hub.targets_ok") or 0)
+        lost = int(gauges.get("hub.targets_lost") or 0)
+        out["hub"] = {
+            "targets": int(targets),
+            "targets_ok": ok_targets,
+            "targets_lost": lost,
+            "degraded": lost > 0,
+            "polls": counters.get("hub.polls", 0),
+        }
+        out["ok"] = bool(out["ok"] and (ok_targets > 0 or int(targets) == 0))
     return out
 
 
@@ -196,6 +228,74 @@ def fleet_health_payload(
         },
         "replicas": replicas,
     }
+
+
+def telemetry_records(
+    surfaces: "OrderedDict[str, Tuple[Any, Any]]", started_at: float,
+    replica: Optional[str] = None,
+) -> List[Dict[str, Any]]:
+    """The /telemetry payload: per surface one typed ``telemetry``
+    record, one cumulative ``hist`` record per histogram (NATIVE
+    buckets — this is the lossless half the /metrics ladder drops), and
+    one ``slo_status`` record per objective verdict. Every record is
+    schema-valid (obs/schema.py) with the surface registry's run
+    identity; ``replica`` filters to one labeled fleet surface."""
+    recs: List[Dict[str, Any]] = []
+    now = time.time()
+    for label, (registry, slo) in surfaces.items():
+        if replica is not None and label != replica:
+            continue
+        snap = registry.snapshot(include_hists=False)
+        seq = 0
+
+        def env(body: Dict[str, Any], *, _reg=registry) -> Dict[str, Any]:
+            nonlocal seq
+            rec = {
+                "event": body.pop("event"),
+                "run_id": _reg.run_id,
+                "schema": SCHEMA_VERSION,
+                "ts": now,
+                "seq": seq,
+            }
+            rec.update(body)
+            seq += 1
+            return rec
+
+        top: Dict[str, Any] = {
+            "event": "telemetry",
+            "source": "exporter",
+            "algorithm": registry.algorithm,
+            "counters": snap["counters"],
+            "gauges": snap["gauges"],
+            "timings": snap["timings"],
+            "health": health_payload(registry, started_at),
+            "uptime_s": round(now - started_at, 3),
+        }
+        if label:
+            top["replica"] = label
+        recs.append(env(top))
+        for name, h in sorted(registry.hists().items()):
+            recs.append(env({"event": "hist", "name": name, **h.to_dict()}))
+        if slo is not None:
+            try:
+                slo.tick()
+                verdicts = slo.verdicts()
+            except Exception as e:  # a scrape must not die on a bad engine
+                log.warning("telemetry slo verdicts unavailable: %s", e)
+                verdicts = []
+            for v in verdicts:
+                recs.append(env({"event": "slo_status", **v}))
+    return recs
+
+
+def telemetry_ndjson(
+    surfaces: "OrderedDict[str, Tuple[Any, Any]]", started_at: float,
+    replica: Optional[str] = None,
+) -> str:
+    return "".join(
+        json.dumps(r, default=str) + "\n"
+        for r in telemetry_records(surfaces, started_at, replica=replica)
+    )
 
 
 class MetricsExporter:
@@ -264,6 +364,33 @@ class MetricsExporter:
                                 200, json.dumps(out).encode(),
                                 "application/json",
                             )
+                    elif path == "/telemetry":
+                        want: Optional[str] = None
+                        parts = self.path.split("?", 1)
+                        if len(parts) == 2:
+                            vals = parse_qs(parts[1]).get("replica")
+                            if vals:
+                                want = vals[0]
+                        if want is not None and want not in surfaces:
+                            self._send(
+                                404,
+                                json.dumps({
+                                    "error": f"no surface labeled "
+                                             f"{want!r}",
+                                    "replicas": [
+                                        k for k in surfaces if k
+                                    ],
+                                }).encode(),
+                                "application/json",
+                            )
+                        else:
+                            body = telemetry_ndjson(
+                                surfaces, exporter.started_at,
+                                replica=want,
+                            ).encode()
+                            self._send(
+                                200, body, "application/x-ndjson"
+                            )
                     else:
                         self._send(404, b"not found\n", "text/plain")
                 except Exception as e:  # a bad scrape must not kill serving
@@ -284,7 +411,7 @@ class MetricsExporter:
         )
         self._thread.start()
         log.info("metrics exporter listening on http://%s:%d "
-                 "(/metrics /healthz /slo)", host, self.port)
+                 "(/metrics /healthz /slo /telemetry)", host, self.port)
 
     def surfaces(self) -> "OrderedDict[str, Tuple[Any, Any]]":
         with self._surface_lock:

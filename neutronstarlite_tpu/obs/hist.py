@@ -28,7 +28,12 @@ the whole distribution even after older raw records were rotated away.
 from __future__ import annotations
 
 import math
-from typing import Any, Dict, Iterable, List, Optional
+import os
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from neutronstarlite_tpu.utils.logging import get_logger
+
+log = get_logger("obs")
 
 DEFAULT_GROWTH = 1.02
 DEFAULT_MIN_VALUE = 1e-3
@@ -257,8 +262,46 @@ def merged_quantiles(events: Iterable[Dict[str, Any]],
 
 # the canonical `le` edge ladder (ms) the Prometheus exporter renders —
 # a fixed, monotone set so scrape output stays bounded no matter how many
-# native log buckets a histogram holds
+# native log buckets a histogram holds. The ladder is LOSSY by design: a
+# quantile derived from it snaps to the nearest edge (error up to the
+# edge spacing — tens of percent between sparse edges), while the native
+# log buckets bound quantile error at sqrt(growth)-1 (~1% at 1.02). Exact
+# cross-host merging therefore rides the /telemetry endpoint's native
+# `hist` records, never the /metrics ladder; NTS_METRICS_LADDER only
+# re-shapes what Prometheus scrapes.
 PROM_EDGES_MS: List[float] = [
     0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0,
     250.0, 500.0, 1000.0, 2500.0, 5000.0, 10000.0, 30000.0, 60000.0,
 ]
+
+# parse-once cache keyed by the raw env value: the exporter calls
+# prom_edges() on every scrape, and the knob never changes mid-process
+_ladder_cache: Optional[Tuple[str, List[float]]] = None
+
+
+def prom_edges() -> List[float]:
+    """The `le` edge ladder the Prometheus exporter renders:
+    ``NTS_METRICS_LADDER`` (comma-separated ms edges, strictly
+    increasing, all > 0) when set and well-formed, else the canonical
+    :data:`PROM_EDGES_MS`. A malformed knob WARNS and falls back — a
+    scrape endpoint must never die on an env typo."""
+    global _ladder_cache
+    raw = os.environ.get("NTS_METRICS_LADDER", "").strip()
+    if not raw:
+        return PROM_EDGES_MS
+    if _ladder_cache is not None and _ladder_cache[0] == raw:
+        return _ladder_cache[1]
+    try:
+        edges = [float(tok) for tok in raw.split(",") if tok.strip()]
+        if not edges:
+            raise ValueError("no edges")
+        if any(e <= 0 for e in edges):
+            raise ValueError("edges must be > 0")
+        if any(b <= a for a, b in zip(edges, edges[1:])):
+            raise ValueError("edges must be strictly increasing")
+    except ValueError as e:
+        log.warning("bad NTS_METRICS_LADDER=%r (%s); using the default "
+                    "%d-edge ladder", raw, e, len(PROM_EDGES_MS))
+        edges = PROM_EDGES_MS
+    _ladder_cache = (raw, edges)
+    return edges

@@ -318,6 +318,37 @@ def serve_row(
     }
 
 
+def fleet_row(
+    targets: int,
+    targets_ok: int,
+    targets_lost: int,
+    polls: int,
+    hist_quantiles: Dict[str, Any],
+    cfg: Optional[str] = None,
+) -> Dict[str, Any]:
+    """One ``kind=fleet`` row from a telemetry-hub poll cycle
+    (obs/hub.py): the MERGED cross-host latency quantiles (exact under
+    the histogram merge law — the same math fleet.close() applies
+    in-process) plus the liveness roll-up. Keyed by target count so a
+    3-target fleet never baselines a 5-target one; the graph digest is
+    a fixed sentinel (the hub aggregates across workloads — its
+    trajectory is the fleet's, not one graph's). ``targets_lost`` is the
+    gated scalar (GATED_METRICS): a fleet that trends toward losing
+    targets is regressing even when the survivors' tails look fine."""
+    return {
+        "kind": "fleet",
+        "ts": time.time(),
+        "cfg": cfg or f"hub|t{int(targets)}",
+        "graph_digest": "fleet",
+        "backend": backend_fingerprint(),
+        "targets": int(targets),
+        "targets_ok": int(targets_ok),
+        "targets_lost": int(targets_lost),
+        "polls": int(polls),
+        "hist_quantiles": hist_quantiles or {},
+    }
+
+
 def probe_row(attempt: int, outcome: str, seconds: float,
               platform: Optional[str], scale: float = 1.0,
               error: Optional[str] = None) -> Dict[str, Any]:

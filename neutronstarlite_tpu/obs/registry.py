@@ -163,6 +163,16 @@ class MetricsRegistry:
                 h = self._hists[name] = LogHistogram(unit=unit)
             h.record(value)
 
+    def hist_set(self, name: str, hist: LogHistogram) -> None:
+        """Install a fully-built histogram under ``name`` (replacing any
+        prior), taking a defensive copy. This is the hub's merged-view
+        hook (obs/hub.py): the hub reconstructs and merges its targets'
+        histograms OUTSIDE the registry, then installs the result so the
+        stock exporter /metrics and ``emit_hists`` render the fleet
+        distribution with zero special-casing."""
+        with self._lock:
+            self._hists[name] = hist.copy()
+
     def hist(self, name: str) -> Optional[LogHistogram]:
         """The live histogram object (shared, not a copy — read-only use;
         the SLO engine reads bucket geometry off it)."""

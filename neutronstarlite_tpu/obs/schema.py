@@ -13,7 +13,8 @@ Envelope (all events):
                    graph_delta | tune_trial | tune_decision | span |
                    stream_rotated | hist | slo_status | backend_probe |
                    program_cost | model_drift | tensor_stats |
-                   nonfinite_provenance
+                   nonfinite_provenance | telemetry | target_loss |
+                   straggler
                    (open set)
   run_id: str      "<algo>-<fingerprint>-<pid>"
   schema: int      SCHEMA_VERSION
@@ -49,7 +50,10 @@ recovery (resilience/): a recovery action taken
 
 heartbeat (resilience/elastic.py): one partition's per-epoch liveness
   beat (NTS_ELASTIC=1)
-  partition: int >= 0, epoch: int | absent
+  partition: int >= 0, epoch: int | absent,
+  seconds: number | null | absent (that partition's measured step/epoch
+  wall time, when the caller separates it — what obs/skew.py's straggler
+  detector and the dashboard heat strip consume)
 
 rank_loss (resilience/elastic.py): the liveness monitor declared a
   partition lost (missed-K heartbeats) or a collective timed out
@@ -224,6 +228,42 @@ nonfinite_provenance (obs/numerics.py): the one-shot layer-by-layer
   epoch: int | null | absent, injected: bool | absent (a
   nan_loss@layer=k chaos poison was pending when the replay ran)
 
+telemetry (obs/exporter.py /telemetry, obs/hub.py): one full-resolution
+  scalar snapshot of a telemetry surface — the non-histogram half of the
+  /telemetry endpoint (the hist/slo_status records travel alongside as
+  their own typed lines) and the hub's per-poll merged fleet fact
+  source: str (non-empty; exporter | hub, open set),
+  counters/gauges: objects (the registry snapshot halves),
+  timings: object | absent,
+  health: object | absent (the /healthz payload facts: ok, liveness,
+  supervisor — the heartbeat/liveness side of the snapshot),
+  replica: str | absent (a fleet replica surface's label),
+  targets / targets_ok / targets_lost: int >= 0 | absent (hub records
+  only: fleet width and liveness at this poll),
+  slo: object | absent (hub records: per-objective worst burn/state
+  across targets), uptime_s: number | absent
+
+target_loss (obs/hub.py): the hub's miss-K liveness verdict on one
+  polled target — the cross-host analog of rank_loss (a dead TARGET is
+  a typed record and a degraded merged view, never a hub exception)
+  target: str (non-empty; the polled URL),
+  reason: str (non-empty; poll_miss, open set),
+  missed_polls: int > 0, miss_k: int > 0 | absent,
+  last_ok_ts: number | null | absent (wall clock of the last good poll)
+
+straggler (obs/skew.py): a partition's epoch time exceeded the fleet
+  median by the k·MAD tolerance (perf_sentinel math) for M consecutive
+  epochs — ADVISORY skew detection, slow-but-alive (a straggler still
+  heartbeats; it is NOT a rank_loss and never trips elastic by itself)
+  partition: int >= 0, epoch: int >= 0,
+  seconds: number (the partition's epoch time),
+  median_s: number (fleet median that epoch),
+  mad_s: number | absent (median absolute deviation),
+  threshold_s: number | absent (median * (1 + tolerance)),
+  excess: number | absent (seconds/median - 1),
+  consecutive: int > 0 (epochs over threshold in a row),
+  source: str | absent (partition_step | heartbeat | ring_step)
+
 model_drift (tools/drift_audit.py): an analytic prediction disagreed
   with what actually ran beyond the audit threshold — the record that
   turns the predict_all/predict_mesh priors and the wire gauges from
@@ -287,6 +327,9 @@ KNOWN_KINDS = (
     "model_drift",
     "tensor_stats",
     "nonfinite_provenance",
+    "telemetry",
+    "target_loss",
+    "straggler",
     "run_summary",
 )
 
@@ -403,6 +446,8 @@ def validate_event(obj: Any) -> None:
             obj["epoch"], int
         ):
             _fail("heartbeat.epoch must be an int when present")
+        if "seconds" in obj:
+            _require_number(obj, "seconds", allow_none=True)
     elif kind == "rank_loss":
         p = obj.get("partition")
         if p is not None and (
@@ -636,6 +681,68 @@ def validate_event(obj: Any) -> None:
         if "injected" in obj and not isinstance(obj["injected"], bool):
             _fail("nonfinite_provenance.injected must be a bool when "
                   "present")
+    elif kind == "telemetry":
+        if not isinstance(obj.get("source"), str) or not obj["source"]:
+            _fail("telemetry.source must be a non-empty string")
+        for key in ("counters", "gauges"):
+            if not isinstance(obj.get(key), dict):
+                _fail(f"telemetry.{key} must be an object, got "
+                      f"{obj.get(key)!r}")
+        for key in ("timings", "health", "slo"):
+            if key in obj and obj[key] is not None and not isinstance(
+                obj[key], dict
+            ):
+                _fail(f"telemetry.{key} must be an object when present")
+        if "replica" in obj and obj["replica"] is not None and not isinstance(
+            obj["replica"], str
+        ):
+            _fail("telemetry.replica must be a string when present")
+        for key in ("targets", "targets_ok", "targets_lost"):
+            v = obj.get(key)
+            if key in obj and (
+                not isinstance(v, int) or isinstance(v, bool) or v < 0
+            ):
+                _fail(f"telemetry.{key} must be a non-negative int when "
+                      f"present, got {v!r}")
+        if "uptime_s" in obj:
+            _require_number(obj, "uptime_s", allow_none=True)
+    elif kind == "target_loss":
+        if not isinstance(obj.get("target"), str) or not obj["target"]:
+            _fail("target_loss.target must be a non-empty string")
+        if not isinstance(obj.get("reason"), str) or not obj["reason"]:
+            _fail("target_loss.reason must be a non-empty string")
+        mp = obj.get("missed_polls")
+        if not isinstance(mp, int) or isinstance(mp, bool) or mp <= 0:
+            _fail(f"target_loss.missed_polls must be a positive int, got "
+                  f"{mp!r}")
+        mk = obj.get("miss_k")
+        if "miss_k" in obj and (
+            not isinstance(mk, int) or isinstance(mk, bool) or mk <= 0
+        ):
+            _fail(f"target_loss.miss_k must be a positive int when "
+                  f"present, got {mk!r}")
+        if "last_ok_ts" in obj:
+            _require_number(obj, "last_ok_ts", allow_none=True)
+    elif kind == "straggler":
+        p = obj.get("partition")
+        if not isinstance(p, int) or isinstance(p, bool) or p < 0:
+            _fail(f"straggler.partition must be a non-negative int, got "
+                  f"{p!r}")
+        ep = obj.get("epoch")
+        if not isinstance(ep, int) or isinstance(ep, bool) or ep < 0:
+            _fail(f"straggler.epoch must be a non-negative int, got "
+                  f"{ep!r}")
+        _require_number(obj, "seconds")
+        _require_number(obj, "median_s")
+        for key in ("mad_s", "threshold_s", "excess"):
+            if key in obj:
+                _require_number(obj, key, allow_none=True)
+        c = obj.get("consecutive")
+        if not isinstance(c, int) or isinstance(c, bool) or c <= 0:
+            _fail(f"straggler.consecutive must be a positive int, got "
+                  f"{c!r}")
+        if "source" in obj and not isinstance(obj["source"], str):
+            _fail("straggler.source must be a string when present")
     elif kind == "model_drift":
         if not isinstance(obj.get("metric"), str) or not obj["metric"]:
             _fail("model_drift.metric must be a non-empty string")

@@ -33,6 +33,13 @@ through a partition loss instead:
   (replicated), so the supervisor then restores them from the last-good
   checkpoint over the rebuilt plan and training continues degraded.
 
+- **Straggler advisory (slow vs dead)** — the straggler detector
+  (obs/skew) notes slow-but-alive partitions here
+  (:func:`note_straggler`); the registry never sheds or raises — it only
+  annotates a LATER rank_loss on the same partition ("flagged slow
+  before it went silent"). A straggler is NOT a rank_loss:
+  docs/RESILIENCE.md has the contract.
+
 The supervisor (resilience/supervisor) owns the recovery decision: on a
 :class:`RankLossError` with an identified partition it replans instead
 of retrying the same plan; a collective-timeout detection with no
@@ -156,6 +163,30 @@ def dead_partitions() -> Set[int]:
     return set(_dead)
 
 
+# ---- advisory straggler registry (slow vs dead, obs/skew) -------------------
+
+# partitions the straggler detector (obs/skew.StragglerDetector) flagged
+# slow-but-alive, in CURRENT numbering. ADVISORY ONLY: nothing here
+# sheds a partition or raises — a straggler still completes epochs and
+# still heartbeats. The registry exists so a LATER rank_loss on a
+# known-slow partition can say "it was flagged slow first" (the _trip
+# message below), turning slow-then-dead into one readable story.
+_stragglers: Set[int] = set()
+
+
+def note_straggler(partition: int) -> None:
+    """The detector's ``on_straggler`` hook (models/gcn_dist wires it)."""
+    _stragglers.add(int(partition))
+
+
+def clear_straggler(partition: int) -> None:
+    _stragglers.discard(int(partition))
+
+
+def stragglers() -> Set[int]:
+    return set(_stragglers)
+
+
 def alive_partitions(partitions: int) -> List[int]:
     """The partitions of a P-way plan still beating (run loops pass this
     to :meth:`LivenessMonitor.epoch_end` each epoch). A dead mark
@@ -179,6 +210,7 @@ def reset() -> None:
     never leak into the next run in the process)."""
     _dead.clear()
     _lost_originals.clear()
+    _stragglers.clear()
 
 
 def renumber_after_loss(lost: int) -> None:
@@ -230,13 +262,22 @@ class LivenessMonitor:
         self._tripped: Set[int] = set()  # unarmed: one record per loss
 
     def epoch_end(self, epoch: int, alive: Optional[Iterable[int]] = None,
-                  step_seconds: Optional[float] = None) -> None:
+                  step_seconds: Optional[float] = None,
+                  partition_seconds: Optional[dict] = None) -> None:
         """One epoch's health gate: beats for ``alive`` partitions, miss
-        accounting for the rest, and the collective-timeout check."""
+        accounting for the rest, and the collective-timeout check.
+        ``partition_seconds`` ({partition: measured epoch wall time})
+        rides each beat as the optional ``seconds`` field — the raw
+        material of the offline straggler replay (obs/skew)."""
         live = set(alive) if alive is not None else set(range(self.partitions))
+        secs = partition_seconds or {}
         for p in sorted(live):
             self._missed[p] = 0
-            events.emit("heartbeat", partition=int(p), epoch=int(epoch))
+            s = secs.get(p)
+            events.emit(
+                "heartbeat", partition=int(p), epoch=int(epoch),
+                **({"seconds": float(s)} if s is not None else {}),
+            )
         self._epochs_seen += 1
         for p in range(self.partitions):
             if p in live:
@@ -278,6 +319,11 @@ class LivenessMonitor:
 
     def _trip(self, msg: str, partition: Optional[int], epoch: int,
               reason: str, missed: Optional[int] = None) -> None:
+        if partition is not None and partition in _stragglers:
+            # the slow-then-dead story: the straggler advisory flagged
+            # this partition before its heartbeats stopped
+            msg += (f" — partition {partition} was flagged as a straggler "
+                    "(slow) before it went silent")
         key = -1 if partition is None else partition
         if key not in self._tripped:
             self._tripped.add(key)

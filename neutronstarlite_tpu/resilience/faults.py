@@ -45,6 +45,18 @@ rank_loss  epoch, partition (default 0) kills one SIM partition mid-epoch:
                                         numbering: a spec firing after a
                                         replan kills the same physical
                                         rank under its renumbered index
+slow_rank  epoch, partition (default 0) sleeps ms inside ONE partition's
+           ms (default 1000), times     per-epoch step (the
+                                        ``partition_step`` point) — the
+                                        simulated straggler. The partition
+                                        keeps heartbeating (slow, NOT
+                                        dead), so the liveness monitor
+                                        stays quiet and the straggler
+                                        detector (obs/skew) must name it —
+                                        the chaos oracle of the
+                                        slow-vs-dead contract. Use
+                                        ``times=M`` to outlast the
+                                        detector's M-consecutive latch
 ========== ============================ =======================================
 
 Common args: ``times`` (how often the spec may fire, default 1) makes
@@ -65,6 +77,10 @@ Fault points currently planted:
   once per sampled batch (sample/pipeline.py); target it with
   ``exc@point=sample_produce`` (or a stall) to kill/slow the sampling
   worker mid-epoch.
+- ``partition_step`` — inside the dist trainer's per-partition step
+  timing (models/gcn_dist.py), once per (epoch, partition), so an
+  injected sleep lands in exactly one partition's MEASURED wall time.
+  slow_rank fires here by default.
 
 State (parsed plan + per-spec fired counts + the save counter) is
 process-global on purpose: a supervised retry inside the same process
@@ -85,12 +101,12 @@ from neutronstarlite_tpu.utils.logging import get_logger, process_index
 log = get_logger("faults")
 
 FAULT_KINDS = ("nan_loss", "crash", "stall", "ckpt_corrupt", "exc",
-               "rank_loss")
+               "rank_loss", "slow_rank")
 
 # every named fault point planted in the codebase; a spec naming any
 # other point would silently never fire — exactly the chaos-test failure
 # parse_fault_spec's loudness contract exists to prevent
-FAULT_POINTS = ("epoch_loss", "save", "sample_produce")
+FAULT_POINTS = ("epoch_loss", "save", "sample_produce", "partition_step")
 
 # where each kind fires when the spec names no point= of its own. exc is
 # the generic in-process failure (raises RuntimeError at its point) —
@@ -103,6 +119,7 @@ DEFAULT_POINTS = {
     "exc": "epoch_loss",
     "ckpt_corrupt": "save",
     "rank_loss": "epoch_loss",
+    "slow_rank": "partition_step",
 }
 
 # exit code of a simulated crash — distinguishable from a real failure's
@@ -117,8 +134,9 @@ class FaultSpec:
     epoch: Optional[int] = None  # fire at this epoch (None: first chance)
     rank: Optional[int] = None  # crash: only on this process index
     save: Optional[int] = None  # ckpt_corrupt: 1-based save counter
-    ms: float = 1000.0  # stall: sleep duration
-    partition: Optional[int] = None  # rank_loss: sim partition to kill
+    ms: float = 1000.0  # stall / slow_rank: sleep duration
+    partition: Optional[int] = None  # rank_loss: sim partition to kill;
+    # slow_rank: the partition whose step the sleep lands in
     layer: Optional[int] = None  # nan_loss: poison the provenance
     # replay's forward at this layer (obs/numerics.poison_hook)
     times: int = 1  # max firings (one-shot by default)
@@ -253,12 +271,15 @@ def _epoch_matches(spec: FaultSpec, epoch: Optional[int]) -> bool:
 
 
 def fault_point(point: str, *, epoch: Optional[int] = None, value=None,
-                path: Optional[str] = None):
+                path: Optional[str] = None,
+                partition: Optional[int] = None):
     """Named injection hook. Run loops call it with the point's context
     and thread ``value`` (the epoch loss) through it; matching specs in
     the active plan fire (at most ``times`` each) and may replace the
     value, sleep, corrupt ``path``, or kill the process. A no-op (returns
-    ``value`` unchanged) when ``NTS_FAULT_SPEC`` is unset."""
+    ``value`` unchanged) when ``NTS_FAULT_SPEC`` is unset. ``partition``
+    is the per-partition context of the ``partition_step`` point (which
+    partition's step is executing) — slow_rank matches against it."""
     plan = active_plan()
     if not plan:
         return value
@@ -348,6 +369,27 @@ def fault_point(point: str, *, epoch: Optional[int] = None, value=None,
             from neutronstarlite_tpu.resilience import elastic
 
             elastic.kill_partition(part)
+        elif spec.kind == "slow_rank":
+            if not _epoch_matches(spec, epoch):
+                continue
+            if (spec.partition if spec.partition is not None
+                    else 0) != partition:
+                continue
+            spec.fired += 1
+            # slow, NOT dead: the sleep lands inside this partition's
+            # MEASURED step time, so its heartbeats keep flowing (the
+            # liveness monitor stays quiet) while the straggler detector
+            # sees the skew — the chaos oracle of the slow-vs-dead
+            # contract (docs/RESILIENCE.md)
+            events.emit_fault(
+                "slow_rank", point=point, epoch=epoch, partition=partition,
+                injected=True, rank=process_index(),
+            )
+            log.warning(
+                "injecting %.0f ms straggler sleep into partition %s at "
+                "epoch %s", spec.ms, partition, epoch,
+            )
+            time.sleep(spec.ms / 1000.0)
         elif spec.kind == "ckpt_corrupt":
             if spec.save is not None and spec.save != _save_count:
                 continue

@@ -496,6 +496,25 @@ class ReplicaSet:
             "per_replica": per,
         }
 
+    def telemetry_targets(self) -> List[str]:
+        """Per-replica ``/telemetry?replica=rK`` URLs off the shared
+        exporter — the fleet's poll targets for a cross-host hub
+        (obs/hub): each URL serves ONE replica's full-resolution
+        snapshot, so a hub pointed at them reconstructs the same merged
+        p99 this fleet computes in-process. Empty when no exporter is
+        armed (``NTS_METRICS_PORT`` unset)."""
+        exps = [r.server.exporter for r in self.replicas
+                if r.server is not None
+                and getattr(r.server, "exporter", None) is not None]
+        if not exps:
+            return []
+        exp = exps[0]  # maybe_start: one singleton port per process
+        host = os.environ.get("NTS_METRICS_HOST", "127.0.0.1")
+        return [
+            f"http://{host}:{exp.port}/telemetry?replica={r.rid}"
+            for r in self.replicas
+        ]
+
     def stream_paths(self) -> List[str]:
         """Every JSONL stream this fleet writes (replicas + front door) —
         what serve_bench merges its percentiles from."""

@@ -295,6 +295,7 @@ def render_serve(path: str, rec: Dict[str, Any],
     lines.extend(rec.get("_cost") or [])
     lines.extend(rec.get("_drift") or [])
     lines.extend(rec.get("_numerics") or [])
+    lines.extend(rec.get("_fleet") or [])
     lines.extend(rec.get("_hists") or [])
     lines.extend(rec.get("_slo") or [])
     lines.extend(rec.get("_trace") or [])
@@ -688,7 +689,8 @@ def recovery_timeline(events: List[Dict[str, Any]]) -> List[str]:
     lines: List[str] = []
     for e in events:
         if e["event"] not in ("fault", "recovery", "rank_loss", "replan",
-                              "stream_rotated", "nonfinite_provenance"):
+                              "stream_rotated", "nonfinite_provenance",
+                              "target_loss", "straggler"):
             continue
         detail = " ".join(
             f"{k}={e[k]}" for k in sorted(e)
@@ -753,6 +755,57 @@ def render_elastic(events: List[Dict[str, Any]],
     return lines
 
 
+def render_fleet(events: List[Dict[str, Any]]) -> List[str]:
+    """The telemetry-fabric block (obs/hub + obs/skew): hub/exporter
+    ``telemetry`` snapshots, every ``target_loss`` (the cross-host
+    analog of rank_loss), and every advisory ``straggler`` verdict.
+    Empty for streams the fabric never touched."""
+    telemetry = [e for e in events if e["event"] == "telemetry"]
+    losses = [e for e in events if e["event"] == "target_loss"]
+    stragglers = [e for e in events if e["event"] == "straggler"]
+    if not (telemetry or losses or stragglers):
+        return []
+    lines = ["fleet telemetry:"]
+    if telemetry:
+        hub = [e for e in telemetry if e.get("source") == "hub"]
+        lines.append(
+            f"#telemetry={len(telemetry)} snapshot(s)"
+            + (f" ({len(hub)} hub poll(s))" if hub else "")
+        )
+        last = (hub or telemetry)[-1]
+        if last.get("targets") is not None:
+            lines.append(
+                f"#fleet_targets={last.get('targets_ok')}/"
+                f"{last.get('targets')} ok, "
+                f"{last.get('targets_lost')} lost"
+            )
+        slo = last.get("slo")
+        if isinstance(slo, dict) and slo.get("objectives"):
+            lines.append(
+                f"#fleet_slo={slo.get('worst')} "
+                f"({slo.get('breaching')}/{slo.get('objectives')} "
+                "breaching)"
+            )
+    for e in losses:
+        lines.append(
+            f"#target_loss={e.get('target')} after "
+            f"{e.get('missed_polls')} missed poll(s) "
+            f"({e.get('reason', '?')}) — merged view continues on the "
+            "survivors"
+        )
+    for e in stragglers:
+        exc = e.get("excess")
+        lines.append(
+            f"#straggler=partition {e.get('partition')} at epoch "
+            f"{e.get('epoch')}"
+            + (f" (+{exc * 100:.0f}% over the fleet median"
+               if isinstance(exc, (int, float)) else " (")
+            + f", {e.get('consecutive')} consecutive) — "
+            "slow-but-alive, advisory (NOT a rank_loss)"
+        )
+    return lines
+
+
 def render_run(path: str, rec: Dict[str, Any]) -> str:
     """The reference-shaped #key=value(ms) block for one run."""
     et = rec.get("epoch_time", {})
@@ -798,6 +851,7 @@ def render_run(path: str, rec: Dict[str, Any]) -> str:
     lines.extend(rec.get("_drift") or [])
     lines.extend(rec.get("_numerics") or [])
     lines.extend(rec.get("_elastic") or [])
+    lines.extend(rec.get("_fleet") or [])
     lines.extend(render_sample(rec))
     lines.extend(rec.get("_hists") or [])
     lines.extend(rec.get("_slo") or [])
@@ -1092,7 +1146,32 @@ def main(argv=None) -> int:
         rec = summarize(p, events)
         srec = summarize_serve(events)
         probe_lines = render_probes(events)
+        fleet_lines = render_fleet(events)
         if rec is None and srec is None:
+            if fleet_lines:
+                # a hub's merged stream (obs/hub): no run behind it —
+                # the fabric block + merged hists + SLO timeline render
+                # it natively instead of "skipping"
+                rows.append({
+                    "event": "fleet_report",
+                    "run_id": events[-1]["run_id"] if events else "?",
+                    "telemetry_records": sum(
+                        1 for e in events if e["event"] == "telemetry"
+                    ),
+                    "target_losses": sum(
+                        1 for e in events if e["event"] == "target_loss"
+                    ),
+                    "stragglers": sum(
+                        1 for e in events if e["event"] == "straggler"
+                    ),
+                    "_path": p,
+                    "_fleet_only": True,
+                    "_fleet": fleet_lines,
+                    "_hists": render_hists(events),
+                    "_slo": slo_timeline(events),
+                    "_timeline": recovery_timeline(events),
+                })
+                continue
             if probe_lines:
                 # a probe-only stream (bench.py's backend check with no
                 # run behind it — every timed-out round since r05 looks
@@ -1137,6 +1216,7 @@ def main(argv=None) -> int:
             rec["_drift"] = drift_lines
             rec["_numerics"] = numerics_lines
             rec["_elastic"] = render_elastic(events, rec)
+            rec["_fleet"] = fleet_lines
             rec["_hists"] = hist_lines
             rec["_slo"] = slo_lines
             rec["_probe"] = probe_lines
@@ -1151,6 +1231,7 @@ def main(argv=None) -> int:
             )
             srec["_drift"] = drift_lines if rec is None else []
             srec["_numerics"] = numerics_lines if rec is None else []
+            srec["_fleet"] = fleet_lines if rec is None else []
             srec["_hists"] = hist_lines if rec is None else []
             srec["_slo"] = slo_lines if rec is None else []
             srec["_trace"] = trace_lines if rec is None else []
@@ -1167,13 +1248,25 @@ def main(argv=None) -> int:
             if rec.get("_probe_only"):
                 print(f"== backend probe — {rec['_path']}")
                 print("\n".join(rec["_probe"]))
+            elif rec.get("_fleet_only"):
+                lines = [f"== fleet {rec.get('run_id', '?')} — "
+                         f"{rec['_path']}"]
+                lines.extend(rec["_fleet"])
+                lines.extend(rec.get("_hists") or [])
+                lines.extend(rec.get("_slo") or [])
+                timeline = rec.get("_timeline") or []
+                if timeline:
+                    lines.append("recovery timeline:")
+                    lines.extend(timeline)
+                print("\n".join(lines))
             elif rec.get("_serve"):
                 print(render_serve(rec["_path"], rec, rec["_events"]))
             else:
                 print(render_run(rec["_path"], rec))
             print()
         train_rows = [r for r in rows if not r.get("_serve")
-                      and not r.get("_probe_only")]
+                      and not r.get("_probe_only")
+                      and not r.get("_fleet_only")]
         if len(train_rows) > 1:
             print(render_table(train_rows))
     return 1 if failed else 0

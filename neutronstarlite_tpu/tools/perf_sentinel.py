@@ -40,6 +40,13 @@ Usage:
       [--suite-budget S] [--suite-fatal] [--json]
   python -m neutronstarlite_tpu.tools.perf_sentinel record-suite
       --duration S --dots N --rc RC --timeout S [--ledger DIR]
+  python -m neutronstarlite_tpu.tools.perf_sentinel list-keys
+      [--ledger DIR] [--json]     (also: perf_sentinel --list-keys)
+
+``list-keys`` enumerates the distinct (kind, graph digest, cfg,
+backend) trajectories the ledger holds with row counts and last-seen
+timestamps — the first stop when a check says "min-baseline not met"
+(usually the key changed: new backend fingerprint, new cfg, new graph).
 """
 
 from __future__ import annotations
@@ -49,6 +56,7 @@ import json
 import os
 import statistics
 import sys
+import time
 from typing import Any, Dict, List, Optional
 
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -57,6 +65,14 @@ if REPO not in sys.path:
 
 from neutronstarlite_tpu.obs import ledger  # noqa: E402
 from neutronstarlite_tpu.obs.ledger import as_number as _num  # noqa: E402
+# the robust median+MAD tolerance math lives in obs/skew.py now — ONE
+# definition shared with the live straggler detector (which applies the
+# identical law to per-partition epoch times); re-exported here under the
+# historical names so existing callers keep working
+from neutronstarlite_tpu.obs.skew import (  # noqa: E402,F401
+    baseline_stats,
+    effective_tolerance,
+)
 
 # lower-is-better scalars gated per row kind; hist p99s join dynamically
 GATED_METRICS = {
@@ -79,6 +95,10 @@ GATED_METRICS = {
     # latency + shed rate trend-gate exactly like epoch time — the key
     # embeds mode/replicas/CB so trajectories never mix load shapes
     "serve": ("p50_ms", "p95_ms", "p99_ms", "shed_rate"),
+    # fleet rows (obs/hub.fleet_row): the hub's merged cross-host view —
+    # the fleet-wide latency tails ride in via hist_quantiles (below),
+    # so the scalar tuple only carries the liveness-shaped metrics
+    "fleet": ("targets_lost",),
 }
 
 SUITE_MARGIN_FRAC = 0.8  # the ROADMAP "watch the margin" note as a number
@@ -100,22 +120,32 @@ def _metric_values(row: Dict[str, Any], kind: str) -> Dict[str, float]:
     return out
 
 
-def baseline_stats(vals: List[float]) -> Dict[str, float]:
-    """median + MAD of a baseline window."""
-    med = float(statistics.median(vals))
-    mad = float(statistics.median([abs(v - med) for v in vals]))
-    return {"median": med, "mad": mad, "n": len(vals)}
-
-
-def effective_tolerance(med: float, mad: float, nsigma: float,
-                        floor: float, max_tol: float) -> float:
-    """The RELATIVE tolerance for one metric: the window's own MAD-scaled
-    noise estimate, floored (a dead-quiet history must not gate at 0%)
-    and capped (a wild history must not wave everything through)."""
-    if med <= 0:
-        return floor
-    rel = nsigma * 1.4826 * mad / med
-    return min(max(rel, floor), max_tol)
+def list_keys(rows: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """The distinct (kind, graph digest, cfg, backend) trajectories a
+    ledger holds, with row counts and first/last-seen timestamps —
+    the answer to "why does the sentinel say min-baseline not met"
+    without hand-grepping JSONL."""
+    groups: Dict[tuple, Dict[str, Any]] = {}
+    for r in rows:
+        key = ledger.row_key(r)
+        g = groups.get(key)
+        ts = _num(r.get("ts"))
+        if g is None:
+            g = groups[key] = {
+                "kind": key[0], "graph_digest": key[1], "cfg": key[2],
+                "backend": key[3], "rows": 0,
+                "first_ts": ts, "last_ts": ts,
+            }
+        g["rows"] += 1
+        if ts is not None:
+            if g["first_ts"] is None or ts < g["first_ts"]:
+                g["first_ts"] = ts
+            if g["last_ts"] is None or ts > g["last_ts"]:
+                g["last_ts"] = ts
+    return sorted(
+        groups.values(),
+        key=lambda g: (str(g["kind"]), -(g["last_ts"] or 0.0)),
+    )
 
 
 def check(rows: List[Dict[str, Any]], kind: str, k: int, min_baseline: int,
@@ -300,9 +330,25 @@ def main(argv=None) -> int:
     rec.add_argument("--rc", type=int, required=True)
     rec.add_argument("--timeout", type=float, required=True)
 
+    lk = sub.add_parser("list-keys", help="enumerate the distinct "
+                        "(kind, graph digest, cfg, backend) trajectories "
+                        "with row counts and last-seen timestamps")
+    lk.add_argument("--ledger", default=None)
+    lk.add_argument("--json", action="store_true")
+
+    ap.add_argument("--list-keys", action="store_true",
+                    dest="list_keys_flag",
+                    help="shorthand for the list-keys subcommand "
+                    "(ledger from NTS_LEDGER_DIR)")
+
     args = ap.parse_args(argv)
+    if args.cmd is None and args.list_keys_flag:
+        args.cmd = "list-keys"
+        args.ledger = None
+        args.json = False
     if args.cmd is None:
-        ap.error("a subcommand is required (check | record-suite)")
+        ap.error("a subcommand is required (check | record-suite | "
+                 "list-keys)")
 
     directory = args.ledger or ledger.ledger_dir()
     if not directory:
@@ -334,6 +380,33 @@ def main(argv=None) -> int:
               "ever recorded here, or the path is wrong)", file=sys.stderr)
         return 1
     rows = ledger.read_rows(directory=directory)
+
+    if args.cmd == "list-keys":
+        keys = list_keys(rows)
+        if args.json:
+            print(json.dumps({"ledger": path, "keys": keys}))
+            return 0
+        print(f"perf_sentinel: {len(keys)} trajectory key(s) across "
+              f"{len(rows)} row(s) in {path}")
+        header = ("kind", "graph_digest", "cfg", "backend", "rows",
+                  "last_seen")
+        table = [header]
+        for g in keys:
+            last = g["last_ts"]
+            last_s = (
+                time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(last))
+                if last is not None else "-"
+            )
+            table.append((
+                str(g["kind"]), str(g["graph_digest"])[:16], str(g["cfg"]),
+                str(g["backend"])[:24], str(g["rows"]), last_s,
+            ))
+        widths = [max(len(r[i]) for r in table) for i in range(len(header))]
+        for row in table:
+            print("  ".join(c.ljust(w) for c, w in zip(row, widths))
+                  .rstrip())
+        return 0
+
     result = check(
         rows, args.kind, args.k, args.min_baseline, args.nsigma,
         args.floor, args.max_tol, suite_budget=args.suite_budget,

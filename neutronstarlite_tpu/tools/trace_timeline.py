@@ -104,6 +104,7 @@ class Stream:
         self.rank = stream_rank(events, path)
         self.offset = mono_wall_offset(events)  # mono -> wall (step 1)
         self.align = 0.0  # cross-rank shift (step 2)
+        self.align_warning: Optional[str] = None  # set by align_streams
         self.run_id = next(
             (e["run_id"] for e in events if e.get("run_id")), "?"
         )
@@ -132,19 +133,52 @@ def align_streams(streams: List["Stream"]) -> None:
     """Epoch-marker alignment (docstring step 2), in place: the lowest
     rank with epoch spans anchors; every other stream shifts by the median
     epoch-end difference over shared epochs. Streams sharing no epochs
-    (e.g. a serve-only stream next to a training stream) keep wall time."""
+    (e.g. a serve-only stream next to a training stream) keep wall time.
+
+    Failure mode is WARN, not crash: a span-bearing stream with no epoch
+    markers (or none shared with the anchor) cannot be cross-rank
+    corrected — it keeps its own wall clock (``align=0``), which may sit
+    skewed against the other ranks by each host's clock error. The
+    stream's ``align_warning`` names the reason and a stderr line
+    surfaces it, so a skewed-looking timeline says WHY instead of
+    silently interleaving misaligned ranks."""
     anchored = sorted(
         (s for s in streams if s.epoch_ends()), key=lambda s: s.rank
     )
     if not anchored:
+        for s in streams:
+            if any(True for _ in spans_of(s.events)):
+                s.align_warning = (
+                    "no stream carries epoch spans: cross-rank alignment "
+                    "skipped (each stream keeps its own wall clock)"
+                )
+                print(f"{s.path}: {s.align_warning}", file=sys.stderr)
         return
     ref = anchored[0].epoch_ends()
+    aligned_ids = {id(a) for a in anchored}
     for s in anchored[1:]:
         own = s.epoch_ends()
         deltas = [ref[e] - own[e] for e in ref.keys() & own.keys()]
         d = _median(deltas)
         if d is not None:
             s.align = d
+        else:
+            s.align_warning = (
+                f"shares no epochs with the anchor (rank "
+                f"{anchored[0].rank}): cross-rank alignment skipped for "
+                "this stream (kept on its own wall clock)"
+            )
+            print(f"{s.path}: warning: {s.align_warning}", file=sys.stderr)
+    for s in streams:
+        if id(s) in aligned_ids or s.offset is None:
+            continue
+        # spans but no epoch markers at all (a serve/probe stream, or a
+        # trainer that died before epoch 0 closed)
+        s.align_warning = (
+            "stream has spans but no epoch markers: cross-rank alignment "
+            "skipped for this stream (kept on its own wall clock)"
+        )
+        print(f"{s.path}: warning: {s.align_warning}", file=sys.stderr)
 
 
 def load_streams(paths: List[str]) -> List[Stream]:

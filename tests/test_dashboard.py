@@ -1,0 +1,190 @@
+"""tools/dashboard: the fabric model and the self-contained renderer.
+
+Pinned: fabric_model digests a hub stream (telemetry / target_loss /
+recovery / straggler / heartbeat.seconds records) into the panel data;
+render_html emits ONE asset-free document containing every panel; the
+CLI renders a real hub stream end-to-end with exit 0 (the HUB_GATE
+invocation); watch mode summarizes the same model on one line; and a
+live-URL snapshot normalizes to /telemetry and validates every record.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from collections import OrderedDict
+
+import pytest
+
+from neutronstarlite_tpu.obs import exporter, registry
+from neutronstarlite_tpu.obs.hub import TelemetryHub
+from neutronstarlite_tpu.tools import dashboard
+
+
+def _hub_stream(tmp_path, lose_r1=True):
+    """A real merged stream: one live source, one (optionally) dying."""
+    reg = registry.MetricsRegistry(
+        "serve-r0-9", algorithm="SERVE", fingerprint="f",
+        path=str(tmp_path / "src.jsonl"),
+    )
+    for v in (5.0, 7.0, 9.0, 250.0):
+        reg.hist_observe("serve.latency_ms", v)
+
+    def fetch(url):
+        if lose_r1 and "r1" in url:
+            raise OSError("down")
+        return exporter.telemetry_ndjson(
+            OrderedDict([("", (reg, None))]), time.time()
+        )
+
+    hub_path = tmp_path / "hub.jsonl"
+    h = TelemetryHub(
+        ["r0.local:1", "r1.local:1"], miss_k=1,
+        registry=registry.MetricsRegistry(
+            "hub-none-9", algorithm="HUB", fingerprint="f",
+            path=str(hub_path)),
+        fetch=fetch,
+    )
+    try:
+        h.poll_once()
+        h.poll_once()
+        # per-partition timings for the heat strip + a straggler verdict
+        h.registry.event("heartbeat", partition=0, epoch=0, seconds=1.0)
+        h.registry.event("heartbeat", partition=1, epoch=0, seconds=2.1)
+        h.registry.event("heartbeat", partition=0, epoch=1, seconds=1.0)
+        h.registry.event("heartbeat", partition=1, epoch=1, seconds=2.2)
+        h.registry.event(
+            "straggler", partition=1, epoch=1, seconds=2.2, median_s=1.0,
+            mad_s=0.0, threshold_s=1.25, excess=1.2, consecutive=2,
+            source="heartbeat",
+        )
+    finally:
+        h.registry.close()
+    reg.close()
+    return hub_path
+
+
+def test_fabric_model_over_a_real_hub_stream(tmp_path):
+    path = _hub_stream(tmp_path)
+    events = dashboard.load_stream_events([str(path)])
+    model = dashboard.fabric_model(events)
+
+    assert model["polls"] == 2
+    assert model["last"]["targets_ok"] == 1
+    assert model["last"]["targets_lost"] == 1
+    (target, info), = model["targets"].items()
+    assert "r1.local" in target and info["state"] == "LOST"
+    q = model["quantiles"]["serve.latency_ms"]
+    assert q["count"] == 4
+    assert abs(q["p99"] - 250.0) / 250.0 <= 0.011
+    assert model["heat"][1][1] == pytest.approx(2.2)
+    assert [s["partition"] for s in model["stragglers"]] == [1]
+
+
+def test_fabric_model_rejoin_supersedes_loss():
+    events = [
+        {"event": "target_loss", "target": "t", "ts": 1.0,
+         "missed_polls": 3},
+        {"event": "recovery", "action": "target_rejoin", "target": "t",
+         "ts": 2.0},
+    ]
+    model = dashboard.fabric_model(events)
+    assert model["targets"]["t"]["state"] == "ok"
+    assert model["targets"]["t"]["rejoined"] is True
+    # the reverse order (loss after rejoin) stays LOST
+    events[0]["ts"], events[1]["ts"] = 2.0, 1.0
+    assert dashboard.fabric_model(events)["targets"]["t"]["state"] == "LOST"
+
+
+def test_render_html_contains_every_panel(tmp_path):
+    path = _hub_stream(tmp_path)
+    events = dashboard.load_stream_events([str(path)])
+    fleet_rows = [
+        {"kind": "fleet",
+         "hist_quantiles": {"serve.latency_ms": {"count": 4, "p50": 7.0,
+                                                 "p95": 250.0,
+                                                 "p99": 250.0}}},
+    ]
+    doc = dashboard.render_html(dashboard.fabric_model(events, fleet_rows))
+    assert doc.startswith("<!doctype html>")
+    for needle in (
+        "DEGRADED", "fleet topology", "fleet health (per poll)",
+        "latency quantiles (exact merge)", "straggler heat strip",
+        "serve.latency_ms", "LOST", "slow-but-alive, advisory",
+        "<svg class=\"spark\"", "NOT the /metrics ladder's",
+    ):
+        assert needle in doc, f"panel marker {needle!r} missing"
+    # self-contained: no external asset references
+    assert "<link" not in doc and "<script" not in doc
+
+
+def test_render_html_empty_input_is_a_valid_fleet_state():
+    doc = dashboard.render_html(dashboard.fabric_model([]))
+    assert "no hub poll records" in doc
+    assert "no targets seen" in doc
+    assert "no histograms" in doc
+    assert "no per-partition timings" in doc
+
+
+def test_sparkline_edge_cases():
+    assert "polyline" not in dashboard.sparkline([])
+    assert "polyline" not in dashboard.sparkline([None, None])
+    one = dashboard.sparkline([3.0])
+    assert "polyline" in one
+    flat = dashboard.sparkline([2.0, 2.0, 2.0])  # zero span must not /0
+    assert "polyline" in flat
+    assert "polyline" in dashboard.sparkline([1.0, None, 2.0])
+
+
+def test_watch_line_summarizes_the_model(tmp_path):
+    path = _hub_stream(tmp_path)
+    events = dashboard.load_stream_events([str(path)])
+    line = dashboard.watch_line(dashboard.fabric_model(events))
+    assert "1/2 ok" in line and "(1 LOST)" in line
+    assert "serve.latency_ms p99=" in line
+    assert "stragglers=1" in line
+    assert dashboard.watch_line(dashboard.fabric_model([])).endswith(
+        "no hub polls yet"
+    )
+
+
+def test_main_renders_stream_to_html(tmp_path, monkeypatch, capsys):
+    monkeypatch.delenv("NTS_LEDGER_DIR", raising=False)
+    path = _hub_stream(tmp_path)
+    out = tmp_path / "dash.html"
+    rc = dashboard.main(["--stream", str(path), "--out", str(out)])
+    assert rc == 0
+    doc = out.read_text()
+    assert "straggler heat strip" in doc and "DEGRADED" in doc
+    assert "wrote" in capsys.readouterr().err
+
+
+def test_main_watch_mode_bounded(tmp_path, monkeypatch, capsys):
+    monkeypatch.delenv("NTS_LEDGER_DIR", raising=False)
+    path = _hub_stream(tmp_path, lose_r1=False)
+    rc = dashboard.main(["--stream", str(path), "--watch", "--polls", "2",
+                         "--interval", "0"])
+    assert rc == 0
+    lines = [l for l in capsys.readouterr().out.splitlines() if l]
+    assert len(lines) == 2 and all("2/2 ok" in l for l in lines)
+
+
+def test_main_unreadable_input_exits_1(tmp_path, capsys):
+    rc = dashboard.main(["--stream", str(tmp_path / "missing.jsonl")])
+    assert rc == 1
+    assert "cannot load input" in capsys.readouterr().err
+
+
+def test_fetch_url_events_normalizes_and_validates(exporter_fixture=None):
+    reg = registry.MetricsRegistry("run-exp", algorithm="SERVE",
+                                   fingerprint="f")
+    reg.hist_observe("serve.latency_ms", 5.0)
+    exp = exporter.MetricsExporter(reg, port=0)
+    try:
+        for url in (f"127.0.0.1:{exp.port}",
+                    f"http://127.0.0.1:{exp.port}/"):
+            events = dashboard.fetch_url_events(url)
+            assert any(e["event"] == "telemetry" for e in events)
+            assert any(e["event"] == "hist" for e in events)
+    finally:
+        exp.close()

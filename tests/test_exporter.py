@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import json
 import threading
+import urllib.error
 import urllib.request
 
 import pytest
@@ -215,3 +216,169 @@ def test_maybe_start_gated_and_rebinds(monkeypatch):
     finally:
         exp.close()
         monkeypatch.setattr(exp_mod, "_singleton", None)
+
+
+# ---- the /metrics ladder knob (NTS_METRICS_LADDER) -------------------------
+
+
+def test_prom_edges_ladder_knob(monkeypatch):
+    import neutronstarlite_tpu.obs.hist as hist_mod
+
+    monkeypatch.delenv("NTS_METRICS_LADDER", raising=False)
+    assert hist_mod.prom_edges() is hist_mod.PROM_EDGES_MS
+
+    monkeypatch.setenv("NTS_METRICS_LADDER", "1, 2, 4, 8")
+    assert hist_mod.prom_edges() == [1.0, 2.0, 4.0, 8.0]
+    # the cache keys on the raw knob value: changing it takes effect
+    monkeypatch.setenv("NTS_METRICS_LADDER", "0.5,5,50")
+    assert hist_mod.prom_edges() == [0.5, 5.0, 50.0]
+
+
+@pytest.mark.parametrize("bad", ["5,3", "0,1,2", "-1,1", "a,b", "1,1,2"])
+def test_prom_edges_bad_ladder_falls_back(monkeypatch, bad):
+    """A malformed knob must WARN and fall back, never break a scrape."""
+    import neutronstarlite_tpu.obs.hist as hist_mod
+
+    monkeypatch.setenv("NTS_METRICS_LADDER", bad)
+    assert hist_mod.prom_edges() == hist_mod.PROM_EDGES_MS
+
+
+def test_ladder_knob_changes_scrape(monkeypatch):
+    monkeypatch.setenv("NTS_METRICS_LADDER", "1,10,100")
+    reg = make_registry()
+    for v in (0.5, 5.0, 50.0, 500.0):
+        reg.hist_observe("serve.latency_ms", v)
+    txt = prometheus_text(reg)
+    les = [
+        line.split('le="', 1)[1].split('"', 1)[0]
+        for line in txt.splitlines()
+        if line.startswith("nts_serve_latency_ms_bucket{")
+    ]
+    assert les == ["1", "10", "100", "+Inf"]
+
+
+# ---- /telemetry: the full-resolution side channel --------------------------
+
+
+def _telemetry_events(port, path="/telemetry"):
+    status, body = get(port, path)
+    assert status == 200
+    return [json.loads(line) for line in body.splitlines() if line.strip()]
+
+
+def test_telemetry_schema_valid_and_native_buckets(exporter):
+    from neutronstarlite_tpu.obs import schema
+    from neutronstarlite_tpu.obs.hist import LogHistogram
+
+    reg, exp = exporter
+    reg.counter_add("serve.requests", 3)
+    reg.gauge_set("serve.queue_depth", 2)
+    for v in (1.0, 3.0, 70.0, 71.0, 900.0):
+        reg.hist_observe("serve.latency_ms", v)
+
+    events = _telemetry_events(exp.port)
+    assert schema.validate_stream(events) == len(events)
+    kinds = {e["event"] for e in events}
+    assert "telemetry" in kinds and "hist" in kinds
+
+    top = next(e for e in events if e["event"] == "telemetry")
+    assert top["source"] == "exporter"
+    assert top["counters"]["serve.requests"] == 3
+    assert top["run_id"] == reg.run_id
+    assert top["health"]["ok"] is True
+
+    # the hist record carries NATIVE buckets: reconstructing it gives the
+    # registry's own quantiles exactly, not a ladder approximation
+    hrec = next(e for e in events if e["event"] == "hist")
+    rebuilt = LogHistogram.from_dict(hrec)
+    native = reg.hists()["serve.latency_ms"]
+    assert rebuilt.count == native.count
+    for q in (0.5, 0.95, 0.99):
+        assert rebuilt.quantile(q) == native.quantile(q)
+
+
+def test_telemetry_replica_filter_and_404(exporter):
+    reg, exp = exporter
+    reg.hist_observe("serve.latency_ms", 5.0)
+    reg_b = registry.MetricsRegistry("run-exp-b", algorithm="SERVE",
+                                     fingerprint="f")
+    reg_b.hist_observe("serve.latency_ms", 7.0)
+    exp.rebind(reg, replica="r0")
+    exp.rebind(reg_b, replica="r1")
+
+    events = _telemetry_events(exp.port, "/telemetry?replica=r1")
+    tops = [e for e in events if e["event"] == "telemetry"]
+    assert len(tops) == 1 and tops[0]["replica"] == "r1"
+    assert tops[0]["run_id"] == reg_b.run_id
+
+    status, body = 0, ""
+    try:
+        status, body = get(exp.port, "/telemetry?replica=nope")
+    except urllib.error.HTTPError as e:
+        status, body = e.code, e.read().decode()
+    assert status == 404
+    payload = json.loads(body)
+    assert sorted(payload["replicas"]) == ["r0", "r1"]
+
+
+# ---- the documented lossiness pin (why /telemetry exists) ------------------
+
+
+def _ladder_p99(txt, family="nts_serve_latency_ms"):
+    """Client-side p99 the way a Prometheus consumer would estimate it
+    from the ladder: smallest bucket edge whose cumulative count covers
+    the 99th percentile rank (upper-edge convention)."""
+    cum = []
+    for line in txt.splitlines():
+        if line.startswith(family + '_bucket{'):
+            le = line.split('le="', 1)[1].split('"', 1)[0]
+            n = int(line.rsplit(" ", 1)[1])
+            cum.append((float("inf") if le == "+Inf" else float(le), n))
+    total = cum[-1][1]
+    rank = 0.99 * total
+    for edge, n in cum:
+        if n >= rank:
+            return edge
+    return cum[-1][0]
+
+
+def test_ladder_p99_is_lossy_but_telemetry_merge_is_not(monkeypatch):
+    """The pin behind OBSERVABILITY.md's lossiness bound: on a
+    distribution clustered BETWEEN ladder edges, the /metrics ladder's
+    p99 errs far beyond the native histogram's documented ~1% relative
+    error, while a /telemetry-reconstructed (and cross-surface merged)
+    p99 stays within ~2.1% (two half-bucket roundings)."""
+    from neutronstarlite_tpu.obs.hist import latest_hists
+
+    monkeypatch.delenv("NTS_METRICS_LADDER", raising=False)
+    # 70 ms sits between the default ladder's 50 and 100 edges
+    true_ms = 70.0
+    reg_a = make_registry()
+    reg_b = registry.MetricsRegistry("run-exp-b", algorithm="SERVE",
+                                     fingerprint="f")
+    exp = MetricsExporter(reg_a, port=0)
+    try:
+        exp.rebind(reg_a, replica="r0")
+        exp.rebind(reg_b, replica="r1")
+        for _ in range(500):
+            reg_a.hist_observe("serve.latency_ms", true_ms)
+            reg_b.hist_observe("serve.latency_ms", true_ms * 1.01)
+
+        status, txt = get(exp.port, "/metrics")
+        assert status == 200
+        ladder_err = abs(_ladder_p99(txt) - true_ms) / true_ms
+        assert ladder_err > 0.021, (
+            f"ladder p99 unexpectedly accurate ({ladder_err:.3f}) — the "
+            "documented lossiness bound no longer holds"
+        )
+
+        events = _telemetry_events(exp.port)  # both surfaces, native buckets
+        merged = latest_hists(events)["serve.latency_ms"]
+        assert merged.count == 1000
+        exact_err = abs(merged.quantile(0.99) - true_ms * 1.01) / true_ms
+        assert exact_err <= 0.021, (
+            f"/telemetry-merged p99 outside the documented bound "
+            f"({exact_err:.4f})"
+        )
+    finally:
+        exp.close()

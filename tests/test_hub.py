@@ -1,0 +1,298 @@
+"""obs/hub: the cross-host telemetry aggregation hub.
+
+Contract under test: the hub polls /telemetry payloads (driven here via
+the injectable ``fetch`` — no sockets), reconstructs native-bucket
+histograms, and merges them by the exact bucket-addition law, so fleet
+quantiles match a client-side exact sort within the histogram's
+documented ~1% relative bucket error. A dead target is a typed
+``target_loss`` record and a frozen snapshot, never an exception; a
+returning target is a ``recovery`` record. The merged stream is
+schema-valid and renders natively in metrics_report.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import time
+from collections import OrderedDict
+
+import pytest
+
+from neutronstarlite_tpu.obs import exporter, hub as hub_mod, registry, schema
+from neutronstarlite_tpu.obs.hist import LogHistogram
+from neutronstarlite_tpu.obs.hub import TelemetryHub, normalize_target
+
+
+# ---- rig: fake targets backed by real registries ---------------------------
+
+
+def _source(run_id, tmp_path, values):
+    reg = registry.MetricsRegistry(
+        run_id, algorithm="SERVE", fingerprint="f",
+        path=str(tmp_path / f"{run_id}.jsonl"),
+    )
+    for v in values:
+        reg.hist_observe("serve.latency_ms", v)
+    return reg
+
+
+def _payload(reg):
+    """What a real exporter would serve on /telemetry for this run."""
+    return exporter.telemetry_ndjson(
+        OrderedDict([("", (reg, None))]), time.time()
+    )
+
+
+def _hub_registry(tmp_path):
+    return registry.MetricsRegistry(
+        "hub-none-0", algorithm="HUB", fingerprint="f",
+        path=str(tmp_path / "hub.jsonl"),
+    )
+
+
+def _exact_p99(values):
+    s = sorted(values)
+    return s[min(len(s) - 1, math.ceil(0.99 * len(s)) - 1)]
+
+
+def _stream_events(path):
+    events = [json.loads(ln) for ln in open(path) if ln.strip()]
+    assert schema.validate_stream(events) == len(events)
+    return events
+
+
+# ---- target normalization / construction -----------------------------------
+
+
+@pytest.mark.parametrize("raw,want", [
+    ("host:9100", "http://host:9100/telemetry"),
+    ("http://host:9100", "http://host:9100/telemetry"),
+    ("  10.0.0.2:9101 ", "http://10.0.0.2:9101/telemetry"),
+    ("http://h:1/telemetry?replica=r1", "http://h:1/telemetry?replica=r1"),
+    ("https://h:1/custom/path", "https://h:1/custom/path"),
+])
+def test_normalize_target(raw, want):
+    assert normalize_target(raw) == want
+
+
+def test_hub_requires_targets(tmp_path):
+    with pytest.raises(ValueError):
+        TelemetryHub([])
+
+
+def test_hub_env_knobs(monkeypatch):
+    monkeypatch.setenv("NTS_HUB_TARGETS", "a:1, b:2 ,")
+    monkeypatch.setenv("NTS_HUB_POLL_S", "0.5")
+    monkeypatch.setenv("NTS_HUB_MISS_K", "7")
+    assert hub_mod.hub_targets() == ["a:1", "b:2"]
+    assert hub_mod.hub_poll_s() == 0.5
+    assert hub_mod.hub_miss_k() == 7
+    monkeypatch.setenv("NTS_HUB_POLL_S", "fast")
+    monkeypatch.setenv("NTS_HUB_MISS_K", "many")
+    assert hub_mod.hub_poll_s() == hub_mod.DEFAULT_POLL_S
+    assert hub_mod.hub_miss_k() == hub_mod.DEFAULT_MISS_K
+
+
+# ---- the exact merge law ---------------------------------------------------
+
+
+def test_three_target_merge_matches_exact_sort(tmp_path):
+    """The acceptance pin: fleet p99 over 3 targets equals the
+    client-side exact sort within the histogram's ~1% bucket error
+    (asserted at 2.1% — two half-bucket roundings)."""
+    vals = {
+        "r0": [float(i) for i in range(1, 101)],          # 1..100 ms
+        "r1": [10.0 + 0.5 * i for i in range(200)],       # 10..109.5
+        "r2": [250.0] * 20 + [5.0] * 80,                  # bimodal tail
+    }
+    regs = {k: _source(f"serve-{k}-1", tmp_path, v) for k, v in vals.items()}
+    fetch = lambda url: _payload(regs[url.split("//", 1)[1].split(".", 1)[0]])
+    h = TelemetryHub(["r0.local:1", "r1.local:1", "r2.local:1"],
+                     registry=_hub_registry(tmp_path), fetch=fetch)
+    try:
+        summary = h.poll_once()
+        assert summary["targets_ok"] == 3 and summary["targets_lost"] == 0
+
+        merged = h.merged_hists()["serve.latency_ms"]
+        all_vals = [v for vs in vals.values() for v in vs]
+        assert merged.count == len(all_vals)
+        exact = _exact_p99(all_vals)
+        assert abs(merged.quantile(0.99) - exact) / exact <= 0.021
+
+        # the same merged view is installed on the hub's own registry, so
+        # the stock exporter serves the FLEET histograms
+        own = h.registry.hists()["serve.latency_ms"]
+        assert own.count == merged.count
+        assert own.quantile(0.99) == merged.quantile(0.99)
+    finally:
+        h.registry.close()
+    for r in regs.values():
+        r.close()
+
+
+# ---- liveness: miss-K, the latch, freeze, rejoin ---------------------------
+
+
+class _FlakyFetch:
+    """Scripted per-target availability: a list of booleans per poll."""
+
+    def __init__(self, regs, down):
+        self.regs = regs      # key -> registry
+        self.down = down      # key -> set of poll indices that fail
+        self.poll = -1
+
+    def begin_poll(self):
+        self.poll += 1
+
+    def __call__(self, url):
+        key = url.split("//", 1)[1].split(".", 1)[0]
+        if self.poll in self.down.get(key, set()):
+            raise OSError("connection refused")
+        return _payload(self.regs[key])
+
+
+def test_target_loss_latch_freeze_and_rejoin(tmp_path):
+    regs = {
+        "r0": _source("serve-r0-2", tmp_path, [10.0] * 50),
+        "r1": _source("serve-r1-2", tmp_path, [20.0] * 50),
+    }
+    fetch = _FlakyFetch(regs, down={"r1": {1, 2, 3, 4}})
+    hub_path = tmp_path / "hub.jsonl"
+    h = TelemetryHub(
+        ["r0.local:1", "r1.local:1"], miss_k=2,
+        registry=registry.MetricsRegistry(
+            "hub-none-1", algorithm="HUB", fingerprint="f",
+            path=str(hub_path)),
+        fetch=fetch,
+    )
+    try:
+        summaries = []
+        for _ in range(6):
+            fetch.begin_poll()
+            summaries.append(h.poll_once())
+
+        # polls 1..4 fail for r1: lost latches at poll index 2 (miss 2)
+        assert [s["targets_lost"] for s in summaries] == [0, 0, 1, 1, 1, 0]
+        # the frozen snapshot keeps r1's 50 observations in the merge
+        assert all(s["hists"]["serve.latency_ms"] == 100 for s in summaries)
+
+        events = _stream_events(hub_path)
+        losses = [e for e in events if e["event"] == "target_loss"]
+        assert len(losses) == 1, "the loss must latch: ONE record per loss"
+        assert losses[0]["reason"] == "poll_miss"
+        assert losses[0]["miss_k"] == 2
+        assert "r1.local" in losses[0]["target"]
+        rejoins = [e for e in events if e["event"] == "recovery"
+                   and e.get("action") == "target_rejoin"]
+        assert len(rejoins) == 1 and "r1.local" in rejoins[0]["target"]
+
+        # the hub block in health_payload: degraded-but-ALIVE while lost
+        h2 = TelemetryHub(["r0.local:1", "r1.local:1"], miss_k=1,
+                          registry=_hub_registry(tmp_path), fetch=fetch)
+        fetch.down["r1"] = set(range(100))
+        fetch.begin_poll()
+        h2.poll_once()
+        payload = exporter.health_payload(h2.registry, h2.started_at)
+        assert payload["hub"]["degraded"] is True
+        assert payload["hub"]["targets_lost"] == 1
+        assert payload["ok"] is True  # one target still answers
+        h2.registry.close()
+    finally:
+        h.registry.close()
+    for r in regs.values():
+        r.close()
+
+
+def test_never_answered_and_bad_payload_are_misses(tmp_path):
+    responses = {"r0": "{not json", "r1": '{"event": "bogus_kind"}\n'}
+    fetch = lambda url: responses[url.split("//", 1)[1].split(".", 1)[0]]
+    h = TelemetryHub(["r0.local:1", "r1.local:1"], miss_k=2,
+                     registry=_hub_registry(tmp_path), fetch=fetch)
+    try:
+        h.poll_once()
+        h.poll_once()
+        events = _stream_events(h.registry.path)
+        losses = [e for e in events if e["event"] == "target_loss"]
+        assert len(losses) == 2
+        assert all(l["reason"] == "never_answered" for l in losses)
+        assert all(l["last_ok_ts"] is None for l in losses)
+    finally:
+        h.registry.close()
+
+
+# ---- the hub stream is an ordinary obs citizen -----------------------------
+
+
+def test_hub_stream_renders_in_metrics_report(tmp_path, capsys):
+    reg = _source("serve-r0-3", tmp_path, [5.0, 7.0, 9.0])
+    fetch = _FlakyFetch({"r0": reg, "r1": reg}, down={"r1": set(range(9))})
+    hub_path = tmp_path / "hubstream.jsonl"
+    h = TelemetryHub(
+        ["r0.local:1", "r1.local:1"], miss_k=1,
+        registry=registry.MetricsRegistry(
+            "hub-none-2", algorithm="HUB", fingerprint="f",
+            path=str(hub_path)),
+        fetch=fetch,
+    )
+    try:
+        for _ in range(2):
+            fetch.begin_poll()
+            h.poll_once()
+    finally:
+        h.registry.close()
+    reg.close()
+
+    from neutronstarlite_tpu.tools.metrics_report import main as report_main
+
+    rc = report_main([str(hub_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "#telemetry=" in out
+    assert "#fleet_targets=1/2 ok, 1 lost" in out
+    assert "#target_loss=" in out
+    assert "#hist_serve.latency_ms=" in out
+
+
+def test_fleet_ledger_row_and_gating(tmp_path):
+    from neutronstarlite_tpu.obs import ledger
+    from neutronstarlite_tpu.tools.perf_sentinel import GATED_METRICS
+
+    reg = _source("serve-r0-4", tmp_path, [10.0] * 100)
+    fetch = lambda url: _payload(reg)
+    ldir = tmp_path / "ledger"
+    h = TelemetryHub(["r0.local:1"], registry=_hub_registry(tmp_path),
+                     ledger_dir=str(ldir), fetch=fetch)
+    try:
+        h.poll_once()
+        h.poll_once()
+    finally:
+        h.registry.close()
+    reg.close()
+
+    rows = ledger.read_rows(directory=str(ldir))
+    fleet = [r for r in rows if r["kind"] == "fleet"]
+    assert len(fleet) == 2
+    row = fleet[-1]
+    assert row["targets"] == 1 and row["targets_ok"] == 1
+    assert row["targets_lost"] == 0 and row["polls"] == 2
+    hq = row["hist_quantiles"]["serve.latency_ms"]
+    assert hq["count"] == 100
+    assert abs(hq["p99"] - 10.0) / 10.0 <= 0.011
+    # the fleet trajectory is perf_sentinel-gated on targets_lost
+    assert "targets_lost" in GATED_METRICS["fleet"]
+
+
+def test_bounded_run_and_close(tmp_path):
+    reg = _source("serve-r0-5", tmp_path, [3.0])
+    seen = []
+    h = TelemetryHub(["r0.local:1"], poll_s=0.0,
+                     registry=_hub_registry(tmp_path),
+                     fetch=lambda url: _payload(reg))
+    try:
+        last = h.run(polls=3, on_poll=seen.append)
+        assert last["poll"] == 3 and len(seen) == 3
+        assert h.stream_path() == h.registry.path
+    finally:
+        h.registry.close()
+    reg.close()

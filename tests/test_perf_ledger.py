@@ -353,3 +353,61 @@ def test_sentinel_gates_serve_p99_trend(tmp_path):
     ledger.append_row(_serve_ledger_row(80.0), directory=d)
     rc = perf_sentinel.main(["check", "--ledger", d, "--kind", "serve"])
     assert rc == 2
+
+
+# ---- list-keys: trajectory inventory ---------------------------------------
+
+
+def test_list_keys_groups_trajectories(tmp_path, capsys):
+    d = str(tmp_path)
+    for i in range(3):
+        ledger.append_row(_run_row(0.1, ts=float(100 + i)), directory=d)
+    ledger.append_row(_run_row(0.2, cfg="othercfg", ts=50.0), directory=d)
+    ledger.append_row(_serve_ledger_row(10.0, ts=200.0), directory=d)
+    ledger.append_row(
+        ledger.fleet_row(3, 3, 0, 1, {"serve.latency_ms": {
+            "count": 10, "p50": 1.0, "p95": 2.0, "p99": 3.0}}),
+        directory=d,
+    )
+
+    keys = perf_sentinel.list_keys(ledger.read_rows(directory=d))
+    by = {(g["kind"], g["cfg"]): g for g in keys}
+    assert len(keys) == 4
+    run = by[("run", "cfgfp")]
+    assert run["rows"] == 3
+    assert (run["first_ts"], run["last_ts"]) == (100.0, 102.0)
+    assert by[("run", "othercfg")]["rows"] == 1
+    serve = next(g for g in keys if g["kind"] == "serve")
+    assert serve["rows"] == 1
+    fleet = next(g for g in keys if g["kind"] == "fleet")
+    assert fleet["graph_digest"] == "fleet" and fleet["rows"] == 1
+
+    # the subcommand renders a table naming every trajectory
+    rc = perf_sentinel.main(["list-keys", "--ledger", d])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "4 trajectory key(s) across 6 row(s)" in out
+    for needle in ("run", "serve", "fleet", "othercfg", "last_seen"):
+        assert needle in out
+
+
+def test_list_keys_flag_and_json(tmp_path, monkeypatch, capsys):
+    monkeypatch.setenv("NTS_LEDGER_DIR", str(tmp_path))
+    ledger.append_row(_run_row(0.1), directory=str(tmp_path))
+    rc = perf_sentinel.main(["--list-keys"])  # shorthand for the subcmd
+    assert rc == 0
+    assert "1 trajectory key(s)" in capsys.readouterr().out
+
+    rc = perf_sentinel.main(["list-keys", "--ledger", str(tmp_path),
+                             "--json"])
+    assert rc == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["keys"][0]["kind"] == "run"
+    assert payload["keys"][0]["rows"] == 1
+
+
+def test_list_keys_missing_ledger_exits_1(tmp_path, capsys):
+    rc = perf_sentinel.main(
+        ["list-keys", "--ledger", str(tmp_path / "nowhere")]
+    )
+    assert rc == 1

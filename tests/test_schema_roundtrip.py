@@ -117,6 +117,23 @@ def _emit_all(reg: registry.MetricsRegistry) -> None:
         flagged_entry="tune-cafecafecafecafe.json",
     )
     reg.event(
+        "telemetry", source="hub", counters={"hub.polls": 3.0},
+        gauges={"hub.targets": 3, "hub.targets_ok": 2,
+                "hub.targets_lost": 1},
+        slo={"objectives": 2, "breaching": 0, "worst": "ok"},
+        targets=3, targets_ok=2, targets_lost=1, uptime_s=12.5,
+    )
+    reg.event(
+        "target_loss", target="http://host2:9100/telemetry",
+        reason="poll_miss", missed_polls=3, miss_k=3,
+        last_ok_ts=1700000000.0,
+    )
+    reg.event(
+        "straggler", partition=2, epoch=5, seconds=1.9, median_s=1.0,
+        mad_s=0.0, threshold_s=1.25, excess=0.9, consecutive=3,
+        source="partition_step",
+    )
+    reg.event(
         "run_summary", algorithm="GCNDIST", fingerprint="cafecafecafe",
         counters={"wire.bytes_fwd": 4096}, gauges={}, timings={},
         epochs=1,
@@ -156,6 +173,9 @@ RENDER_MARKERS = {
     "model_drift": "prediction drift:",
     "tensor_stats": "numerics:",
     "nonfinite_provenance": "#nonfinite_provenance=",
+    "telemetry": "#telemetry=",
+    "target_loss": "#target_loss=",
+    "straggler": "#straggler=",
     "run_summary": "finish algorithm !",
 }
 
@@ -230,6 +250,9 @@ def test_validator_rejects_mutations_per_kind(tmp_path):
         "model_drift": {"drift": "lots"},
         "tensor_stats": {"finite_fraction": 1.5},
         "nonfinite_provenance": {"checked": -1},
+        "telemetry": {"source": ""},
+        "target_loss": {"missed_polls": 0},
+        "straggler": {"partition": -1},
         "run_summary": {"epoch_time": None},
     }
     assert set(mutations) == set(schema.KNOWN_KINDS)

@@ -1,0 +1,306 @@
+"""Straggler analytics (obs/skew) + the slow_rank chaos leg, on CPU.
+
+What is pinned here:
+
+- the shared robust-tolerance math (median + k·MAD with floor/cap) that
+  both the live detector and tools/perf_sentinel import — they must
+  never drift apart;
+- StragglerDetector units: the M-consecutive latch, re-arm after
+  recovery, the <2-partition no-op, advisory emission (typed record +
+  ``dist.straggler_partition`` gauge + elastic callback) and the
+  never-raises contract;
+- the offline replay (partition_epoch_seconds / detect_stragglers /
+  hop_skew) over recorded heartbeat ``seconds``;
+- the ``slow_rank`` fault kind: the injected sleep lands in exactly ONE
+  partition's measured ``partition_step`` time;
+- end-to-end chaos: ``slow_rank@partition=k`` on the 4-partition sim
+  ring yields a typed ``straggler`` record naming partition k and NO
+  rank_loss — slow is advisory, dead is actionable (the elastic
+  contract), and a later rank_loss on a flagged partition says so.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+from neutronstarlite_tpu.graph.storage import build_graph
+from neutronstarlite_tpu.models.base import get_algorithm
+from neutronstarlite_tpu.obs import skew
+from neutronstarlite_tpu.obs.registry import MetricsRegistry
+from neutronstarlite_tpu.resilience import elastic, faults
+from neutronstarlite_tpu.resilience.faults import fault_point
+from neutronstarlite_tpu.resilience.supervisor import supervised_run
+from tests.test_elastic import _dist_cfg, _dist_rig, _stream_events
+
+
+@pytest.fixture(autouse=True)
+def _clean_state(monkeypatch):
+    for var in ("NTS_FAULT_SPEC", "NTS_ELASTIC", "NTS_STRAGGLER",
+                "NTS_STRAGGLER_K", "NTS_STRAGGLER_M",
+                "NTS_STRAGGLER_FLOOR", "NTS_HEARTBEAT_MISS_K",
+                "NTS_GUARDS"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("NTS_BACKOFF_BASE_S", "0")
+    faults.reset()
+    elastic.reset()
+    yield
+    faults.reset()
+    elastic.reset()
+
+
+def _of(events, kind):
+    return [e for e in events if e.get("event") == kind]
+
+
+# ---- the shared tolerance math ---------------------------------------------
+
+
+def test_baseline_stats_and_tolerance_units():
+    stats = skew.baseline_stats([1.0, 1.0, 1.0, 10.0])
+    assert stats["median"] == 1.0 and stats["mad"] == 0.0
+
+    # MAD ~ 0 -> the floor governs (the sim-ring regime)
+    assert skew.effective_tolerance(1.0, 0.0, 3.0, 0.25, 4.0) == 0.25
+    # a wild history is capped, not waved through
+    assert skew.effective_tolerance(1.0, 10.0, 3.0, 0.25, 4.0) == 4.0
+    # a degenerate median cannot divide: floor
+    assert skew.effective_tolerance(0.0, 1.0, 3.0, 0.25, 4.0) == 0.25
+    # in between: the MAD-scaled noise estimate itself
+    tol = skew.effective_tolerance(1.0, 0.1, 3.0, 0.25, 4.0)
+    assert tol == pytest.approx(3.0 * 1.4826 * 0.1)
+
+
+def test_perf_sentinel_reuses_the_same_math():
+    from neutronstarlite_tpu.tools import perf_sentinel
+
+    assert perf_sentinel.baseline_stats is skew.baseline_stats
+    assert perf_sentinel.effective_tolerance is skew.effective_tolerance
+
+
+# ---- the live detector ------------------------------------------------------
+
+
+def _even(partitions, t=1.0):
+    return {p: t for p in range(partitions)}
+
+
+def test_detector_m_consecutive_latch_and_rearm():
+    det = skew.StragglerDetector(4, nsigma=3.0, m=2, floor=0.25)
+    slow = {**_even(4), 2: 2.0}  # 100% over an even 1.0s fleet
+
+    assert det.observe_epoch(0, slow) == []           # streak 1 of 2
+    hits = det.observe_epoch(1, slow)                 # streak 2: fires
+    assert len(hits) == 1
+    body = hits[0]
+    assert body["partition"] == 2 and body["consecutive"] == 2
+    assert body["excess"] == pytest.approx(1.0)
+    assert body["threshold_s"] == pytest.approx(1.25)  # floor-governed
+
+    assert det.observe_epoch(2, slow) == []           # latched: ONE record
+    assert det.observe_epoch(3, _even(4)) == []       # recovery re-arms
+    assert det.observe_epoch(4, slow) == []
+    assert det.observe_epoch(5, slow) != []           # fires again
+
+
+def test_detector_needs_a_fleet_and_skips_dead_values():
+    det = skew.StragglerDetector(4, m=1)
+    assert det.observe_epoch(0, {0: 5.0}) == []          # one partition
+    assert det.observe_epoch(1, {0: 5.0, 1: None}) == []  # dead filtered
+    assert det.observe_epoch(2, {}) == []
+
+
+def test_detector_emits_record_gauge_and_advisory(tmp_path):
+    reg = MetricsRegistry("gcndist-f-1", algorithm="GCNDIST",
+                          fingerprint="f", path=str(tmp_path / "s.jsonl"))
+    flagged = []
+    det = skew.StragglerDetector(3, m=1, registry=reg,
+                                 on_straggler=flagged.append)
+    det.observe_epoch(0, {0: 1.0, 1: 1.0, 2: 3.0})
+    reg.close()
+    assert flagged == [2]
+    events = _stream_events(tmp_path)
+    recs = _of(events, "straggler")
+    assert len(recs) == 1 and recs[0]["partition"] == 2
+    assert recs[0]["source"] == "partition_step"
+    assert reg.snapshot()["gauges"]["dist.straggler_partition"] == 2
+
+
+def test_detector_is_advisory_even_when_the_hook_blows_up():
+    def bomb(_p):
+        raise RuntimeError("advisory hooks must never reach the step loop")
+
+    det = skew.StragglerDetector(3, m=1, on_straggler=bomb)
+    hits = det.observe_epoch(0, {0: 1.0, 1: 1.0, 2: 3.0})
+    assert hits and hits[0]["partition"] == 2  # verdict still returned
+
+
+def test_env_knobs(monkeypatch):
+    assert skew.straggler_enabled(default=False) is False
+    assert skew.straggler_enabled(default=True) is True
+    monkeypatch.setenv("NTS_STRAGGLER", "0")
+    assert skew.straggler_enabled(default=True) is False
+    monkeypatch.setenv("NTS_STRAGGLER", "1")
+    assert skew.straggler_enabled(default=False) is True
+    monkeypatch.setenv("NTS_STRAGGLER_K", "2.5")
+    monkeypatch.setenv("NTS_STRAGGLER_M", "5")
+    monkeypatch.setenv("NTS_STRAGGLER_FLOOR", "0.1")
+    det = skew.StragglerDetector(4)
+    assert (det.nsigma, det.m, det.floor) == (2.5, 5, 0.1)
+
+
+# ---- offline replay ---------------------------------------------------------
+
+
+def _hb(partition, epoch, seconds=None):
+    rec = {"event": "heartbeat", "partition": partition, "epoch": epoch}
+    if seconds is not None:
+        rec["seconds"] = seconds
+    return rec
+
+
+def test_partition_epoch_seconds_filters_junk():
+    events = [
+        _hb(0, 0, 1.0), _hb(1, 0, 1.1), _hb(0, 1, 1.2),
+        _hb(0, 2),                      # pre-fabric beat: no seconds
+        _hb(1, 1, 0.0),                 # non-positive dropped
+        {"event": "epoch", "epoch": 0, "seconds": 9.0},  # wrong kind
+    ]
+    out = skew.partition_epoch_seconds(events)
+    assert out == {0: {0: 1.0, 1: 1.2}, 1: {0: 1.1}}
+
+
+def test_detect_stragglers_replays_the_live_math():
+    events = []
+    for ep in range(4):
+        for p in range(4):
+            events.append(_hb(p, ep, 2.0 if p == 3 and ep >= 1 else 1.0))
+    hits = skew.detect_stragglers(events, m=2)
+    assert len(hits) == 1
+    assert hits[0]["partition"] == 3 and hits[0]["epoch"] == 2
+    assert hits[0]["source"] == "heartbeat"
+    assert skew.detect_stragglers(events[:4], m=2) == []  # one epoch only
+
+
+def test_hop_skew_groups_by_stream():
+    def hop(run, s):
+        return {"event": "ring_step", "run_id": run, "seconds": s}
+
+    events = [hop("r0", 0.010), hop("r0", 0.012),
+              hop("r1", 0.011), hop("r2", 0.050)]
+    out = skew.hop_skew(events)
+    assert out["streams"] == 3
+    assert out["slow_streams"] == ["r2"]
+    assert skew.hop_skew(events[:2]) is None  # <2 streams: no verdict
+
+
+# ---- the slow_rank fault kind ----------------------------------------------
+
+
+def test_slow_rank_sleeps_in_exactly_one_partitions_step(monkeypatch):
+    monkeypatch.setenv("NTS_FAULT_SPEC", "slow_rank@partition=2,ms=150,times=2")
+    faults.reset()
+    for epoch in range(3):  # times=2: the third epoch is untouched
+        for p in range(4):
+            t0 = time.monotonic()
+            fault_point("partition_step", epoch=epoch, partition=p)
+            dt = time.monotonic() - t0
+            if p == 2 and epoch < 2:
+                assert dt >= 0.14, "the sleep must land in partition 2"
+            else:
+                assert dt < 0.1, f"partition {p} epoch {epoch} slept"
+
+
+def test_parse_slow_rank_spec():
+    specs = faults.parse_fault_spec("slow_rank@partition=2,ms=250,times=3")
+    (s,) = specs
+    assert (s.kind, s.partition, s.ms, s.times) == ("slow_rank", 2, 250.0, 3)
+    assert faults.DEFAULT_POINTS[s.kind] == "partition_step"
+
+
+# ---- the elastic contract: slow is advisory, dead is actionable ------------
+
+
+def test_trip_message_names_a_flagged_straggler(monkeypatch):
+    monkeypatch.setenv("NTS_GUARDS", "1")
+    elastic.note_straggler(2)
+    assert elastic.stragglers() == {2}
+    mon = elastic.LivenessMonitor(4, miss_k=1, collective_timeout=0)
+    with pytest.raises(elastic.RankLossError) as ei:
+        mon.epoch_end(0, alive=[0, 1, 3])
+    assert "flagged as a straggler (slow) before it went silent" in str(
+        ei.value
+    )
+    elastic.clear_straggler(2)
+    assert elastic.stragglers() == set()
+
+
+# ---- end-to-end chaos on the sim ring --------------------------------------
+
+
+@pytest.mark.parametrize("k", [1, 2])
+def test_slow_rank_chaos_flags_the_partition(tmp_path, monkeypatch, k):
+    """The acceptance oracle: a 500 ms sleep injected into partition k's
+    step for 3 epochs (> the 25% tolerance floor of the warm epoch time)
+    yields ONE straggler record naming k — and the run neither sheds the
+    partition nor emits a rank_loss."""
+    monkeypatch.setenv("NTS_METRICS_DIR", str(tmp_path / "obs"))
+    monkeypatch.setenv("NTS_STRAGGLER", "1")
+    monkeypatch.setenv("NTS_STRAGGLER_M", "2")
+    monkeypatch.setenv("NTS_FAULT_SPEC",
+                       f"slow_rank@partition={k},ms=500,times=3")
+    faults.reset()
+    src, dst, datum, g = _dist_rig(seed=11)
+    cfg = _dist_cfg(epochs=4, partitions=4)
+    trainer = get_algorithm("GCNDIST").from_arrays(
+        cfg, src, dst, datum, host_graph=g
+    )
+    trainer.run()
+
+    assert trainer.dist.partitions == 4  # advisory: nothing was shed
+    assert all(np.isfinite(v) for v in trainer.loss_history)
+    assert (trainer.metrics.snapshot()["gauges"]["dist.straggler_partition"]
+            == k)
+    assert elastic.stragglers() == {k}  # the advisory note reached elastic
+
+    evs = _stream_events(tmp_path / "obs")
+    stragglers = _of(evs, "straggler")
+    assert len(stragglers) == 1, "the latch: one record per slow episode"
+    assert stragglers[0]["partition"] == k
+    assert stragglers[0]["consecutive"] >= 2
+    assert stragglers[0]["excess"] > 0.25
+    assert _of(evs, "rank_loss") == [], "slow is NOT dead"
+    injected = _of(evs, "fault")
+    assert injected and all(f["kind"] == "slow_rank" for f in injected)
+
+
+def test_straggler_default_follows_elastic_and_replay_agrees(
+    tmp_path, monkeypatch,
+):
+    """With NTS_ELASTIC=1 and NTS_STRAGGLER unset the detector arms by
+    default, heartbeats carry per-partition seconds, and the offline
+    replay over the recorded stream reaches the same verdict."""
+    monkeypatch.setenv("NTS_METRICS_DIR", str(tmp_path / "obs"))
+    monkeypatch.setenv("NTS_ELASTIC", "1")
+    monkeypatch.setenv("NTS_STRAGGLER_M", "2")
+    monkeypatch.setenv("NTS_FAULT_SPEC",
+                       "slow_rank@partition=2,ms=500,times=3")
+    faults.reset()
+    src, dst, datum, g = _dist_rig(seed=11)
+    cfg = _dist_cfg(epochs=4, partitions=4)
+    trainer = get_algorithm("GCNDIST").from_arrays(
+        cfg, src, dst, datum, host_graph=g
+    )
+    supervised_run(trainer)
+
+    evs = _stream_events(tmp_path / "obs")
+    beats = [e for e in _of(evs, "heartbeat") if "seconds" in e]
+    assert beats, "heartbeats must carry the measured epoch seconds"
+    live = _of(evs, "straggler")
+    assert live and live[0]["partition"] == 2
+    assert _of(evs, "rank_loss") == []
+    # offline replay over the same stream agrees with the in-run verdict
+    replay = skew.detect_stragglers(evs, m=2)
+    assert replay and replay[0]["partition"] == 2

@@ -160,6 +160,108 @@ def test_epoch_marker_alignment_snaps_skewed_rank(tmp_path):
     assert by_rank[0] == pytest.approx(by_rank[1], abs=1.0)  # us
 
 
+def _mk_drifting_stream(path, rank, wall0, mono0, epochs, drift_per_s):
+    """Like _mk_stream, but the host's wall clock DRIFTS: every elapsed
+    monotonic second adds ``drift_per_s`` of wall error (a bad oscillator,
+    not just a constant NTP offset)."""
+    events = [{
+        "event": "run_start", "run_id": f"r{rank}", "schema":
+        schema.SCHEMA_VERSION, "ts": wall0, "seq": 0, "algorithm": "A",
+        "fingerprint": "f", "process_index": rank,
+    }]
+    for i, (t0, dur) in enumerate(epochs):
+        end_mono = t0 + dur
+        elapsed = end_mono - mono0
+        events.append({
+            "event": "span", "run_id": f"r{rank}",
+            "schema": schema.SCHEMA_VERSION,
+            "ts": wall0 + elapsed + drift_per_s * elapsed, "seq": i + 1,
+            "name": "epoch", "cat": "epoch", "span_id": f"e{i}",
+            "trace_id": f"r{rank}", "parent_id": None,
+            "t0": t0, "dur_s": dur, "rank": rank, "epoch": i,
+        })
+    assert schema.validate_stream(events) == len(events)
+    return trace_timeline.Stream(str(path), events)
+
+
+def test_alignment_recovers_skew_under_clock_drift(tmp_path):
+    """Injected skew + drift: rank 1's wall clock starts 5 s ahead AND
+    gains 10 ms per monotonic second. The median offset/alignment
+    estimators must recover the shared timeline to within half the total
+    drift accumulated over the run (the bound of a median corrector —
+    residuals are the per-epoch drift around the middle sample)."""
+    epochs = [(100.0 + i, 0.8) for i in range(6)]
+    s0 = _mk_stream(tmp_path / "a-p0.jsonl", 0, wall0=1000.0, mono0=100.0,
+                    epochs=epochs)
+    s1 = _mk_drifting_stream(tmp_path / "b-p1.jsonl", 1, wall0=1005.0,
+                             mono0=100.0, epochs=epochs, drift_per_s=0.010)
+    trace_timeline.align_streams([s0, s1])
+    assert s0.align == 0.0
+    total_drift = 0.010 * (epochs[-1][0] + epochs[-1][1] - 100.0)
+    # skew recovered: the -5 s shift dominates, residual bounded by drift
+    assert s1.align == pytest.approx(-5.0, abs=total_drift)
+    e0, e1 = s0.epoch_ends(), s1.epoch_ends()
+    for e in e0:
+        assert e0[e] == pytest.approx(e1[e], abs=total_drift / 2 + 1e-9)
+    assert s1.align_warning is None  # aligned streams carry no warning
+
+
+def _mk_spans_no_epochs(path, rank, wall0, mono0):
+    """A span-bearing stream with NO epoch markers (a serve surface, or
+    a trainer that died before epoch 0 closed)."""
+    events = [{
+        "event": "span", "run_id": f"r{rank}", "schema":
+        schema.SCHEMA_VERSION, "ts": wall0 + 0.5, "seq": 0,
+        "name": "flush", "cat": "serve", "span_id": "f0",
+        "trace_id": f"r{rank}", "parent_id": None,
+        "t0": mono0, "dur_s": 0.5, "rank": rank, "epoch": None,
+    }]
+    assert schema.validate_stream(events) == len(events)
+    return trace_timeline.Stream(str(path), events)
+
+
+def test_alignment_warns_not_crashes_without_epoch_markers(
+    tmp_path, capsys,
+):
+    """The satellite pin: a rank with no alignment markers is a WARNING
+    and a kept-own-clock stream, never a crash — and the timeline still
+    renders."""
+    s0 = _mk_stream(tmp_path / "a-p0.jsonl", 0, wall0=1000.0, mono0=10.0,
+                    epochs=[(10.0, 1.0), (11.0, 1.0)])
+    s1 = _mk_spans_no_epochs(tmp_path / "b-p1.jsonl", 1, wall0=1005.0,
+                             mono0=100.0)
+    trace_timeline.align_streams([s0, s1])
+    assert s1.align == 0.0  # kept on its own wall clock
+    assert "no epoch markers" in (s1.align_warning or "")
+    assert "no epoch markers" in capsys.readouterr().err
+    trace = trace_timeline.chrome_trace([s0, s1])
+    assert trace_timeline.validate_chrome_trace(trace) > 0
+
+    # no stream anchored at all: every span-bearing stream warns
+    s2 = _mk_spans_no_epochs(tmp_path / "c-p0.jsonl", 0, wall0=1.0,
+                             mono0=0.0)
+    s3 = _mk_spans_no_epochs(tmp_path / "d-p1.jsonl", 1, wall0=2.0,
+                             mono0=0.0)
+    trace_timeline.align_streams([s2, s3])
+    assert all("no stream carries epoch spans" in (s.align_warning or "")
+               for s in (s2, s3))
+
+    # anchored but disjoint epochs: the non-anchor stream warns
+    s4 = _mk_stream(tmp_path / "e-p0.jsonl", 0, wall0=1000.0, mono0=10.0,
+                    epochs=[(10.0, 1.0)])
+    s5 = trace_timeline.Stream(str(tmp_path / "f-p1.jsonl"), [
+        dict(e, epoch=(e.get("epoch") or 0) + 7,
+             span_id=f"x{i}") if e["event"] == "span" else e
+        for i, e in enumerate(_mk_stream(
+            tmp_path / "f-p1.jsonl", 1, wall0=1000.0, mono0=10.0,
+            epochs=[(10.0, 1.0)],
+        ).events)
+    ])
+    trace_timeline.align_streams([s4, s5])
+    assert "shares no epochs with the anchor" in (s5.align_warning or "")
+    assert s5.align == 0.0
+
+
 def test_chrome_trace_validator_rejects_garbage():
     with pytest.raises(ValueError, match="traceEvents"):
         trace_timeline.validate_chrome_trace({"events": []})
