@@ -299,7 +299,15 @@ def telemetry_ndjson(
 
 
 class MetricsExporter:
-    """The HTTP listener; its surfaces are rebindable live."""
+    """The HTTP listener; its surfaces are rebindable live.
+
+    Besides the read-only scrape paths, a serve process can bind a DATA
+    plane onto the same port: ``bind_predict(fn)`` arms ``POST
+    /predict`` (serve/crosshost replica children use this so one
+    host:port per replica carries both traffic and telemetry — the
+    NTS_FLEET_TARGETS grammar stays a single address). ``fn`` receives
+    the decoded JSON body and returns ``(status_code, payload_dict)``;
+    unbound, /predict answers 404 like any other unknown path."""
 
     def __init__(self, registry, port: int, host: str = "127.0.0.1",
                  slo=None, replica: Optional[str] = None):
@@ -308,6 +316,7 @@ class MetricsExporter:
         self.registry = registry
         self.slo = slo
         self.started_at = time.time()
+        self._predict_fn = None
         self.rebind(registry, slo, replica=replica)
         exporter = self
 
@@ -402,6 +411,42 @@ class MetricsExporter:
                     except Exception:
                         pass
 
+            def do_POST(self):  # noqa: N802 (http.server API)
+                try:
+                    path = self.path.split("?", 1)[0]
+                    fn = exporter._predict_fn
+                    if path != "/predict" or fn is None:
+                        self._send(404, b'{"error": "not found"}\n',
+                                   "application/json")
+                        return
+                    try:
+                        n = int(self.headers.get("Content-Length") or 0)
+                        payload = json.loads(
+                            self.rfile.read(n).decode("utf-8") or "{}"
+                        )
+                        if not isinstance(payload, dict):
+                            raise ValueError("body must be a JSON object")
+                    except (ValueError, UnicodeDecodeError) as e:
+                        self._send(
+                            400,
+                            json.dumps({"error": f"bad request: {e}"}
+                                       ).encode(),
+                            "application/json",
+                        )
+                        return
+                    code, out = fn(payload)
+                    self._send(int(code), json.dumps(out).encode(),
+                               "application/json")
+                except Exception as e:  # a bad request must not kill serving
+                    try:
+                        self._send(
+                            500,
+                            json.dumps({"error": str(e)}).encode(),
+                            "application/json",
+                        )
+                    except Exception:
+                        pass
+
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
         self.port = self._httpd.server_address[1]
@@ -433,6 +478,13 @@ class MetricsExporter:
             # legacy attributes track the newest surface
             self.registry = registry
             self.slo = slo
+
+    def bind_predict(self, fn) -> None:
+        """Arm (or with ``None`` disarm) the POST /predict data plane.
+        ``fn(payload_dict) -> (status_code, response_dict)`` runs on the
+        listener's request thread — it must be thread-safe and bounded
+        (the serve batcher's submit/result path already is)."""
+        self._predict_fn = fn
 
     def close(self) -> None:
         try:

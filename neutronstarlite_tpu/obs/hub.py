@@ -59,10 +59,9 @@ from __future__ import annotations
 import json
 import os
 import time
-import urllib.request
 from typing import Any, Callable, Dict, List, Optional
 
-from neutronstarlite_tpu.obs import ledger, registry as obs_registry
+from neutronstarlite_tpu.obs import httpc, ledger, registry as obs_registry
 from neutronstarlite_tpu.obs.hist import LogHistogram, latest_hists
 from neutronstarlite_tpu.obs.schema import validate_event
 from neutronstarlite_tpu.utils.logging import get_logger
@@ -119,10 +118,13 @@ def normalize_target(target: str) -> str:
 
 
 def _default_fetch(url: str) -> str:
-    with urllib.request.urlopen(url, timeout=FETCH_TIMEOUT_S) as resp:
-        if resp.status != 200:
-            raise OSError(f"HTTP {resp.status} from {url}")
-        return resp.read().decode("utf-8")
+    """One poll's fetch: the shared retrying client (obs/httpc), so a
+    single dropped connection is retried within the poll before it costs
+    the target one of its ``miss_k`` misses — retry-then-miss, not
+    miss-on-first-blip. The whole retry budget is bounded by the poll
+    timeout so a hung target cannot stall the cycle."""
+    return httpc.fetch(url, timeout_s=FETCH_TIMEOUT_S,
+                       deadline_s=FETCH_TIMEOUT_S * 2)
 
 
 class _Target:
@@ -141,7 +143,8 @@ class TelemetryHub:
     """Poll N ``/telemetry`` targets; merge into one fleet view.
 
     ``fetch`` is injectable (tests drive the hub without sockets); the
-    default is a plain urllib GET with a bounded timeout. The hub NEVER
+    default is the shared retrying client (obs/httpc: bounded jittered
+    backoff under a per-poll deadline). The hub NEVER
     raises out of a poll: a dead target is a liveness fact (miss-K ->
     ``target_loss``), a malformed payload is a warning + a miss (a
     half-written response must not poison the merged view), and ledger

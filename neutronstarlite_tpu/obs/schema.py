@@ -14,7 +14,7 @@ Envelope (all events):
                    stream_rotated | hist | slo_status | backend_probe |
                    program_cost | model_drift | tensor_stats |
                    nonfinite_provenance | telemetry | target_loss |
-                   straggler
+                   straggler | rollout
                    (open set)
   run_id: str      "<algo>-<fingerprint>-<pid>"
   schema: int      SCHEMA_VERSION
@@ -264,6 +264,24 @@ straggler (obs/skew.py): a partition's epoch time exceeded the fleet
   consecutive: int > 0 (epochs over threshold in a row),
   source: str | absent (partition_step | heartbeat | ring_step)
 
+rollout (serve/crosshost.py): one rolling model rollout attempt across
+  the cross-host fleet — preflight (digest manifest) → canary
+  (shadow-eval the candidate vs the serving model under NTS_CANARY_TOL)
+  → sequential drain/restart — and where it ended. Exactly one record
+  per rollout() call, whatever the outcome
+  ckpt_dir: str (non-empty; the candidate checkpoint root),
+  verdict: str (non-empty: promoted | preflight_reject | canary_reject |
+  aborted | refused, open set),
+  ckpt_step: int | null | absent (the candidate's step, once known),
+  replicas: int >= 0 | absent (fleet width at rollout start),
+  restarted: int >= 0 | absent (replicas running the candidate when the
+  rollout ended — 0 for every refusal),
+  rolled_back: int >= 0 | absent (replicas returned to the old model by
+  an abort),
+  canary: object | null | absent (the gate's evidence: disagreement /
+  tolerance / seeds / passed),
+  seconds: number | absent, error: str | absent (why it aborted)
+
 model_drift (tools/drift_audit.py): an analytic prediction disagreed
   with what actually ran beyond the audit threshold — the record that
   turns the predict_all/predict_mesh priors and the wire gauges from
@@ -330,6 +348,7 @@ KNOWN_KINDS = (
     "telemetry",
     "target_loss",
     "straggler",
+    "rollout",
     "run_summary",
 )
 
@@ -743,6 +762,31 @@ def validate_event(obj: Any) -> None:
                   f"{c!r}")
         if "source" in obj and not isinstance(obj["source"], str):
             _fail("straggler.source must be a string when present")
+    elif kind == "rollout":
+        if not isinstance(obj.get("ckpt_dir"), str) or not obj["ckpt_dir"]:
+            _fail("rollout.ckpt_dir must be a non-empty string")
+        if not isinstance(obj.get("verdict"), str) or not obj["verdict"]:
+            _fail("rollout.verdict must be a non-empty string")
+        for key in ("replicas", "restarted", "rolled_back"):
+            v = obj.get(key)
+            if key in obj and (
+                not isinstance(v, int) or isinstance(v, bool) or v < 0
+            ):
+                _fail(f"rollout.{key} must be a non-negative int when "
+                      f"present, got {v!r}")
+        if "ckpt_step" in obj:
+            v = obj.get("ckpt_step")
+            if v is not None and (isinstance(v, bool)
+                                  or not isinstance(v, int)):
+                _fail(f"rollout.ckpt_step must be an int or null, got {v!r}")
+        if "canary" in obj and obj["canary"] is not None \
+                and not isinstance(obj["canary"], dict):
+            _fail("rollout.canary must be an object or null")
+        if "seconds" in obj:
+            _require_number(obj, "seconds", allow_none=True)
+        if "error" in obj and obj["error"] is not None \
+                and not isinstance(obj["error"], str):
+            _fail("rollout.error must be a string when present")
     elif kind == "model_drift":
         if not isinstance(obj.get("metric"), str) or not obj["metric"]:
             _fail("model_drift.metric must be a non-empty string")

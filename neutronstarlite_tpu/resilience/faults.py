@@ -57,6 +57,22 @@ slow_rank  epoch, partition (default 0) sleeps ms inside ONE partition's
                                         slow-vs-dead contract. Use
                                         ``times=M`` to outlast the
                                         detector's M-consecutive latch
+net_drop   target (optional), times     raises ConnectionRefusedError at
+                                        the ``http_fetch`` point
+                                        (obs/httpc) — one HTTP scrape
+                                        sees a refused connection, the
+                                        cross-host analog of a dropped
+                                        heartbeat. With ``target=k`` only
+                                        the caller polling target index k
+                                        is hit; ``times=M`` outlasts the
+                                        client's retry budget so the hub/
+                                        router miss-K escalation fires
+slow_net   target (optional),           sleeps ms inside the ``http_fetch``
+           ms (default 1000), times     point before the socket opens —
+                                        injected scrape latency. Slow, NOT
+                                        dead: the fetch still succeeds, so
+                                        liveness stays quiet while
+                                        deadline accounting is exercised
 ========== ============================ =======================================
 
 Common args: ``times`` (how often the spec may fire, default 1) makes
@@ -81,6 +97,10 @@ Fault points currently planted:
   timing (models/gcn_dist.py), once per (epoch, partition), so an
   injected sleep lands in exactly one partition's MEASURED wall time.
   slow_rank fires here by default.
+- ``http_fetch`` — inside obs/httpc.fetch, once per HTTP attempt (before
+  the socket opens), with ``target=`` carrying the caller's integer
+  index for the endpoint being fetched. net_drop/slow_net fire here —
+  the chaos legs of the cross-host router/hub contract.
 
 State (parsed plan + per-spec fired counts + the save counter) is
 process-global on purpose: a supervised retry inside the same process
@@ -101,12 +121,13 @@ from neutronstarlite_tpu.utils.logging import get_logger, process_index
 log = get_logger("faults")
 
 FAULT_KINDS = ("nan_loss", "crash", "stall", "ckpt_corrupt", "exc",
-               "rank_loss", "slow_rank")
+               "rank_loss", "slow_rank", "net_drop", "slow_net")
 
 # every named fault point planted in the codebase; a spec naming any
 # other point would silently never fire — exactly the chaos-test failure
 # parse_fault_spec's loudness contract exists to prevent
-FAULT_POINTS = ("epoch_loss", "save", "sample_produce", "partition_step")
+FAULT_POINTS = ("epoch_loss", "save", "sample_produce", "partition_step",
+                "http_fetch")
 
 # where each kind fires when the spec names no point= of its own. exc is
 # the generic in-process failure (raises RuntimeError at its point) —
@@ -120,6 +141,8 @@ DEFAULT_POINTS = {
     "ckpt_corrupt": "save",
     "rank_loss": "epoch_loss",
     "slow_rank": "partition_step",
+    "net_drop": "http_fetch",
+    "slow_net": "http_fetch",
 }
 
 # exit code of a simulated crash — distinguishable from a real failure's
@@ -139,6 +162,8 @@ class FaultSpec:
     # slow_rank: the partition whose step the sleep lands in
     layer: Optional[int] = None  # nan_loss: poison the provenance
     # replay's forward at this layer (obs/numerics.poison_hook)
+    target: Optional[int] = None  # net_drop/slow_net: only hit fetches
+    # of this target index (the caller's replica/target numbering)
     times: int = 1  # max firings (one-shot by default)
     point: Optional[str] = None  # fire at this named fault point
     # (default: the kind's classic point, DEFAULT_POINTS)
@@ -148,7 +173,8 @@ class FaultSpec:
         return self.fired >= self.times
 
 
-_INT_ARGS = ("epoch", "rank", "save", "times", "partition", "layer")
+_INT_ARGS = ("epoch", "rank", "save", "times", "partition", "layer",
+             "target")
 _ALLOWED_ARGS = frozenset(_INT_ARGS) | {"ms", "point"}
 
 
@@ -272,14 +298,18 @@ def _epoch_matches(spec: FaultSpec, epoch: Optional[int]) -> bool:
 
 def fault_point(point: str, *, epoch: Optional[int] = None, value=None,
                 path: Optional[str] = None,
-                partition: Optional[int] = None):
+                partition: Optional[int] = None,
+                target: Optional[int] = None):
     """Named injection hook. Run loops call it with the point's context
     and thread ``value`` (the epoch loss) through it; matching specs in
     the active plan fire (at most ``times`` each) and may replace the
     value, sleep, corrupt ``path``, or kill the process. A no-op (returns
     ``value`` unchanged) when ``NTS_FAULT_SPEC`` is unset. ``partition``
     is the per-partition context of the ``partition_step`` point (which
-    partition's step is executing) — slow_rank matches against it."""
+    partition's step is executing) — slow_rank matches against it.
+    ``target`` is the per-fetch context of the ``http_fetch`` point
+    (which endpoint index is being fetched) — net_drop/slow_net match
+    against it."""
     plan = active_plan()
     if not plan:
         return value
@@ -388,6 +418,41 @@ def fault_point(point: str, *, epoch: Optional[int] = None, value=None,
             log.warning(
                 "injecting %.0f ms straggler sleep into partition %s at "
                 "epoch %s", spec.ms, partition, epoch,
+            )
+            time.sleep(spec.ms / 1000.0)
+        elif spec.kind == "net_drop":
+            if spec.target is not None and spec.target != target:
+                continue
+            spec.fired += 1
+            # the injection-site record; the DETECTION records are the
+            # caller's own (the hub's miss-K target_loss, the router's
+            # re-route) — exactly the rank_loss split, one tier up
+            events.emit_fault(
+                "net_drop", point=point, target=target, injected=True,
+                rank=process_index(),
+            )
+            log.warning(
+                "injecting net drop: refusing HTTP fetch of target %s",
+                target,
+            )
+            raise ConnectionRefusedError(
+                f"injected fault: net_drop at point {point!r} "
+                f"(target {target})"
+            )
+        elif spec.kind == "slow_net":
+            if spec.target is not None and spec.target != target:
+                continue
+            spec.fired += 1
+            # slow, NOT dead: the fetch still succeeds after the sleep,
+            # so liveness stays quiet while the client's deadline math
+            # absorbs the latency — the scrape-tier slow-vs-dead leg
+            events.emit_fault(
+                "slow_net", point=point, target=target, injected=True,
+                rank=process_index(),
+            )
+            log.warning(
+                "injecting %.0f ms scrape latency into target %s",
+                spec.ms, target,
             )
             time.sleep(spec.ms / 1000.0)
         elif spec.kind == "ckpt_corrupt":

@@ -690,7 +690,7 @@ def recovery_timeline(events: List[Dict[str, Any]]) -> List[str]:
     for e in events:
         if e["event"] not in ("fault", "recovery", "rank_loss", "replan",
                               "stream_rotated", "nonfinite_provenance",
-                              "target_loss", "straggler"):
+                              "target_loss", "straggler", "rollout"):
             continue
         detail = " ".join(
             f"{k}={e[k]}" for k in sorted(e)
@@ -756,14 +756,16 @@ def render_elastic(events: List[Dict[str, Any]],
 
 
 def render_fleet(events: List[Dict[str, Any]]) -> List[str]:
-    """The telemetry-fabric block (obs/hub + obs/skew): hub/exporter
-    ``telemetry`` snapshots, every ``target_loss`` (the cross-host
-    analog of rank_loss), and every advisory ``straggler`` verdict.
+    """The telemetry-fabric block (obs/hub + obs/skew + serve/crosshost):
+    hub/exporter ``telemetry`` snapshots, every ``target_loss`` (the
+    cross-host analog of rank_loss), every advisory ``straggler``
+    verdict, and every ``rollout`` attempt with its canary evidence.
     Empty for streams the fabric never touched."""
     telemetry = [e for e in events if e["event"] == "telemetry"]
     losses = [e for e in events if e["event"] == "target_loss"]
     stragglers = [e for e in events if e["event"] == "straggler"]
-    if not (telemetry or losses or stragglers):
+    rollouts = [e for e in events if e["event"] == "rollout"]
+    if not (telemetry or losses or stragglers or rollouts):
         return []
     lines = ["fleet telemetry:"]
     if telemetry:
@@ -802,6 +804,26 @@ def render_fleet(events: List[Dict[str, Any]]) -> List[str]:
                if isinstance(exc, (int, float)) else " (")
             + f", {e.get('consecutive')} consecutive) — "
             "slow-but-alive, advisory (NOT a rank_loss)"
+        )
+    for e in rollouts:
+        canary = e.get("canary") or {}
+        dis = canary.get("disagreement")
+        detail = ""
+        if dis is not None:
+            tol = canary.get("tolerance")
+            detail = (
+                f" canary disagreement={dis:g}"
+                + (f" (tol {tol:g})" if isinstance(tol, (int, float))
+                   else "")
+            )
+        err = e.get("error")
+        lines.append(
+            f"#rollout={e.get('verdict')} ckpt={e.get('ckpt_dir')}"
+            f"{detail} restarted={e.get('restarted', 0)}/"
+            f"{e.get('replicas', '?')}"
+            + (f" rolled_back={e['rolled_back']}"
+               if e.get("rolled_back") else "")
+            + (f" — {err}" if err else "")
         )
     return lines
 
@@ -1163,6 +1185,9 @@ def main(argv=None) -> int:
                     ),
                     "stragglers": sum(
                         1 for e in events if e["event"] == "straggler"
+                    ),
+                    "rollouts": sum(
+                        1 for e in events if e["event"] == "rollout"
                     ),
                     "_path": p,
                     "_fleet_only": True,

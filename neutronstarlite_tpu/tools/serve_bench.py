@@ -29,6 +29,10 @@ Fleet/live-graph legs:
   (``--delta-edges`` random edge inserts each, the previous batch
   removed) DURING the load — the open-loop "predictions track a live
   graph" leg.
+- ``--targets host:port,...`` drives already-running replica PROCESSES
+  through the cross-host router (serve/crosshost) instead of building an
+  in-process server; latency comes from the router's merged fleet
+  histograms (``--v-num`` supplies the seed-id space).
 
 ``--train`` first runs the cfg's training loop (with CHECKPOINT_DIR set
 to the serving checkpoint dir) when no checkpoint exists yet — the
@@ -262,6 +266,61 @@ def run_delta_loop(target, rate: float, edges_per_delta: int, seed: int,
         counts["applied"] += 1
 
 
+def _run_targets_mode(args) -> int:
+    """Drive already-running replica processes through the cross-host
+    router (serve/crosshost): same load loops, same front-door contract
+    (``submit()`` -> future), latency from the router's merged fleet
+    histograms (the exact bucket-addition view) instead of local
+    streams."""
+    from neutronstarlite_tpu.serve.crosshost import CrossHostFleet
+
+    targets = [t.strip() for t in args.targets.split(",") if t.strip()]
+    fleet = CrossHostFleet.from_targets(targets)
+    t0 = time.perf_counter()
+    try:
+        if args.mode == "closed":
+            errors = run_closed_loop(
+                fleet, args.v_num, args.requests, args.clients,
+                args.seeds_per_request, args.seed,
+            )
+        else:
+            errors = run_open_loop(
+                fleet, args.v_num, args.requests, args.rps,
+                args.seeds_per_request, args.seed,
+            )
+        wall_s = time.perf_counter() - t0
+    finally:
+        stats = fleet.close()
+    lat = stats["latency_ms"]
+    result = {
+        "metric": "serve_p99_latency_ms",
+        "value": lat.get("p99"),
+        "unit": "ms",
+        "vs_baseline": None,
+        "extra": {
+            "mode": args.mode,
+            "clients": args.clients if args.mode == "closed" else None,
+            "rps_offered": args.rps if args.mode == "open" else None,
+            "requests": args.requests,
+            "seeds_per_request": args.seeds_per_request,
+            "p50_ms": lat.get("p50"),
+            "p95_ms": lat.get("p95"),
+            "p99_ms": lat.get("p99"),
+            "latency_source": "fleet_hist",
+            "served": stats["requests"],
+            "shed": stats["shed"],
+            "errors": errors,
+            "restarts": stats["restarts"],
+            "targets": targets,
+            "replicas": stats["replicas"],
+            "targets_lost": stats["targets_lost"],
+            "wall_s": wall_s,
+        },
+    }
+    print(json.dumps(result))
+    return 0
+
+
 def main(argv=None) -> int:
     from neutronstarlite_tpu.utils.platform import honor_platform_env
 
@@ -270,7 +329,8 @@ def main(argv=None) -> int:
         description="closed/open-loop serving benchmark over the serve/ "
         "stack; prints one BENCH-compatible JSON line"
     )
-    ap.add_argument("cfg")
+    ap.add_argument("cfg", nargs="?", default="",
+                    help="cfg file (unused in --targets mode)")
     ap.add_argument("ckpt", nargs="?", default="",
                     help="checkpoint dir (default: cfg CHECKPOINT_DIR, "
                     "or a temp dir with --train)")
@@ -297,7 +357,18 @@ def main(argv=None) -> int:
     ap.add_argument("--delta-edges", type=int, default=4,
                     help="edge inserts per delta batch (the previous "
                     "batch is removed)")
+    ap.add_argument("--targets", default=None,
+                    help="drive a cross-host fleet (serve/crosshost) at "
+                    "these replica addresses instead of an in-process "
+                    "server; cfg/ckpt are ignored")
+    ap.add_argument("--v-num", type=int, default=2708,
+                    help="seed-id space for --targets mode (the remote "
+                    "graph is not introspectable)")
     args = ap.parse_args(argv)
+    if args.targets:
+        return _run_targets_mode(args)
+    if not args.cfg:
+        ap.error("cfg is required without --targets")
     if args.cb is not None:
         os.environ["NTS_SERVE_CB"] = args.cb
     if args.route is not None:

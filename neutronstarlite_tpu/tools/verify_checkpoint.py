@@ -16,6 +16,12 @@ do not fail the check (restore already routes around them).
 
 Exit codes: 0 = every verifiable checkpoint is intact; 1 = corruption or
 an unreadable input; 2 = no checkpoint found at all.
+
+Beyond the CLI, :func:`preflight_checkpoint` is the ROLLOUT preflight
+(serve/crosshost.py): a rolling model rollout refuses a candidate
+checkpoint root whose NEWEST retained step fails manifest/digest
+verification — restore would silently route around it to an older step,
+and "promote checkpoint X" must never quietly serve checkpoint X-1.
 """
 
 from __future__ import annotations
@@ -24,7 +30,7 @@ import argparse
 import json
 import os
 import sys
-from typing import List
+from typing import List, Tuple
 
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 if REPO not in sys.path:
@@ -38,6 +44,50 @@ from neutronstarlite_tpu.utils.checkpoint import (  # noqa: E402
     list_steps,
     verify_step_dir,
 )
+
+
+class PreflightError(RuntimeError):
+    """A checkpoint root failed rollout preflight; carries the digest/
+    manifest problems (empty for "no checkpoint at all")."""
+
+    def __init__(self, msg: str, problems: List[str] = ()):  # type: ignore[assignment]
+        super().__init__(msg)
+        self.problems = list(problems)
+
+
+def preflight_checkpoint(root: str) -> Tuple[str, int]:
+    """Verify the checkpoint a restore from ``root`` would actually
+    trust: the newest retained ``step-<n>/`` (or a legacy flat layout).
+    Returns ``(step_dir, step)`` when it verifies; raises
+    :class:`PreflightError` when the root holds no checkpoint or the
+    newest step fails manifest schema / sha256 digest verification.
+
+    Strictness is deliberate: ``restore_checkpoint`` quarantines a
+    corrupt newest step and falls back to an older one — right for crash
+    recovery, wrong for a rollout, where the operator named a SPECIFIC
+    model and a silent fallback would canary (and promote) a different
+    one."""
+    if not os.path.isdir(root):
+        raise PreflightError(f"{root}: not a directory")
+    steps = list_steps(root)
+    if steps:
+        step_dir = steps[-1][1]  # list_steps sorts ascending by step
+    elif os.path.exists(os.path.join(root, MANIFEST)):
+        step_dir = root  # legacy flat layout / direct step dir
+    else:
+        raise PreflightError(
+            f"{root}: no checkpoint found (no step-*/ dirs, no {MANIFEST})"
+        )
+    try:
+        manifest, _status, _arrays = verify_step_dir(step_dir)
+    except CheckpointCorruptError as e:
+        raise PreflightError(
+            f"{step_dir}: failed digest/manifest verification",
+            problems=e.problems,
+        ) from e
+    except OSError as e:
+        raise PreflightError(f"{step_dir}: unreadable ({e})") from e
+    return step_dir, int(manifest.get("step", 0))
 
 
 def _verify_one(step_dir: str, quiet: bool) -> bool:

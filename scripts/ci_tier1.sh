@@ -1024,6 +1024,251 @@ if [ "${NTS_CI_MICRO_FATAL:-0}" = "1" ] && [ "$strag_rc" -ne 0 ]; then
   hub_rc=$strag_rc
 fi
 
+# ---- cross-host serve gate (ISSUE 17) --------------------------------------
+# STRUCTURAL (hard): a 3-PROCESS fleet — router + spawned serve children
+# over real sockets (serve/crosshost) — under open-loop load must
+# (1) survive a SIGKILL'd replica: supervised respawn from the recorded
+#     launch recipe (EXACTLY one typed target_loss + one recovery
+#     action=restart) with ZERO fleet-level sheds — every owed request
+#     re-routes to a survivor;
+# (2) complete one rolling rollout under the same load: digest preflight
+#     + canary gate -> 3 sequential drain/restarts -> exactly one typed
+#     rollout record (verdict=promoted, canary attached) and kind=fleet
+#     ledger rows whose merged p99, once established, never goes null
+#     across the roll (the drain freeze keeps the merge continuous);
+# (3) post-rollout, every replica answers a replay_seed /predict probe
+#     BITWISE equal to a fresh single-process engine built from the
+#     promoted checkpoint (the rng-neutral state-swap on both sides).
+crosshost_rc=0
+rm -rf /tmp/_t1_xh
+mkdir -p /tmp/_t1_xh
+if JAX_PLATFORMS=cpu NTS_METRICS_DIR=/tmp/_t1_xh/obs NTS_NO_NATIVE=1 \
+    NTS_SAMPLE_WORKERS=0 NTS_SLO_SPEC='serve_p99_ms<=5000@30s' \
+    timeout -k 10 900 python - > /tmp/_t1_xh.log 2>&1 <<'EOF'
+import glob, json, os, shutil, signal, threading, time
+
+import numpy as np
+
+from neutronstarlite_tpu.utils.platform import honor_platform_env
+
+honor_platform_env()
+from neutronstarlite_tpu.obs import httpc, ledger, schema
+from neutronstarlite_tpu.serve.crosshost import CrossHostFleet
+from neutronstarlite_tpu.serve.engine import InferenceEngine
+from neutronstarlite_tpu.tools.serve_bench import (
+    ensure_checkpoint, run_open_loop,
+)
+from neutronstarlite_tpu.utils.config import InputInfo
+
+XH = "/tmp/_t1_xh"
+cfg_path = "configs/serve_fleet_smoke.cfg"
+cfg = InputInfo.read_from_cfg_file(cfg_path)
+base_dir = os.path.dirname(os.path.abspath(cfg_path))
+ckpt1, ckpt2 = f"{XH}/ckpt_v1", f"{XH}/ckpt_v2"
+cfg.checkpoint_dir = ckpt1
+ensure_checkpoint(cfg, base_dir, ckpt1, train=True)
+shutil.copytree(ckpt1, ckpt2)  # the candidate: byte-identical params
+
+# the single-process oracle for leg 3, built on the candidate
+oracle = InferenceEngine.from_config(
+    cfg, base_dir=base_dir, ckpt_dir=ckpt2, rng=np.random.default_rng(0)
+)
+oracle.warmup()
+v = oracle.toolkit.host_graph.v_num
+
+fleet = CrossHostFleet.spawn(
+    cfg_path, ckpt1, 3, spawn_dir=f"{XH}/spawn",
+    poll_s=0.25, miss_k=2, ledger_dir=f"{XH}/ledger", ledger_every=1,
+)
+try:
+    # ---- leg 1: SIGKILL one replica under open-loop load
+    out = {}
+    t = threading.Thread(target=lambda: out.update(
+        e1=run_open_loop(fleet, v, 120, 60.0, 1, 7)))
+    t.start()
+    time.sleep(0.5)
+    victim = fleet.replicas[1]
+    victim.proc.send_signal(signal.SIGKILL)
+    t.join(timeout=300.0)
+    assert out.get("e1") == 0, f"leg1 dropped {out.get('e1')} request(s)"
+    deadline = time.time() + 60.0
+    while time.time() < deadline and (
+        victim.restarts == 0 or fleet.hub.targets[1].lost
+    ):
+        time.sleep(0.2)
+    assert victim.restarts == 1, "SIGKILL'd replica never respawned"
+    assert not fleet.hub.targets[1].lost, "respawned replica never rejoined"
+
+    # ---- leg 2: rolling rollout under load (the pump spans the WHOLE
+    # roll, so the fresh children keep receiving observations and the
+    # merged-p99 ledger trajectory stays continuous)
+    stop, errs = threading.Event(), []
+    def pump():
+        while not stop.is_set():
+            errs.append(run_open_loop(fleet, v, 60, 60.0, 1, 8))
+    t2 = threading.Thread(target=pump)
+    t2.start()
+    time.sleep(0.5)
+    rec = fleet.rollout(ckpt2)
+    stop.set()
+    t2.join(timeout=300.0)
+    assert rec["verdict"] == "promoted", rec
+    assert rec["restarted"] == 3 and rec["rolled_back"] == 0, rec
+    assert rec["canary"] and rec["canary"]["passed"], rec
+    assert rec["canary"]["disagreement"] == 0.0, rec  # identical params
+    assert sum(errs) == 0, f"leg2 dropped {sum(errs)} request(s)"
+
+    # ---- leg 3: bitwise replay oracle against every replica
+    rng = np.random.default_rng(99)
+    for r in fleet.replicas:
+        for probe in range(2):
+            ids = [int(i) for i in rng.integers(0, v, size=3)]
+            seed = 1234 + probe
+            resp = json.loads(httpc.fetch(
+                r.predict_url,
+                data=json.dumps(
+                    {"node_ids": ids, "replay_seed": seed}
+                ).encode("utf-8"),
+            ))
+            assert resp.get("replay") is True, resp
+            got = np.asarray(resp["values"], dtype=np.dtype(resp["dtype"]))
+            gen = oracle.sampler.rng
+            saved = gen.bit_generator.state
+            gen.bit_generator.state = np.random.default_rng(
+                seed).bit_generator.state
+            try:
+                want = oracle.predict(np.asarray(ids, dtype=np.int64))
+            finally:
+                gen.bit_generator.state = saved
+            assert np.array_equal(got, want), (
+                f"{r.rid} diverged from the promoted-ckpt oracle on {ids}"
+            )
+
+    stats = fleet.stats()
+    assert stats["shed"] == 0, stats
+    assert stats["requests"] >= 300, stats
+finally:
+    fleet.close()
+
+evs = []
+for p in sorted(glob.glob(f"{XH}/obs/*.jsonl")):
+    for line in open(p, encoding="utf-8"):
+        if line.strip():
+            evs.append(json.loads(line))
+assert schema.validate_stream(evs) == len(evs)
+assert not [e for e in evs if e["event"] == "shed"], "fleet shed requests"
+losses = [e for e in evs if e["event"] == "target_loss"]
+assert len(losses) == 1, f"want exactly 1 target_loss, got {len(losses)}"
+restarts = [e for e in evs if e["event"] == "recovery"
+            and e.get("action") == "restart"]
+assert len(restarts) == 1 and restarts[0]["replica"] == "r1", restarts
+rollouts = [e for e in evs if e["event"] == "rollout"]
+assert len(rollouts) == 1 and rollouts[0]["verdict"] == "promoted", rollouts
+drift = [e for e in evs if e["event"] == "model_drift"
+         and e.get("source") == "canary"]
+assert len(drift) == 1 and drift[0]["drift"] <= drift[0]["threshold"], drift
+
+rows = [r for r in ledger.read_rows(f"{XH}/ledger") if r["kind"] == "fleet"]
+assert rows, "no kind=fleet ledger rows"
+p99s = [r["hist_quantiles"].get("serve.latency_ms", {}).get("p99")
+        for r in rows]
+first = next((i for i, q in enumerate(p99s) if q is not None), None)
+assert first is not None, "merged p99 never established in the ledger"
+broken = [i for i, q in enumerate(p99s[first:], first) if q is None]
+assert not broken, (
+    f"merged-p99 trajectory broke at poll row(s) {broken[:5]} "
+    "(the rollout drain must keep the merge continuous)"
+)
+print(
+    f"crosshost gate: SIGKILL -> 1 target_loss + supervised restart of "
+    f"{restarts[0]['replica']}, 0/300+ shed; rollout promoted (canary "
+    f"disagreement 0.0, 3 drain/restarts) under load; replay oracle "
+    f"bitwise over 6 probes; {len(rows)} fleet ledger rows, p99 unbroken "
+    f"from row {first}"
+)
+EOF
+then
+  grep "crosshost gate:" /tmp/_t1_xh.log
+else
+  crosshost_rc=$?
+  tail -40 /tmp/_t1_xh.log
+fi
+if [ "$crosshost_rc" -ne 0 ]; then
+  echo "CROSSHOST_GATE=FAIL (rc=$crosshost_rc)"
+else
+  echo "CROSSHOST_GATE=OK"
+fi
+
+# ADVISORY canary-reject leg: a deliberately drifted candidate (float
+# leaves rescaled, digests valid so preflight PASSES) offered to a live
+# 2-replica fleet via the serve_router CLI must be refused by the canary
+# gate — exit 3, one rollout record verdict=canary_reject, ZERO replicas
+# restarted, and the fleet still serving its original checkpoint.
+xh_adv_rc=0
+if [ "$crosshost_rc" -eq 0 ]; then
+  rm -rf /tmp/_t1_xh_adv
+  mkdir -p /tmp/_t1_xh_adv
+  JAX_PLATFORMS=cpu timeout -k 10 120 python - >> /tmp/_t1_xh.log 2>&1 <<'EOF' || xh_adv_rc=$?
+import numpy as np
+
+from neutronstarlite_tpu.utils import checkpoint as ck
+
+src, dst = "/tmp/_t1_xh/ckpt_v1", "/tmp/_t1_xh/ckpt_drift"
+step, step_dir = ck.list_steps(src)[-1]
+manifest, status, arrays = ck.verify_step_dir(step_dir)
+state = {}
+for name, info in manifest["trees"].items():
+    leaves = []
+    for i in range(info["n_leaves"]):
+        a = arrays[f"{name}.{i}"]
+        if np.issubdtype(a.dtype, np.floating):
+            a = (a * 1.5 + 0.25).astype(a.dtype)  # real drift, valid digest
+        leaves.append(a)
+    state[name] = leaves
+ck.save_checkpoint(dst, state, step=step)
+EOF
+  if [ "$xh_adv_rc" -eq 0 ]; then
+    JAX_PLATFORMS=cpu NTS_METRICS_DIR=/tmp/_t1_xh_adv/obs NTS_NO_NATIVE=1 \
+      NTS_SAMPLE_WORKERS=0 timeout -k 10 600 \
+      python -m neutronstarlite_tpu.tools.serve_router \
+      configs/serve_fleet_smoke.cfg /tmp/_t1_xh/ckpt_v1 --replicas 2 \
+      --poll 0.3 --polls 3 --rollout /tmp/_t1_xh/ckpt_drift \
+      --rollout-after 1 --spawn-dir /tmp/_t1_xh_adv/spawn \
+      >> /tmp/_t1_xh.log 2>&1
+    router_rc=$?
+    [ "$router_rc" -eq 3 ] || xh_adv_rc=1
+    if [ "$xh_adv_rc" -eq 0 ]; then
+      JAX_PLATFORMS=cpu python - >> /tmp/_t1_xh.log 2>&1 <<'EOF' || xh_adv_rc=$?
+import glob, json
+
+evs = []
+for p in sorted(glob.glob("/tmp/_t1_xh_adv/obs/*.jsonl")):
+    for line in open(p, encoding="utf-8"):
+        if line.strip():
+            evs.append(json.loads(line))
+rollouts = [e for e in evs if e["event"] == "rollout"]
+assert len(rollouts) == 1, rollouts
+r = rollouts[0]
+assert r["verdict"] == "canary_reject", r
+assert r["restarted"] == 0 and r["rolled_back"] == 0, r
+drift = [e for e in evs if e["event"] == "model_drift"
+         and e.get("source") == "canary"]
+assert drift and drift[0]["drift"] > drift[0]["threshold"], drift
+print(
+    f"canary-reject leg: drifted candidate refused "
+    f"(disagreement {drift[0]['drift']:.4f} > tol "
+    f"{drift[0]['threshold']}), 0 replicas restarted, router exit 3"
+)
+EOF
+    fi
+  fi
+  [ "$xh_adv_rc" -eq 0 ] && grep "canary-reject leg:" /tmp/_t1_xh.log
+fi
+echo "CROSSHOST_CANARY_GATE=rc$xh_adv_rc (advisory unless NTS_CI_MICRO_FATAL=1)"
+if [ "${NTS_CI_MICRO_FATAL:-0}" = "1" ] && [ "$xh_adv_rc" -ne 0 ]; then
+  crosshost_rc=$xh_adv_rc
+fi
+
 [ "$rc" -eq 0 ] && rc=$fused_rc
 [ "$rc" -eq 0 ] && rc=$samp_rc
 [ "$rc" -eq 0 ] && rc=$elastic_rc
@@ -1034,4 +1279,5 @@ fi
 [ "$rc" -eq 0 ] && rc=$fleet_rc
 [ "$rc" -eq 0 ] && rc=$numerics_rc
 [ "$rc" -eq 0 ] && rc=$hub_rc
+[ "$rc" -eq 0 ] && rc=$crosshost_rc
 exit $rc

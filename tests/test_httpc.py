@@ -1,0 +1,270 @@
+"""obs/httpc: the shared retrying HTTP client + the network fault kinds.
+
+Contract under test: one client (deadline + bounded jittered backoff +
+typed timeout/refused/status taxonomy) serves BOTH cross-host callers —
+hub polls and router scrapes. A transient refusal is retried within the
+call (retry-then-miss: the hub only burns a miss_k miss after the whole
+budget); the deadline bounds requests AND backoff sleeps; errors carry
+their failure mode as a type, not a string. The ``net_drop@target=k`` /
+``slow_net@target=k,ms=`` fault kinds ride the existing loudness
+contract (unknown kinds/args refuse to parse) and fire at the
+``http_fetch`` point with per-target selectivity.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import threading
+import time
+
+import pytest
+
+from neutronstarlite_tpu.obs import httpc
+from neutronstarlite_tpu.resilience import faults
+
+
+# ---- rig: a scriptable local HTTP server -----------------------------------
+
+
+class _Script:
+    """Per-path behavior: a list of (status, body) consumed per request;
+    the last entry repeats."""
+
+    def __init__(self):
+        self.steps = {}
+        self.hits = {}
+        self.lock = threading.Lock()
+
+    def next_step(self, path):
+        with self.lock:
+            self.hits[path] = self.hits.get(path, 0) + 1
+            steps = self.steps.get(path, [(200, "ok")])
+            i = min(self.hits[path] - 1, len(steps) - 1)
+            return steps[i]
+
+
+@pytest.fixture()
+def server():
+    script = _Script()
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def _serve(self):
+            status, body = script.next_step(self.path)
+            data = body.encode("utf-8")
+            self.send_response(status)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        do_GET = _serve
+        do_POST = _serve
+
+        def log_message(self, *a):  # keep pytest output clean
+            pass
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    yield base, script
+    httpd.shutdown()
+    httpd.server_close()
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv("NTS_FAULT_SPEC", raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# ---- the typed taxonomy ----------------------------------------------------
+
+
+def test_ok_fetch_and_post(server):
+    base, script = server
+    assert httpc.fetch(f"{base}/x", retries=0) == "ok"
+    script.steps["/echo"] = [(200, "posted")]
+    out = httpc.fetch(f"{base}/echo", data=json.dumps({"a": 1}).encode(),
+                      retries=0)
+    assert out == "posted"
+
+
+def test_refused_is_typed(server):
+    base, _ = server
+    # a port with no listener: connection refused, typed as HttpRefused
+    with pytest.raises(httpc.HttpRefused):
+        httpc.fetch("http://127.0.0.1:9", retries=0, timeout_s=2.0)
+
+
+def test_status_error_carries_status(server):
+    base, script = server
+    script.steps["/bad"] = [(503, "overloaded")]
+    with pytest.raises(httpc.HttpStatusError) as ei:
+        httpc.fetch(f"{base}/bad", retries=0)
+    assert ei.value.status == 503
+    assert isinstance(ei.value, httpc.HttpError)
+    assert isinstance(ei.value, OSError)  # legacy handlers keep working
+
+
+def test_classify_timeout_and_oserror():
+    import socket
+
+    assert isinstance(httpc._classify(socket.timeout("t"), "u"),
+                      httpc.HttpTimeout)
+    assert isinstance(httpc._classify(TimeoutError(), "u"),
+                      httpc.HttpTimeout)
+    assert isinstance(httpc._classify(ConnectionResetError(), "u"),
+                      httpc.HttpRefused)
+    e = OSError()
+    e.errno = 113  # EHOSTUNREACH
+    assert isinstance(httpc._classify(e, "u"), httpc.HttpRefused)
+    assert type(httpc._classify(RuntimeError("x"), "u")) is httpc.HttpError
+
+
+# ---- retry / backoff / deadline --------------------------------------------
+
+
+def test_retry_then_succeed(server):
+    base, script = server
+    script.steps["/flaky"] = [(500, "boom"), (500, "boom"), (200, "fine")]
+    out = httpc.fetch(f"{base}/flaky", retries=2, backoff_s=0.001)
+    assert out == "fine"
+    assert script.hits["/flaky"] == 3
+
+
+def test_retries_zero_is_single_shot(server):
+    base, script = server
+    script.steps["/once"] = [(500, "boom"), (200, "fine")]
+    with pytest.raises(httpc.HttpStatusError):
+        httpc.fetch(f"{base}/once", retries=0)
+    assert script.hits["/once"] == 1
+
+
+def test_deadline_bounds_whole_call():
+    t0 = time.monotonic()
+    with pytest.raises(httpc.HttpError):
+        # nothing listening: every attempt refuses instantly, so only
+        # the backoff sleeps could overshoot — the deadline must clamp
+        # them (generous margin for slow CI)
+        httpc.fetch("http://127.0.0.1:9", retries=50, backoff_s=0.2,
+                    timeout_s=1.0, deadline_s=0.5)
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_deadline_already_spent_raises_typed():
+    with pytest.raises(httpc.HttpTimeout):
+        httpc.fetch("http://127.0.0.1:9", retries=0, deadline_s=0.0)
+
+
+def test_env_knobs(monkeypatch):
+    monkeypatch.setenv("NTS_HTTPC_TIMEOUT_S", "1.5")
+    monkeypatch.setenv("NTS_HTTPC_RETRIES", "7")
+    monkeypatch.setenv("NTS_HTTPC_BACKOFF_S", "0.25")
+    assert httpc.http_timeout_s() == 1.5
+    assert httpc.http_retries() == 7
+    assert httpc.http_backoff_s() == 0.25
+    monkeypatch.setenv("NTS_HTTPC_RETRIES", "nope")
+    assert httpc.http_retries() == httpc.DEFAULT_RETRIES
+
+
+# ---- the network fault kinds ----------------------------------------------
+
+
+def test_net_drop_fires_at_http_fetch(server, monkeypatch):
+    base, script = server
+    monkeypatch.setenv("NTS_FAULT_SPEC", "net_drop@times=1")
+    faults.reset()
+    # first attempt is dropped by the injected fault, retry succeeds —
+    # the chaos path spends the same retry budget a real blip would
+    out = httpc.fetch(f"{base}/x", retries=1, backoff_s=0.001)
+    assert out == "ok"
+    assert script.hits["/x"] == 1  # the dropped attempt never hit a socket
+
+
+def test_net_drop_target_selectivity(server, monkeypatch):
+    base, _ = server
+    monkeypatch.setenv("NTS_FAULT_SPEC", "net_drop@target=1")
+    faults.reset()
+    # target 0 unaffected (the spec names target 1, so it stays armed)
+    assert httpc.fetch(f"{base}/x", retries=0, target=0) == "ok"
+    # target 1 dropped; no retries, so the injected refusal surfaces
+    with pytest.raises(httpc.HttpRefused):
+        httpc.fetch(f"{base}/x", retries=0, target=1)
+
+
+def test_slow_net_injects_latency(server, monkeypatch):
+    base, _ = server
+    monkeypatch.setenv("NTS_FAULT_SPEC", "slow_net@target=0,ms=80,times=1")
+    faults.reset()
+    t0 = time.monotonic()
+    assert httpc.fetch(f"{base}/x", retries=0, target=0) == "ok"
+    assert time.monotonic() - t0 >= 0.08
+
+
+def test_fault_records_are_emitted(server, monkeypatch, tmp_path):
+    from neutronstarlite_tpu.obs import registry
+    from neutronstarlite_tpu.resilience import events
+
+    reg = registry.MetricsRegistry(
+        "r", algorithm="A", fingerprint="f",
+        path=str(tmp_path / "s.jsonl"),
+    )
+    prev = events.get_sink()
+    events.set_sink(reg)
+    try:
+        base, _ = server
+        monkeypatch.setenv("NTS_FAULT_SPEC", "net_drop@times=1")
+        faults.reset()
+        httpc.fetch(f"{base}/x", retries=1, backoff_s=0.001, target=3)
+    finally:
+        events.set_sink(prev)
+        reg.close()
+    recs = [json.loads(ln) for ln in open(tmp_path / "s.jsonl")
+            if ln.strip()]
+    drops = [r for r in recs if r["event"] == "fault"
+             and r["kind"] == "net_drop"]
+    assert len(drops) == 1
+    assert drops[0]["target"] == 3 and drops[0]["injected"] is True
+
+
+# ---- loudness contract -----------------------------------------------------
+
+
+def test_unknown_net_fault_args_refuse_to_parse():
+    with pytest.raises(ValueError):
+        faults.parse_fault_spec("net_drop@bogus=1")
+    with pytest.raises(ValueError):
+        faults.parse_fault_spec("slow_net@point=nowhere")  # unknown point
+    with pytest.raises(ValueError):
+        faults.parse_fault_spec("net_lag@target=1")  # unknown kind
+    with pytest.raises(ValueError):
+        faults.parse_fault_spec("slow_net@ms=fast")  # non-int arg
+    # the legit grammar parses
+    specs = faults.parse_fault_spec(
+        "net_drop@target=2,times=3;slow_net@ms=20"
+    )
+    assert [s.kind for s in specs] == ["net_drop", "slow_net"]
+    assert specs[0].target == 2 and specs[0].times == 3
+    assert specs[1].ms == 20
+
+
+# ---- the hub becomes retry-then-miss ---------------------------------------
+
+
+def test_hub_default_fetch_retries_before_missing(server, monkeypatch):
+    from neutronstarlite_tpu.obs import hub as hub_mod
+
+    base, script = server
+    # a valid one-record telemetry payload after one refused attempt
+    payload = json.dumps({
+        "event": "telemetry", "ts": time.time(), "run_id": "x",
+        "source": "serve", "counters": {}, "gauges": {},
+    })
+    script.steps["/telemetry"] = [(500, "blip"), (200, payload)]
+    monkeypatch.setenv("NTS_HTTPC_BACKOFF_S", "0.001")
+    body = hub_mod._default_fetch(f"{base}/telemetry")
+    assert json.loads(body)["event"] == "telemetry"
+    assert script.hits["/telemetry"] == 2  # retried within ONE poll

@@ -134,6 +134,13 @@ def _emit_all(reg: registry.MetricsRegistry) -> None:
         source="partition_step",
     )
     reg.event(
+        "rollout", ckpt_dir="/ckpt/step-5", verdict="promoted",
+        ckpt_step=5, replicas=3, restarted=3, rolled_back=0,
+        canary={"disagreement": 0.0, "tolerance": 0.05, "seeds": 32,
+                "passed": True},
+        seconds=4.2, error=None,
+    )
+    reg.event(
         "run_summary", algorithm="GCNDIST", fingerprint="cafecafecafe",
         counters={"wire.bytes_fwd": 4096}, gauges={}, timings={},
         epochs=1,
@@ -176,6 +183,7 @@ RENDER_MARKERS = {
     "telemetry": "#telemetry=",
     "target_loss": "#target_loss=",
     "straggler": "#straggler=",
+    "rollout": "#rollout=",
     "run_summary": "finish algorithm !",
 }
 
@@ -253,6 +261,7 @@ def test_validator_rejects_mutations_per_kind(tmp_path):
         "telemetry": {"source": ""},
         "target_loss": {"missed_polls": 0},
         "straggler": {"partition": -1},
+        "rollout": {"verdict": ""},
         "run_summary": {"epoch_time": None},
     }
     assert set(mutations) == set(schema.KNOWN_KINDS)
