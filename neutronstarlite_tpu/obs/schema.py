@@ -14,7 +14,7 @@ Envelope (all events):
                    stream_rotated | hist | slo_status | backend_probe |
                    program_cost | model_drift | tensor_stats |
                    nonfinite_provenance | telemetry | target_loss |
-                   straggler | rollout
+                   straggler | rollout | delta_commit | finetune_round
                    (open set)
   run_id: str      "<algo>-<fingerprint>-<pid>"
   schema: int      SCHEMA_VERSION
@@ -106,6 +106,38 @@ graph_delta (serve/delta.py): one live-graph update batch applied to a
   have changed),
   seconds: number | null (plan + apply wall time),
   replica: str | absent (the fleet replica this record's stream serves)
+
+delta_commit (stream/ingest.py): one stream-log entry applied to this
+  process's serving engines — the per-sequence-point receipt of the
+  multi-writer delta log (stream/log.py). graph_delta records the
+  server-side damage; delta_commit records the LOG's total-order facts:
+  which writer's delta landed at which seq, under which dirty-closure
+  mode, with the digest every replica must agree on
+  seq: int > 0 (the log's total-order position),
+  writer: str (non-empty; the committing WriterSession id),
+  writer_seq: int > 0 (position within that writer's session),
+  added_edges / removed_edges / added_vertices: int >= 0,
+  graph_digest: str (non-empty; the canonical digest AT this seq —
+  bitwise-identical to a fresh build, the replicated-apply oracle),
+  dirty: int >= 0 | absent (dirty-region size this entry contributed),
+  dirty_mode: str | absent (exact | bitset),
+  fp_rate: number | absent (bitset mode's measured false-positive rate
+  on an audited commit), seconds: number | null
+
+finetune_round (stream/finetune.py): one completed continuous
+  fine-tune drain — the dirty region between serve flushes trained
+  through the sampled trainer's jitted step, checkpointed through the
+  digest-verified path, and (when wired) published into the
+  canary-gated rollout
+  round: int >= 0,
+  seq_lo / seq_hi: int >= 0 (the drained sequence range, inclusive),
+  dirty: int >= 0 (dirty vertices drained),
+  epochs: int > 0 (epochs-per-drain), batches: int >= 0,
+  loss: number | null (last batch's loss),
+  ckpt_step: int >= 0 (the published checkpoint step),
+  verdict: str | null | absent (the rollout verdict when a publish
+  hook is wired: promoted | canary_reject | ..., open set),
+  seconds: number | null
 
 tune_trial (tune/runner.py): one autotuner candidate scored — a timed
   micro-trial (source=measured), an analytic-prior-only entry
@@ -292,7 +324,8 @@ model_drift (tools/drift_audit.py): an analytic prediction disagreed
   drift: number (signed fraction, observed/predicted - 1; for ranking
   drift, the measured slowdown of the prior's pick vs the measured
   best), threshold: number,
-  source: str (wire_accounting | tune_prior | program_cost, open set),
+  source: str (wire_accounting | tune_prior | program_cost | staleness,
+  open set),
   family / candidate / partitions / graph_digest / backend / layers /
   episode_run_id: open context fields (the tuning episode's cache-key
   facts when the stream carries them),
@@ -349,6 +382,8 @@ KNOWN_KINDS = (
     "target_loss",
     "straggler",
     "rollout",
+    "delta_commit",
+    "finetune_round",
     "run_summary",
 )
 
@@ -538,6 +573,58 @@ def validate_event(obj: Any) -> None:
         _require_number(obj, "seconds", allow_none=True)
         if "replica" in obj and not isinstance(obj["replica"], str):
             _fail("graph_delta.replica must be a string when present")
+    elif kind == "delta_commit":
+        s = obj.get("seq")
+        if not isinstance(s, int) or isinstance(s, bool) or s <= 0:
+            _fail(f"delta_commit.seq must be a positive int, got {s!r}")
+        if not isinstance(obj.get("writer"), str) or not obj["writer"]:
+            _fail("delta_commit.writer must be a non-empty string")
+        ws = obj.get("writer_seq")
+        if not isinstance(ws, int) or isinstance(ws, bool) or ws <= 0:
+            _fail(f"delta_commit.writer_seq must be a positive int, "
+                  f"got {ws!r}")
+        for key in ("added_edges", "removed_edges", "added_vertices"):
+            v = obj.get(key)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                _fail(f"delta_commit.{key} must be a non-negative int, "
+                      f"got {v!r}")
+        gd = obj.get("graph_digest")
+        if not isinstance(gd, str) or not gd:
+            _fail("delta_commit.graph_digest must be a non-empty string")
+        d = obj.get("dirty")
+        if "dirty" in obj and (
+            not isinstance(d, int) or isinstance(d, bool) or d < 0
+        ):
+            _fail(f"delta_commit.dirty must be a non-negative int when "
+                  f"present, got {d!r}")
+        if "dirty_mode" in obj and (
+            not isinstance(obj["dirty_mode"], str) or not obj["dirty_mode"]
+        ):
+            _fail("delta_commit.dirty_mode must be a non-empty string "
+                  "when present")
+        if "fp_rate" in obj:
+            _require_number(obj, "fp_rate", allow_none=True)
+        _require_number(obj, "seconds", allow_none=True)
+    elif kind == "finetune_round":
+        r = obj.get("round")
+        if not isinstance(r, int) or isinstance(r, bool) or r < 0:
+            _fail(f"finetune_round.round must be a non-negative int, "
+                  f"got {r!r}")
+        for key in ("seq_lo", "seq_hi", "dirty", "batches", "ckpt_step"):
+            v = obj.get(key)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                _fail(f"finetune_round.{key} must be a non-negative int, "
+                      f"got {v!r}")
+        e = obj.get("epochs")
+        if not isinstance(e, int) or isinstance(e, bool) or e <= 0:
+            _fail(f"finetune_round.epochs must be a positive int, got {e!r}")
+        _require_number(obj, "loss", allow_none=True)
+        if "verdict" in obj and obj["verdict"] is not None and (
+            not isinstance(obj["verdict"], str) or not obj["verdict"]
+        ):
+            _fail("finetune_round.verdict must be a non-empty string or "
+                  "null")
+        _require_number(obj, "seconds", allow_none=True)
     elif kind in ("tune_trial", "tune_decision"):
         for key in ("candidate", "family", "source"):
             if not isinstance(obj.get(key), str) or not obj[key]:

@@ -73,6 +73,15 @@ slow_net   target (optional),           sleeps ms inside the ``http_fetch``
                                         dead: the fetch still succeeds, so
                                         liveness stays quiet while
                                         deadline accounting is exercised
+writer_crash seq (optional)             hard process death (os._exit) at
+                                        the ``delta_commit`` point, MID
+                                        log-entry write — the stream log's
+                                        torn-tail chaos input. With
+                                        ``seq=k`` only the commit
+                                        assigning sequence number k dies;
+                                        recovery must drop the torn tail
+                                        and keep the committed prefix
+                                        intact (stream/log.py)
 ========== ============================ =======================================
 
 Common args: ``times`` (how often the spec may fire, default 1) makes
@@ -101,6 +110,16 @@ Fault points currently planted:
   the socket opens), with ``target=`` carrying the caller's integer
   index for the endpoint being fetched. net_drop/slow_net fire here —
   the chaos legs of the cross-host router/hub contract.
+- ``delta_commit`` — inside stream/log.DeltaLog's commit, once per
+  assigned sequence number, planted MID entry write (half the serialized
+  line is already on disk) with ``seq=`` carrying the sequence number
+  being committed. writer_crash fires here — the deterministic torn-tail
+  producer for the log-recovery chaos tests.
+- ``finetune_round`` — inside stream/finetune.FineTuneWorker, once per
+  drain round before training starts, with ``epoch=`` carrying the round
+  index; target it with ``exc@point=finetune_round`` to kill one
+  fine-tune round so the supervisor's bounded-retry roll-through is
+  exercisable.
 
 State (parsed plan + per-spec fired counts + the save counter) is
 process-global on purpose: a supervised retry inside the same process
@@ -121,13 +140,14 @@ from neutronstarlite_tpu.utils.logging import get_logger, process_index
 log = get_logger("faults")
 
 FAULT_KINDS = ("nan_loss", "crash", "stall", "ckpt_corrupt", "exc",
-               "rank_loss", "slow_rank", "net_drop", "slow_net")
+               "rank_loss", "slow_rank", "net_drop", "slow_net",
+               "writer_crash")
 
 # every named fault point planted in the codebase; a spec naming any
 # other point would silently never fire — exactly the chaos-test failure
 # parse_fault_spec's loudness contract exists to prevent
 FAULT_POINTS = ("epoch_loss", "save", "sample_produce", "partition_step",
-                "http_fetch")
+                "http_fetch", "delta_commit", "finetune_round")
 
 # where each kind fires when the spec names no point= of its own. exc is
 # the generic in-process failure (raises RuntimeError at its point) —
@@ -143,6 +163,7 @@ DEFAULT_POINTS = {
     "slow_rank": "partition_step",
     "net_drop": "http_fetch",
     "slow_net": "http_fetch",
+    "writer_crash": "delta_commit",
 }
 
 # exit code of a simulated crash — distinguishable from a real failure's
@@ -164,6 +185,8 @@ class FaultSpec:
     # replay's forward at this layer (obs/numerics.poison_hook)
     target: Optional[int] = None  # net_drop/slow_net: only hit fetches
     # of this target index (the caller's replica/target numbering)
+    seq: Optional[int] = None  # writer_crash: only die on the commit
+    # assigning this log sequence number (None: first commit seen)
     times: int = 1  # max firings (one-shot by default)
     point: Optional[str] = None  # fire at this named fault point
     # (default: the kind's classic point, DEFAULT_POINTS)
@@ -174,7 +197,7 @@ class FaultSpec:
 
 
 _INT_ARGS = ("epoch", "rank", "save", "times", "partition", "layer",
-             "target")
+             "target", "seq")
 _ALLOWED_ARGS = frozenset(_INT_ARGS) | {"ms", "point"}
 
 
@@ -299,7 +322,8 @@ def _epoch_matches(spec: FaultSpec, epoch: Optional[int]) -> bool:
 def fault_point(point: str, *, epoch: Optional[int] = None, value=None,
                 path: Optional[str] = None,
                 partition: Optional[int] = None,
-                target: Optional[int] = None):
+                target: Optional[int] = None,
+                seq: Optional[int] = None):
     """Named injection hook. Run loops call it with the point's context
     and thread ``value`` (the epoch loss) through it; matching specs in
     the active plan fire (at most ``times`` each) and may replace the
@@ -309,7 +333,9 @@ def fault_point(point: str, *, epoch: Optional[int] = None, value=None,
     partition's step is executing) — slow_rank matches against it.
     ``target`` is the per-fetch context of the ``http_fetch`` point
     (which endpoint index is being fetched) — net_drop/slow_net match
-    against it."""
+    against it. ``seq`` is the per-commit context of the
+    ``delta_commit`` point (which log sequence number is being
+    committed) — writer_crash matches against it."""
     plan = active_plan()
     if not plan:
         return value
@@ -455,6 +481,23 @@ def fault_point(point: str, *, epoch: Optional[int] = None, value=None,
                 spec.ms, target,
             )
             time.sleep(spec.ms / 1000.0)
+        elif spec.kind == "writer_crash":
+            if spec.seq is not None and spec.seq != seq:
+                continue
+            spec.fired += 1
+            # like crash, the record can only come from the injection
+            # site — the process is gone an instant later. The point is
+            # planted MID entry write, so the log's tail file holds a
+            # torn line the recovery path must drop.
+            events.emit_fault(
+                "writer_crash", point=point, seq=seq, injected=True,
+                rank=process_index(),
+            )
+            log.warning(
+                "injecting writer crash mid-commit of seq %s (exit %d)",
+                seq, CRASH_EXIT_CODE,
+            )
+            os._exit(CRASH_EXIT_CODE)
         elif spec.kind == "ckpt_corrupt":
             if spec.save is not None and spec.save != _save_count:
                 continue

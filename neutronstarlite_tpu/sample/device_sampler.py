@@ -85,6 +85,24 @@ class DeviceUniformSampler:
         self.thinned = int(thinned)  # vertices whose neighbor set was capped
         self.nbr = jax.device_put(nbr)  # [V, D] int32
         self.eff_deg = jax.device_put(eff_deg)  # [V] int32
+        # row-capacity margin (stream/ingest): slack rows beyond V with
+        # eff_deg 0 — never drawn from until a vertex append claims them
+        self.margin = 0
+
+    def reserve_capacity(self, extra_rows: int) -> None:
+        """Pre-size the table with ``extra_rows`` slack rows so vertex
+        appends within the margin PATCH rows in place instead of forcing
+        a full rebuild + re-upload (the stream ingestion contract —
+        docs/STREAMING.md). Slack rows carry eff_deg 0, so no draw ever
+        reads them until a delta's dirty_rows patch claims them."""
+        extra = int(extra_rows)
+        if extra <= 0:
+            return
+        self.margin = max(self.margin, extra)
+        pad_nbr = jnp.zeros((extra, int(self.nbr.shape[1])), dtype=jnp.int32)
+        pad_deg = jnp.zeros((extra,), dtype=jnp.int32)
+        self.nbr = jnp.concatenate([self.nbr, pad_nbr], axis=0)
+        self.eff_deg = jnp.concatenate([self.eff_deg, pad_deg], axis=0)
 
     @classmethod
     def from_host(
@@ -158,8 +176,11 @@ class DeviceUniformSampler:
         # neighbor subsets came from the PRE-delta global priority stream
         # (positions shift with the edge layout), so an in-place patch of
         # other rows would leave them diverged from what a fresh build
-        # over the post-delta graph holds — only full shapes patch
-        if (graph.v_num != int(self.nbr.shape[0]) or needed > self.width
+        # over the post-delta graph holds — only full shapes patch. With
+        # a reserved capacity margin (reserve_capacity), appended
+        # vertices whose rows still fit the table patch like any dirty
+        # row; only OUTGROWING the physical rows forces the rebuild
+        if (graph.v_num > int(self.nbr.shape[0]) or needed > self.width
                 or rows_over or self.thinned > 0):
             log.warning(
                 "device sampler: delta changed the table shape or "
@@ -170,6 +191,8 @@ class DeviceUniformSampler:
             fresh = DeviceUniformSampler.from_host(graph, seed=seed)
             self.nbr, self.eff_deg = fresh.nbr, fresh.eff_deg
             self.width, self.thinned = fresh.width, fresh.thinned
+            if self.margin:
+                self.reserve_capacity(self.margin)  # keep the slack armed
             return graph.v_num
         if len(rows) == 0:
             return 0

@@ -227,6 +227,15 @@ def child_main(argv=None) -> int:
     except ServeSetupError as e:
         print(f"serve replica {args.replica}: {e}", file=sys.stderr)
         return 2
+    # NTS_STREAM_LOG: follow a shared DeltaLog — the margin must be
+    # reserved BEFORE warmup so in-margin appends never touch the ladder
+    stream_root = os.environ.get("NTS_STREAM_LOG", "")
+    ingestor = None
+    if stream_root:
+        from neutronstarlite_tpu.stream.ingest import StreamIngestor
+
+        ingestor = StreamIngestor([engine], log_root=stream_root)
+        ingestor.arm()
     engine.warmup()
     server = InferenceServer(engine, replica=args.replica)
     reg = server.metrics
@@ -304,6 +313,41 @@ def child_main(argv=None) -> int:
 
     signal.signal(signal.SIGTERM, _on_signal)
     signal.signal(signal.SIGINT, _on_signal)
+
+    if ingestor is not None:
+        # tail the shared log: every replica applies the same committed
+        # total order, so the whole fleet converges on the same per-seq
+        # digests without any cross-replica coordination
+        ingestor.servers = [server]
+        poll_s = max(
+            float(os.environ.get("NTS_STREAM_POLL_S", "0.5") or 0.5), 0.01
+        )
+
+        def _tail():
+            while not stop.is_set():
+                try:
+                    applied = ingestor.consume()
+                except Exception:
+                    # divergence / corruption is permanent for this
+                    # replica: stop applying (stale but consistent
+                    # serving beats silently-wrong graphs), keep serving
+                    log.exception(
+                        "replica %s: stream tail failed at seq %d; "
+                        "serving freezes on the last applied graph",
+                        args.replica, ingestor.applied_seq,
+                    )
+                    return
+                if applied:
+                    log.info(
+                        "replica %s: applied %d stream entries, head "
+                        "seq %d", args.replica, len(applied),
+                        ingestor.applied_seq,
+                    )
+                stop.wait(poll_s)
+
+        threading.Thread(
+            target=_tail, name="stream-tail", daemon=True
+        ).start()
 
     if args.port_file:
         _write_port_file(args.port_file, {

@@ -167,8 +167,16 @@ def _out_neighbors(g: CSCGraph, vs: np.ndarray) -> np.ndarray:
     return np.unique(g.column_indices[idx].astype(np.int64))
 
 
-def plan_delta(graph: CSCGraph, delta: GraphDelta, hops: int) -> DeltaPlan:
-    """Turn a delta into the post-delta graph + dirty sets (pure)."""
+def plan_delta(graph: CSCGraph, delta: GraphDelta, hops: int,
+               dirty_closure=None) -> DeltaPlan:
+    """Turn a delta into the post-delta graph + dirty sets (pure).
+
+    ``dirty_closure`` swaps the exact out-closure for an approximate one
+    (stream/ingest.py's bitset tracker): a callable
+    ``(old_graph, new_graph, changed_src, changed_dst, hops) -> dirty``
+    whose result must be a SUPERSET of the exact closure — invalidating
+    extra cache rows costs recompute, missing one serves stale logits.
+    """
     old_src = graph.row_indices.astype(np.int64)
     old_dst = graph.dst_of_edge.astype(np.int64)
     new_v = graph.v_num + int(delta.add_vertices)
@@ -211,26 +219,32 @@ def plan_delta(graph: CSCGraph, delta: GraphDelta, hops: int) -> DeltaPlan:
 
     changed_dst = np.unique(np.concatenate([delta.remove_dst, delta.add_dst]))
     changed_src = np.unique(np.concatenate([delta.remove_src, delta.add_src]))
-    # aggregation inputs that changed: touched destinations (in-degree
-    # renormalizes every in-edge weight) + out-neighbors of touched
-    # sources (out-degree renormalizes every out-edge weight) — walked on
-    # BOTH graphs so removed reach still counts
-    seed = np.unique(np.concatenate([
-        changed_dst,
-        _out_neighbors(graph, changed_src),
-        _out_neighbors(g2, changed_src),
-    ])).astype(np.int64)
-    dirty = seed
-    frontier = seed
-    for _ in range(max(int(hops) - 1, 0)):
-        nxt = np.union1d(
-            _out_neighbors(graph, frontier), _out_neighbors(g2, frontier)
-        )
-        fresh = np.setdiff1d(nxt, dirty, assume_unique=False)
-        if len(fresh) == 0:
-            break
-        dirty = np.union1d(dirty, fresh)
-        frontier = fresh
+    if dirty_closure is not None:
+        dirty = np.unique(np.asarray(
+            dirty_closure(graph, g2, changed_src, changed_dst, int(hops)),
+            dtype=np.int64,
+        ))
+    else:
+        # aggregation inputs that changed: touched destinations (in-degree
+        # renormalizes every in-edge weight) + out-neighbors of touched
+        # sources (out-degree renormalizes every out-edge weight) — walked
+        # on BOTH graphs so removed reach still counts
+        seed = np.unique(np.concatenate([
+            changed_dst,
+            _out_neighbors(graph, changed_src),
+            _out_neighbors(g2, changed_src),
+        ])).astype(np.int64)
+        dirty = seed
+        frontier = seed
+        for _ in range(max(int(hops) - 1, 0)):
+            nxt = np.union1d(
+                _out_neighbors(graph, frontier), _out_neighbors(g2, frontier)
+            )
+            fresh = np.setdiff1d(nxt, dirty, assume_unique=False)
+            if len(fresh) == 0:
+                break
+            dirty = np.union1d(dirty, fresh)
+            frontier = fresh
 
     return DeltaPlan(
         src=src, dst=dst, v_num=new_v, graph=g2, digest=graph_digest(g2),
@@ -269,6 +283,7 @@ def apply_to_engines(engines: Sequence, delta: GraphDelta,
         rows_patched = hop.apply_delta(g, plan.dirty_rows)
         hop_samplers.add(id(hop))
     new_feature = None
+    in_margin = False
     if plan.added_vertices:
         feat = base.feature
         rows = np.asarray(plan.add_features)
@@ -278,9 +293,40 @@ def apply_to_engines(engines: Sequence, delta: GraphDelta,
                 f"add_features must be [{plan.added_vertices}, "
                 f"{feat.shape[1]}], got {rows.shape}"
             )
-        new_feature = jnp.concatenate(
-            [feat, jnp.asarray(rows, dtype=feat.dtype)], axis=0
-        )
+        v0 = plan.v_num - plan.added_vertices
+        if int(feat.shape[0]) >= plan.v_num:
+            # recompile-free path (stream/ingest.reserve_feature_margin):
+            # the slab was pre-sized with capacity slack, so the appended
+            # rows PATCH into reserved space — the shape (and therefore
+            # the AOT ladder's feature aval) never changes; zero bucket
+            # recompiles, compile_counts pinned by tests
+            in_margin = True
+            new_feature = feat.at[v0:plan.v_num].set(
+                jnp.asarray(rows, dtype=feat.dtype)
+            )
+            log.info(
+                "graph delta appended %d vertices within the capacity "
+                "margin (%d slack rows remain): feature rows patched in "
+                "place, AOT bucket ladder untouched",
+                plan.added_vertices, int(feat.shape[0]) - plan.v_num,
+            )
+        else:
+            new_feature = jnp.concatenate(
+                [feat, jnp.asarray(rows, dtype=feat.dtype)], axis=0
+            )
+            if int(feat.shape[0]) > v0 or getattr(base, "margin_armed",
+                                                  False):
+                # margin was armed but this append outgrew it (possibly
+                # with zero slack left): degrade LOUDLY to the full
+                # AOT-invalidation path (the PR 14 behavior) — re-arm
+                # via stream/ingest to restore the recompile-free
+                # contract
+                log.warning(
+                    "graph delta appended %d vertices, OVERFLOWING the "
+                    "capacity margin (%d slack rows available): falling "
+                    "back to the full AOT-invalidation path",
+                    plan.added_vertices, int(feat.shape[0]) - v0,
+                )
 
     toolkits = set()
     ladders = set()
@@ -300,7 +346,7 @@ def apply_to_engines(engines: Sequence, delta: GraphDelta,
             toolkits.add(id(tk))
         if new_feature is not None:
             eng.feature = new_feature
-            if id(eng._compiled) not in ladders:
+            if not in_margin and id(eng._compiled) not in ladders:
                 ladders.add(id(eng._compiled))
                 if eng._compiled:
                     log.warning(
@@ -311,23 +357,33 @@ def apply_to_engines(engines: Sequence, delta: GraphDelta,
                         len(eng._compiled),
                     )
                 eng._compiled.clear()
+    if new_feature is not None:
+        # the fine-tune worker trains over the SAME slab the engines
+        # serve from — keep the shared toolkit's reference current
+        for tk_id, tk in {id(e.toolkit): e.toolkit for e in engines}.items():
+            tk.feature = new_feature
     plan.rows_patched = rows_patched
     return plan
 
 
 def apply_to_servers(servers: Sequence, delta: GraphDelta,
-                     extra_engines: Sequence = ()) -> DeltaPlan:
+                     extra_engines: Sequence = (),
+                     plan: Optional[DeltaPlan] = None,
+                     dirty_closure=None) -> DeltaPlan:
     """The full between-flushes application over one or many servers
     (the fleet path): compute the plan once, take every server's graph
     gate (no flush is mid-produce while the graph swaps), swap engines,
     invalidate only the dirty embedding-cache entries, refresh hot
     masks, bump graph versions, and emit one ``graph_delta`` record per
-    server stream."""
+    server stream. ``plan``/``dirty_closure`` are the stream ingestor's
+    hooks (precomputed plan; approximate dirty closure)."""
     if not servers:
         raise ValueError("apply_to_servers needs at least one server")
     t0 = time.perf_counter()
     base = servers[0].engine
-    plan = plan_delta(base.sampler.graph, delta, hops=len(base.fanouts))
+    if plan is None:
+        plan = plan_delta(base.sampler.graph, delta, hops=len(base.fanouts),
+                          dirty_closure=dirty_closure)
     engines: List = []
     seen = set()
     for eng in [s.engine for s in servers] + list(extra_engines):

@@ -1,0 +1,22 @@
+"""Streaming graph learning: the live half of the serving story.
+
+Three legs (docs/STREAMING.md):
+
+- :mod:`neutronstarlite_tpu.stream.log` — the multi-writer, sequence-
+  numbered, append-only GraphDelta log with deterministic merge
+  semantics and a canonical graph digest at every sequence point.
+- :mod:`neutronstarlite_tpu.stream.ingest` — recompile-free ingestion:
+  a pre-sized vertex-capacity margin so appends patch into reserved
+  slack instead of invalidating the AOT bucket ladder, plus the bitset
+  approximate dirty-closure for high delta rates.
+- :mod:`neutronstarlite_tpu.stream.finetune` — the continuous
+  fine-tune worker draining the accumulated dirty region between serve
+  flushes and publishing checkpoints into the canary-gated rollout.
+"""
+
+from neutronstarlite_tpu.stream.log import (  # noqa: F401
+    DeltaLog,
+    LogEntry,
+    WriterSession,
+    read_log_entries,
+)

@@ -27,7 +27,15 @@ over any obs stream (the CLI), it compares:
   ``NTS_QUANT_TOL``. A ``WIRE_DTYPE:bf16`` tuner decision whose measured
   error exceeds the tolerance gets its tune-cache entry flagged for
   re-trial exactly like a mispriced prior: the decision traded accuracy
-  for bytes on a payload where the trade measurably does not hold.
+  for bytes on a payload where the trade measurably does not hold;
+- **staleness** (the streaming leg, stream/): how far the served model's
+  last fine-tuned sequence point (``finetune_round.seq_hi`` /
+  ``stream.model_seq``) lags the graph head the fleet is actually
+  serving (``delta_commit.seq`` / ``stream.head_seq``). The implicit
+  "prediction" here is the freshness contract — the model was trained
+  on the graph it serves — and a lag beyond ``NTS_STALENESS_TOL``
+  sequence points is that contract measurably broken: the fine-tune
+  worker is not keeping up with the delta rate.
 
 Drift beyond ``--threshold`` (``NTS_DRIFT_TOL``, default 0.1) emits one
 typed ``model_drift`` record per disagreement (rendered by
@@ -241,6 +249,65 @@ def wire_quant_drift(events: List[Dict[str, Any]],
     return out
 
 
+def staleness_drift(events: List[Dict[str, Any]],
+                    tol: Optional[int] = None) -> List[Dict[str, Any]]:
+    """The streaming-freshness leg: per run, the graph-head sequence the
+    fleet serves (max ``delta_commit.seq``, with the run_summary
+    ``stream.head_seq`` gauge as the records-rotated-away fallback) vs
+    the last sequence point the published model was fine-tuned through
+    (max ``finetune_round.seq_hi`` / ``stream.model_seq``). A lag beyond
+    ``NTS_STALENESS_TOL`` emits one ``source="staleness"`` entry — drift
+    and threshold are expressed as fractions of the head (the report's
+    rendering contract); the raw ``lag``/``tol`` counts ride along."""
+    if tol is None:
+        from neutronstarlite_tpu.stream.finetune import (
+            staleness_tol_from_env,
+        )
+
+        tol = staleness_tol_from_env()
+    heads: Dict[str, int] = {}
+    models: Dict[str, int] = {}
+    for e in events:
+        rid = e.get("run_id")
+        kind = e.get("event")
+        if kind == "delta_commit":
+            s = _num(e.get("seq"))
+            if s is not None:
+                heads[rid] = max(heads.get(rid, 0), int(s))
+        elif kind == "finetune_round":
+            s = _num(e.get("seq_hi"))
+            if s is not None:
+                models[rid] = max(models.get(rid, 0), int(s))
+        elif kind == "run_summary":
+            g = e.get("gauges") or {}
+            h, m = _num(g.get("stream.head_seq")), _num(
+                g.get("stream.model_seq"))
+            if h is not None:
+                heads[rid] = max(heads.get(rid, 0), int(h))
+            if m is not None:
+                models[rid] = max(models.get(rid, 0), int(m))
+    out: List[Dict[str, Any]] = []
+    for rid, head in sorted(heads.items(), key=lambda kv: str(kv[0])):
+        model = models.get(rid, 0)
+        lag = head - model
+        if lag <= tol or head <= 0:
+            continue
+        out.append({
+            "metric": "model_staleness_seq",
+            "source": "staleness",
+            "predicted": float(head),
+            "observed": float(model),
+            "drift": float(model) / head - 1.0,
+            "threshold": float(tol) / head,
+            "head_seq": head,
+            "model_seq": model,
+            "lag": lag,
+            "tol": int(tol),
+            "episode_run_id": rid,
+        })
+    return out
+
+
 def audit_events(events: List[Dict[str, Any]],
                  threshold: Optional[float] = None,
                  quant_threshold: Optional[float] = None
@@ -258,6 +325,7 @@ def audit_events(events: List[Dict[str, Any]],
             ))
     out.extend(tune_prior_drift(events, threshold))
     out.extend(wire_quant_drift(events, quant_threshold))
+    out.extend(staleness_drift(events))
     return out
 
 
@@ -433,6 +501,14 @@ def main(argv=None) -> int:
             print(f"drift audit: no prediction drifted beyond "
                   f"{threshold:.0%}")
         for d in drifts:
+            if d.get("source") == "staleness":
+                print(
+                    f"model drift: {d['metric']} model at seq "
+                    f"{d['observed']:g} vs graph head {d['predicted']:g} "
+                    f"(lag {d['lag']} > NTS_STALENESS_TOL {d['tol']}, "
+                    f"source=staleness)"
+                )
+                continue
             extra = ""
             if d.get("candidate"):
                 extra = (

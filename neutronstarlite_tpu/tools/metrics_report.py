@@ -292,6 +292,7 @@ def render_serve(path: str, rec: Dict[str, Any],
         )
     lines.extend(render_sample(rec))
     lines.extend(rec.get("_deltas") or [])
+    lines.extend(rec.get("_stream") or [])
     lines.extend(rec.get("_cost") or [])
     lines.extend(rec.get("_drift") or [])
     lines.extend(rec.get("_numerics") or [])
@@ -605,6 +606,60 @@ def render_deltas(events: List[Dict[str, Any]]) -> List[str]:
     return lines
 
 
+_MAX_STREAM_LINES = 20
+
+
+def render_stream(events: List[Dict[str, Any]]) -> List[str]:
+    """The streaming-graph block (stream/): every ``delta_commit``
+    receipt (the multi-writer log's total-order facts per sequence
+    point) and every ``finetune_round`` drain, with the closing
+    head-vs-model staleness summary. Empty for non-streaming runs."""
+    commits = [e for e in events if e["event"] == "delta_commit"]
+    rounds = [e for e in events if e["event"] == "finetune_round"]
+    if not (commits or rounds):
+        return []
+    lines = ["stream:"]
+    for i, e in enumerate(commits):
+        if i >= _MAX_STREAM_LINES:
+            lines.append(
+                f"  ... and {len(commits) - _MAX_STREAM_LINES} more "
+                "commit(s) (full detail in the stream)"
+            )
+            break
+        secs = e.get("seconds")
+        fp = e.get("fp_rate")
+        lines.append(
+            f"#delta_commit=seq {e['seq']} [{e['writer']}#"
+            f"{e['writer_seq']}] +{e['added_edges']}e "
+            f"-{e['removed_edges']}e +{e['added_vertices']}v "
+            f"dirty={e.get('dirty', 0)} "
+            f"({e.get('dirty_mode', 'exact')}"
+            + (f", fp={fp:.3f}" if fp is not None else "")
+            + f") digest={str(e['graph_digest'])[:12]}"
+            + (f" ({secs * 1000:.1f} ms)" if secs is not None else "")
+        )
+    for e in rounds:
+        secs = e.get("seconds")
+        loss = e.get("loss")
+        lines.append(
+            f"#finetune_round={e['round']} seq {e['seq_lo']}.."
+            f"{e['seq_hi']} dirty={e['dirty']} "
+            f"epochs={e['epochs']} batches={e['batches']} "
+            + (f"loss={loss:.4f} " if loss is not None else "loss=n/a ")
+            + f"ckpt_step={e['ckpt_step']}"
+            + (f" rollout={e['verdict']}" if e.get("verdict") else "")
+            + (f" ({secs:.2f}s)" if secs is not None else "")
+        )
+    if commits and rounds:
+        head = commits[-1]["seq"]
+        model = rounds[-1]["seq_hi"]
+        lines.append(
+            f"#stream_staleness=model at seq {model} vs graph head "
+            f"{head} (lag {max(head - model, 0)})"
+        )
+    return lines
+
+
 def render_numerics(events: List[Dict[str, Any]],
                     rec: Dict[str, Any]) -> List[str]:
     """The numerics-health block (obs/numerics, NTS_NUMERICS=1): the
@@ -869,6 +924,7 @@ def render_run(path: str, rec: Dict[str, Any]) -> str:
     lines.extend(rec.get("_ring") or [])
     lines.extend(rec.get("_tune") or [])
     lines.extend(rec.get("_deltas") or [])
+    lines.extend(rec.get("_stream") or [])
     lines.extend(rec.get("_cost") or [])
     lines.extend(rec.get("_drift") or [])
     lines.extend(rec.get("_numerics") or [])
@@ -1214,6 +1270,27 @@ def main(argv=None) -> int:
                     "_probe": probe_lines,
                 })
                 continue
+            only_stream = render_stream(events)
+            if only_stream:
+                # a stream-only file (a tailing replica's delta_commit /
+                # finetune_round receipts with no run behind them, e.g. a
+                # rotated-away or ingest-sidecar stream) renders the
+                # streaming block natively
+                rows.append({
+                    "event": "stream_report",
+                    "run_id": events[-1]["run_id"] if events else "?",
+                    "delta_commits": sum(
+                        1 for e in events if e["event"] == "delta_commit"
+                    ),
+                    "finetune_rounds": sum(
+                        1 for e in events if e["event"] == "finetune_round"
+                    ),
+                    "_path": p,
+                    "_stream_only": True,
+                    "_stream": only_stream,
+                    "_hists": render_hists(events),
+                })
+                continue
             # a run_start-only stream (trainer constructed/crashed before
             # its first epoch) is skippable noise, not a render failure —
             # but a directory yielding NOTHING still exits 1 below
@@ -1230,6 +1307,7 @@ def main(argv=None) -> int:
         slo_lines = slo_timeline(events)
         drift_lines = render_drift(events)
         delta_lines = render_deltas(events)
+        stream_lines = render_stream(events)
         numerics_lines = render_numerics(events, rec or {})
         if rec is not None:
             rec["_path"] = p
@@ -1237,6 +1315,7 @@ def main(argv=None) -> int:
             rec["_ring"] = render_ring(events, rec)
             rec["_tune"] = render_tuning(events, rec)
             rec["_deltas"] = delta_lines
+            rec["_stream"] = stream_lines
             rec["_cost"] = render_program_costs(events, rec)
             rec["_drift"] = drift_lines
             rec["_numerics"] = numerics_lines
@@ -1251,6 +1330,7 @@ def main(argv=None) -> int:
             srec["_events"] = events
             srec["_serve"] = True
             srec["_deltas"] = delta_lines if rec is None else []
+            srec["_stream"] = stream_lines if rec is None else []
             srec["_cost"] = (
                 render_program_costs(events, srec) if rec is None else []
             )
@@ -1284,6 +1364,12 @@ def main(argv=None) -> int:
                     lines.append("recovery timeline:")
                     lines.extend(timeline)
                 print("\n".join(lines))
+            elif rec.get("_stream_only"):
+                lines = [f"== stream {rec.get('run_id', '?')} — "
+                         f"{rec['_path']}"]
+                lines.extend(rec["_stream"])
+                lines.extend(rec.get("_hists") or [])
+                print("\n".join(lines))
             elif rec.get("_serve"):
                 print(render_serve(rec["_path"], rec, rec["_events"]))
             else:
@@ -1291,7 +1377,8 @@ def main(argv=None) -> int:
             print()
         train_rows = [r for r in rows if not r.get("_serve")
                       and not r.get("_probe_only")
-                      and not r.get("_fleet_only")]
+                      and not r.get("_fleet_only")
+                      and not r.get("_stream_only")]
         if len(train_rows) > 1:
             print(render_table(train_rows))
     return 1 if failed else 0

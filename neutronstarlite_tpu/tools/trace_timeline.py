@@ -201,7 +201,7 @@ def load_streams(paths: List[str]) -> List[Stream]:
 
 _INSTANT_KINDS = ("fault", "recovery", "shed", "rank_loss", "replan",
                   "tune_trial", "tune_decision", "slo_status",
-                  "backend_probe")
+                  "backend_probe", "delta_commit", "finetune_round")
 _ENVELOPE_OR_SPAN = (
     "event", "run_id", "schema", "ts", "seq", "name", "cat", "span_id",
     "trace_id", "parent_id", "t0", "dur_s", "rank", "thread",

@@ -338,3 +338,43 @@ def test_report_renders_drift_block(tmp_path, capsys):
     assert rc == 0
     assert "prediction drift:" in out
     assert "#model_drift=wire_bytes_fwd_per_epoch" in out
+
+
+# ---- streaming staleness leg (docs/STREAMING.md) ----------------------------
+
+
+def _stream_events(head, model, rid="r1"):
+    evs = [{"run_id": rid, "event": "delta_commit", "seq": s}
+           for s in range(1, head + 1)]
+    if model:
+        evs.append({"run_id": rid, "event": "finetune_round",
+                    "round": 0, "seq_hi": model})
+    return evs
+
+
+def test_staleness_within_tolerance_is_silent():
+    assert drift_audit.staleness_drift(_stream_events(10, 8), tol=2) == []
+
+
+def test_staleness_beyond_tolerance_reports():
+    (d,) = drift_audit.staleness_drift(_stream_events(10, 4), tol=2)
+    assert d["metric"] == "model_staleness_seq"
+    assert d["source"] == "staleness"
+    assert d["head_seq"] == 10 and d["model_seq"] == 4 and d["lag"] == 6
+    # drift/threshold are fractions of the head (report rendering contract)
+    assert d["drift"] == pytest.approx(4 / 10 - 1.0)
+    assert d["threshold"] == pytest.approx(2 / 10)
+
+
+def test_never_finetuned_model_is_maximally_stale():
+    (d,) = drift_audit.staleness_drift(_stream_events(5, 0), tol=2)
+    assert d["model_seq"] == 0 and d["lag"] == 5
+
+
+def test_staleness_falls_back_to_run_summary_gauges():
+    """delta_commit records can rotate away; the run_summary gauges carry
+    the same head/model pair."""
+    evs = [{"run_id": "r2", "event": "run_summary",
+            "gauges": {"stream.head_seq": 12, "stream.model_seq": 3}}]
+    (d,) = drift_audit.staleness_drift(evs, tol=4)
+    assert d["lag"] == 9 and d["episode_run_id"] == "r2"

@@ -141,6 +141,16 @@ def _emit_all(reg: registry.MetricsRegistry) -> None:
         seconds=4.2, error=None,
     )
     reg.event(
+        "delta_commit", seq=3, writer="w1", writer_seq=2, added_edges=4,
+        removed_edges=1, added_vertices=1, graph_digest="feed" * 16,
+        dirty=12, dirty_mode="bitset", fp_rate=0.05, seconds=0.004,
+    )
+    reg.event(
+        "finetune_round", round=0, seq_lo=1, seq_hi=3, dirty=12, epochs=2,
+        batches=6, loss=0.42, ckpt_step=7, verdict="promoted",
+        seconds=1.25,
+    )
+    reg.event(
         "run_summary", algorithm="GCNDIST", fingerprint="cafecafecafe",
         counters={"wire.bytes_fwd": 4096}, gauges={}, timings={},
         epochs=1,
@@ -184,6 +194,8 @@ RENDER_MARKERS = {
     "target_loss": "#target_loss=",
     "straggler": "#straggler=",
     "rollout": "#rollout=",
+    "delta_commit": "#delta_commit=",
+    "finetune_round": "#finetune_round=",
     "run_summary": "finish algorithm !",
 }
 
@@ -262,6 +274,8 @@ def test_validator_rejects_mutations_per_kind(tmp_path):
         "target_loss": {"missed_polls": 0},
         "straggler": {"partition": -1},
         "rollout": {"verdict": ""},
+        "delta_commit": {"seq": 0},
+        "finetune_round": {"epochs": 0},
         "run_summary": {"epoch_time": None},
     }
     assert set(mutations) == set(schema.KNOWN_KINDS)
@@ -269,3 +283,30 @@ def test_validator_rejects_mutations_per_kind(tmp_path):
         bad = dict(events[kind], **mut)
         with pytest.raises(ValueError):
             schema.validate_event(bad)
+
+
+def test_stream_only_file_renders_natively(tmp_path, capsys):
+    """A file holding only streaming receipts (delta_commit /
+    finetune_round with no run_summary, epoch, or serve events — e.g. an
+    ingest-sidecar or rotated-away stream) renders the stream block
+    natively instead of "skipping", the same courtesy probe-only and
+    hub-merged streams get."""
+    path = tmp_path / "stream_only.jsonl"
+    reg = registry.MetricsRegistry("rs", algorithm="G", fingerprint="f",
+                                   path=str(path))
+    reg.event("delta_commit", seq=1, writer="w1", writer_seq=1,
+              added_edges=2, removed_edges=0, added_vertices=1,
+              graph_digest="d1", dirty=5, dirty_mode="exact",
+              seconds=0.01)
+    reg.event("finetune_round", round=0, seq_lo=1, seq_hi=1, dirty=5,
+              epochs=1, batches=3, loss=0.9, ckpt_step=0,
+              verdict="promoted", seconds=0.5)
+    reg.close()
+    from neutronstarlite_tpu.tools.metrics_report import main as report_main
+
+    rc = report_main([str(path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "== stream" in out
+    assert "#delta_commit=seq 1" in out
+    assert "#finetune_round=0" in out

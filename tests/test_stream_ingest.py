@@ -1,0 +1,303 @@
+"""stream/ingest: recompile-free ingestion — the capacity margin keeps
+the AOT ladder untouched across in-margin vertex appends (pinned via
+compile_counts), overflow degrades LOUDLY to full invalidation, served
+predictions stay bitwise-fresh either way, and the bitset dirty closure
+is a measured superset of exact (ISSUE 18)."""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import numpy as np
+import pytest
+
+from neutronstarlite_tpu.graph.storage import build_graph
+from neutronstarlite_tpu.models.gcn_sample import GCNSampleTrainer
+from neutronstarlite_tpu.serve.delta import GraphDelta, plan_delta
+from neutronstarlite_tpu.serve.engine import InferenceEngine
+from neutronstarlite_tpu.stream.ingest import (
+    BitsetDirtyTracker, StreamIngestor, dirty_mode_from_env,
+    margin_from_env,
+)
+from neutronstarlite_tpu.stream.log import DeltaLog
+from tests.test_models import _planted_data
+from tests.test_serve import _serve_cfg
+
+
+@pytest.fixture(scope="module")
+def trained(tmp_path_factory):
+    os.environ["NTS_SAMPLE_WORKERS"] = "0"
+    try:
+        cfg = _serve_cfg()
+        cfg.serve_max_batch = 8
+        cfg.checkpoint_dir = str(tmp_path_factory.mktemp("stream") / "ckpt")
+        src, dst, datum = _planted_data(v_num=300, seed=11)
+        toolkit = GCNSampleTrainer.from_arrays(cfg, src, dst, datum)
+        pristine_graph = toolkit.host_graph
+        toolkit.run()
+    finally:
+        os.environ.pop("NTS_SAMPLE_WORKERS", None)
+    return toolkit, cfg, datum, pristine_graph
+
+
+def _engine(toolkit, cfg, graph, v=300):
+    """A fresh engine over a PRISTINE toolkit: earlier tests pad/patch
+    the module toolkit's shared feature slab and repoint host_graph at
+    their post-delta head (by design — the fine-tune worker trains over
+    the live slab), so reset both to the fixture state first. Rows
+    0..v-1 of the slab are never rewritten by appends."""
+    toolkit.feature = toolkit.feature[:v]
+    toolkit.host_graph = graph
+    return InferenceEngine(toolkit, cfg.checkpoint_dir,
+                           rng=np.random.default_rng(123))
+
+
+def _vertex_append_delta(v_now, f, k=1, seed=0):
+    """Append k vertices, each wired to a fixed low vertex."""
+    rng = np.random.default_rng(seed)
+    add = []
+    for i in range(k):
+        add.extend([(7, v_now + i), (v_now + i, 11)])
+    return GraphDelta.edges(
+        add=add, add_vertices=k,
+        add_features=(rng.standard_normal((k, f)) * 0.1).astype(np.float32),
+    )
+
+
+def _populated_log(tmp_path, graph, feat_dim, *, appends=2):
+    root = str(tmp_path / "log")
+    log_ = DeltaLog(root, graph)
+    w1, w2 = log_.writer("w1"), log_.writer("w2")
+    v = graph.v_num
+    for i in range(appends):
+        w1.stage(_vertex_append_delta(v, feat_dim, seed=i))
+        w2.stage(GraphDelta.edges(add=[(3 * i, 5), (5, 3 * i + 1)]))
+        log_.commit()
+        v += 1
+    return root, log_
+
+
+# ---- the margin: zero recompiles inside, loud degrade outside ---------------
+
+
+def test_in_margin_appends_never_touch_the_ladder(trained, tmp_path):
+    """THE recompile-free pin: with a margin covering every append, the
+    2-writer stream applies with compile_counts IDENTICAL to warmup —
+    and served predictions are bitwise what a fresh engine on the
+    post-delta graph serves."""
+    toolkit, cfg, datum, graph = trained
+    os.environ["NTS_SAMPLE_WORKERS"] = "0"
+    try:
+        eng = _engine(toolkit, cfg, graph)
+        ing = StreamIngestor([eng], margin=4, dirty_mode="exact")
+        ing.arm()  # BEFORE warmup: the ladder compiles on the padded aval
+        eng.warmup()
+        counts_after_warmup = dict(eng.compile_counts)
+        assert all(v == 1 for v in counts_after_warmup.values())
+
+        f = int(eng.feature.shape[1])
+        root, log_ = _populated_log(tmp_path, eng.sampler.graph, f,
+                                    appends=2)
+        applied = ing.consume(root)
+        assert [e.seq for e in applied] == [1, 2, 3, 4]
+        assert ing.head_seq == 4
+
+        # zero recompiles: the SAME dict, bucket for bucket
+        assert dict(eng.compile_counts) == counts_after_warmup
+        # the slab never changed shape (rows patched into the slack)...
+        assert int(eng.feature.shape[0]) == 300 + 4
+        assert eng.sampler.graph.v_num == 302
+        # ...and the digest chain matches the log head
+        assert eng.graph_digest() == log_.head_digest
+
+        # bitwise oracle vs a fresh unpadded engine on the final graph
+        # (datum extended with the streamed-in feature rows, so the
+        # fresh side actually KNOWS the appended vertices)
+        from neutronstarlite_tpu.graph.dataset import GNNDatum
+
+        head = log_.head_graph
+        rows = np.concatenate([
+            np.asarray(e.delta.add_features) for e in log_.entries()
+            if e.delta.add_features is not None
+        ])
+        datum2 = GNNDatum(
+            feature=np.concatenate([datum.feature, rows]),
+            label=np.concatenate(
+                [datum.label, np.zeros(len(rows), np.int32)]),
+            mask=np.concatenate(
+                [datum.mask, np.full(len(rows), 2, np.int32)]),
+        )
+        fresh_tk = GCNSampleTrainer.from_arrays(
+            cfg, head.row_indices.astype(np.uint32),
+            head.dst_of_edge.astype(np.uint32), datum2, host_graph=head,
+        )
+        eng2 = InferenceEngine(fresh_tk, cfg.checkpoint_dir,
+                               rng=np.random.default_rng(123))
+        rng = np.random.default_rng(9)
+        for _ in range(3):
+            seeds = rng.integers(0, 302, size=int(rng.integers(1, 8)))
+            np.testing.assert_array_equal(
+                eng.predict(seeds), eng2.predict(seeds)
+            )
+    finally:
+        os.environ.pop("NTS_SAMPLE_WORKERS", None)
+
+
+def test_margin_overflow_degrades_loudly(trained, tmp_path):
+    """Appends past the reserved slack fall back to the PR 14 concat +
+    full-invalidation path — with a WARNING naming the overflow — and
+    serving stays correct (just slower)."""
+    toolkit, cfg, _datum, graph = trained
+    os.environ["NTS_SAMPLE_WORKERS"] = "0"
+    try:
+        eng = _engine(toolkit, cfg, graph)
+        ing = StreamIngestor([eng], margin=1, dirty_mode="exact")
+        ing.arm()
+        eng.warmup()
+        f = int(eng.feature.shape[1])
+        root, log_ = _populated_log(tmp_path, eng.sampler.graph, f,
+                                    appends=2)  # 2 appends > margin 1
+        records = []
+        handler = logging.Handler()
+        handler.emit = records.append
+        serve_logger = logging.getLogger("nts.serve")
+        serve_logger.addHandler(handler)
+        try:
+            ing.consume(root)
+        finally:
+            serve_logger.removeHandler(handler)
+        assert any(
+            "OVERFLOWING the capacity margin" in r.getMessage()
+            for r in records if r.levelno >= logging.WARNING
+        )
+        # past the margin the slab had to grow -> ladder invalidated,
+        # but the graph and digest chain are still exact
+        assert eng.sampler.graph.v_num == 302
+        assert eng.graph_digest() == log_.head_digest
+        assert int(eng.feature.shape[0]) == 302
+        vals = eng.predict(np.array([301, 7, 11]))
+        assert np.isfinite(np.asarray(vals)).all()
+    finally:
+        os.environ.pop("NTS_SAMPLE_WORKERS", None)
+
+
+def test_out_of_order_apply_is_refused(trained, tmp_path):
+    toolkit, cfg, _datum, graph = trained
+    eng = _engine(toolkit, cfg, graph)
+    ing = StreamIngestor([eng], margin=0, dirty_mode="exact")
+    f = int(eng.feature.shape[1])
+    root, log_ = _populated_log(tmp_path, eng.sampler.graph, f, appends=1)
+    entries = log_.entries()
+    with pytest.raises(ValueError, match="replay the log"):
+        ing.apply(entries[1])  # seq 2 before seq 1
+
+
+# ---- the bitset dirty closure: superset of exact, measured fp ---------------
+
+
+def _rand_graph(v=120, e=600, seed=4):
+    rng = np.random.default_rng(seed)
+    src = rng.integers(0, v, e).astype(np.uint32)
+    dst = rng.integers(0, v, e).astype(np.uint32)
+    return build_graph(src, dst, v, use_native=False)
+
+
+def test_bitset_closure_is_superset_of_exact():
+    """The soundness direction, directly: for a pile of random deltas,
+    every exact-dirty vertex is inside the bitset closure (few buckets
+    -> heavy collisions -> the hard case for the invariant)."""
+    g = _rand_graph()
+    rng = np.random.default_rng(7)
+    for buckets in (8, 32, 1024):
+        tracker = BitsetDirtyTracker(g, buckets=buckets)
+        for trial in range(5):
+            pairs = [(int(rng.integers(0, g.v_num)),
+                      int(rng.integers(0, g.v_num))) for _ in range(6)]
+            delta = GraphDelta.edges(add=pairs)
+            tracker.observe_delta(delta)
+            exact = plan_delta(g, delta, hops=2)
+            approx = plan_delta(g, delta, hops=2,
+                                dirty_closure=tracker.closure)
+            missing = np.setdiff1d(exact.dirty, approx.dirty)
+            assert len(missing) == 0, (
+                f"buckets={buckets} trial={trial}: bitset closure missed "
+                f"{missing[:10]}"
+            )
+            # the graphs themselves are identical — only dirty differs
+            assert approx.digest == exact.digest
+
+
+def test_bitset_ingest_audits_fp_rate(trained, tmp_path):
+    """NTS_STREAM_DIRTY=bitset end to end: the ingestor audits every
+    apply (audit_every=1), never trips the superset invariant, and
+    publishes the measured stream.dirty_fp_rate gauge."""
+    toolkit, cfg, _datum, graph = trained
+    os.environ["NTS_SAMPLE_WORKERS"] = "0"
+    try:
+        eng = _engine(toolkit, cfg, graph)
+        ing = StreamIngestor([eng], margin=4, dirty_mode="bitset",
+                             buckets=64, audit_every=1)
+        ing.arm()
+        f = int(eng.feature.shape[1])
+        root, log_ = _populated_log(tmp_path, eng.sampler.graph, f,
+                                    appends=2)
+        ing.consume(root)
+        assert ing.head_seq == 4
+        assert eng.graph_digest() == log_.head_digest
+        fp = ing.tracker.fp_rate
+        assert 0.0 <= fp <= 1.0
+        if eng.metrics is not None:
+            snap = eng.metrics.snapshot(include_hists=False)
+            assert "stream.dirty_fp_rate" in snap["gauges"]
+        # the dirty feed accumulated across entries, then resets
+        dirty, lo, hi = ing.take_dirty()
+        assert (lo, hi) == (1, 4) and len(dirty) > 0
+        d2, lo2, hi2 = ing.take_dirty()
+        assert len(d2) == 0 and hi2 < lo2
+    finally:
+        os.environ.pop("NTS_SAMPLE_WORKERS", None)
+
+
+def test_bitset_rebuild_drops_stale_bits():
+    g = _rand_graph(v=64, e=128, seed=9)
+    tracker = BitsetDirtyTracker(g, buckets=16)
+    tracker.adj[:] = True  # worst-case staleness
+    tracker.rebuild(g)
+    fresh = BitsetDirtyTracker(g, buckets=16)
+    np.testing.assert_array_equal(tracker.adj, fresh.adj)
+    assert not tracker.adj.all()
+
+
+# ---- env knob parsing -------------------------------------------------------
+
+
+def test_env_knobs(monkeypatch):
+    monkeypatch.setenv("NTS_STREAM_VERTEX_MARGIN", "32")
+    assert margin_from_env() == 32
+    monkeypatch.setenv("NTS_STREAM_VERTEX_MARGIN", "junk")
+    assert margin_from_env() == 0
+    monkeypatch.setenv("NTS_STREAM_DIRTY", "bitset")
+    assert dirty_mode_from_env() == "bitset"
+    monkeypatch.setenv("NTS_STREAM_DIRTY", "fuzzy")
+    with pytest.raises(ValueError, match="fuzzy"):
+        dirty_mode_from_env()
+
+
+def test_dirty_biased_seeds_split():
+    from neutronstarlite_tpu.sample.sampler import dirty_biased_seeds
+
+    rng = np.random.default_rng(0)
+    seed_nids = np.arange(100)
+    dirty = np.arange(10)  # 10 dirty, 90 clean
+    out = dirty_biased_seeds(seed_nids, dirty, 20, 0.7, rng)
+    assert len(out) == 20 and len(np.unique(out)) == 20
+    n_dirty = int(np.isin(out, dirty).sum())
+    # want 14 dirty but only 10 exist: all 10 taken, clean fills the rest
+    assert n_dirty == 10
+    # small-n case: the bias fraction rounds but the total always holds
+    out2 = dirty_biased_seeds(seed_nids, dirty, 3, 0.7, rng)
+    assert len(out2) == 3
+    # no dirty at all: pure clean sample
+    out3 = dirty_biased_seeds(seed_nids, np.empty(0, np.int64), 5, 0.7, rng)
+    assert len(out3) == 5 and not np.isin(out3, dirty[:0]).any()

@@ -1,0 +1,249 @@
+"""stream/log: the multi-writer delta log — canonical-order determinism
+(two shuffled stage orders -> the same digest sequence, the multi-writer
+bitwise oracle), per-seq digest == fresh build, replay-from-seq,
+torn-tail recovery, seal/dedup, and the writer_crash subprocess chaos
+kill (ISSUE 18)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from neutronstarlite_tpu.graph.digest import graph_digest
+from neutronstarlite_tpu.graph.storage import build_graph
+from neutronstarlite_tpu.serve.delta import GraphDelta
+from neutronstarlite_tpu.stream.log import (
+    DeltaLog, TAIL_NAME, read_log_entries,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _base_graph(v=40, e=160, seed=3):
+    rng = np.random.default_rng(seed)
+    src = rng.integers(0, v, e).astype(np.uint32)
+    dst = rng.integers(0, v, e).astype(np.uint32)
+    return src, dst, build_graph(src, dst, v, use_native=False)
+
+
+def _writer_deltas(graph, writer_seed):
+    """Three add-only deltas per writer (add-only keeps any interleaving
+    valid — removals are exercised separately where order is single)."""
+    rng = np.random.default_rng(writer_seed)
+    out = []
+    for _ in range(3):
+        pairs = [(int(rng.integers(0, graph.v_num)),
+                  int(rng.integers(0, graph.v_num))) for _ in range(4)]
+        out.append(GraphDelta.edges(add=pairs))
+    return out
+
+
+# ---- determinism: the multi-writer bitwise oracle ---------------------------
+
+
+def test_interleaved_stage_orders_commit_identically(tmp_path):
+    """THE multi-writer oracle: the same per-writer delta streams staged
+    in two different arrival interleavings commit to the SAME total
+    order and the SAME per-seq digest sequence."""
+    _, _, g = _base_graph()
+    per_writer = {w: _writer_deltas(g, seed) for w, seed in
+                  (("alice", 7), ("bob", 8), ("carol", 9))}
+
+    # order 1: round-robin across writers
+    log1 = DeltaLog(str(tmp_path / "log1"), g)
+    for i in range(3):
+        for w in ("alice", "bob", "carol"):
+            log1.writer(w).stage(per_writer[w][i])
+    log1.commit()
+
+    # order 2: each writer's whole stream at once, writers reversed
+    log2 = DeltaLog(str(tmp_path / "log2"), g)
+    for w in ("carol", "bob", "alice"):
+        for d in per_writer[w]:
+            log2.writer(w).stage(d)
+    log2.commit()
+
+    assert log1.digest_sequence() == log2.digest_sequence()
+    assert [(e.seq, e.writer, e.writer_seq) for e in log1.entries()] == \
+           [(e.seq, e.writer, e.writer_seq) for e in log2.entries()]
+    assert log1.head_digest == log2.head_digest
+    # the canonical order is (writer_id, writer_seq), NOT arrival
+    assert [e.writer for e in log1.entries()] == \
+        ["alice"] * 3 + ["bob"] * 3 + ["carol"] * 3
+
+
+def test_per_seq_digest_is_fresh_build(tmp_path):
+    """Every recorded digest equals a fresh deterministic build at that
+    sequence point (replayed via iter_graphs from the base)."""
+    src, dst, g = _base_graph()
+    log_ = DeltaLog(str(tmp_path / "log"), g)
+    w = log_.writer("w0")
+    w.stage(GraphDelta.edges(add=[(1, 2), (3, 4)]))
+    w.stage(GraphDelta.edges(
+        add=[(5, 40)], remove=[(int(src[0]), int(dst[0]))],
+        add_vertices=1, add_features=np.ones((1, 4), np.float32),
+    ))
+    log_.commit()
+    digests = log_.digest_sequence()
+    assert len(digests) == 2
+    fresh_base = build_graph(src, dst, g.v_num, use_native=False)
+    for (seq, graph), recorded in zip(log_.iter_graphs(fresh_base), digests):
+        assert graph_digest(graph) == recorded, f"seq {seq} diverged"
+    # a feature roundtrip through JSON is exact (float32 -> JSON -> f32)
+    e2 = log_.entries()[1]
+    np.testing.assert_array_equal(
+        e2.delta.add_features, np.ones((1, 4), np.float32)
+    )
+    assert e2.delta.add_features.dtype == np.float32
+
+
+def test_replay_from_seq_and_reopen(tmp_path):
+    _, _, g = _base_graph()
+    log_ = DeltaLog(str(tmp_path / "log"), g)
+    w = log_.writer("w0")
+    for d in _writer_deltas(g, 5):
+        w.stage(d)
+    log_.commit()
+    assert [e.seq for e in log_.entries(after_seq=1)] == [2, 3]
+    assert [e.seq for e in read_log_entries(str(tmp_path / "log"),
+                                            after_seq=2)] == [3]
+    # reopen verifies the digest chain and lands on the same head
+    re = DeltaLog(str(tmp_path / "log"), g)
+    assert re.head_seq == 3 and re.head_digest == log_.head_digest
+    # ...but a WRONG base graph is refused
+    _, _, other = _base_graph(seed=99)
+    with pytest.raises(ValueError, match="wrong base graph"):
+        DeltaLog(str(tmp_path / "log"), other)
+
+
+def test_empty_delta_refused_and_invalid_commit_atomic(tmp_path):
+    _, _, g = _base_graph()
+    log_ = DeltaLog(str(tmp_path / "log"), g)
+    with pytest.raises(ValueError, match="empty"):
+        log_.writer("w0").stage(GraphDelta.edges())
+    # an invalid delta anywhere in the batch aborts the WHOLE commit:
+    # nothing written, nothing staged lost
+    log_.writer("w0").stage(GraphDelta.edges(add=[(0, 1)]))
+    log_.writer("w1").stage(GraphDelta.edges(remove=[(39, 39)]))
+    before = list(log_.entries())
+    with pytest.raises(ValueError):
+        log_.commit()
+    assert log_.entries() == before and log_.head_seq == 0
+    assert len(log_.writer("w1").staged) == 1
+    # dropping the bad delta lets the good one through
+    log_.writer("w1").staged.clear()
+    assert [e.seq for e in log_.commit()] == [1]
+
+
+# ---- durability: torn tail, seal, dedup -------------------------------------
+
+
+def test_torn_tail_dropped_committed_prefix_intact(tmp_path):
+    _, _, g = _base_graph()
+    root = str(tmp_path / "log")
+    log_ = DeltaLog(root, g)
+    w = log_.writer("w0")
+    for d in _writer_deltas(g, 5):
+        w.stage(d)
+    log_.commit()
+    # tear the tail: a half-written 4th line (no newline, broken JSON)
+    with open(os.path.join(root, TAIL_NAME), "ab") as fh:
+        fh.write(b'{"seq":4,"writer":"w0","wr')
+    re = DeltaLog(root, g)
+    assert re.head_seq == 3
+    assert re.recovered_dropped == 1
+    assert re.digest_sequence() == log_.digest_sequence()
+    # recovery REWROTE the tail: a second open sees nothing torn
+    assert DeltaLog(root, g).recovered_dropped == 0
+
+
+def test_seal_compacts_and_readers_dedup(tmp_path):
+    _, _, g = _base_graph()
+    root = str(tmp_path / "log")
+    log_ = DeltaLog(root, g)
+    w = log_.writer("w0")
+    deltas = _writer_deltas(g, 6)
+    w.stage(deltas[0])
+    w.stage(deltas[1])
+    log_.commit()
+    seg = log_.seal()
+    assert seg and os.path.basename(seg) == "seg-00000001-00000002.jsonl"
+    w.stage(deltas[2])
+    log_.commit()
+    assert [e.seq for e in log_.entries()] == [1, 2, 3]
+    # simulate the crash window between segment publish and tail
+    # truncation: duplicate seq 1-2 back into the tail — dedup wins
+    with open(seg) as fh:
+        dup = fh.read()
+    tail = os.path.join(root, TAIL_NAME)
+    with open(tail) as fh:
+        tail_body = fh.read()
+    with open(tail, "w") as fh:
+        fh.write(dup + tail_body)
+    assert [e.seq for e in read_log_entries(root)] == [1, 2, 3]
+    assert DeltaLog(root, g).head_digest == log_.head_digest
+
+
+# ---- chaos: writer_crash@seq=k (hard kill MID entry write) ------------------
+
+_CRASH_SCRIPT = """
+import os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np
+from neutronstarlite_tpu.graph.storage import build_graph
+from neutronstarlite_tpu.serve.delta import GraphDelta
+from neutronstarlite_tpu.stream.log import DeltaLog
+
+rng = np.random.default_rng(3)
+src = rng.integers(0, 40, 160).astype(np.uint32)
+dst = rng.integers(0, 40, 160).astype(np.uint32)
+g = build_graph(src, dst, 40, use_native=False)
+log_ = DeltaLog(sys.argv[1], g)
+w = log_.writer("w0")
+for i in range(3):
+    w.stage(GraphDelta.edges(add=[(i, i + 1), (i + 2, i)]))
+log_.commit()
+print("SURVIVED", log_.head_seq)
+"""
+
+
+def test_writer_crash_mid_commit_leaves_committed_prefix(tmp_path):
+    """writer_crash@seq=2 hard-kills the writer with HALF of seq 2's
+    line durably on disk; recovery drops exactly the torn line, keeps
+    seq 1, and the log accepts new commits that REUSE seq 2."""
+    from neutronstarlite_tpu.resilience import faults
+
+    root = str(tmp_path / "log")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["NTS_FAULT_SPEC"] = "writer_crash@seq=2"
+    r = subprocess.run(
+        [sys.executable, "-c", _CRASH_SCRIPT, root],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+    assert r.returncode == faults.CRASH_EXIT_CODE, (
+        r.returncode, r.stdout[-2000:], r.stderr[-2000:],
+    )
+    assert "SURVIVED" not in r.stdout
+    # the torn tail is physically there: seq 1 complete + half of seq 2
+    raw = open(os.path.join(root, TAIL_NAME), "rb").read()
+    assert raw.count(b"\n") == 1 and not raw.endswith(b"\n")
+
+    # the injection site logged before dying (the record that can only
+    # come from the kill site — nothing survives to detect it after)
+    assert "injecting writer crash mid-commit of seq 2" in (
+        r.stdout + r.stderr
+    )
+
+    _, _, g = _base_graph()
+    re = DeltaLog(root, g)
+    assert re.head_seq == 1 and re.recovered_dropped == 1
+    # the recovered log keeps working: the next commit reuses seq 2
+    re.writer("w1").stage(GraphDelta.edges(add=[(0, 3)]))
+    assert [e.seq for e in re.commit()] == [2]
+    assert DeltaLog(root, g).head_seq == 2
