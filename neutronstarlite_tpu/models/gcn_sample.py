@@ -62,7 +62,8 @@ class GCNSampleTrainer(ToolkitBase):
     # ever sees padded batch subgraphs — uploading the full edge set to HBM
     # would waste gigabytes at Reddit scale for arrays never touched
     needs_device_graph = False
-    # SAMPLE_PIPELINE (sample/pipeline.py): sync | pipelined | device
+    # SAMPLE_PIPELINE (sample/pipeline.py): sync | pipelined | device |
+    # fused (sample/fused.py: whole epochs as one scanned dispatch)
     supports_sample_pipeline = True
 
     def _finalize_datum(self) -> None:
@@ -89,18 +90,19 @@ class GCNSampleTrainer(ToolkitBase):
         # background thread; device additionally draws each hop on-device
         self.sample_mode = resolve_sample_pipeline(cfg)
         hop_sampler = None
-        if self.sample_mode == "device":
+        if self.sample_mode in ("device", "fused"):
             # the device table upload is a JAX backend touch, which is
-            # fine here: device mode samples inline (no forked pool)
+            # fine here: both modes sample inline (no forked pool); the
+            # fused epoch scan reads the SAME resident neighbor table
             from neutronstarlite_tpu.sample.device_sampler import (
                 DeviceUniformSampler,
             )
 
             hop_sampler = DeviceUniformSampler.from_host(self.host_graph)
             log.info(
-                "SAMPLE_PIPELINE:device — on-device uniform hop sampler "
+                "SAMPLE_PIPELINE:%s — on-device uniform hop sampler "
                 "(neighbor table [%d, %d], %d pre-thinned vertices)",
-                self.host_graph.v_num, hop_sampler.width,
+                self.sample_mode, self.host_graph.v_num, hop_sampler.width,
                 hop_sampler.thinned,
             )
         # one object for every worker count (workers=0 runs inline): the
@@ -236,6 +238,54 @@ class GCNSampleTrainer(ToolkitBase):
             "wire.feature_gather_bytes_per_batch",
             self._gather_bytes_per_batch,
         )
+        # sample.h2d_bytes accounting (single-definition formula,
+        # tools/wire_accounting): the sync path ships one padded batch
+        # payload per step; the pipeline producer MEASURES the same
+        # number per staged batch; fused ships nothing per batch
+        from neutronstarlite_tpu.tools.wire_accounting import (
+            sample_batch_payload_bytes,
+        )
+
+        self._sample_payload_bytes = sample_batch_payload_bytes(
+            caps, self.fanouts
+        )
+
+        # SAMPLE_PIPELINE:fused (sample/fused.py): whole epochs run as
+        # ONE AOT-compiled lax.scan over the resident neighbor/degree
+        # tables — draw -> remap -> gather -> train per batch with zero
+        # per-batch H2D. The step math is the SAME batch_loss +
+        # adam_update composition train_batch jits (draws are
+        # distribution-equivalent to the host sampler, docs/SAMPLING.md)
+        self._fused = None
+        if self.sample_mode == "fused":
+            from neutronstarlite_tpu.sample.fused import (
+                FusedEpochRunner,
+                degree_tables,
+            )
+
+            hs = self.par_sampler.hop_sampler
+            tables = (hs.nbr, hs.eff_deg) + degree_tables(self.host_graph)
+            numerics_on = self._numerics_on
+
+            def fused_step(params, opt_state, feature, label, nodes,
+                           hops, seed_mask, seeds, key):
+                loss, grads = jax.value_and_grad(batch_loss)(
+                    params, feature, label, nodes, hops, seed_mask,
+                    seeds, key,
+                )
+                params, opt_state = adam_update(
+                    params, grads, opt_state, adam_cfg
+                )
+                if numerics_on:
+                    stats = numerics.step_stats(params=params, grads=grads)
+                    return params, opt_state, loss, stats
+                return params, opt_state, loss
+
+            self._fused = FusedEpochRunner(
+                fused_step, caps, self.fanouts, cfg.batch_size, tables,
+                np.where(self.datum.mask == 0)[0],
+                metrics=self.metrics, has_stats=numerics_on,
+            )
 
     def aot_args(self):
         """The exact argument tuple run() passes to the jitted per-batch
@@ -292,6 +342,74 @@ class GCNSampleTrainer(ToolkitBase):
             yield arrays
         self._last_sample_s = sample_s
 
+    def _after_epoch(self, epoch: int, t0: float, losses, stats_dev,
+                     dispatch_s: float, device_s: float) -> None:
+        """Shared epoch-end bookkeeping for the per-batch and fused
+        (one-dispatch) loops: numerics/chaos hooks, loss history, the
+        sampling counters — ``sample.h2d_bytes`` priced per batch on the
+        sync path (the wire_accounting formula), producer-MEASURED when
+        pipelined/device, and exactly 0 when fused — the typed
+        epoch/epoch_scan records, and the epoch-boundary checkpoint
+        hook (for fused runs this IS the scan boundary)."""
+        cfg = self.cfg
+        fused = self._fused is not None
+        self.maybe_emit_numerics(epoch, stats_dev)
+        # chaos hook (NTS_FAULT_SPEC): nan_loss/stall/crash fire
+        # here, before the loss reaches history or the guards
+        epoch_loss = fault_point(
+            "epoch_loss", epoch=epoch,
+            value=float(np.mean([float(l) for l in losses])),
+        )
+        dt = get_time() - t0
+        self.epoch_times.append(dt)
+        self.loss_history.append(float(epoch_loss))
+        # fused gathers features on-device from the resident slab: the
+        # wire gather AND the per-batch H2D payload are structurally 0
+        gather_bytes = (
+            0 if fused else len(losses) * self._gather_bytes_per_batch
+        )
+        if self.sample_mode in ("sync", "fused"):
+            # pipelined/device measure this per staged batch in the
+            # producer (sample/pipeline.py); sync prices the formula
+            h2d = 0 if fused else len(losses) * self._sample_payload_bytes
+            self.metrics.counter_add("sample.h2d_bytes", h2d)
+        self.metrics.counter_add("sample.batches", len(losses))
+        self.metrics.counter_add(
+            "wire.feature_gather_bytes", gather_bytes
+        )
+        if fused:
+            self.metrics.event(
+                "epoch_scan", bucket=int(self._fused.n_batches),
+                batches=len(losses), dispatches=1, h2d_bytes=0,
+                epoch=int(epoch), seconds=round(dt, 6),
+            )
+        # the host-observable epoch split (the fullbatch/gcn_dist
+        # attribution from PR 5, completing the trainer family):
+        # sample_wait = host time blocked on sampling (serial
+        # sample time when sync; residual pipeline stall when
+        # pipelined; 0 when fused — sampling is inside the scan),
+        # step_dispatch = time issuing async device steps (ONE scan
+        # dispatch when fused), step_device = the epoch-end wait for
+        # the device to drain
+        stages = {
+            "sample_wait": self._last_sample_s,
+            "step_dispatch": dispatch_s,
+            "step_device": device_s,
+        }
+        self.emit_epoch(
+            epoch, dt, self.loss_history[-1], stages=stages,
+            batches=len(losses), feature_gather_bytes=gather_bytes,
+        )
+        if (
+            epoch % max(1, cfg.epochs // 10) == 0
+            or epoch == cfg.epochs - 1
+        ):
+            log.info(
+                "Epoch %d loss %f (%d batches)",
+                epoch, self.loss_history[-1], len(losses),
+            )
+        self.ckpt_epoch_end(epoch)
+
     def run(self) -> Dict[str, Any]:
         cfg = self.cfg
         key = jax.random.PRNGKey(self.seed + 1)
@@ -307,7 +425,8 @@ class GCNSampleTrainer(ToolkitBase):
         # the inference engine restores exactly these step dirs
         start_epoch = self.ckpt_begin()
         pipeline = None
-        if self.sample_mode != "sync" and start_epoch < cfg.epochs:
+        if self.sample_mode in ("pipelined", "device") \
+                and start_epoch < cfg.epochs:
             from neutronstarlite_tpu.sample.pipeline import SamplePipeline
 
             # fresh pipeline per run(): a supervised retry re-enters here
@@ -322,6 +441,27 @@ class GCNSampleTrainer(ToolkitBase):
                 losses = []
                 dispatch_s = 0.0
                 stats_dev = None
+                if self._fused is not None:
+                    # ONE dispatch: shuffle + per-batch draw/remap/
+                    # gather/train all inside the scanned program; the
+                    # epoch-end block is the only sync point and the
+                    # ckpt/numerics hooks below run at this scan boundary
+                    td = get_time()
+                    (self.params, self.opt_state, losses_dev,
+                     stats_dev) = self._fused.run_epoch(
+                        self.params, self.opt_state, self.feature,
+                        self.label, epoch, key,
+                    )
+                    dispatch_s = get_time() - td
+                    t_wait = get_time()
+                    jax.block_until_ready(losses_dev)
+                    device_s = get_time() - t_wait
+                    losses = list(np.asarray(losses_dev))
+                    loss = losses[-1]
+                    self._last_sample_s = 0.0
+                    self._after_epoch(epoch, t0, losses, stats_dev,
+                                      dispatch_s, device_s)
+                    continue
                 for bi, (nodes, hops, seed_mask, seeds) in enumerate(
                     self._epoch_batches(epoch, pipeline)
                 ):
@@ -348,46 +488,8 @@ class GCNSampleTrainer(ToolkitBase):
                 t_wait = get_time()
                 jax.block_until_ready(loss)
                 device_s = get_time() - t_wait
-                self.maybe_emit_numerics(epoch, stats_dev)
-                # chaos hook (NTS_FAULT_SPEC): nan_loss/stall/crash fire
-                # here, before the loss reaches history or the guards
-                epoch_loss = fault_point(
-                    "epoch_loss", epoch=epoch,
-                    value=float(np.mean([float(l) for l in losses])),
-                )
-                dt = get_time() - t0
-                self.epoch_times.append(dt)
-                self.loss_history.append(float(epoch_loss))
-                gather_bytes = len(losses) * self._gather_bytes_per_batch
-                self.metrics.counter_add("sample.batches", len(losses))
-                self.metrics.counter_add(
-                    "wire.feature_gather_bytes", gather_bytes
-                )
-                # the host-observable epoch split (the fullbatch/gcn_dist
-                # attribution from PR 5, completing the trainer family):
-                # sample_wait = host time blocked on sampling (serial
-                # sample time when sync; residual pipeline stall when
-                # pipelined — the measured overlap win), step_dispatch =
-                # time issuing async device steps, step_device = the
-                # epoch-end wait for the device to drain
-                stages = {
-                    "sample_wait": self._last_sample_s,
-                    "step_dispatch": dispatch_s,
-                    "step_device": device_s,
-                }
-                self.emit_epoch(
-                    epoch, dt, self.loss_history[-1], stages=stages,
-                    batches=len(losses), feature_gather_bytes=gather_bytes,
-                )
-                if (
-                    epoch % max(1, cfg.epochs // 10) == 0
-                    or epoch == cfg.epochs - 1
-                ):
-                    log.info(
-                        "Epoch %d loss %f (%d batches)",
-                        epoch, self.loss_history[-1], len(losses),
-                    )
-                self.ckpt_epoch_end(epoch)
+                self._after_epoch(epoch, t0, losses, stats_dev,
+                                  dispatch_s, device_s)
         finally:
             # drain on ANY exit — early stop, guard trip, worker fault —
             # so no producer thread outlives its epoch loop

@@ -217,6 +217,7 @@ def run_row(
     epochs = summary.get("epochs") or 0
     wire = counters.get("wire.bytes_fwd")
     stall = counters.get("sample.stall_ms")
+    h2d = counters.get("sample.h2d_bytes")
     return {
         "kind": "run",
         "ts": time.time(),
@@ -234,6 +235,9 @@ def run_row(
         ),
         "sample_stall_ms_per_epoch": (
             stall / epochs if stall is not None and epochs > 0 else None
+        ),
+        "sample_h2d_bytes_per_epoch": (
+            h2d / epochs if h2d is not None and epochs > 0 else None
         ),
         "edge_hbm_bytes_per_epoch": gauges.get(
             "kernel.edge_hbm_bytes_per_epoch"

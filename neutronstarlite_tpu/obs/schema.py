@@ -14,7 +14,8 @@ Envelope (all events):
                    stream_rotated | hist | slo_status | backend_probe |
                    program_cost | model_drift | tensor_stats |
                    nonfinite_provenance | telemetry | target_loss |
-                   straggler | rollout | delta_commit | finetune_round
+                   straggler | rollout | delta_commit | finetune_round |
+                   epoch_scan
                    (open set)
   run_id: str      "<algo>-<fingerprint>-<pid>"
   schema: int      SCHEMA_VERSION
@@ -23,6 +24,16 @@ Envelope (all events):
 
 epoch:
   epoch: int >= 0, seconds: number > 0, loss: number | null
+
+epoch_scan (models/gcn_sample.py, SAMPLE_PIPELINE:fused): one fused
+  lax.scan epoch — the whole draw→remap→gather→train loop ran as a
+  single XLA dispatch with zero per-batch host→device transfer
+  bucket: int > 0 (the per-epoch batch-count bucket the scan program
+  was compiled for), batches: int > 0 (batches the scan consumed this
+  epoch), dispatches: int > 0 (XLA dispatches for the epoch — the
+  zero-H2D contract pins this to 1), h2d_bytes: int >= 0 (per-batch
+  sample payload bytes shipped host→device inside the epoch — pinned
+  to 0 in fused mode), epoch: int | absent, seconds: number | absent
 
 ring_step (parallel/dist_ring_blocked.py): one rotation hop of the
   ring-pipelined exchange, per epoch — bytes shipped per device across
@@ -384,6 +395,7 @@ KNOWN_KINDS = (
     "rollout",
     "delta_commit",
     "finetune_round",
+    "epoch_scan",
     "run_summary",
 )
 
@@ -550,6 +562,23 @@ def validate_event(obj: Any) -> None:
         b = obj.get("bucket")
         if b is not None and not isinstance(b, int):
             _fail(f"batch_flush.bucket must be an int or null, got {b!r}")
+    elif kind == "epoch_scan":
+        for key in ("bucket", "batches", "dispatches"):
+            v = obj.get(key)
+            if not isinstance(v, int) or isinstance(v, bool) or v <= 0:
+                _fail(f"epoch_scan.{key} must be a positive int, got "
+                      f"{v!r}")
+        hb = obj.get("h2d_bytes")
+        if not isinstance(hb, int) or isinstance(hb, bool) or hb < 0:
+            _fail(f"epoch_scan.h2d_bytes must be a non-negative int, got "
+                  f"{hb!r}")
+        if "epoch" in obj and (
+            not isinstance(obj["epoch"], int) or isinstance(obj["epoch"], bool)
+        ):
+            _fail(f"epoch_scan.epoch must be an int when present, got "
+                  f"{obj['epoch']!r}")
+        if "seconds" in obj:
+            _require_number(obj, "seconds", allow_none=True)
     elif kind == "shed":
         if not isinstance(obj.get("reason"), str) or not obj["reason"]:
             _fail("shed.reason must be a non-empty string")

@@ -1,0 +1,342 @@
+"""Fused on-device sampling: draw -> remap -> gather -> train, ONE program.
+
+``SAMPLE_PIPELINE:device`` (sample/device_sampler.py) moved only the
+per-hop draw on-device — dedup/remap/weights still round-trip through the
+host, so every mini-batch pays an H2D staging copy and a fresh dispatch.
+This module takes the hardware-sampling direction (PAPERS.md,
+arXiv:2209.02916) to its limit, ``SAMPLE_PIPELINE:fused``: the WHOLE
+batch — seed shuffle, hop draws, dedup/remap, feature gather,
+forward/backward, optimizer — is one jitted program over the resident
+neighbor table (device_sampler's fixed-width [V, D] layout), the degree
+vectors and the (margin-padded, stream-compatible) feature slab; whole
+epochs then wrap in ``lax.scan`` over per-(epoch, index) fold-in keys so
+a training epoch is ONE dispatch with ZERO per-batch host->device
+transfer (``sample.h2d_bytes`` reads exactly 0 — scalar dispatch
+operands like the epoch index are not batch payload and are not
+counted).
+
+The fixed-shape trick everywhere: every hop works at the sampler's
+static capacities (``node_caps``/``fanouts``, sample/sampler.py), so one
+program per batch-count bucket compiles once and replays — the serve
+AOT ladder's discipline (serve/engine.py), now applied to training.
+
+On-device dedup+remap (:func:`device_dedup_remap`) reproduces the host
+``np.unique + np.searchsorted`` sorted-unique semantics exactly with a
+stable sort + new-run cumsum + fixed-width scatter; capacity overflow is
+impossible in-pipeline because a hop's candidate count equals its unique
+capacity (``ecap = node_caps[h+1] * fanout == node_caps[h]``) by the
+sampler's capacity construction.
+
+Determinism contract (docs/SAMPLING.md): fused draws consume
+``jax.random`` fold-in streams keyed on (epoch, batch index, hop), so
+fused mode is DISTRIBUTION-equivalent to the host sampler (same
+top-k-of-uniform-priorities construction; the statistical oracles in
+tests pin it) and BITWISE deterministic across reruns of the same seed —
+the same contract device mode carries, now for the whole batch.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neutronstarlite_tpu.utils.logging import get_logger
+
+log = get_logger("fused_sample")
+
+# fold-in tags separating the fused key streams: the per-batch key feeds
+# dropout exactly like the sync path's bkey; the draw stream must not
+# alias it or sampling would correlate with dropout masks
+_DRAW_TAG = 0x5eed
+_SHUFFLE_TAG = 0x5f0e
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def device_dedup_remap(src, valid, ncap: int):
+    """On-device ``np.unique`` + ``np.searchsorted`` at fixed width.
+
+    ``src [E]`` candidate global ids, ``valid [E]`` which entries are
+    real draws; returns ``(uniq [ncap], src_local [E], n_uniq)`` where
+    ``uniq`` holds the sorted distinct valid ids (zero-padded past
+    ``n_uniq``) and ``src_local[i]`` is the batch-local index of
+    ``src[i]`` in ``uniq`` (0 for invalid entries — the host padder's
+    fill). Matches the host dedup bit-for-bit: sorted-unique order,
+    searchsorted indices.
+
+    Construction: invalid slots are priced to the dtype's max sentinel,
+    a STABLE argsort groups equal ids into runs, the run-head flags
+    cumsum into dense ranks, and a ``mode='drop'`` scatter places each
+    run head at its rank (ranks past ``ncap`` fall off the edge instead
+    of corrupting memory — in-pipeline they cannot occur, since the
+    sampler's ``ecap == ncap`` capacity identity bounds uniques by
+    construction). Real ids must stay below the sentinel (graph vertex
+    ids always do: ``v_num < iinfo(int32).max``).
+    """
+    E = src.shape[0]
+    sent = jnp.iinfo(src.dtype).max
+    keyv = jnp.where(valid, src, sent)
+    order = jnp.argsort(keyv)  # stable: equal ids keep input order
+    sv = keyv[order]
+    prev = jnp.concatenate([jnp.full((1,), -1, dtype=sv.dtype), sv[:-1]])
+    new_run = (sv != prev) & (sv != sent)
+    rank = jnp.cumsum(new_run) - 1  # dense rank of each sorted slot's id
+    n_uniq = new_run.sum().astype(jnp.int32)
+    uniq = jnp.zeros((ncap,), dtype=src.dtype).at[
+        jnp.where(new_run, rank, ncap)
+    ].set(sv, mode="drop")
+    sorted_local = jnp.where(sv != sent, rank, 0).astype(jnp.int32)
+    src_local = jnp.zeros((E,), dtype=jnp.int32).at[order].set(sorted_local)
+    return uniq, src_local, n_uniq
+
+
+def _draw_hop(nbr, eff_deg, key, dsts_pad, n_dst, fanout: int):
+    """One fused uniform without-replacement draw over a PADDED dst set:
+    the device_sampler._hop construction (k smallest per-slot priorities,
+    padding slots priced out at 2) plus the row mask ``row < n_dst`` —
+    padded dst rows index row 0, which has a REAL effective degree, so
+    without the mask they would contribute phantom draws."""
+    rows = nbr[dsts_pad]  # [dcap, D]
+    eff = eff_deg[dsts_pad]
+    slot = jnp.arange(rows.shape[1])[None, :]
+    prio = jax.random.uniform(key, rows.shape)
+    prio = jnp.where(slot < eff[:, None], prio, 2.0)
+    k = min(int(fanout), int(rows.shape[1]))
+    neg, idx = jax.lax.top_k(-prio, k)
+    src = jnp.take_along_axis(rows, idx, axis=1)  # [dcap, k]
+    valid = -neg < 1.5
+    valid = valid & (jnp.arange(rows.shape[0])[:, None] < n_dst)
+    if k < fanout:  # table narrower than the fanout: pad draw columns
+        pad = int(fanout) - k
+        src = jnp.pad(src, ((0, 0), (0, pad)))
+        valid = jnp.pad(valid, ((0, 0), (0, pad)))
+    return src, valid
+
+
+def fused_sample_subgraph(
+    nbr, eff_deg, out_deg, in_deg, seeds_pad, n_real, key,
+    node_caps: Tuple[int, ...], fanouts: Tuple[int, ...],
+):
+    """The whole padded multi-hop subgraph of one seed batch, on-device.
+
+    The traced twin of ``Sampler._make_batch`` (sample/sampler.py): walks
+    hops outermost-in at the sampler's static capacities and returns
+    ``(nodes, hops)`` in exactly the batch-array structure the trainers'
+    ``batch_forward`` consumes — ``nodes[l] [node_caps[l]]`` padded
+    global ids, ``hops[h] = (src_local, dst_local, weight)`` each at
+    ``ecap_h = node_caps[h+1] * fanouts[h]``. ``n_real`` is the traced
+    live-seed count (padded seed rows never draw); weights are the
+    GCN-norm ``1/sqrt(out_deg * in_deg)``, 0 on padding, like the host.
+    """
+    n_hops = len(fanouts)
+    nodes = [None] * (n_hops + 1)
+    hops = [None] * n_hops
+    nodes[-1] = seeds_pad
+    cur, cur_n = seeds_pad, n_real
+    for h in range(n_hops - 1, -1, -1):
+        fanout = int(fanouts[h])
+        dcap = int(node_caps[h + 1])
+        ncap = int(node_caps[h])
+        hkey = jax.random.fold_in(key, h)
+        src2d, valid2d = _draw_hop(nbr, eff_deg, hkey, cur, cur_n, fanout)
+        src = src2d.reshape(-1)  # [ecap], row-major: slot r*fanout+j
+        valid = valid2d.reshape(-1)
+        dst_idx = jnp.repeat(
+            jnp.arange(dcap, dtype=jnp.int32), fanout
+        )
+        uniq, src_local, n_uniq = device_dedup_remap(src, valid, ncap)
+        d_out = jnp.maximum(out_deg[src], 1).astype(jnp.float32)
+        d_in = jnp.maximum(in_deg[cur[dst_idx]], 1).astype(jnp.float32)
+        w = jnp.where(valid, 1.0 / jnp.sqrt(d_out * d_in), 0.0)
+        hops[h] = (
+            src_local,
+            jnp.where(valid, dst_idx, 0),
+            w.astype(jnp.float32),
+        )
+        nodes[h] = uniq
+        cur, cur_n = uniq, n_uniq
+    return nodes, hops
+
+
+def degree_tables(graph):
+    """Device-resident int32 degree vectors for the fused weight math —
+    uploaded once next to the neighbor table, read by every fused batch."""
+    out_deg = jax.device_put(jnp.asarray(graph.out_degree, jnp.int32))
+    in_deg = jax.device_put(jnp.asarray(graph.in_degree, jnp.int32))
+    return out_deg, in_deg
+
+
+class FusedEpochRunner:
+    """One AOT-compiled ``lax.scan`` program per batch-count bucket.
+
+    ``step_fn(params, opt_state, feature, label, nodes, hops, seed_mask,
+    seeds, key)`` is the trainer's UNJITTED per-batch update (loss +
+    grad + optimizer; with ``has_stats`` it returns a 4th numerics-stats
+    pytree). The runner wraps seed shuffle + per-batch fused sampling +
+    ``step_fn`` in one scanned program, compiles it AHEAD OF TIME via
+    ``jax.jit(...).lower(...).compile()`` (the serve ladder's explicit
+    compile-count discipline — ``compile_counts`` proves one compile per
+    bucket, ever) and replays it once per epoch: one dispatch, zero
+    per-batch H2D.
+
+    Epoch boundaries are the scan boundaries: checkpoint hooks, numerics
+    emission and loss-history/guard reads all happen between dispatches
+    on materialized host values — a mid-epoch rollback lands on the
+    previous scan's output exactly like the sync path's epoch end.
+    """
+
+    def __init__(
+        self,
+        step_fn,
+        node_caps: Sequence[int],
+        fanouts: Sequence[int],
+        batch_size: int,
+        tables,
+        train_nids,
+        metrics: Any = None,
+        has_stats: bool = False,
+    ):
+        self.step_fn = step_fn
+        self.node_caps = tuple(int(c) for c in node_caps)
+        self.fanouts = tuple(int(f) for f in fanouts)
+        self.batch_size = int(batch_size)
+        self.nbr, self.eff_deg, self.out_deg, self.in_deg = tables
+        nids = np.asarray(train_nids, dtype=np.int32)
+        self.n_seeds = int(len(nids))
+        if self.n_seeds == 0:
+            raise ValueError("fused sampling needs at least one seed")
+        self.train_nids = jax.device_put(jnp.asarray(nids))
+        self.n_batches = -(-self.n_seeds // self.batch_size)  # ceil
+        self.metrics = metrics
+        self.has_stats = bool(has_stats)
+        self._fns: Dict[int, Any] = {}
+        self._compiled: Dict[int, Any] = {}
+        self.compile_counts: Dict[int, int] = {}
+        self._lock = threading.Lock()
+
+    # ---- program construction -------------------------------------------
+    def build_epoch_fn(self, n_batches: int):
+        """The pure epoch function for a batch-count bucket (cached; also
+        the structural-pin surface — tests ``jax.make_jaxpr`` this and
+        assert one ``scan`` and no host callbacks in the epoch body)."""
+        fn = self._fns.get(n_batches)
+        if fn is not None:
+            return fn
+        B = self.batch_size
+        caps, fanouts = self.node_caps, self.fanouts
+        n_seeds, step, has_stats = self.n_seeds, self.step_fn, self.has_stats
+
+        def epoch_fn(params, opt_state, feature, label, nbr, eff_deg,
+                     out_deg, in_deg, train_nids, epoch, key):
+            ekey = jax.random.fold_in(key, epoch)
+            # on-device epoch shuffle: the host sampler's per-epoch
+            # reshuffle, from the scan program's own fold-in stream
+            perm = jax.random.permutation(
+                jax.random.fold_in(ekey, _SHUFFLE_TAG), n_seeds
+            )
+            shuffled = train_nids[perm]
+            total = n_batches * B
+            seeds_flat = jnp.zeros(
+                (total,), dtype=shuffled.dtype
+            ).at[:n_seeds].set(shuffled)
+            mask_flat = (jnp.arange(total) < n_seeds).astype(jnp.float32)
+            seeds_mat = seeds_flat.reshape(n_batches, B)
+            mask_mat = mask_flat.reshape(n_batches, B)
+            counts = mask_mat.sum(axis=1).astype(jnp.int32)
+
+            def body(carry, xs):
+                params, opt_state = carry
+                seeds, smask, n_live, bi = xs
+                # the sync loop's per-batch key schedule, so dropout
+                # streams line up with the host path's epoch*100003+bi
+                bkey = jax.random.fold_in(key, epoch * 100003 + bi)
+                skey = jax.random.fold_in(bkey, _DRAW_TAG)
+                nodes, hops = fused_sample_subgraph(
+                    nbr, eff_deg, out_deg, in_deg, seeds, n_live, skey,
+                    caps, fanouts,
+                )
+                out = step(params, opt_state, feature, label, nodes,
+                           hops, smask, seeds, bkey)
+                if has_stats:
+                    params, opt_state, loss, stats = out
+                    return (params, opt_state), (loss, stats)
+                params, opt_state, loss = out
+                return (params, opt_state), loss
+
+            xs = (seeds_mat, mask_mat, counts,
+                  jnp.arange(n_batches, dtype=jnp.int32))
+            (params, opt_state), ys = jax.lax.scan(
+                body, (params, opt_state), xs
+            )
+            if has_stats:
+                losses, stats = ys
+                # the sync loop keeps the LAST batch's stats per epoch
+                stats_last = jax.tree_util.tree_map(lambda a: a[-1], stats)
+                return params, opt_state, losses, stats_last
+            return params, opt_state, ys
+
+        self._fns[n_batches] = epoch_fn
+        return epoch_fn
+
+    def _epoch_args(self, params, opt_state, feature, label, epoch, key):
+        return (params, opt_state, feature, label, self.nbr, self.eff_deg,
+                self.out_deg, self.in_deg, self.train_nids,
+                np.int32(epoch), key)
+
+    def _ensure_compiled(self, n_batches: int, args):
+        compiled = self._compiled.get(n_batches)
+        if compiled is not None:
+            return compiled
+        with self._lock:
+            compiled = self._compiled.get(n_batches)
+            if compiled is not None:
+                return compiled
+            fn = self.build_epoch_fn(n_batches)
+            t0 = time.perf_counter()
+            compiled = jax.jit(fn).lower(*args).compile()
+            dt = time.perf_counter() - t0
+            self._compiled[n_batches] = compiled
+            self.compile_counts[n_batches] = (
+                self.compile_counts.get(n_batches, 0) + 1
+            )
+            if self.metrics is not None:
+                self.metrics.counter_add(
+                    f"sample.epoch_compiles.b{n_batches}"
+                )
+                from neutronstarlite_tpu.obs.cost import (
+                    capture_program_cost,
+                )
+
+                capture_program_cost(
+                    self.metrics, f"sample.epoch_scan_b{n_batches}",
+                    compiled=compiled, bucket=n_batches,
+                    compile_s=round(dt, 4),
+                )
+            log.info(
+                "AOT-compiled fused epoch scan (%d batches x %d seeds, "
+                "caps %s) in %.3fs",
+                n_batches, self.batch_size, list(self.node_caps), dt,
+            )
+            return compiled
+
+    # ---- the one dispatch ------------------------------------------------
+    def run_epoch(self, params, opt_state, feature, label, epoch: int, key):
+        """One epoch, one dispatch. Returns ``(params, opt_state,
+        losses[n_batches], stats_or_None)`` — all device values; the
+        caller's ``block_until_ready`` is the epoch sync point."""
+        args = self._epoch_args(params, opt_state, feature, label, epoch,
+                                key)
+        compiled = self._ensure_compiled(self.n_batches, args)
+        out = compiled(*args)
+        if self.metrics is not None:
+            self.metrics.counter_add("sample.dispatches")
+        if self.has_stats:
+            params, opt_state, losses, stats = out
+            return params, opt_state, losses, stats
+        params, opt_state, losses = out
+        return params, opt_state, losses, None

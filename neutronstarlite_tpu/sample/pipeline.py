@@ -66,7 +66,7 @@ from neutronstarlite_tpu.utils.timing import get_time
 
 log = get_logger("sample_pipeline")
 
-SAMPLE_PIPELINE_MODES = ("sync", "pipelined", "device")
+SAMPLE_PIPELINE_MODES = ("sync", "pipelined", "device", "fused")
 
 
 class SampleWorkerError(HealthError):
@@ -90,9 +90,21 @@ def resolve_sample_pipeline(cfg: Any = None) -> str:
         return "pipelined"
     if v == "device":
         return "device"
+    if v == "fused":
+        return "fused"
+    if v == "auto":
+        # the tuner (tune/select.py) resolves SAMPLE_PIPELINE:auto into a
+        # concrete mode BEFORE the trainer funnel reads it — reaching here
+        # means a non-tuned entry point got a raw auto
+        raise ValueError(
+            "SAMPLE_PIPELINE:auto is resolved by the tuner "
+            "(models/base._resolve_tune_autos); this entry point received "
+            "it unresolved — set an explicit mode (sync, pipelined, "
+            "device or fused)"
+        )
     raise ValueError(
-        f"SAMPLE_PIPELINE/NTS_SAMPLE_PIPELINE must be sync, pipelined or "
-        f"device, got {raw!r}"
+        f"SAMPLE_PIPELINE/NTS_SAMPLE_PIPELINE must be sync, pipelined, "
+        f"device or fused, got {raw!r}"
     )
 
 
@@ -123,6 +135,20 @@ def batch_to_device(b: SampledBatch):
         b.seed_mask,
         b.seeds,
     ))
+
+
+def payload_nbytes(b) -> int:
+    """Host bytes of one padded SampledBatch's device payload — the
+    measured twin of ``wire_accounting.sample_batch_payload_bytes`` (the
+    two must agree: padded capacities are static, so measured == priced).
+    Non-batch payloads (tests inject arbitrary objects) count 0."""
+    try:
+        arrs = list(b.nodes) + [b.seed_mask, b.seeds]
+        for h in b.hops:
+            arrs += [h.src_local, h.dst_local, h.weight]
+        return int(sum(np.asarray(a).nbytes for a in arrs))
+    except (AttributeError, TypeError):
+        return 0
 
 
 class _EpochDone:
@@ -227,6 +253,12 @@ class SamplePipeline:
                         self.metrics.counter_add("sample.produced")
                         self.metrics.counter_add(
                             "sample.h2d_ms", (t2 - t1) * 1000.0
+                        )
+                        # the staged payload's size next to its time:
+                        # zero-H2D (SAMPLE_PIPELINE:fused) is a measured
+                        # number, not just a structural claim
+                        self.metrics.counter_add(
+                            "sample.h2d_bytes", payload_nbytes(b)
                         )
                         # depth as a distribution (obs/hist), not just a
                         # peak: stall diagnosis sees whether the queue sat

@@ -85,7 +85,9 @@ class ServeOptions:
     # sync (sample inside the flush, the parity oracle), pipelined (the
     # flusher samples + stages H2D while a separate executor thread runs
     # the previous flush on the device — serve/server.py two-stage flush),
-    # device (pipelined + the on-device uniform hop sampler)
+    # device (pipelined + the on-device uniform hop sampler), fused (a
+    # cache miss's sample+execute is ONE dispatch per bucket through the
+    # engine's fused ladder — serve/engine.py _fused_forward_fn)
     continuous_batching: bool = False  # SERVE_CB / NTS_SERVE_CB: run the
     # two-stage flush even with sync sampling — the batcher admits and
     # PRODUCES the next bucket (cache pass + sample + H2D staging) while

@@ -73,6 +73,28 @@ def _eval_forward_fn(caps: List[int], compute_dtype):
     return forward
 
 
+def _fused_forward_fn(caps: List[int], fanouts: List[int], compute_dtype):
+    """SAMPLE_PIPELINE:fused — the request's WHOLE cache-miss path as one
+    program: on-device fan-out draw + dedup/remap (sample/fused.py) feeding
+    the same eval-mode forward, so a served bucket is sample+execute in ONE
+    dispatch. Operands are the resident tables plus a padded seed vector,
+    the live count and a draw key — no per-request subgraph H2D."""
+    forward = _eval_forward_fn(caps, compute_dtype)
+    from neutronstarlite_tpu.sample.fused import fused_sample_subgraph
+
+    caps_t, fans_t = tuple(int(c) for c in caps), tuple(int(f) for f in fanouts)
+
+    def fused_forward(params, feature, nbr, eff_deg, out_deg, in_deg,
+                      seeds_pad, n_real, key):
+        nodes, hops = fused_sample_subgraph(
+            nbr, eff_deg, out_deg, in_deg, seeds_pad, n_real, key,
+            caps_t, fans_t,
+        )
+        return forward(params, feature, nodes, hops)
+
+    return fused_forward
+
+
 def batch_device_args(batch: SampledBatch):
     """SampledBatch -> the (nodes, hops) device pytree, one conversion for
     both the AOT lowering and every steady-state call (shapes and dtypes
@@ -120,11 +142,13 @@ class InferenceEngine:
             jnp.bfloat16 if self.cfg.precision == "bfloat16" else None
         )
         hop_sampler = None
-        if self.opts.sample_pipeline == "device":
+        if self.opts.sample_pipeline in ("device", "fused"):
             # SAMPLE_PIPELINE:device — per-request fan-outs draw on-device
             # (sample/device_sampler.py); distribution-equivalent to the
-            # host sampler, see docs/SAMPLING.md. The sampled trainer this
-            # engine restored through already built the neighbor table for
+            # host sampler, see docs/SAMPLING.md. fused goes further: the
+            # same table feeds the one-dispatch sample+execute program
+            # (_fused_forward_fn). The sampled trainer this engine
+            # restored through already built the neighbor table for
             # the same mode — reuse it rather than uploading a second copy.
             hop_sampler = getattr(
                 getattr(toolkit, "par_sampler", None), "hop_sampler", None
@@ -143,6 +167,14 @@ class InferenceEngine:
         )
         self.buckets = self.sampler.buckets
         self._compiled: Dict[int, Any] = {}
+        # fused ladder: bucket -> (table_shapes, executable). Keyed off the
+        # live table shapes so a delta that REBUILT the neighbor table
+        # (new V or width) recompiles instead of feeding the executable
+        # shape-mismatched operands; in-place row patches keep the program.
+        self._fused_compiled: Dict[int, Any] = {}
+        # degree vectors shared across clones, re-derived when a delta
+        # swaps the host graph (mutated in place so clones see the swap)
+        self._fused_shared: Dict[str, Any] = {"graph": None, "degrees": None}
         self.compile_counts: Dict[int, int] = {}
         # shared across clones (serve/fleet.py): two replica executors
         # racing a cold bucket must still compile it exactly once
@@ -178,9 +210,18 @@ class InferenceEngine:
         )
         new.buckets = new.sampler.buckets
         new._compiled = self._compiled
+        new._fused_compiled = self._fused_compiled
+        new._fused_shared = self._fused_shared
         new.compile_counts = self.compile_counts
         new._compile_lock = self._compile_lock
         return new
+
+    @property
+    def fused(self) -> bool:
+        """SAMPLE_PIPELINE:fused — serve cache misses through the
+        one-dispatch sample+execute ladder instead of host sample +
+        bucket forward."""
+        return self.opts.sample_pipeline == "fused"
 
     def graph_digest(self) -> str:
         """The canonical digest of the graph this engine serves — the
@@ -307,9 +348,13 @@ class InferenceEngine:
 
     # ---- AOT bucket executables ------------------------------------------
     def warmup(self, buckets: Optional[List[int]] = None) -> None:
-        """Compile the executable ladder ahead of traffic."""
+        """Compile the executable ladder ahead of traffic (the ladder the
+        configured pipeline actually serves through)."""
         for b in buckets if buckets is not None else self.buckets:
-            self._ensure_compiled(int(b))
+            if self.fused:
+                self._ensure_fused(int(b))
+            else:
+                self._ensure_compiled(int(b))
 
     def _ensure_compiled(self, bucket: int):
         compiled = self._compiled.get(bucket)
@@ -362,6 +407,112 @@ class InferenceEngine:
         log.info("AOT-compiled bucket %d (caps %s) in %.3fs", bucket, caps, dt)
         return compiled
 
+    # ---- fused one-dispatch ladder (SAMPLE_PIPELINE:fused) ----------------
+    def _fused_exec_tables(self):
+        """The live device operand tables of the fused program — read at
+        call time, never snapshotted at construction: a graph delta
+        patches/rebuilds ``hop_sampler.nbr``/``eff_deg`` in place
+        (serve/delta.py) and swaps the host graph, and the next request
+        must draw from the post-delta structure."""
+        hs = self.sampler.hop_sampler
+        shared = self._fused_shared
+        g = self.sampler.graph
+        if shared["graph"] is not g:
+            from neutronstarlite_tpu.sample.fused import degree_tables
+
+            shared["degrees"] = degree_tables(g)
+            shared["graph"] = g
+        out_deg, in_deg = shared["degrees"]
+        return hs.nbr, hs.eff_deg, out_deg, in_deg
+
+    def _ensure_fused(self, bucket: int):
+        tables = self._fused_exec_tables()
+        shapes = tuple(a.shape for a in tables)
+        entry = self._fused_compiled.get(bucket)
+        if entry is not None and entry[0] == shapes:
+            return entry[1]
+        with self._compile_lock:
+            entry = self._fused_compiled.get(bucket)
+            if entry is not None and entry[0] == shapes:
+                return entry[1]
+            return self._compile_fused_bucket(bucket, tables, shapes)
+
+    def _compile_fused_bucket(self, bucket: int, tables, shapes):
+        caps = self.sampler.node_caps(bucket)
+        fn = _fused_forward_fn(caps, self.fanouts, self.compute_dtype)
+        seeds = jnp.zeros((bucket,), jnp.int32)
+        t0 = time.perf_counter()
+        compiled = jax.jit(fn).lower(
+            self.params, self.feature, *tables, seeds, np.int32(1),
+            jax.random.PRNGKey(0),
+        ).compile()
+        dt = time.perf_counter() - t0
+        self._fused_compiled[bucket] = (shapes, compiled)
+        self.compile_counts[bucket] = self.compile_counts.get(bucket, 0) + 1
+        if self.metrics is not None:
+            self.metrics.counter_add(f"serve.compiles.bucket_{bucket}")
+            self.metrics.observe("serve.compile", dt)
+            from neutronstarlite_tpu.obs.cost import capture_program_cost
+
+            capture_program_cost(
+                self.metrics, f"serve.fused_bucket_{bucket}",
+                compiled=compiled, bucket=bucket, compile_s=round(dt, 4),
+            )
+        log.info(
+            "AOT-compiled fused bucket %d (caps %s, sample+execute one "
+            "dispatch) in %.3fs", bucket, caps, dt,
+        )
+        return compiled
+
+    def prepare_fused(self, ids: np.ndarray, bucket: int):
+        """The fused flush's produce stage: pad the miss set to the bucket
+        and stage (seeds, live count, draw key) — the ONLY per-request
+        operands; the subgraph itself never exists host-side. The draw key
+        consumes the sampler's shared Generator so a serving trace stays
+        replayable end-to-end from one seed (the device-mode contract)."""
+        ids = np.asarray(ids, dtype=np.int64).reshape(-1)
+        seeds = np.zeros((int(bucket),), dtype=np.int32)
+        seeds[: len(ids)] = ids
+        key = jax.random.PRNGKey(
+            int(self.sampler.rng.integers(0, 2 ** 31 - 1))
+        )
+        seeds_dev, key_dev = jax.device_put((seeds, key))
+        return seeds_dev, np.int32(len(ids)), key_dev
+
+    def execute_fused_prepared(self, prepared, bucket: int,
+                               exec_ctx=None) -> np.ndarray:
+        """ONE dispatch: on-device draw + remap + gather + forward for a
+        prepared fused flush. ``exec_ctx`` is the pipelined server's
+        produce-time (executable, params, feature, tables) snapshot."""
+        b = int(bucket)
+        if exec_ctx is not None:
+            compiled, params, feature, tables = exec_ctx
+        else:
+            compiled = self._ensure_fused(b)
+            params, feature = self.params, self.feature
+            tables = self._fused_exec_tables()
+        seeds, n_real, key = prepared
+        out = np.asarray(
+            compiled(params, feature, *tables, seeds, n_real, key)
+        )
+        if self.metrics is not None:
+            self.metrics.counter_add(f"serve.fused_dispatches.bucket_{b}")
+            from neutronstarlite_tpu.obs import numerics
+
+            if numerics.numerics_enabled():
+                numerics.observe_serve_batch(self.metrics, out, b)
+        return out
+
+    def fused_predict_rows(self, ids: np.ndarray,
+                           bucket: Optional[int] = None) -> np.ndarray:
+        """Fresh fused logits [n, n_classes] for arbitrary vertex ids —
+        prepare + the one dispatch."""
+        ids = np.asarray(ids, dtype=np.int64).reshape(-1)
+        b = int(bucket) if bucket is not None \
+            else self.sampler.bucket_for(len(ids))
+        logits = self.execute_fused_prepared(self.prepare_fused(ids, b), b)
+        return logits[: len(ids)]
+
     # ---- scoring ---------------------------------------------------------
     def prepare_batch(self, batch: SampledBatch):
         """SampledBatch -> device-resident (nodes, hops), the H2D stage of
@@ -401,6 +552,8 @@ class InferenceEngine:
         """Fresh-sampled logits [n, n_classes] for arbitrary vertex ids."""
         ids = np.asarray(node_ids, dtype=np.int64).reshape(-1)
         bucket = self.sampler.bucket_for(len(ids))
+        if self.fused:
+            return self.fused_predict_rows(ids, bucket)
         batch = self.sampler.sample(bucket, ids)
         logits = self.forward_batch(batch, bucket)
         return logits[: len(ids)]

@@ -107,7 +107,11 @@ class InferenceServer:
         # which sheds — overload policy unchanged.
         # continuous batching (SERVE_CB / NTS_SERVE_CB) rides the same
         # two-stage machinery with synchronous sampling: the produce
-        # stage of bucket i+1 overlaps the execute of bucket i
+        # stage of bucket i+1 overlaps the execute of bucket i.
+        # SAMPLE_PIPELINE:fused deliberately does NOT force the two-stage
+        # path: its flush has no host sampling to overlap (sample+execute
+        # is one dispatch), so fused alone uses the simple sync flush and
+        # only rides the producer/executor split when CB asks for it
         self.pipelined = (
             self.opts.continuous_batching
             or self.opts.sample_pipeline in ("pipelined", "device")
@@ -243,9 +247,17 @@ class InferenceServer:
         if all_ids:
             uniq = np.asarray(all_ids, dtype=np.int64)
             bucket = self.engine.sampler.bucket_for(len(uniq))
-            batch = self.engine.sampler.sample(bucket, uniq)
-            t_sample = time.perf_counter()
-            logits = self.engine.forward_batch(batch, bucket)
+            if getattr(self.engine, "fused", False):
+                # SAMPLE_PIPELINE:fused — the miss set's fan-out draw,
+                # remap, gather and forward are ONE dispatch inside the
+                # engine's fused bucket executable; there is no host
+                # sampling stage (its span is structurally zero)
+                t_sample = time.perf_counter()
+                logits = self.engine.fused_predict_rows(uniq, bucket)
+            else:
+                batch = self.engine.sampler.sample(bucket, uniq)
+                t_sample = time.perf_counter()
+                logits = self.engine.forward_batch(batch, bucket)
             for i, vid in enumerate(uniq.tolist()):
                 rows[vid] = logits[i]
             self.cache.insert(uniq, logits[: len(uniq)])
@@ -318,21 +330,37 @@ class InferenceServer:
                 if all_ids:
                     uniq = np.asarray(all_ids, dtype=np.int64)
                     bucket = self.engine.sampler.bucket_for(len(uniq))
-                    batch = self.engine.sampler.sample(bucket, uniq)
-                    t_sample = time.perf_counter()
-                    prepared = self.engine.prepare_batch(batch)
-                    # snapshot the executable + operands UNDER the gate:
-                    # a vertex-appending delta swaps engine.feature and
-                    # clears the AOT ladder, and an in-flight prepared
-                    # flush must answer with the PRE-delta view — not
-                    # crash on a shape-mismatched operand (the staleness
-                    # contract). Compiling here (cold bucket) also keeps
-                    # compile out of the executor's steady-state path.
-                    exec_ctx = (
-                        self.engine._ensure_compiled(bucket),
-                        self.engine.params,
-                        self.engine.feature,
-                    )
+                    if getattr(self.engine, "fused", False):
+                        # fused produce stage: no host sampling, no
+                        # subgraph H2D — only the padded seed vector +
+                        # draw key stage to device; sample+execute run
+                        # as ONE dispatch in the executor
+                        t_sample = time.perf_counter()
+                        prepared = self.engine.prepare_fused(uniq, bucket)
+                        exec_ctx = (
+                            self.engine._ensure_fused(bucket),
+                            self.engine.params,
+                            self.engine.feature,
+                            self.engine._fused_exec_tables(),
+                        )
+                    else:
+                        batch = self.engine.sampler.sample(bucket, uniq)
+                        t_sample = time.perf_counter()
+                        prepared = self.engine.prepare_batch(batch)
+                        # snapshot the executable + operands UNDER the
+                        # gate: a vertex-appending delta swaps
+                        # engine.feature and clears the AOT ladder, and
+                        # an in-flight prepared flush must answer with
+                        # the PRE-delta view — not crash on a
+                        # shape-mismatched operand (the staleness
+                        # contract). Compiling here (cold bucket) also
+                        # keeps compile out of the executor's
+                        # steady-state path.
+                        exec_ctx = (
+                            self.engine._ensure_compiled(bucket),
+                            self.engine.params,
+                            self.engine.feature,
+                        )
                     t_h2d = time.perf_counter()
             for name, a, b in (
                 ("cache_lookup", t0, t_cache),
@@ -413,15 +441,23 @@ class InferenceServer:
         )
         rows: Dict[int, np.ndarray] = dict(cached_rows)
         if prepared is not None:
-            nodes, hops = prepared
-            if exec_ctx is not None:
+            if getattr(self.engine, "fused", False):
+                # one dispatch: sample+execute inside the fused bucket
+                # executable (exec_ctx carries the produce-time snapshot
+                # incl. the table operands, same staleness contract)
+                logits = self.engine.execute_fused_prepared(
+                    prepared, bucket, exec_ctx=exec_ctx
+                )
+            elif exec_ctx is not None:
                 # the produce-time snapshot: executable + params + feature
                 # captured under the graph gate, so a delta that swapped
                 # engine.feature / cleared the AOT ladder mid-flight
                 # cannot hand this flush a shape-mismatched operand
+                nodes, hops = prepared
                 executable, params, feature = exec_ctx
                 logits = np.asarray(executable(params, feature, nodes, hops))
             else:
+                nodes, hops = prepared
                 logits = self.engine.execute_prepared(nodes, hops, bucket)
             for i, vid in enumerate(uniq.tolist()):
                 rows[vid] = logits[i]

@@ -291,6 +291,7 @@ def render_serve(path: str, rec: Dict[str, Any],
             "expired={expired}".format(**cache)
         )
     lines.extend(render_sample(rec))
+    lines.extend(rec.get("_scan") or [])
     lines.extend(rec.get("_deltas") or [])
     lines.extend(rec.get("_stream") or [])
     lines.extend(rec.get("_cost") or [])
@@ -367,7 +368,11 @@ def render_sample(rec: Dict[str, Any]) -> List[str]:
     never pipelined sampling."""
     gauges = rec.get("gauges") or {}
     counters = rec.get("counters") or {}
-    if "sample.queue_depth" not in gauges and "sample.stall_ms" not in counters:
+    if (
+        "sample.queue_depth" not in gauges
+        and "sample.stall_ms" not in counters
+        and "sample.h2d_bytes" not in counters
+    ):
         return []
     lines = ["sampling pipeline:"]
     depth = gauges.get("sample.queue_depth")
@@ -386,6 +391,44 @@ def render_sample(rec: Dict[str, Any]) -> List[str]:
     h2d = counters.get("sample.h2d_ms")
     if h2d is not None:
         lines.append(f"#sample_h2d={h2d:.3f}(ms)")
+    hb = counters.get("sample.h2d_bytes")
+    if hb is not None:
+        # the per-batch H2D payload total (sample/pipeline.py producers
+        # measure it; the sync path prices the wire_accounting formula;
+        # SAMPLE_PIPELINE:fused pins it to exactly 0)
+        lines.append(f"#sample_h2d_bytes={int(hb)}")
+    return lines
+
+
+def render_epoch_scan(events: List[Dict[str, Any]]) -> List[str]:
+    """The fused one-dispatch epoch block (``epoch_scan`` records,
+    SAMPLE_PIPELINE:fused): per-epoch scan receipts aggregated per
+    bucket — batches, dispatches (pinned to 1/epoch by the trainer), and
+    the H2D byte count (pinned to 0). Empty for non-fused runs."""
+    recs = [e for e in events if e["event"] == "epoch_scan"]
+    if not recs:
+        return []
+    by_bucket: Dict[int, Dict[str, Any]] = {}
+    for e in recs:
+        agg = by_bucket.setdefault(
+            int(e["bucket"]),
+            {"epochs": 0, "batches": 0, "dispatches": 0, "h2d_bytes": 0,
+             "seconds": 0.0},
+        )
+        agg["epochs"] += 1
+        agg["batches"] += int(e["batches"])
+        agg["dispatches"] += int(e["dispatches"])
+        agg["h2d_bytes"] += int(e["h2d_bytes"])
+        if isinstance(e.get("seconds"), (int, float)):
+            agg["seconds"] += float(e["seconds"])
+    lines = ["fused epoch scan:"]
+    for bucket, agg in sorted(by_bucket.items()):
+        lines.append(
+            f"#epoch_scan=bucket {bucket} epochs={agg['epochs']} "
+            f"batches={agg['batches']} dispatches={agg['dispatches']} "
+            f"h2d_bytes={agg['h2d_bytes']} "
+            f"total={_ms(agg['seconds'])}(ms)"
+        )
     return lines
 
 
@@ -931,6 +974,7 @@ def render_run(path: str, rec: Dict[str, Any]) -> str:
     lines.extend(rec.get("_elastic") or [])
     lines.extend(rec.get("_fleet") or [])
     lines.extend(render_sample(rec))
+    lines.extend(rec.get("_scan") or [])
     lines.extend(rec.get("_hists") or [])
     lines.extend(rec.get("_slo") or [])
     lines.extend(rec.get("_probe") or [])
@@ -1027,6 +1071,13 @@ def _diff_metrics(rec, srec):
         stall = counters.get("sample.stall_ms")
         out["sample_stall_ms_per_epoch"] = (
             stall / n_epochs if stall is not None and n_epochs > 0 else None
+        )
+        # the per-batch H2D payload (sample/fused.py's structural gate:
+        # exactly 0 when fused, so any regression that reintroduces a
+        # host transfer trips the zero-baseline absolute floor)
+        h2d = counters.get("sample.h2d_bytes")
+        out["sample_h2d_bytes_per_epoch"] = (
+            h2d / n_epochs if h2d is not None and n_epochs > 0 else None
         )
         # numerics plane (obs/numerics, NTS_NUMERICS=1 / NTS_QUANT_PROBE):
         # the final grad-norm trajectory point and the measured wire
@@ -1309,6 +1360,7 @@ def main(argv=None) -> int:
         delta_lines = render_deltas(events)
         stream_lines = render_stream(events)
         numerics_lines = render_numerics(events, rec or {})
+        scan_lines = render_epoch_scan(events)
         if rec is not None:
             rec["_path"] = p
             rec["_timeline"] = recovery_timeline(events)
@@ -1319,6 +1371,7 @@ def main(argv=None) -> int:
             rec["_cost"] = render_program_costs(events, rec)
             rec["_drift"] = drift_lines
             rec["_numerics"] = numerics_lines
+            rec["_scan"] = scan_lines
             rec["_elastic"] = render_elastic(events, rec)
             rec["_fleet"] = fleet_lines
             rec["_hists"] = hist_lines
@@ -1336,6 +1389,7 @@ def main(argv=None) -> int:
             )
             srec["_drift"] = drift_lines if rec is None else []
             srec["_numerics"] = numerics_lines if rec is None else []
+            srec["_scan"] = scan_lines if rec is None else []
             srec["_fleet"] = fleet_lines if rec is None else []
             srec["_hists"] = hist_lines if rec is None else []
             srec["_slo"] = slo_lines if rec is None else []

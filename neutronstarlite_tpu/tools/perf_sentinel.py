@@ -81,6 +81,10 @@ GATED_METRICS = {
         "avg_epoch_s",
         "wire_bytes_fwd_per_epoch",
         "sample_stall_ms_per_epoch",
+        # the fused sampler's structural zero (sample/fused.py): any
+        # regression that reintroduces a per-batch host transfer grows
+        # it off the 0-baseline trajectory
+        "sample_h2d_bytes_per_epoch",
         "edge_hbm_bytes_per_epoch",
         "peak_hbm_bytes",
         # measured wire quantization error (obs/numerics): a dtype or

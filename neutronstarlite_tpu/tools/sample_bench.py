@@ -8,11 +8,19 @@ graph (one native build — tie-edge order is nondeterministic across
 builds, and a shared graph keeps sync/pipelined bitwise-comparable) and
 reports steady-state batches/sec per mode plus the telemetry that explains
 the difference: the synchronous path's serial sample time vs the pipelined
-path's residual ``sample.stall_ms``.
+path's residual ``sample.stall_ms`` vs the fused path's dispatch count
+(one ``lax.scan`` per epoch, ``sample.h2d_bytes`` exactly 0 —
+sample/fused.py).
+
+With ``NTS_LEDGER_DIR`` set, each measured mode also lands one kind=run
+row in the cross-run perf ledger (cfg key ``sample_bench/<mode>`` so the
+modes never share a trajectory): perf_sentinel trend-gates the
+steady-state ``warm_median_epoch_s`` per mode, and the batches/s +
+dispatch counts ride along as context.
 
 Usage: python -m neutronstarlite_tpu.tools.sample_bench [--scale S]
          [--batch-size 512] [--fanout 25-10] [--epochs 3]
-         [--modes sync,pipelined]
+         [--modes sync,pipelined,fused]
 Prints ONE BENCH-style JSON line:
   {"metric": "sample_pipeline_batches_per_sec", "value": <pipelined bps>,
    "extra": {per-mode epoch times, stall/sample ms, loss parity}}
@@ -84,6 +92,17 @@ def measure_mode(mode, cfg_proto, src, dst, datum, host_graph):
         "sample_stall_ms_total": counters.get("sample.stall_ms"),
         "sample_stall_ms_dist": _hq("sample.stall_ms"),
         "sample_h2d_ms_total": counters.get("sample.h2d_ms"),
+        # fused pins this to exactly 0; sync prices the wire_accounting
+        # formula; pipelined/device measure it per staged batch
+        "sample_h2d_bytes_total": counters.get("sample.h2d_bytes"),
+        # fused: ONE scan dispatch per epoch (sample/fused.py counts
+        # them), plus the per-bucket compile count — steady state must
+        # show dispatches == epochs and exactly one compile
+        "dispatches": counters.get("sample.dispatches"),
+        "epoch_compiles": {
+            k: int(v) for k, v in counters.items()
+            if k.startswith("sample.epoch_compiles.")
+        } or None,
         "queue_depth_peak": snap["gauges"].get("sample.queue_depth"),
         "queue_depth_dist": _hq("sample.queue_depth"),
         # full precision: the sync==pipelined parity flag below is a
@@ -104,14 +123,14 @@ def main(argv=None) -> int:
     ap.add_argument("--epochs", type=int, default=3)
     ap.add_argument("--modes", default="sync,pipelined",
                     help="comma list of SAMPLE_PIPELINE modes to sweep "
-                    "(sync, pipelined, device)")
+                    "(sync, pipelined, device, fused)")
     ap.add_argument("--precision", default="float32",
                     choices=["float32", "bfloat16"])
     args = ap.parse_args(argv)
 
     modes = [m.strip() for m in args.modes.split(",") if m.strip()]
     for m in modes:
-        if m not in ("sync", "pipelined", "device"):
+        if m not in ("sync", "pipelined", "device", "fused"):
             raise SystemExit(f"unknown mode {m!r} in --modes")
     # the env override outranks cfg.sample_pipeline in
     # resolve_sample_pipeline — left set, every leg of this sweep would
@@ -155,11 +174,22 @@ def main(argv=None) -> int:
         m: measure_mode(m, cfg, src, dst, datum, host_graph) for m in modes
     }
 
-    head = rows.get("pipelined") or rows[modes[0]]
+    head = rows.get("fused") or rows.get("pipelined") or rows[modes[0]]
     sync = rows.get("sync")
     parity = None
     if sync is not None and "pipelined" in rows:
         parity = sync["loss_history"] == rows["pipelined"]["loss_history"]
+    # fused draws the SAME distribution with a different (on-device)
+    # stream, so its oracle is proximity, not bitwise equality — report
+    # the max per-epoch divergence for the caller to judge
+    fused_vs_sync = None
+    if sync is not None and "fused" in rows:
+        fl = rows["fused"]["loss_history"]
+        sl = sync["loss_history"]
+        if fl and sl and len(fl) == len(sl):
+            fused_vs_sync = round(
+                max(abs(a - b) for a, b in zip(fl, sl)), 6
+            )
     out = {
         "metric": "sample_pipeline_batches_per_sec",
         "value": head["batches_per_sec"],
@@ -178,9 +208,43 @@ def main(argv=None) -> int:
             "epochs": args.epochs,
             "modes": rows,
             "sync_pipelined_loss_parity": parity,
+            "fused_sync_loss_maxdiff": fused_vs_sync,
             "graph_cache_build_s": round(gen_s, 1),
         },
     }
+    # one kind=run row PER MODE into the cross-run perf ledger
+    # (NTS_LEDGER_DIR; disabled = no-op): the cfg key embeds the mode so
+    # sync/pipelined/device/fused never share a trajectory —
+    # perf_sentinel trend-gates warm_median_epoch_s per mode and the
+    # batches/s + dispatch counts ride as context
+    from neutronstarlite_tpu.obs import ledger
+
+    if ledger.ledger_dir():
+        for m, r in rows.items():
+            ledger.append_row({
+                "kind": "run",
+                "ts": time.time(),
+                "run_id": f"sample_bench-{m}",
+                "algorithm": "GCNSAMPLESINGLE",
+                "cfg": f"sample_bench/{m}/B{args.batch_size}/"
+                       f"{args.fanout}/s{args.scale}",
+                "graph_digest": None,
+                "backend": ledger.backend_fingerprint(),
+                "epochs": args.epochs,
+                "warm_median_epoch_s": r["warm_epoch_s"],
+                "avg_epoch_s": r["warm_epoch_s"],
+                "sample_stall_ms_per_epoch": (
+                    r["sample_stall_ms_total"] / max(args.epochs, 1)
+                    if r["sample_stall_ms_total"] is not None else None
+                ),
+                "sample_h2d_bytes_per_epoch": (
+                    r["sample_h2d_bytes_total"] / max(args.epochs, 1)
+                    if r["sample_h2d_bytes_total"] is not None else None
+                ),
+                "batches_per_sec": r["batches_per_sec"],
+                "dispatches": r["dispatches"],
+                "final_loss": r["final_loss"],
+            })
     print(json.dumps(out))
     return 0
 

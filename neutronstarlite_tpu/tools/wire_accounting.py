@@ -63,6 +63,46 @@ def exchange_rows_per_device(kind: str, P: int, vp: int, mb: int = 0) -> int:
     return (P - 1) * vp
 
 
+def sample_batch_payload_bytes(node_caps, fanouts) -> int:
+    """Bytes of ONE padded SampledBatch device payload — the sampled
+    path's per-batch H2D cost the ``sample.h2d_bytes`` counter carries.
+
+    The single formula in three places: the sync trainer loop prices it
+    per step, the async producer MEASURES the staged payload
+    (sample/pipeline.payload_nbytes — padded capacities are static, so
+    measured == priced), and the tuner's sampled-family prior ranks
+    modes by it (``SAMPLE_PIPELINE:fused`` ships 0 — the whole batch
+    lives on-device). Layout (sample/sampler.py): per-level padded
+    int64 node ids at ``node_caps[l]``; per hop ``ecap_h =
+    node_caps[h+1] * fanouts[h]`` edges of (int64 src_local, int64
+    dst_local, f32 weight); int64 seeds + f32 seed_mask at batch width.
+    """
+    caps = [int(c) for c in node_caps]
+    fo = [int(f) for f in fanouts]
+    if len(caps) != len(fo) + 1:
+        raise ValueError(
+            f"node_caps must be one longer than fanouts, got "
+            f"{len(caps)} caps / {len(fo)} fanouts"
+        )
+    nodes = sum(caps) * 8
+    hops = sum(caps[h + 1] * fo[h] * (8 + 8 + 4) for h in range(len(fo)))
+    return nodes + hops + caps[-1] * (8 + 4)
+
+
+def sample_h2d_bytes_per_epoch(n_seeds: int, node_caps, fanouts,
+                               mode: str = "sync") -> int:
+    """Per-epoch sampled-path H2D bytes for a SAMPLE_PIPELINE mode:
+    batches/epoch x the payload formula above for the host-staged modes
+    (sync/pipelined/device all ship the same padded payload — the
+    pipeline changes WHEN, device mode changes WHERE the draw runs, not
+    what crosses the wire), exactly 0 for fused."""
+    if mode == "fused":
+        return 0
+    B = int(node_caps[-1])
+    n_batches = -(-int(n_seeds) // max(B, 1))
+    return n_batches * sample_batch_payload_bytes(node_caps, fanouts)
+
+
 def peak_resident_rows(kind: str, P: int, vp: int, mb: int = 0) -> int:
     """Peak EXCHANGE-BUFFER rows live at once per device (the memory half
     of the comm-layer decision; the row count the obs gauge

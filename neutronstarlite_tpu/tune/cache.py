@@ -41,8 +41,11 @@ log = get_logger("tune")
 
 # v2: the candidate tuple gained the 5th axis (MESH — the 2D vertex x
 # feature partitioner); v1 entries carry 4-part labels that can never be
-# half-parsed against the new space, so they are warned misses (re-tune)
-TUNE_SCHEMA_VERSION = 2
+# half-parsed against the new space, so they are warned misses (re-tune).
+# v3: the 6th axis (SAMPLE_PIPELINE — the sampled family's sync/
+# pipelined/device/fused scheduling modes); 5-part v2 labels are warned
+# misses for the same reason
+TUNE_SCHEMA_VERSION = 3
 
 _MODES = ("off", "cached", "measure")
 

@@ -74,9 +74,24 @@ def _bf16(wire_dtype: str) -> bool:
 # ---- stage 1: the analytic prior -------------------------------------------
 
 
+def _sample_caps(sample_cfg) -> tuple:
+    """(batch_size, fanouts, node_caps, n_seeds) from the sampled-family
+    leg config — the sampler's capacity recurrence (sample/sampler.py),
+    shared by the prior and the micro-trial legs."""
+    sc = sample_cfg or {}
+    B = int(sc.get("batch_size", 16) or 16)
+    fans = [int(x) for x in (sc.get("fanouts") or [])] or [2]
+    caps = [B]
+    for fo in reversed(fans):
+        caps.append(caps[-1] * fo)
+    caps = list(reversed(caps))
+    return B, fans, caps, int(sc.get("n_seeds", 0) or 0)
+
+
 def analytic_priors(host_graph, P: int, sizes: List[int], family: str,
                     candidates: List[Candidate], precision: str = "float32",
                     score_channels: int = 1, eager_widths: bool = False,
+                    sample_cfg: Optional[dict] = None,
                     ) -> Dict[str, int]:
     """{candidate label: predicted bytes/epoch} — lower is better.
 
@@ -156,6 +171,24 @@ def analytic_priors(host_graph, P: int, sizes: List[int], family: str,
                 score += sum(
                     e * (2 * f + 3 * score_channels) * 4 for f in hidden
                 )
+        elif family == "sampled":
+            # per-epoch sample-payload H2D bytes, the SAME formula the
+            # sample.h2d_bytes counter is priced by (wire_accounting.
+            # sample_h2d_bytes_per_epoch): sync/pipelined/device all ship
+            # every padded batch host->device; fused ships 0 by
+            # construction, so the prior prefers it and the trials then
+            # arbitrate the host-cost ordering of the other three
+            from neutronstarlite_tpu.tools.wire_accounting import (
+                sample_h2d_bytes_per_epoch,
+            )
+
+            B, fans, caps, n_seeds = _sample_caps(sample_cfg)
+            mode = _norm(
+                "sample_pipeline", cand.sample_pipeline
+            ) or "sync"
+            score = sample_h2d_bytes_per_epoch(
+                n_seeds or int(host_graph.v_num), caps, fans, mode=mode
+            )
         out[cand.label()] = int(score)
     return out
 
@@ -208,6 +241,7 @@ def measure_candidates(
     candidates: List[Candidate], simulate: bool,
     kernel_tile: int = 0, edge_chunk: int = 0, score_channels: int = 1,
     steps: Optional[int] = None, seed: int = 7, metrics=None,
+    sample_cfg: Optional[dict] = None,
 ) -> Dict[str, Optional[float]]:
     """{candidate label: warm seconds | None (unmeasurable on this rig)}.
 
@@ -519,8 +553,155 @@ def measure_candidates(
                 out[label] = None
         return out
 
+    if family == "sampled":
+        return _measure_sampled(
+            host_graph, candidates, steps, seed, sample_cfg, metrics
+        )
+
     # plain family: nothing to measure — the space is one empty tuple
     return {cand.label(): None for cand in candidates}
+
+
+def _measure_sampled(host_graph, candidates: List[Candidate], steps: int,
+                     seed: int, sample_cfg: Optional[dict], metrics=None,
+                     ) -> Dict[str, Optional[float]]:
+    """Per-mode sampling critical path, one batch at the model's real
+    (batch_size, fanouts) shape. The legs contain HOST work (that is the
+    thing being compared), so timing is hand-rolled over the same
+    compile-attribution collector ``_time_leg`` uses instead of a jitted
+    scale trick:
+
+    - sync: full host fan-out sample + the padded payload H2D, blocked —
+      everything the trainer's batch loop serializes on.
+    - pipelined: only the H2D of a pre-sampled payload — the host
+      sampling overlaps device compute by construction, so the critical
+      path keeps just the staging copy.
+    - device: on-device hop draw + host dedup/remap + payload H2D (the
+      device_sampler split).
+    - fused: ONE dispatch of the jitted on-device sample program
+      (sample/fused.py) over the resident tables — no host sampling, no
+      payload.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from neutronstarlite_tpu.obs.collectors import steady_state_stats
+    from neutronstarlite_tpu.sample.sampler import Sampler
+
+    B, fans, caps, _ = _sample_caps(sample_cfg)
+    v = int(host_graph.v_num)
+    seed_ids = np.random.default_rng(seed).integers(
+        0, v, size=min(B, v)
+    ).astype(np.int64)
+
+    def payload(b):
+        return (
+            [np.asarray(n) for n in b.nodes],
+            [(h.src_local, h.dst_local, h.weight) for h in b.hops],
+            b.seed_mask, b.seeds,
+        )
+
+    def warm(run) -> float:
+        times = []
+        for _ in range(steps + 1):
+            t0 = time.perf_counter()
+            run()
+            times.append(time.perf_counter() - t0)
+        stats = steady_state_stats(times)
+        w = stats["warm_median_s"]
+        return float(w if w is not None else times[-1])
+
+    host = Sampler(
+        host_graph, np.empty(0, np.int64), B, fans,
+        rng=np.random.default_rng(seed),
+    )
+    device_sampler = None
+    out: Dict[str, Optional[float]] = {}
+    for cand in candidates:
+        label = cand.label()
+        mode = _norm("sample_pipeline", cand.sample_pipeline) or "sync"
+        if mode == "sync":
+            def run_sync(s=host):
+                jax.block_until_ready(
+                    jax.device_put(payload(s.sample_batch(seed_ids)))
+                )
+
+            out[label] = warm(run_sync)
+        elif mode == "pipelined":
+            staged = payload(host.sample_batch(seed_ids))
+
+            def run_pipe(p=staged):
+                jax.block_until_ready(jax.device_put(p))
+
+            out[label] = warm(run_pipe)
+        elif mode == "device":
+            if device_sampler is None:
+                from neutronstarlite_tpu.sample.device_sampler import (
+                    DeviceUniformSampler,
+                )
+
+                device_sampler = DeviceUniformSampler.from_host(host_graph)
+            dsam = Sampler(
+                host_graph, np.empty(0, np.int64), B, fans,
+                rng=np.random.default_rng(seed),
+                hop_sampler=device_sampler,
+            )
+
+            def run_dev(s=dsam):
+                jax.block_until_ready(
+                    jax.device_put(payload(s.sample_batch(seed_ids)))
+                )
+
+            out[label] = warm(run_dev)
+        elif mode == "fused":
+            if device_sampler is None:
+                from neutronstarlite_tpu.sample.device_sampler import (
+                    DeviceUniformSampler,
+                )
+
+                device_sampler = DeviceUniformSampler.from_host(host_graph)
+            from neutronstarlite_tpu.sample.fused import (
+                degree_tables,
+                fused_sample_subgraph,
+            )
+
+            out_deg, in_deg = degree_tables(host_graph)
+            caps_t, fans_t = tuple(caps), tuple(fans)
+            fsf = jax.jit(
+                lambda nbr, eff, od, idg, s, n, k: fused_sample_subgraph(
+                    nbr, eff, od, idg, s, n, k, caps_t, fans_t
+                )
+            )
+            seeds_pad = np.zeros((B,), np.int32)
+            seeds_pad[: len(seed_ids)] = seed_ids
+            seeds_dev = jax.device_put(seeds_pad)
+            n_real = np.int32(len(seed_ids))
+            if metrics is not None:
+                from neutronstarlite_tpu.obs.cost import (
+                    capture_program_cost,
+                )
+
+                capture_program_cost(
+                    metrics, f"tune.trial/{label}", jitted=fsf,
+                    args=(device_sampler.nbr, device_sampler.eff_deg,
+                          out_deg, in_deg, seeds_dev, n_real,
+                          jax.random.PRNGKey(0)),
+                )
+            tick = [0]
+
+            def run_fused(t=tick, nbr=device_sampler.nbr,
+                          eff=device_sampler.eff_deg, od=out_deg,
+                          idg=in_deg, sd=seeds_dev, nr=n_real):
+                t[0] += 1
+                jax.block_until_ready(
+                    fsf(nbr, eff, od, idg, sd, nr,
+                        jax.random.PRNGKey(t[0]))
+                )
+
+            out[label] = warm(run_fused)
+        else:
+            out[label] = None
+    return out
 
 
 def _padded(space, rng, width: int, mesh):
@@ -570,6 +751,7 @@ def score_candidates(
         precision=leg_kwargs.pop("precision", "float32"),
         score_channels=leg_kwargs.get("score_channels", 1),
         eager_widths=leg_kwargs.pop("eager_widths", False),
+        sample_cfg=leg_kwargs.get("sample_cfg"),
     )
     rows = [
         {"candidate": c.label(), "seconds": None,

@@ -226,6 +226,27 @@ def _decide(toolkit, autos: Set[str], measure_allowed: bool,
         chan = getattr(cls, "edge_score_channels", None)
         if chan is not None:
             C = int(chan(sizes[1]))
+    sample_cfg = None
+    if fam_short == "sampled":
+        # the sampled-family legs measure at the model's REAL shape
+        # (batch size + per-layer fan-outs) and the prior prices the real
+        # per-epoch payload, so both need the trainer's sampling facts
+        import numpy as np
+
+        fans = cfg.fanouts()
+        if len(sizes) > 1 and fans:
+            fans = fans[-(len(sizes) - 1):]
+        datum = getattr(toolkit, "datum", None)
+        mask = getattr(datum, "mask", None) if datum is not None else None
+        n_seeds = (
+            int((np.asarray(mask) == 0).sum()) if mask is not None
+            else int(toolkit.host_graph.v_num) // 3
+        )
+        sample_cfg = {
+            "batch_size": int(cfg.batch_size or 16),
+            "fanouts": fans,
+            "n_seeds": n_seeds,
+        }
     metrics = getattr(toolkit, "metrics", None)
     # trial records carry the FULL cache-key facts (digest/backend/
     # layers ride as open fields), so the drift auditor can flag exactly
@@ -248,6 +269,7 @@ def _decide(toolkit, autos: Set[str], measure_allowed: bool,
         kernel_tile=cfg.kernel_tile, edge_chunk=cfg.edge_chunk,
         score_channels=C, precision=cfg.precision,
         eager_widths=bool(getattr(cls, "eager", False)),
+        sample_cfg=sample_cfg,
     )
     if metrics is not None and measure:
         metrics.counter_add(

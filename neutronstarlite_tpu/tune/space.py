@@ -1,7 +1,8 @@
 """The autotuner's typed candidate space.
 
-A candidate is one (dist_path, kernel, ell_levels, wire_dtype, mesh)
-tuple — exactly the five auto-capable cfg axes. :func:`enumerate_candidates`
+A candidate is one (dist_path, kernel, ell_levels, wire_dtype, mesh,
+sample_pipeline) tuple — exactly the six auto-capable cfg axes.
+:func:`enumerate_candidates`
 yields the tuples that are (a) shaped for the trainer's algorithm family,
 (b) consistent with every axis the user PINNED (a non-auto cfg value is
 a constraint, not a suggestion), and (c) accepted by the SAME
@@ -34,6 +35,11 @@ the refusals key off):
   (ring schedule). The ring stacked tables keep the shared pow2 ladder
   (cross-device K fragmentation pads more — PR 6), so ELL_LEVELS is not
   an axis here.
+- ``sampled`` (``supports_sample_pipeline``: GCNSAMPLESINGLE) —
+  SAMPLE_PIPELINE '' (sync, the parity oracle) vs pipelined (prefetch
+  thread overlap) vs device (on-device hop draw) vs fused (the whole
+  epoch as one on-device ``lax.scan`` dispatch, zero per-batch H2D —
+  sample/fused.py).
 - ``plain`` (everything else) — the space is the single empty tuple;
   ``auto`` degrades to the family's only valid choice.
 """
@@ -49,10 +55,12 @@ from neutronstarlite_tpu.utils.logging import get_logger
 log = get_logger("tune")
 
 # the auto-capable cfg axes, in canonical label order ("mesh" appended
-# last so pre-mesh labels extend with a trailing "|-"; the cache schema
-# version was bumped with it, so old persisted labels can never be
-# half-parsed)
-AXES = ("dist_path", "kernel", "ell_levels", "wire_dtype", "mesh")
+# last so pre-mesh labels extend with a trailing "|-", and
+# "sample_pipeline" after it for the same reason; the cache schema
+# version was bumped with each growth, so old persisted labels can never
+# be half-parsed)
+AXES = ("dist_path", "kernel", "ell_levels", "wire_dtype", "mesh",
+        "sample_pipeline")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -66,10 +74,12 @@ class Candidate:
     ell_levels: str = ""
     wire_dtype: str = ""
     mesh: str = ""
+    sample_pipeline: str = ""
 
     def label(self) -> str:
         """Canonical record/cache label: axis values joined by '|', '-'
-        for empty — e.g. ``ring_blocked|-|-|bf16|2,2``."""
+        for empty — e.g. ``ring_blocked|-|-|bf16|2,2|-`` or
+        ``-|-|-|-|-|fused``."""
         return "|".join(getattr(self, a) or "-" for a in AXES)
 
     def as_dict(self) -> dict:
@@ -93,6 +103,8 @@ def family_of(trainer_cls) -> str:
         if not getattr(trainer_cls, "needs_device_graph", True):
             return "edge_dist"
         return "edge_single"
+    if getattr(trainer_cls, "supports_sample_pipeline", False):
+        return "sampled"
     return "plain"
 
 
@@ -110,6 +122,11 @@ def _norm(axis: str, value: str) -> str:
         return "ring_blocked"
     if axis == "wire_dtype":
         return {"f32": "", "float32": "", "bfloat16": "bf16"}.get(v, v)
+    if axis == "sample_pipeline":
+        # the selector grammar's aliases (sample/pipeline.py): sync is
+        # the '' default, the on/off switches map to their modes
+        return {"sync": "", "off": "", "0": "", "on": "pipelined",
+                "1": "pipelined"}.get(v, v)
     if axis == "mesh" and v not in ("", "auto"):
         from neutronstarlite_tpu.parallel.partitioner import (
             normalize_mesh_value,
@@ -144,6 +161,7 @@ def candidate_valid(trainer_cls, cfg, cand: Candidate,
     try:
         trainer_cls._check_kernel(probe)
         trainer_cls._check_dist_path(probe)
+        trainer_cls._check_sample_pipeline(probe)
     except ValueError:
         return False
     return True
@@ -180,6 +198,12 @@ def _axis_values(family: str, axis: str, autos: Set[str], cfg,
     elif family == "edge_dist":
         if axis == "kernel":
             return ["", "fused_edge"]
+    elif family == "sampled":
+        if axis == "sample_pipeline":
+            # '' is the sync oracle; the other three are the scheduling/
+            # placement variants (docs/SAMPLING.md) — all train the same
+            # distributional objective, so they are freely interchangeable
+            return ["", "pipelined", "device", "fused"]
     return [""]
 
 
@@ -234,11 +258,13 @@ def enumerate_candidates(trainer_cls, cfg, partitions: int,
             for lv in lvs:
                 for wd in values["wire_dtype"]:
                     for ms in values["mesh"]:
-                        cand = Candidate(dist_path=dp, kernel=kn,
-                                         ell_levels=lv, wire_dtype=wd,
-                                         mesh=ms)
-                        if _consistent(family, cand) and candidate_valid(
-                            trainer_cls, cfg, cand, autos
-                        ):
-                            out.append(cand)
+                        for sp in values["sample_pipeline"]:
+                            cand = Candidate(dist_path=dp, kernel=kn,
+                                             ell_levels=lv, wire_dtype=wd,
+                                             mesh=ms, sample_pipeline=sp)
+                            if _consistent(family, cand) and \
+                                    candidate_valid(
+                                        trainer_cls, cfg, cand, autos
+                                    ):
+                                out.append(cand)
     return out

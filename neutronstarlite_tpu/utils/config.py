@@ -196,10 +196,15 @@ class InputInfo:
     # for the sampled path (training gcn_sample + serve/): "" / sync (the
     # in-step-loop host sampler — the parity oracle), pipelined (K-deep
     # prefetching background pipeline + async H2D, sample/pipeline.py;
-    # bitwise-identical batches to sync), or device (pipelined + the
-    # jitted on-device uniform hop sampler, sample/device_sampler.py —
-    # distribution-equivalent, not bitwise). Env override
-    # NTS_SAMPLE_PIPELINE (sample.pipeline.resolve_sample_pipeline).
+    # bitwise-identical batches to sync), device (pipelined + the jitted
+    # on-device uniform hop sampler, sample/device_sampler.py —
+    # distribution-equivalent, not bitwise), fused (the whole
+    # draw->remap->gather->train batch in ONE jitted program over the
+    # resident tables, epochs scanned into one dispatch with zero
+    # per-batch H2D, sample/fused.py — distribution-equivalent, bitwise
+    # deterministic across reruns), or auto (tuner-resolved like
+    # KERNEL:auto, tune/select.py). Env override NTS_SAMPLE_PIPELINE
+    # (sample.pipeline.resolve_sample_pipeline).
 
     @staticmethod
     def read_from_cfg_file(path: str) -> "InputInfo":
@@ -384,10 +389,11 @@ class InputInfo:
             # validated like DIST_PATH/KERNEL: a typo'd value would
             # silently run the synchronous sampler while the user
             # benchmarks it as the pipeline
-            if v not in ("", "sync", "pipelined", "device"):
+            if v not in ("", "sync", "pipelined", "device", "fused",
+                         "auto"):
                 raise ValueError(
-                    f"SAMPLE_PIPELINE must be sync, pipelined or device, "
-                    f"got {value!r}"
+                    f"SAMPLE_PIPELINE must be sync, pipelined, device, "
+                    f"fused or auto, got {value!r}"
                 )
             self.sample_pipeline = v
         # unknown keys ignored, matching the reference's else-silence

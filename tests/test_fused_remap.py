@@ -1,0 +1,224 @@
+"""On-device dedup/remap + fused draw: equivalence with the host sampler.
+
+``sample/fused.py`` replaces the host ``np.unique + np.searchsorted``
+dedup (``Sampler._make_batch``) with a sorted-scatter construction inside
+the fused epoch program. These tests pin the primitive STANDALONE against
+the host oracle on adversarial inputs — duplicates across hops, empty
+neighborhoods, over-capacity thinned rows, margin-padded slack vertices —
+and the fused hop draw against the host sampler's uniform
+without-replacement distribution (a statistical oracle: same
+top-k-of-uniform-priorities construction, different stream).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neutronstarlite_tpu.graph.storage import build_graph
+from neutronstarlite_tpu.sample.device_sampler import DeviceUniformSampler
+from neutronstarlite_tpu.sample.fused import (
+    _draw_hop,
+    device_dedup_remap,
+    degree_tables,
+    fused_sample_subgraph,
+)
+from neutronstarlite_tpu.sample.sampler import Sampler
+
+
+def _host_oracle(src: np.ndarray, valid: np.ndarray, ncap: int):
+    """The host dedup semantics device_dedup_remap must reproduce:
+    sorted-unique over the VALID entries, searchsorted locals (0 on
+    invalid slots — the padder's fill), zero-padded uniq."""
+    live = src[valid]
+    uniq = np.unique(live)
+    out = np.zeros(ncap, dtype=src.dtype)
+    out[: len(uniq)] = uniq
+    local = np.zeros(len(src), dtype=np.int32)
+    if len(uniq):
+        local[valid] = np.searchsorted(uniq, live).astype(np.int32)
+    return out, local, len(uniq)
+
+
+def _check(src, valid, ncap):
+    uniq, local, n = device_dedup_remap(
+        jnp.asarray(src), jnp.asarray(valid), ncap
+    )
+    euniq, elocal, en = _host_oracle(src, valid, ncap)
+    np.testing.assert_array_equal(np.asarray(uniq), euniq)
+    np.testing.assert_array_equal(np.asarray(local), elocal)
+    assert int(n) == en
+
+
+def test_remap_duplicates_across_hops():
+    # the same vertex drawn under several dst rows (duplicates across
+    # the flattened hop) must collapse to ONE unique with shared locals
+    src = np.array([7, 3, 7, 7, 3, 12, 0, 12], dtype=np.int32)
+    valid = np.ones(8, dtype=bool)
+    _check(src, valid, ncap=8)
+
+
+def test_remap_empty_neighborhoods():
+    # an entirely-invalid candidate set (every dst row isolated): zero
+    # uniques, all-zero locals — and never a NaN/sentinel leak
+    src = np.arange(6, dtype=np.int32)
+    valid = np.zeros(6, dtype=bool)
+    _check(src, valid, ncap=4)
+
+
+def test_remap_thinned_over_capacity_rows():
+    # pre-thinned high-degree rows repeat a small id set many times
+    # (device_sampler thins to the table width): heavy duplication, a
+    # handful of uniques, capacity far above the unique count
+    rng = np.random.default_rng(3)
+    src = rng.choice(np.array([5, 9, 11], dtype=np.int32), size=64)
+    valid = rng.random(64) < 0.8
+    _check(src, valid, ncap=64)
+
+
+def test_remap_margin_padded_slack_vertices():
+    # ids near the top of a margin-padded slab (stream growth slack) mix
+    # with low ids; invalid slots carry garbage that must not surface
+    src = np.array([2_000_000, 3, 2_000_000, 1, 9999, 3], dtype=np.int32)
+    valid = np.array([True, True, False, True, True, True])
+    _check(src, valid, ncap=6)
+
+
+def test_remap_zero_id_is_a_real_vertex():
+    # vertex 0 is a legitimate id AND the padding fill — a live 0 must
+    # survive dedup while invalid slots still read as local 0
+    src = np.array([0, 4, 0, 4, 2], dtype=np.int32)
+    valid = np.array([True, True, True, False, True])
+    _check(src, valid, ncap=5)
+
+
+def test_remap_adversarial_fuzz():
+    rng = np.random.default_rng(11)
+    for _ in range(25):
+        E = int(rng.integers(1, 96))
+        src = rng.integers(0, max(E // 2, 2), size=E).astype(np.int32)
+        valid = rng.random(E) < rng.random()
+        _check(src, valid, ncap=E)
+
+
+def _toy_graph(rng, v_num=60, e_num=600):
+    src = rng.integers(0, v_num, size=e_num).astype(np.int64)
+    dst = rng.integers(0, v_num, size=e_num).astype(np.int64)
+    # drop parallel edges: the distribution oracle below counts per-ID
+    # frequencies, and a multi-edge doubles an id's draw probability
+    pairs = np.unique(np.stack([src, dst], axis=1), axis=0)
+    return build_graph(pairs[:, 0], pairs[:, 1], v_num, use_native=False)
+
+
+def test_draw_hop_is_uniform_without_replacement(rng):
+    """The statistical oracle: over many keys, each neighbor of a fixed
+    dst is drawn with frequency fanout/deg (uniform without
+    replacement), matching the host sampler's distribution."""
+    g = _toy_graph(rng)
+    hs = DeviceUniformSampler.from_host(g)
+    # pick a vertex with a healthy degree strictly above the fanout
+    degs = np.diff(g.column_offset)
+    v = int(np.argmax(degs))
+    deg = int(min(degs[v], hs.width))
+    fanout = 3
+    assert deg > fanout
+    dsts = jnp.asarray([v], dtype=jnp.int32)
+    counts: dict = {}
+    trials = 400
+    for t in range(trials):
+        src, valid = _draw_hop(
+            hs.nbr, hs.eff_deg, jax.random.PRNGKey(t), dsts,
+            jnp.int32(1), fanout,
+        )
+        src, valid = np.asarray(src)[0], np.asarray(valid)[0]
+        drawn = src[valid]
+        # without replacement within a draw
+        assert len(np.unique(drawn)) == len(drawn) == min(fanout, deg)
+        for s in drawn:
+            counts[int(s)] = counts.get(int(s), 0) + 1
+    nbrs = np.asarray(hs.nbr[v][: deg])
+    expected = trials * fanout / deg
+    freqs = np.array([counts.get(int(s), 0) for s in np.unique(nbrs)],
+                     dtype=float)
+    # each neighbor within 5 sigma of the binomial expectation
+    sigma = np.sqrt(trials * (fanout / deg) * (1 - fanout / deg))
+    assert np.all(np.abs(freqs - expected) <= 5 * sigma), (
+        freqs, expected, sigma,
+    )
+
+
+def test_fused_subgraph_matches_host_structure(rng):
+    """fused_sample_subgraph returns the host sampler's exact batch
+    structure: padded shapes at the sampler capacities, locals indexing
+    into the hop's unique set, GCN-norm weights on live edges and 0 on
+    padding."""
+    g = _toy_graph(rng)
+    B, fanouts = 8, [3, 2]
+    host = Sampler(g, np.arange(g.v_num, dtype=np.int64), B, fanouts,
+                   rng=np.random.default_rng(0))
+    caps = tuple(host.node_caps)
+    hs = DeviceUniformSampler.from_host(g)
+    out_deg, in_deg = degree_tables(g)
+    seeds = np.zeros(B, dtype=np.int32)
+    live = 5
+    seeds[:live] = rng.choice(g.v_num, size=live, replace=False)
+    nodes, hops = jax.jit(
+        lambda s, n, k: fused_sample_subgraph(
+            hs.nbr, hs.eff_deg, out_deg, in_deg, s, n, k, caps,
+            tuple(fanouts),
+        ),
+        static_argnums=(),
+    )(jnp.asarray(seeds), jnp.int32(live), jax.random.PRNGKey(9))
+    assert [int(n.shape[0]) for n in nodes] == list(caps)
+    for h, fanout in enumerate(fanouts):
+        src_local, dst_local, w = hops[h]
+        ecap = caps[h + 1] * fanout
+        assert src_local.shape == dst_local.shape == w.shape == (ecap,)
+        src_local = np.asarray(src_local)
+        dst_local = np.asarray(dst_local)
+        w = np.asarray(w)
+        live_e = w > 0
+        # locals index into this hop's unique set / dst set
+        assert src_local.max() < caps[h]
+        assert dst_local.max() < caps[h + 1]
+        uniq = np.asarray(nodes[h])
+        dsts = np.asarray(nodes[h + 1])
+        # every live edge's GCN-norm weight matches the host formula
+        gsrc = uniq[src_local[live_e]]
+        gdst = dsts[dst_local[live_e]]
+        expect = 1.0 / np.sqrt(
+            np.maximum(g.out_degree[gsrc], 1)
+            * np.maximum(g.in_degree[gdst], 1)
+        )
+        np.testing.assert_allclose(w[live_e], expect, rtol=1e-6)
+        # live sources really are neighbors of their dst in the table
+        nbr = np.asarray(hs.nbr)
+        eff = np.asarray(hs.eff_deg)
+        for s, d in zip(gsrc[:64], gdst[:64]):
+            assert s in nbr[d][: eff[d]], (s, d)
+    # uniq sets are sorted-unique over the live prefix (host semantics)
+    for h in range(len(fanouts)):
+        uniq = np.asarray(nodes[h])
+        live_u = uniq[uniq > 0]
+        assert np.all(np.diff(live_u) > 0)
+
+
+def test_fused_subgraph_is_bitwise_deterministic(rng):
+    g = _toy_graph(rng)
+    hs = DeviceUniformSampler.from_host(g)
+    out_deg, in_deg = degree_tables(g)
+    caps, fanouts = (32, 8), (4,)
+    seeds = jnp.asarray(np.arange(8, dtype=np.int32))
+
+    def run():
+        return fused_sample_subgraph(
+            hs.nbr, hs.eff_deg, out_deg, in_deg, seeds, jnp.int32(8),
+            jax.random.PRNGKey(4), caps, fanouts,
+        )
+
+    n1, h1 = jax.jit(run)()
+    n2, h2 = jax.jit(run)()
+    for a, b in zip(jax.tree_util.tree_leaves((n1, h1)),
+                    jax.tree_util.tree_leaves((n2, h2))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -381,7 +381,7 @@ def test_mesh_auto_enumerates_factorizations():
     # duplicate of ''
     assert meshes == {"", "2,2", "1,4"}
     labels = [c.label() for c in cands]
-    assert "ring_blocked_sim|-|-|-|2,2" in labels
+    assert "ring_blocked_sim|-|-|-|2,2|-" in labels
 
 
 def test_mesh_auto_resolution_and_cached_replay(tmp_path, monkeypatch, rng):
@@ -401,7 +401,7 @@ def test_mesh_auto_resolution_and_cached_replay(tmp_path, monkeypatch, rng):
     assert "mesh" in d[0]["decision"]
     trials = [e for e in evs if e["event"] == "tune_trial"]
     assert {t["candidate"] for t in trials} >= {
-        "ring_blocked_sim|-|-|-|2,2"
+        "ring_blocked_sim|-|-|-|2,2|-"
     }
     # cached replay: identical decision, zero trials
     monkeypatch.setenv("NTS_TUNE", "cached")

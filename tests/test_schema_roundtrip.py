@@ -26,6 +26,8 @@ def _emit_all(reg: registry.MetricsRegistry) -> None:
     reg.event("run_start", algorithm="GCNDIST", fingerprint="cafecafecafe",
               seed=0, process_index=0, pid=1234)
     reg.event("epoch", epoch=0, seconds=0.5, loss=1.25)
+    reg.event("epoch_scan", bucket=4, batches=4, dispatches=1,
+              h2d_bytes=0, epoch=0, seconds=0.12)
     reg.event("ring_step", epoch=0, step=1, bytes=4096, skipped=False,
               seconds=None, epoch_span="s1")
     reg.event("fault", kind="nonfinite_loss", epoch=1, attempt=1,
@@ -168,6 +170,7 @@ def _emit_all(reg: registry.MetricsRegistry) -> None:
 RENDER_MARKERS = {
     "run_start": None,
     "epoch": "#epochs=",
+    "epoch_scan": "#epoch_scan=",
     "ring_step": "ring-pipelined exchange:",
     "fault": "kind=nonfinite_loss",
     "recovery": "action=rollback",
@@ -248,6 +251,7 @@ def test_validator_rejects_mutations_per_kind(tmp_path):
     mutations = {
         "run_start": {"algorithm": 7},
         "epoch": {"seconds": 0},
+        "epoch_scan": {"dispatches": 0},
         "ring_step": {"step": 0},
         "fault": {"kind": ""},
         "recovery": {"action": ""},

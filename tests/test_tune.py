@@ -145,10 +145,10 @@ def test_space_refused_tuples_are_absent():
         cls, cfg, space.Candidate(kernel="fused_edge"), space.auto_axes(cfg)
     )
     # no all_gather on a sim rig (the gather family has no sim twin)...
-    assert "all_gather|-|-|-|-" not in labels
+    assert "all_gather|-|-|-|-|-" not in labels
     # ...and bf16 wire only ever rides the ring
     with_mesh = space.enumerate_candidates(cls, cfg, 4, simulate=False)
-    assert "all_gather|-|-|-|-" in [c.label() for c in with_mesh]
+    assert "all_gather|-|-|-|-|-" in [c.label() for c in with_mesh]
     for c in with_mesh:
         if c.wire_dtype:
             assert c.dist_path == "ring_blocked"
@@ -161,7 +161,7 @@ def test_space_pinned_axis_is_a_constraint():
     cfg.layer_string = "8-8-3"
     cfg.ell_levels = "auto"  # KERNEL stays pinned at "" (eager)
     cands = space.enumerate_candidates(cls, cfg, 1)
-    assert [c.label() for c in cands] == ["-|-|-|-|-"]
+    assert [c.label() for c in cands] == ["-|-|-|-|-|-"]
 
 
 def test_candidate_label_roundtrip():
@@ -184,7 +184,7 @@ def _key(**over):
 def _decision():
     return {"dist_path": "ring_blocked", "kernel": "", "ell_levels": "",
             "wire_dtype": "bf16", "mesh": "",
-            "candidate": "ring_blocked|-|-|bf16|-",
+            "candidate": "ring_blocked|-|-|bf16|-|-",
             "seconds": 0.01, "predicted_bytes": 4096, "source": "measured"}
 
 
@@ -195,7 +195,7 @@ def test_cache_hit_miss_and_staleness(tmp_path, caplog):
     path = cache.store(key, _decision(), directory=d)
     assert path and os.path.exists(path)
     entry = cache.load(key, d)
-    assert entry["decision"]["candidate"] == "ring_blocked|-|-|bf16|-"
+    assert entry["decision"]["candidate"] == "ring_blocked|-|-|bf16|-|-"
 
     # digest change -> different key -> miss (re-tune)
     assert cache.load(_key(graph_digest="e" * 64), d) is None
@@ -515,9 +515,9 @@ def test_analytic_prior_orders_dist_candidates_sanely(rng):
         space.Candidate(dist_path="ring_blocked", wire_dtype="bf16"),
     ]
     priors = runner.analytic_priors(g, 4, [16, 8, 4], "dist_dense", cands)
-    ag = priors["all_gather|-|-|-|-"]
-    rf = priors["ring_blocked|-|-|-|-"]
-    rb = priors["ring_blocked|-|-|bf16|-"]
+    ag = priors["all_gather|-|-|-|-|-"]
+    rf = priors["ring_blocked|-|-|-|-|-"]
+    rb = priors["ring_blocked|-|-|bf16|-|-"]
     assert rb < rf < ag
 
 
