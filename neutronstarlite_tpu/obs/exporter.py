@@ -59,6 +59,7 @@ from urllib.parse import parse_qs
 
 from neutronstarlite_tpu.obs.hist import PROM_EDGES_MS, prom_edges  # noqa: F401 (PROM_EDGES_MS re-exported for callers pinned to the canonical ladder)
 from neutronstarlite_tpu.obs.schema import SCHEMA_VERSION
+from neutronstarlite_tpu.obs.trace import TraceContext, Tracer
 from neutronstarlite_tpu.utils.logging import get_logger
 
 log = get_logger("obs")
@@ -317,6 +318,8 @@ class MetricsExporter:
         self.slo = slo
         self.started_at = time.time()
         self._predict_fn = None
+        self._predict_takes_ctx = False
+        self._tracer = Tracer(registry)
         self.rebind(registry, slo, replica=replica)
         exporter = self
 
@@ -374,6 +377,11 @@ class MetricsExporter:
                                 "application/json",
                             )
                     elif path == "/telemetry":
+                        ctx = (
+                            TraceContext.from_headers(self.headers)
+                            if exporter._tracer.enabled else None
+                        )
+                        t_scrape = time.monotonic()
                         want: Optional[str] = None
                         parts = self.path.split("?", 1)
                         if len(parts) == 2:
@@ -400,6 +408,17 @@ class MetricsExporter:
                             self._send(
                                 200, body, "application/x-ndjson"
                             )
+                            if ctx is not None:
+                                # remote-parented scrape span: carries
+                                # the (send_ts, recv_ts) clock pair the
+                                # fleet timeline merge estimates
+                                # cross-process offsets from
+                                exporter._tracer.complete(
+                                    "telemetry_scrape",
+                                    dur_s=time.monotonic() - t_scrape,
+                                    cat="http", ctx=ctx,
+                                    bytes=len(body),
+                                )
                     else:
                         self._send(404, b"not found\n", "text/plain")
                 except Exception as e:  # a bad scrape must not kill serving
@@ -434,9 +453,32 @@ class MetricsExporter:
                             "application/json",
                         )
                         return
-                    code, out = fn(payload)
+                    tracer = exporter._tracer
+                    ctx = (TraceContext.from_headers(self.headers)
+                           if tracer.enabled else None)
+                    if ctx is not None:
+                        # pre-allocate the handler span's id so the
+                        # replica's request/queue spans (emitted first,
+                        # from the batcher) can parent into it
+                        hid = tracer.next_id()
+                        t_handle = time.monotonic()
+                        down = ctx.child(hid)
+                    else:
+                        hid = None
+                        down = None
+                    if exporter._predict_takes_ctx:
+                        code, out = fn(payload, down)
+                    else:
+                        code, out = fn(payload)
                     self._send(int(code), json.dumps(out).encode(),
                                "application/json")
+                    if hid is not None:
+                        tracer.complete(
+                            "predict_handler",
+                            dur_s=time.monotonic() - t_handle,
+                            cat="serve", ctx=ctx, span_id=hid,
+                            status=int(code),
+                        )
                 except Exception as e:  # a bad request must not kill serving
                     try:
                         self._send(
@@ -475,15 +517,38 @@ class MetricsExporter:
             else:
                 self._surfaces.pop("", None)
                 self._surfaces[str(replica)] = (registry, slo)
-            # legacy attributes track the newest surface
+            # legacy attributes track the newest surface; handler spans
+            # (predict_handler / telemetry_scrape) follow it
             self.registry = registry
             self.slo = slo
+            self._tracer = Tracer(registry)
 
     def bind_predict(self, fn) -> None:
         """Arm (or with ``None`` disarm) the POST /predict data plane.
         ``fn(payload_dict) -> (status_code, response_dict)`` runs on the
         listener's request thread — it must be thread-safe and bounded
-        (the serve batcher's submit/result path already is)."""
+        (the serve batcher's submit/result path already is). A two-arg
+        ``fn(payload_dict, ctx)`` additionally receives the request's
+        :class:`TraceContext` (or None) so replica-side spans can parent
+        into the caller's trace."""
+        takes_ctx = False
+        if fn is not None:
+            import inspect
+
+            try:
+                sig = inspect.signature(fn)
+                pos = [
+                    p for p in sig.parameters.values()
+                    if p.kind in (p.POSITIONAL_ONLY,
+                                  p.POSITIONAL_OR_KEYWORD)
+                ]
+                takes_ctx = len(pos) >= 2 or any(
+                    p.kind == p.VAR_POSITIONAL
+                    for p in sig.parameters.values()
+                )
+            except (TypeError, ValueError):
+                takes_ctx = False
+        self._predict_takes_ctx = takes_ctx
         self._predict_fn = fn
 
     def close(self) -> None:

@@ -135,6 +135,19 @@ def _classify(exc: BaseException, url: str) -> HttpError:
     return HttpError(f"fetch failed for {url}: {exc}")
 
 
+def error_class(exc: BaseException) -> str:
+    """The span-attribute error taxonomy: timeout / refused / status /
+    error — the retry-attribution tag on httpc's child spans and the
+    router's suspect spans."""
+    if isinstance(exc, HttpTimeout):
+        return "timeout"
+    if isinstance(exc, HttpRefused):
+        return "refused"
+    if isinstance(exc, HttpStatusError):
+        return "status"
+    return "error"
+
+
 def fetch(url: str, *,
           timeout_s: Optional[float] = None,
           retries: Optional[int] = None,
@@ -142,7 +155,10 @@ def fetch(url: str, *,
           deadline_s: Optional[float] = None,
           target: Optional[int] = None,
           data: Optional[bytes] = None,
-          content_type: str = "application/json") -> str:
+          content_type: str = "application/json",
+          tracer=None,
+          ctx=None,
+          span_name: str = "http_fetch") -> str:
     """GET (or POST, when ``data`` is given) ``url`` with retries.
 
     ``deadline_s`` bounds the WHOLE call (requests + backoff sleeps);
@@ -150,6 +166,21 @@ def fetch(url: str, *,
     by ``net_drop@target=k`` / ``slow_net@target=k`` fault specs. POSTs
     are retried like GETs — callers whose POST is not idempotent (the
     router's /predict) should pass ``retries=0`` and own re-dispatch.
+
+    Distributed tracing (both optional, zero cost when absent):
+
+    - ``tracer`` (obs/trace.Tracer) — the call emits one ``span_name``
+      span covering the whole call (child of ``ctx`` / the caller's open
+      span), plus one ``http_retry`` child span per FAILED attempt
+      tagged with the :func:`error_class` (timeout/refused/status) and
+      the backoff it cost;
+    - ``ctx`` (obs/trace.TraceContext) — the trace crosses the wire:
+      ``X-NTS-Trace-Id`` / ``X-NTS-Parent-Span`` / ``X-NTS-Send-Ts``
+      headers are injected on every attempt (send_ts re-stamped per
+      retry), parenting the server-side handler spans under this call's
+      span. With a disabled tracer (``NTS_TRACE=0``) no context exists,
+      no headers are added and no spans allocate — the hot path is
+      byte-identical to the pre-tracing client.
 
     Raises the typed :class:`HttpError` subclass of the LAST attempt
     once the retry budget (or the deadline) is exhausted.
@@ -166,18 +197,53 @@ def fetch(url: str, *,
                  else max(float(backoff_s), 0.0))
     t0 = time.monotonic()
 
+    trace_on = tracer is not None and getattr(tracer, "enabled", False)
+    sid = None          # this call's span id (remote spans parent to it)
+    hdr_ctx = None      # context serialized into the request headers
+    emit_ctx = None     # context our own child retry spans emit under
+    send_ts: Optional[float] = None
+    if trace_on:
+        from neutronstarlite_tpu.obs.trace import TraceContext
+
+        sid = tracer.next_id()
+        trace_id = ctx.trace_id if ctx is not None else tracer.trace_id
+        hdr_ctx = TraceContext(trace_id, sid)
+        emit_ctx = TraceContext(trace_id, sid)
+    elif ctx is not None:
+        hdr_ctx = ctx
+
     def remaining() -> Optional[float]:
         if deadline_s is None:
             return None
         return deadline_s - (time.monotonic() - t0)
 
+    def finish(outcome: str, status: Optional[int], attempts: int) -> None:
+        if not trace_on:
+            return
+        attrs = {"url": url, "outcome": outcome, "attempts": attempts}
+        if target is not None:
+            attrs["target"] = target
+        if status is not None:
+            attrs["status"] = status
+        if send_ts is not None:
+            attrs["send_ts"] = send_ts
+        tracer.complete(
+            span_name, dur_s=time.monotonic() - t0, cat="http",
+            span_id=sid, ctx=ctx, **attrs,
+        )
+
     last: Optional[HttpError] = None
+    attempt = 0
     for attempt in range(1, retries + 2):
         budget = remaining()
         if budget is not None and budget <= 0:
-            raise last or HttpTimeout(
+            err = last or HttpTimeout(
                 f"deadline {deadline_s:g}s expired before fetching {url}"
             )
+            finish(error_class(err), getattr(err, "status", None),
+                   attempt - 1)
+            raise err
+        t_attempt = time.monotonic()
         try:
             # the chaos seam: net_drop raises refused here, slow_net
             # sleeps here — BEFORE the socket, so injected faults spend
@@ -186,25 +252,52 @@ def fetch(url: str, *,
             req = urllib.request.Request(url, data=data)
             if data is not None:
                 req.add_header("Content-Type", content_type)
+            if hdr_ctx is not None:
+                send_ts = time.time()  # re-stamped per attempt
+                for k, v in hdr_ctx.to_headers(send_ts=send_ts).items():
+                    req.add_header(k, v)
             t = timeout_s if budget is None else max(min(timeout_s, budget),
                                                      1e-3)
             with urllib.request.urlopen(req, timeout=t) as resp:
                 if resp.status != 200:
                     raise HttpStatusError(resp.status, url)
-                return resp.read().decode("utf-8")
+                body = resp.read().decode("utf-8")
+                finish("ok", 200, attempt)
+                return body
         except HttpError as e:
             last = e
         except Exception as e:
             last = _classify(e, url)
-        if attempt <= retries:
+        delay = 0.0
+        will_retry = attempt <= retries
+        if will_retry:
             delay = backoff_s * (2.0 ** (attempt - 1))
             delay *= 1.0 + backoff_jitter_frac(attempt)
             budget = remaining()
             if budget is not None:
                 if budget <= 0:
-                    break
-                delay = min(delay, budget)
-            if delay > 0:
-                time.sleep(delay)
+                    will_retry = False
+                    delay = 0.0
+                else:
+                    delay = min(delay, budget)
+        if trace_on:
+            # retry attribution: one child span per failed attempt, the
+            # error class + the backoff it cost readable off the trace
+            retry_attrs = {
+                "attempt": attempt, "error": error_class(last),
+                "backoff_s": delay if will_retry else 0.0,
+                "will_retry": will_retry,
+            }
+            if isinstance(last, HttpStatusError):
+                retry_attrs["status"] = last.status
+            tracer.complete(
+                "http_retry", dur_s=time.monotonic() - t_attempt,
+                cat="http", ctx=emit_ctx, **retry_attrs,
+            )
+        if not will_retry:
+            break
+        if delay > 0:
+            time.sleep(delay)
     assert last is not None
+    finish(error_class(last), getattr(last, "status", None), attempt)
     raise last

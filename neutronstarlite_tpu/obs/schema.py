@@ -187,7 +187,16 @@ span (obs/trace.py): one completed interval on the causal timeline
   process-local; tools/trace_timeline maps it to wall clock via the
   envelope ts and aligns ranks on epoch spans),
   dur_s: number >= 0,
-  rank: int | absent, thread: str | absent, plus open attribute fields
+  rank: int | absent, thread: str | absent,
+  send_ts: number | absent, recv_ts: number | absent (remote-parent
+  link stamps, obs/trace.TraceContext: the caller's wall clock at HTTP
+  send and this process's wall clock at receive — the NTP-style pair
+  tools/trace_timeline --fleet uses to estimate per-process clock
+  offset with an RTT/2 skew bound),
+  graph_seq: int | absent, model_seq: int | absent (prediction
+  freshness lineage: the last applied graph-delta sequence and the
+  serving model's rollout sequence at execution time),
+  plus open attribute fields
 
 stream_rotated (obs/registry.py): the NTS_METRICS_MAX_MB size guard fired
   reason: str, rotated_to: str | null, bytes_written: int
@@ -688,6 +697,18 @@ def validate_event(obj: Any) -> None:
             _fail(f"span.dur_s must be >= 0, got {obj['dur_s']!r}")
         if "rank" in obj and not isinstance(obj["rank"], int):
             _fail("span.rank must be an int when present")
+        # remote-parent link stamps (obs/trace.TraceContext) — wall
+        # clocks from TWO processes, so numbers, never required
+        for key in ("send_ts", "recv_ts"):
+            if key in obj and obj[key] is not None:
+                _require_number(obj, key)
+        # prediction freshness lineage rides serve-request spans
+        for key in ("graph_seq", "model_seq"):
+            if key in obj and obj[key] is not None and (
+                    not isinstance(obj[key], int)
+                    or isinstance(obj[key], bool)):
+                _fail(f"span.{key} must be an int when present, "
+                      f"got {obj[key]!r}")
     elif kind == "stream_rotated":
         if not isinstance(obj.get("reason"), str) or not obj["reason"]:
             _fail("stream_rotated.reason must be a non-empty string")

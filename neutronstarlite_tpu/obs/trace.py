@@ -69,20 +69,95 @@ def _now() -> float:
 _SPAN_IDS = itertools.count()
 
 
+class TraceContext:
+    """A serializable hop in a distributed trace.
+
+    Three facts cross the process boundary (as HTTP headers, injected by
+    obs/httpc and extracted by the exporter's /predict + /telemetry
+    handlers):
+
+    - ``trace_id``   — which trace the remote spans should join;
+    - ``span_id``    — the CALLER's span the remote spans parent into
+      (``parent_id`` on the receiving side);
+    - ``send_ts``    — the caller's wall clock at send time.
+
+    The receiver stamps ``recv_ts`` (its own wall clock) at extraction.
+    A span emitted with a context therefore carries one (send_ts,
+    recv_ts) pair of the two processes' wall clocks taken ~one network
+    hop apart — tools/trace_timeline turns the pairs into per-process
+    clock offsets (NTP-style, error bounded by RTT/2; see
+    docs/OBSERVABILITY.md)."""
+
+    __slots__ = ("trace_id", "span_id", "send_ts", "recv_ts")
+
+    H_TRACE = "X-NTS-Trace-Id"
+    H_PARENT = "X-NTS-Parent-Span"
+    H_SEND_TS = "X-NTS-Send-Ts"
+
+    def __init__(self, trace_id: str, span_id: Optional[str],
+                 send_ts: Optional[float] = None,
+                 recv_ts: Optional[float] = None):
+        self.trace_id = str(trace_id)
+        self.span_id = span_id
+        self.send_ts = send_ts
+        self.recv_ts = recv_ts
+
+    def to_headers(self, send_ts: Optional[float] = None) -> dict:
+        """Header dict for one outbound request. ``send_ts`` defaults to
+        now — pass it explicitly to re-stamp per retry attempt."""
+        ts = send_ts if send_ts is not None else (
+            self.send_ts if self.send_ts is not None else time.time()
+        )
+        h = {self.H_TRACE: self.trace_id, self.H_SEND_TS: f"{ts:.6f}"}
+        if self.span_id:
+            h[self.H_PARENT] = self.span_id
+        return h
+
+    @classmethod
+    def from_headers(cls, headers) -> Optional["TraceContext"]:
+        """Parse a received header mapping (anything with ``.get``);
+        ``None`` when the request carries no trace. Stamps ``recv_ts``
+        with the receiver's wall clock at extraction."""
+        trace_id = headers.get(cls.H_TRACE)
+        if not trace_id:
+            return None
+        send_ts: Optional[float] = None
+        raw = headers.get(cls.H_SEND_TS)
+        if raw:
+            try:
+                send_ts = float(raw)
+            except (TypeError, ValueError):
+                send_ts = None
+        return cls(trace_id, headers.get(cls.H_PARENT) or None,
+                   send_ts=send_ts, recv_ts=time.time())
+
+    def child(self, span_id: Optional[str]) -> "TraceContext":
+        """Same trace, re-parented under ``span_id`` (send/recv stamps
+        carried along so downstream spans keep the clock pair)."""
+        return TraceContext(self.trace_id, span_id,
+                            send_ts=self.send_ts, recv_ts=self.recv_ts)
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return (f"TraceContext({self.trace_id!r}, {self.span_id!r}, "
+                f"send_ts={self.send_ts}, recv_ts={self.recv_ts})")
+
+
 class SpanHandle:
     """One open (or retroactively completed) span."""
 
     __slots__ = ("name", "cat", "span_id", "parent_id", "t0", "attrs",
-                 "_ann", "_ann_tid")
+                 "trace_id", "_ann", "_ann_tid")
 
     def __init__(self, name: str, cat: str, span_id: str,
-                 parent_id: Optional[str], t0: float, attrs: dict):
+                 parent_id: Optional[str], t0: float, attrs: dict,
+                 trace_id: Optional[str] = None):
         self.name = name
         self.cat = cat
         self.span_id = span_id
         self.parent_id = parent_id
         self.t0 = t0
         self.attrs = attrs
+        self.trace_id = trace_id  # per-span override (remote parenting)
         self._ann = None  # the open jax.profiler annotation, if any
         self._ann_tid = None  # thread that opened it (scopes are TLS)
 
@@ -116,11 +191,57 @@ class Tracer:
     def _next_id(self) -> str:
         return f"s{next(_SPAN_IDS):x}"
 
-    def _resolve_parent(self, parent) -> Optional[str]:
+    def _resolve_parent(self, parent) -> tuple:
+        """(parent_id, inherited trace override). A child belongs to its
+        parent's trace: when the parent (explicit handle or innermost
+        open span) carries a remote trace override, spans nested under
+        it join that trace too — the propagation that keeps a replica's
+        whole request subtree in the router's trace."""
         if parent is not None:
-            return parent.span_id if isinstance(parent, SpanHandle) else str(parent)
+            if isinstance(parent, SpanHandle):
+                return parent.span_id, parent.trace_id
+            return str(parent), None
         st = self._stack()
-        return st[-1].span_id if st else None
+        if st:
+            return st[-1].span_id, st[-1].trace_id
+        return None, None
+
+    def _apply_ctx(self, ctx: Optional[TraceContext], parent,
+                   attrs: dict) -> tuple:
+        """(parent_id, trace_override) under a remote ``ctx``: the remote
+        caller's span becomes the parent (unless an explicit local parent
+        was given), the span joins the caller's trace, and the clock-pair
+        stamps ride along as attributes."""
+        if ctx is None:
+            return self._resolve_parent(parent)
+        if parent is None:
+            parent_id = ctx.span_id
+        else:
+            parent_id, _ = self._resolve_parent(parent)
+        if ctx.send_ts is not None:
+            attrs.setdefault("send_ts", float(ctx.send_ts))
+        if ctx.recv_ts is not None:
+            attrs.setdefault("recv_ts", float(ctx.recv_ts))
+        return parent_id, ctx.trace_id
+
+    # ---- distributed-context helpers -------------------------------------
+    def next_id(self) -> str:
+        """Pre-allocate a span id (for callers that must hand a child its
+        parent id before the parent span itself is emitted — the router's
+        per-request root, httpc's in-flight fetch span)."""
+        return self._next_id()
+
+    def make_ctx(self, parent=None,
+                 trace_id: Optional[str] = None) -> Optional[TraceContext]:
+        """Context for an outbound hop: this tracer's trace (or the given
+        override) parented under ``parent`` (or the innermost open span).
+        ``None`` when tracing is off — callers pass it straight through,
+        keeping the disabled path allocation-free."""
+        if not self.enabled:
+            return None
+        parent_id, inherited = self._resolve_parent(parent)
+        return TraceContext(trace_id or inherited or self.trace_id,
+                            parent_id)
 
     def _emit(self, h: SpanHandle, dur_s: float, extra: dict) -> None:
         if not self.enabled:
@@ -133,7 +254,7 @@ class Tracer:
                 name=h.name,
                 cat=h.cat,
                 span_id=h.span_id,
-                trace_id=self.trace_id,
+                trace_id=h.trace_id or self.trace_id,
                 parent_id=h.parent_id,
                 t0=float(h.t0),
                 dur_s=max(float(dur_s), 0.0),
@@ -146,12 +267,15 @@ class Tracer:
 
     # ---- explicit begin/end (long-lived roots) ---------------------------
     def begin(self, name: str, cat: str = "host", parent=None,
-              **attrs: Any) -> SpanHandle:
+              ctx: Optional[TraceContext] = None, **attrs: Any) -> SpanHandle:
         """Open a span and push it on this thread's stack (it becomes the
-        default parent for spans opened on the same thread until ended)."""
+        default parent for spans opened on the same thread until ended).
+        With ``ctx`` the span joins a remote caller's trace (see
+        :class:`TraceContext`)."""
+        parent_id, trace_override = self._apply_ctx(ctx, parent, attrs)
         h = SpanHandle(
-            name, cat, self._next_id(), self._resolve_parent(parent),
-            _now(), attrs,
+            name, cat, self._next_id(), parent_id,
+            _now(), attrs, trace_id=trace_override,
         )
         if self.enabled:
             self._stack().append(h)
@@ -193,42 +317,51 @@ class Tracer:
         self._emit(h, _now() - h.t0, attrs)
 
     # ---- context-manager form -------------------------------------------
-    def span(self, name: str, cat: str = "host", parent=None, **attrs: Any):
+    def span(self, name: str, cat: str = "host", parent=None,
+             ctx: Optional[TraceContext] = None, **attrs: Any):
         """``with tracer.span("sample", cat="serve") as h:`` — nests via the
         thread-local stack, annotates the device trace when profiling."""
-        return _SpanCtx(self, name, cat, parent, attrs)
+        return _SpanCtx(self, name, cat, parent, ctx, attrs)
 
     # ---- retroactive completion -----------------------------------------
     def complete(self, name: str, dur_s: float, end: Optional[float] = None,
                  t0: Optional[float] = None, cat: str = "host", parent=None,
-                 **attrs: Any) -> SpanHandle:
+                 ctx: Optional[TraceContext] = None,
+                 span_id: Optional[str] = None, **attrs: Any) -> SpanHandle:
         """Emit a span that ALREADY happened: callers that timed an interval
         themselves (the epoch loop's ``get_time()`` bracketing) hand over
-        the duration; ``end`` defaults to now, ``t0`` to ``end - dur_s``."""
+        the duration; ``end`` defaults to now, ``t0`` to ``end - dur_s``.
+        ``ctx`` joins the span into a remote caller's trace; ``span_id``
+        uses a pre-allocated id (``next_id()``) so children emitted earlier
+        can already reference this span as their parent."""
         if t0 is None:
             t0 = (end if end is not None else _now()) - max(dur_s, 0.0)
+        parent_id, trace_override = self._apply_ctx(ctx, parent, attrs)
         h = SpanHandle(
-            name, cat, self._next_id(), self._resolve_parent(parent),
-            float(t0), attrs,
+            name, cat, span_id or self._next_id(), parent_id,
+            float(t0), attrs, trace_id=trace_override,
         )
         self._emit(h, dur_s, {})
         return h
 
 
 class _SpanCtx:
-    __slots__ = ("tracer", "name", "cat", "parent", "attrs", "handle")
+    __slots__ = ("tracer", "name", "cat", "parent", "ctx", "attrs", "handle")
 
-    def __init__(self, tracer: Tracer, name: str, cat: str, parent, attrs):
+    def __init__(self, tracer: Tracer, name: str, cat: str, parent, ctx,
+                 attrs):
         self.tracer = tracer
         self.name = name
         self.cat = cat
         self.parent = parent
+        self.ctx = ctx
         self.attrs = attrs
         self.handle: Optional[SpanHandle] = None
 
     def __enter__(self) -> SpanHandle:
         self.handle = self.tracer.begin(
-            self.name, cat=self.cat, parent=self.parent, **self.attrs
+            self.name, cat=self.cat, parent=self.parent, ctx=self.ctx,
+            **self.attrs
         )
         return self.handle
 

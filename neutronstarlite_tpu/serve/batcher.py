@@ -188,12 +188,17 @@ _REQ_IDS = itertools.count()
 
 
 class ServeRequest:
-    """One in-flight request: seed ids + a completion future."""
+    """One in-flight request: seed ids + a completion future.
+
+    ``ctx`` (obs/trace.TraceContext or None) is the remote caller's trace
+    hop when the request arrived over the wire — the server's lifecycle
+    spans parent into it so the replica-side timeline joins the router's
+    trace."""
 
     __slots__ = ("node_ids", "req_id", "t_submit", "t_flush", "t_done",
-                 "status", "logits", "error", "_done")
+                 "status", "logits", "error", "ctx", "_done")
 
-    def __init__(self, node_ids: np.ndarray):
+    def __init__(self, node_ids: np.ndarray, ctx: Any = None):
         self.node_ids = node_ids
         self.req_id = f"q{next(_REQ_IDS):x}"
         self.t_submit = time.perf_counter()
@@ -202,6 +207,7 @@ class ServeRequest:
         self.status = "pending"
         self.logits: Optional[np.ndarray] = None
         self.error: Optional[BaseException] = None
+        self.ctx = ctx
         self._done = threading.Event()
 
     # -- completion (batcher/server side) ---------------------------------
@@ -274,11 +280,13 @@ class MicroBatcher:
         self._thread.start()
 
     # ---- client side -----------------------------------------------------
-    def submit(self, node_ids: Sequence[int]) -> ServeRequest:
+    def submit(self, node_ids: Sequence[int],
+               ctx: Any = None) -> ServeRequest:
         """Enqueue one request; never blocks. Overload and malformed input
-        reject-with-reason on the returned future."""
+        reject-with-reason on the returned future. ``ctx`` carries the
+        remote caller's TraceContext through to the lifecycle spans."""
         ids = np.asarray(node_ids, dtype=np.int64).reshape(-1)
-        req = ServeRequest(ids)
+        req = ServeRequest(ids, ctx=ctx)
         reason = None
         if len(ids) == 0:
             reason = "empty_request"

@@ -99,6 +99,7 @@ import numpy as np
 
 from neutronstarlite_tpu.obs import httpc, registry as obs_registry
 from neutronstarlite_tpu.obs.hub import TelemetryHub
+from neutronstarlite_tpu.obs.trace import TraceContext, Tracer
 from neutronstarlite_tpu.serve.batcher import RequestShedError, ServeRequest
 from neutronstarlite_tpu.serve.fleet import (
     FleetOptions,
@@ -253,8 +254,15 @@ def child_main(argv=None) -> int:
         return 2
 
     predict_timeout = max(float(args.predict_timeout_s), 1.0)
+    # freshness lineage for the request spans: which delta-log seq (the
+    # stream ingestor's applied head; 0 for a static graph) answered
+    if ingestor is not None:
+        server.graph_seq_source = lambda: ingestor.applied_seq
+    else:
+        server.graph_seq_source = lambda: 0
 
-    def _predict(payload: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
+    def _predict(payload: Dict[str, Any],
+                 ctx=None) -> Tuple[int, Dict[str, Any]]:
         ids = payload.get("node_ids")
         if not isinstance(ids, list) or not ids or not all(
             isinstance(i, int) and not isinstance(i, bool) for i in ids
@@ -282,7 +290,7 @@ def child_main(argv=None) -> int:
                          "dtype": str(vals.dtype), "replay": True,
                          "ckpt_step": engine.ckpt_step,
                          "replica": args.replica}
-        req = server.submit(node_ids)
+        req = server.submit(node_ids, ctx=ctx)
         if reg is not None:
             reg.gauge_set("serve.queue_depth", server.batcher.depth)
         try:
@@ -367,6 +375,24 @@ def child_main(argv=None) -> int:
 # ---------------------------------------------------------------------------
 
 
+# Env the fabric's observability depends on, pinned INTO each recipe at
+# spawn: ``LaunchRecipe.env()`` re-reads ``os.environ`` at every respawn,
+# so a router whose environment mutated between spawn and a supervised
+# restart (or a rollout respawn) would silently hand the new child a
+# different tracing config — a restarted replica must keep emitting spans
+# into its own stream (the restart-then-trace test pins this).
+_TRACE_ENV_KEYS = ("NTS_TRACE", "NTS_METRICS_DIR", "NTS_TRACE_STEP")
+
+
+def _pin_trace_env(extra_env: Dict[str, str]) -> Dict[str, str]:
+    """Snapshot the spawn-time tracing env into ``extra_env`` (explicit
+    caller-provided values win)."""
+    for key in _TRACE_ENV_KEYS:
+        if key not in extra_env and key in os.environ:
+            extra_env[key] = os.environ[key]
+    return extra_env
+
+
 @dataclasses.dataclass
 class LaunchRecipe:
     """Everything needed to (re)spawn one replica child compile-warm:
@@ -447,6 +473,7 @@ class CrossHostFleet:
         self.options = options or FleetOptions()
         self.registry = registry or obs_registry.open_run("router")
         self._owns_registry = registry is None
+        self.tracer = Tracer(self.registry)
         self.predict_timeout_s = float(predict_timeout_s)
         self.spawn_timeout_s = float(spawn_timeout_s)
         self.drain_timeout_s = float(drain_timeout_s)
@@ -537,7 +564,10 @@ class CrossHostFleet:
                     cfg_path=cfg_path, ckpt_dir=ckpt_dir, replica=f"r{i}",
                     seed=seed + i,
                     port_file=os.path.join(spawn_dir, f"r{i}.port.json"),
-                    extra_env=dict(extra_env or {}),
+                    # pin the SPAWN-TIME tracing env into the recipe so a
+                    # supervised restart / rollout respawn (which re-reads
+                    # os.environ) keeps the child's trace config stable
+                    extra_env=_pin_trace_env(dict(extra_env or {})),
                 )
                 r = _RouterReplica(i, recipe=recipe)
                 r.proc = _spawn_child(recipe)
@@ -569,7 +599,9 @@ class CrossHostFleet:
             body = self._fetch_impl(url)
         else:
             body = httpc.fetch(url, target=idx,
-                               deadline_s=httpc.http_timeout_s() * 2)
+                               deadline_s=httpc.http_timeout_s() * 2,
+                               tracer=self.tracer,
+                               span_name="telemetry_poll")
         r.cached_body = body
         return body
 
@@ -647,9 +679,13 @@ class CrossHostFleet:
             timeout if timeout is not None else self.predict_timeout_s + 5.0
         )
 
-    def _shed(self, req: ServeRequest, reason: str) -> None:
+    def _shed(self, req: ServeRequest, reason: str, ctx=None) -> None:
         self.registry.counter_add("fleet.sheds", 1.0)
         try:
+            if ctx is not None:
+                self.tracer.complete("shed", dur_s=0.0, cat="router",
+                                     ctx=ctx, req_id=req.req_id,
+                                     reason=reason)
             self.registry.event("shed", reason=reason, req_id=req.req_id)
             self.registry.event(
                 "serve_request", n_seeds=max(len(req.node_ids), 1),
@@ -674,13 +710,47 @@ class CrossHostFleet:
         deadline = time.monotonic() + self.predict_timeout_s
         tried: set = set()
         shed_seen = False
+        # per-request trace: trace_id = run_id:req_id so every span this
+        # request produces — router-side, httpc's predict_post, and the
+        # replica's handler/request/queue spans across the wire — joins
+        # on one id in the fleet-merged timeline
+        tracing = self.tracer.enabled
+        root_id = None
+        root_ctx = None
+        if tracing:
+            trace_id = f"{self.registry.run_id}:{req.req_id}"
+            root_id = self.tracer.next_id()
+            root_ctx = TraceContext(trace_id, root_id)
+
+        def _root_done(status: str, **extra) -> None:
+            if not tracing:
+                return
+            self.tracer.complete(
+                "fleet_request",
+                dur_s=time.perf_counter() - req.t_submit,
+                t0=req.t_submit, cat="router", span_id=root_id,
+                ctx=TraceContext(root_ctx.trace_id, None),
+                req_id=req.req_id, status=status,
+                n_seeds=len(req.node_ids), **extra,
+            )
+
         while True:
             if self._closed:
-                self._shed(req, "fleet_closed")
+                self._shed(req, "fleet_closed", ctx=root_ctx)
+                _root_done("shed", reason="fleet_closed")
                 return
             states = self.route_states()
             fresh = [s for s in states if s["idx"] not in tried]
+            is_reroute = bool(tried)
+            t_route = time.perf_counter()
             idx, reason = self._route(fresh if fresh else states)
+            if tracing:
+                self.tracer.complete(
+                    "re_route" if is_reroute else "route_decision",
+                    dur_s=time.perf_counter() - t_route, t0=t_route,
+                    cat="router", ctx=root_ctx, req_id=req.req_id,
+                    target=idx, reason=reason,
+                )
             if idx is not None and idx in tried:
                 # every replica has already failed this request once;
                 # this is a fresh pass (bounded by the deadline)
@@ -695,22 +765,31 @@ class CrossHostFleet:
                 if reason and reason.startswith("fleet_breach"):
                     # the SLO contract: all live replicas breaching is
                     # the ONLY load-based fleet-level shed
-                    self._shed(req, reason)
+                    self._shed(req, reason, ctx=root_ctx)
+                    _root_done("shed", reason=reason)
                     return
                 if time.monotonic() >= deadline:
-                    self._shed(
-                        req,
+                    shed_reason = (
                         "replica_queues_full (every live replica shed)"
-                        if shed_seen else (reason or "fleet_down"),
+                        if shed_seen else (reason or "fleet_down")
                     )
+                    self._shed(req, shed_reason, ctx=root_ctx)
+                    _root_done("shed", reason=shed_reason)
                     return
-                time.sleep(min(self.hub.poll_s, 0.2) or 0.05)
+                nap = min(self.hub.poll_s, 0.2) or 0.05
+                time.sleep(nap)
+                if tracing:
+                    self.tracer.complete(
+                        "backoff", dur_s=nap, cat="router", ctx=root_ctx,
+                        req_id=req.req_id, reason=reason,
+                    )
                 tried.clear()
                 continue
             r = self.replicas[idx]
             budget = deadline - time.monotonic()
             if budget <= 0:
-                self._shed(req, "dispatch_deadline")
+                self._shed(req, "dispatch_deadline", ctx=root_ctx)
+                _root_done("shed", reason="dispatch_deadline")
                 return
             with self._lock:
                 r.in_flight += 1
@@ -725,6 +804,8 @@ class CrossHostFleet:
                     # replica: re-dispatch is OURS, across replicas
                     timeout_s=min(self.predict_timeout_s, budget),
                     target=idx,
+                    tracer=self.tracer, ctx=root_ctx,
+                    span_name="predict_post",
                 )
             except httpc.HttpStatusError as e:
                 with self._lock:
@@ -741,9 +822,14 @@ class CrossHostFleet:
                     r.in_flight -= 1
                 # refused/timeout: the replica may be dead — cool it down
                 # for a poll and RE-ROUTE the owed request
-                r.suspect_until = time.monotonic() + max(
-                    self.hub.poll_s, 0.2
-                )
+                cooldown = max(self.hub.poll_s, 0.2)
+                r.suspect_until = time.monotonic() + cooldown
+                if tracing:
+                    self.tracer.complete(
+                        "suspect", dur_s=0.0, cat="router", ctx=root_ctx,
+                        req_id=req.req_id, target=idx,
+                        error=httpc.error_class(e), cooldown_s=cooldown,
+                    )
                 log.warning("router: replica %s unreachable (%s); "
                             "re-routing %s", r.rid, e, req.req_id)
                 tried.add(idx)
@@ -764,6 +850,8 @@ class CrossHostFleet:
             self.registry.counter_add("fleet.requests", 1.0)
             self._mirror.append([int(i) for i in req.node_ids])
             req._complete(vals, "ok")
+            _root_done("ok", target=idx,
+                       replica_req_id=str(out.get("req_id") or ""))
             return
 
     # ---- polling + supervision -------------------------------------------
@@ -796,6 +884,7 @@ class CrossHostFleet:
 
     def _restart_replica(self, r: _RouterReplica, reason: str) -> bool:
         """Supervised process restart from the recorded launch recipe."""
+        t_restart = time.perf_counter()
         old_url = r.base_url
         with self._lock:
             owed = r.in_flight
@@ -822,11 +911,25 @@ class CrossHostFleet:
             with self._proc_lock:
                 _reap(r.proc)
                 r.proc = None
+            if self.tracer.enabled:
+                self.tracer.complete(
+                    "restart_replica",
+                    dur_s=time.perf_counter() - t_restart, t0=t_restart,
+                    cat="fleet", replica=r.rid, reason=reason,
+                    error=str(e)[:200],
+                )
             return False
         r.respawn_failures = 0
         r.restarts += 1
         r.recipe = recipe
         self._repoint(r, f"http://127.0.0.1:{info['port']}")
+        if self.tracer.enabled:
+            self.tracer.complete(
+                "restart_replica",
+                dur_s=time.perf_counter() - t_restart, t0=t_restart,
+                cat="fleet", replica=r.rid, reason=reason,
+                restarts=r.restarts,
+            )
         self.registry.counter_add("fleet.restarts", 1.0)
         self.registry.event(
             "recovery", action="restart", replica=r.rid,
@@ -866,7 +969,12 @@ class CrossHostFleet:
                 )
             self._rollout_active = True
         try:
-            return self._rollout_impl(ckpt_dir, t0)
+            # the traced rollout chain: preflight / canary / roll_one
+            # spans emitted inside auto-parent under this root (same
+            # thread — the tracer's thread-local span stack)
+            with self.tracer.span("rollout", cat="rollout",
+                                  ckpt_dir=ckpt_dir):
+                return self._rollout_impl(ckpt_dir, t0)
         finally:
             self._rollout_active = False
 
@@ -887,23 +995,41 @@ class CrossHostFleet:
             )
         # 1. preflight: the digest-verified gate — a corrupt candidate is
         # refused before any replica is touched
+        t_pf = time.perf_counter()
         try:
             _step_dir, step = preflight_checkpoint(ckpt_dir)
         except PreflightError as e:
             detail = "; ".join(e.problems[:3])
+            self.tracer.complete(
+                "rollout_preflight", dur_s=time.perf_counter() - t_pf,
+                t0=t_pf, cat="rollout", ok=False,
+            )
             return self._emit_rollout(
                 ckpt_dir, "preflight_reject", t0=t0,
                 error=f"{e}" + (f" [{detail}]" if detail else ""),
             )
+        self.tracer.complete(
+            "rollout_preflight", dur_s=time.perf_counter() - t_pf,
+            t0=t_pf, cat="rollout", ok=True, ckpt_step=step,
+        )
         # 2. canary gate: shadow-eval mirrored traffic, promote only
         # inside NTS_CANARY_TOL
+        t_cn = time.perf_counter()
         try:
             canary = self._canary(ckpt_dir)
         except Exception as e:
+            self.tracer.complete(
+                "rollout_canary", dur_s=time.perf_counter() - t_cn,
+                t0=t_cn, cat="rollout", ok=False,
+            )
             return self._emit_rollout(
                 ckpt_dir, "canary_reject", t0=t0, ckpt_step=step,
                 error=f"canary evaluation failed: {e}",
             )
+        self.tracer.complete(
+            "rollout_canary", dur_s=time.perf_counter() - t_cn, t0=t_cn,
+            cat="rollout", ok=bool(canary.get("passed")),
+        )
         if not canary.get("passed"):
             return self._emit_rollout(
                 ckpt_dir, "canary_reject", t0=t0, ckpt_step=step,
@@ -947,6 +1073,13 @@ class CrossHostFleet:
 
     def _roll_one(self, r: _RouterReplica, ckpt_dir: str) -> bool:
         """Drain one replica, restart it on the candidate checkpoint."""
+        with self.tracer.span("roll_one", cat="rollout",
+                              replica=r.rid) as h:
+            ok = self._roll_one_impl(r, ckpt_dir)
+            h.attrs["ok"] = ok
+            return ok
+
+    def _roll_one_impl(self, r: _RouterReplica, ckpt_dir: str) -> bool:
         r.expected_down = True  # no NEW routing; hub sees the frozen
         # last-good snapshot (continuous merged view, zero misses)
         drain_deadline = time.monotonic() + self.drain_timeout_s

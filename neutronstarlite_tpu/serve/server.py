@@ -39,6 +39,7 @@ from neutronstarlite_tpu.serve.batcher import (  # noqa: E402
     ServeOptions,
     ServeRequest,
 )
+from neutronstarlite_tpu.obs.trace import TraceContext  # noqa: E402
 from neutronstarlite_tpu.serve.engine import InferenceEngine  # noqa: E402
 from neutronstarlite_tpu.serve.sampling import EmbeddingCache  # noqa: E402
 from neutronstarlite_tpu.utils.logging import get_logger  # noqa: E402
@@ -148,12 +149,21 @@ class InferenceServer:
         self._t_last: Optional[float] = None
         self.request_count = 0
         self._closed = False
+        # freshness lineage: every request span is stamped with the graph
+        # version (delta-log seq) and model version (checkpoint step) that
+        # answered it. model_seq comes from the engine; graph_seq from
+        # whoever owns the delta stream (the crosshost child wires its
+        # StreamIngestor's applied_seq here; standalone servers report the
+        # engine's static graph as seq 0)
+        self.graph_seq_source = None  # () -> int | None
 
     # ---- request API -----------------------------------------------------
-    def submit(self, node_ids) -> ServeRequest:
+    def submit(self, node_ids, ctx=None) -> ServeRequest:
         """Enqueue one request (any 1..max_batch vertex ids); returns the
-        future. Overload rejects with RequestShedError on the future."""
-        return self.batcher.submit(node_ids)
+        future. Overload rejects with RequestShedError on the future.
+        ``ctx`` (obs/trace.TraceContext) parents this request's lifecycle
+        spans into a remote caller's trace."""
+        return self.batcher.submit(node_ids, ctx=ctx)
 
     def predict(self, node_ids, timeout: Optional[float] = 60.0) -> np.ndarray:
         """Blocking convenience wrapper: logits [n, n_classes]."""
@@ -491,6 +501,25 @@ class InferenceServer:
         )
         self._record(requests, reason, bucket, n_seeds, exec_ms, flush_id)
 
+    def _lineage(self):
+        """(graph_seq, model_seq) for the freshness-lineage span fields:
+        which delta-log seq and which checkpoint step answered. Never
+        raises — lineage is best-effort telemetry."""
+        graph_seq = None
+        if self.graph_seq_source is not None:
+            try:
+                v = self.graph_seq_source()
+                graph_seq = int(v) if v is not None else None
+            except Exception:
+                graph_seq = None
+        model_seq = getattr(self.engine, "ckpt_step", None)
+        if model_seq is not None:
+            try:
+                model_seq = int(model_seq)
+            except (TypeError, ValueError):
+                model_seq = None
+        return graph_seq, model_seq
+
     def _record(self, requests: List[ServeRequest], reason: str,
                 bucket: Optional[int], n_seeds: int, exec_ms: float,
                 flush_id: Optional[int] = None) -> None:
@@ -524,6 +553,7 @@ class InferenceServer:
             reason=reason, bucket=bucket, exec_ms=exec_ms,
             flush_id=flush_id,
         )
+        graph_seq, model_seq = self._lineage()
         for r in requests:
             if r.status == "cached":
                 self.metrics.counter_add("serve.cached_requests")
@@ -539,15 +569,24 @@ class InferenceServer:
             if r.t_done is None or r.t_flush is None:
                 continue
             # request lifecycle spans, retroactive from the recorded
-            # perf_counter marks (same clock domain as the tracer)
+            # perf_counter marks (same clock domain as the tracer). When
+            # the request arrived over the wire (r.ctx), the span joins
+            # the caller's trace — parented under the exporter's handler
+            # span, carrying the (send_ts, recv_ts) clock pair and the
+            # graph_seq/model_seq freshness lineage.
             span = self.tracer.complete(
                 "request", dur_s=r.t_done - r.t_submit, t0=r.t_submit,
-                cat="serve", req_id=r.req_id, status=r.status,
+                cat="serve", ctx=r.ctx, req_id=r.req_id, status=r.status,
                 n_seeds=len(r.node_ids), flush_id=flush_id,
+                graph_seq=graph_seq, model_seq=model_seq,
+            )
+            queue_ctx = (
+                TraceContext(r.ctx.trace_id, span.span_id)
+                if r.ctx is not None else None
             )
             self.tracer.complete(
                 "queue", dur_s=r.t_flush - r.t_submit, t0=r.t_submit,
-                cat="serve", parent=span, req_id=r.req_id,
+                cat="serve", parent=span, ctx=queue_ctx, req_id=r.req_id,
             )
         if self.slo is not None:
             # completions are the SLO engine's observation stream; a tick

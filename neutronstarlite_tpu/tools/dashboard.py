@@ -20,7 +20,14 @@ Panels:
   ``kind=fleet`` rows (``--ledger`` / NTS_LEDGER_DIR) when available;
 - **straggler heat strip** — per-partition epoch seconds from
   ``heartbeat.seconds`` shaded against the fleet median, with typed
-  ``straggler`` records called out (obs/skew).
+  ``straggler`` records called out (obs/skew);
+- **per-request waterfall** — when the input carries distributed-trace
+  spans (router + replica streams, NTS_TRACE on), the slowest complete
+  request chains render as stacked stage bars (queue/sample/execute/…
+  plus the router-overhead remainder), with the complete-chain
+  fraction, router-overhead quantiles, and the freshness lineage
+  (graph_seq/model_seq) summarized above them
+  (tools/trace_timeline.request_tracing_report).
 
 Usage:
   python -m neutronstarlite_tpu.tools.dashboard --stream DIR_OR_FILE
@@ -116,9 +123,18 @@ def fabric_model(events: List[Dict[str, Any]],
         for name, h in sorted(hists.items())
     }
 
+    # distributed request tracing (lazy import: trace_timeline pulls in
+    # metrics_report at module load)
+    from neutronstarlite_tpu.tools.trace_timeline import (
+        request_tracing_report,
+    )
+
+    tracing = request_tracing_report(events)
+
     last_poll = hub_polls[-1] if hub_polls else None
     heat = partition_epoch_seconds(events)
     return {
+        "tracing": tracing,
         "polls": len(hub_polls),
         "last": last_poll,
         "poll_series": [
@@ -195,7 +211,22 @@ th { background: #1b2027; }
 .badge { display: inline-block; padding: .1rem .5rem; border-radius: 3px;
          font-size: .8rem; margin-right: .4rem; }
 .badge.ok { background: #1e3a24; } .badge.bad { background: #3a1e1e; }
+.wf { display: inline-block; width: 420px; height: 1.1rem;
+      background: #1b2027; border: 1px solid #2a313b;
+      font-size: 0; white-space: nowrap; overflow: hidden; }
+.wf span { display: inline-block; height: 100%; }
+.legend span { display: inline-block; width: .8rem; height: .8rem;
+               margin: 0 .25rem 0 .8rem; vertical-align: middle; }
 """
+
+# waterfall stage palette: the serve stages in causal order, then the
+# router-overhead remainder
+_WF_COLORS = (
+    ("queue", "#7b8694"), ("cache_lookup", "#2a7de1"),
+    ("sample", "#8e5ad1"), ("h2d_copy", "#1faf9b"),
+    ("handoff", "#b0a030"), ("execute", "#4caf50"),
+    ("reply", "#caa26a"), ("router overhead", "#ef5350"),
+)
 
 
 def _heat_color(ratio: Optional[float]) -> str:
@@ -312,6 +343,80 @@ def render_html(model: Dict[str, Any], title: str = "fleet telemetry",
                    "~1% relative error, NOT the /metrics ladder's</p>")
     else:
         out.append("<p class='dim'>no histograms in this input</p>")
+
+    # per-request waterfall (distributed traces) ---------------------------
+    tracing = model.get("tracing")
+    if tracing is not None:
+        out.append("<h2>per-request waterfall (distributed traces)</h2>")
+        ov = {
+            q: tracing.get(f"router_overhead_{q}_ms")
+            for q in ("p50", "p95", "p99")
+        }
+        gs = tracing.get("graph_seqs") or []
+        ms_ = tracing.get("model_seqs") or []
+        out.append(
+            f"<p>{_fmt(tracing['n_complete'])}/{_fmt(tracing['n_ok'])} "
+            f"complete chains "
+            f"(frac {_fmt(tracing['complete_frac'], 3)}); "
+            f"router overhead ms p50/p95/p99 = "
+            f"{_fmt(ov['p50'])}/{_fmt(ov['p95'])}/{_fmt(ov['p99'])}; "
+            f"lineage graph_seq {_fmt(gs[0]) + '..' + _fmt(gs[-1]) if gs else 'n/a'}, "
+            f"model_seq {html.escape(','.join(str(m) for m in ms_)) if ms_ else 'n/a'}"
+            f"</p>"
+        )
+        complete = [c for c in tracing["chains"] if c["complete"]]
+        complete.sort(key=lambda c: c["total_ms"], reverse=True)
+        if complete:
+            out.append("<p class='legend dim'>" + "".join(
+                f"<span style='background:{col}'></span>{html.escape(nm)}"
+                for nm, col in _WF_COLORS
+            ) + "</p>")
+            out.append("<table><tr><th>req</th><th>total</th>"
+                       "<th>stages</th><th>fabric</th></tr>")
+            for c in complete[:12]:
+                total = c["total_ms"] or 1e-9
+                segs = []
+                for nm, col in _WF_COLORS[:-1]:
+                    d = (c.get("stages_ms") or {}).get(nm)
+                    if not d:
+                        continue
+                    segs.append(
+                        f"<span style='width:{d / total * 100:.2f}%;"
+                        f"background:{col}' "
+                        f"title='{html.escape(nm)}: {d:.3f}ms'></span>"
+                    )
+                overhead = c.get("router_overhead_ms")
+                if overhead and overhead > 0:
+                    segs.append(
+                        f"<span style='width:"
+                        f"{overhead / total * 100:.2f}%;"
+                        f"background:{_WF_COLORS[-1][1]}' "
+                        f"title='router overhead: {overhead:.3f}ms'>"
+                        f"</span>"
+                    )
+                fabric = ", ".join(
+                    f"{c[k]} {lbl}" for k, lbl in (
+                        ("n_retries", "retry"), ("n_reroutes", "re-route"),
+                        ("n_suspects", "suspect"), ("n_sheds", "shed"),
+                    ) if c.get(k)
+                ) or "—"
+                out.append(
+                    f"<tr><td>{html.escape(str(c.get('req_id')))}</td>"
+                    f"<td>{_fmt(c['total_ms'])}ms</td>"
+                    f"<td><div class='wf'>{''.join(segs)}</div></td>"
+                    f"<td class='dim'>{html.escape(fabric)}</td></tr>"
+                )
+            out.append("</table>")
+        incomplete = [
+            c for c in tracing["chains"]
+            if not c["complete"] and c["status"] == "ok"
+        ]
+        if incomplete:
+            out.append(
+                f"<p class='warn'>{len(incomplete)} answered request(s) "
+                f"with an incomplete trace chain (replica leg missing — "
+                f"torn stream or NTS_TRACE off on a replica)</p>"
+            )
 
     # straggler heat strip -------------------------------------------------
     out.append("<h2>straggler heat strip</h2>")

@@ -1265,6 +1265,10 @@ def main(argv=None) -> int:
         return 1
     rows: List[Dict[str, Any]] = []
     failed = False
+    # every loaded stream also feeds the CROSS-stream request-tracing
+    # block: per-request chains span the router's and each replica's
+    # files, so the join only makes sense over the merged view
+    all_events: List[Dict[str, Any]] = []
     for p in paths:
         try:
             events = load_events(p)
@@ -1272,6 +1276,7 @@ def main(argv=None) -> int:
             print(f"{p}: {e}", file=sys.stderr)
             failed = True
             continue
+        all_events.extend(events)
         rec = summarize(p, events)
         srec = summarize_serve(events)
         probe_lines = render_probes(events)
@@ -1428,6 +1433,18 @@ def main(argv=None) -> int:
                 print(render_serve(rec["_path"], rec, rec["_events"]))
             else:
                 print(render_run(rec["_path"], rec))
+            print()
+        # fleet-merged distributed tracing: the per-request chain block
+        # joins spans ACROSS the loaded streams (router + replicas), so
+        # it renders once over the merged view, after the per-stream
+        # blocks (lazy import: trace_timeline imports from this module)
+        from neutronstarlite_tpu.tools.trace_timeline import (
+            request_tracing_block,
+        )
+
+        tracing_lines = request_tracing_block(all_events)
+        if tracing_lines:
+            print("\n".join(tracing_lines))
             print()
         train_rows = [r for r in rows if not r.get("_serve")
                       and not r.get("_probe_only")

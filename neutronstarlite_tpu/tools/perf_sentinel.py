@@ -97,8 +97,12 @@ GATED_METRICS = {
     "probe": ("seconds",),
     # serve rows (tools/serve_bench --> obs/ledger.serve_row): tail
     # latency + shed rate trend-gate exactly like epoch time — the key
-    # embeds mode/replicas/CB so trajectories never mix load shapes
-    "serve": ("p50_ms", "p95_ms", "p99_ms", "shed_rate"),
+    # embeds mode/replicas/CB so trajectories never mix load shapes.
+    # router_overhead_p99_ms rides only on serve_bench --trace rows
+    # (client latency minus the replica's summed stage time, from the
+    # merged span streams) — absent on untraced rows, so it just skips
+    "serve": ("p50_ms", "p95_ms", "p99_ms", "shed_rate",
+              "router_overhead_p99_ms"),
     # fleet rows (obs/hub.fleet_row): the hub's merged cross-host view —
     # the fleet-wide latency tails ride in via hist_quantiles (below),
     # so the scalar tuple only carries the liveness-shaped metrics

@@ -33,6 +33,14 @@ Fleet/live-graph legs:
   through the cross-host router (serve/crosshost) instead of building an
   in-process server; latency comes from the router's merged fleet
   histograms (``--v-num`` supplies the seed-id space).
+- ``--trace`` (targets mode): after the load, merge the router's and
+  the replicas' span streams (the tools/trace_timeline ``--fleet``
+  cross-process join) and report the complete-chain fraction plus
+  router-overhead p50/p95/p99 — client latency minus the replica's
+  summed stage time, per traced request. The scalars ride the
+  kind=serve ledger row so perf_sentinel can gate router_overhead_p99.
+  ``--trace-dirs`` overrides which streams are merged (default:
+  NTS_METRICS_DIR).
 
 ``--train`` first runs the cfg's training loop (with CHECKPOINT_DIR set
 to the serving checkpoint dir) when no checkpoint exists yet — the
@@ -275,6 +283,12 @@ def _run_targets_mode(args) -> int:
     from neutronstarlite_tpu.serve.crosshost import CrossHostFleet
 
     targets = [t.strip() for t in args.targets.split(",") if t.strip()]
+    if args.trace and not os.environ.get("NTS_METRICS_DIR"):
+        # the router's span stream must land somewhere readable: the
+        # post-run merge joins it with the replicas' streams by trace_id
+        os.environ["NTS_METRICS_DIR"] = tempfile.mkdtemp(
+            prefix="nts_serve_bench_trace_"
+        )
     fleet = CrossHostFleet.from_targets(targets)
     t0 = time.perf_counter()
     try:
@@ -291,6 +305,41 @@ def _run_targets_mode(args) -> int:
         wall_s = time.perf_counter() - t0
     finally:
         stats = fleet.close()
+    trace_view: Dict[str, Any] = {}
+    if args.trace:
+        # merge the router's + replicas' span streams (all processes
+        # share NTS_METRICS_DIR, or pass --trace-dirs) and derive the
+        # per-request chain verdict the same way trace_timeline --fleet
+        # does — the measurement artifact is the shared obs streams
+        from neutronstarlite_tpu.tools.metrics_report import (
+            expand_paths,
+            load_events,
+        )
+        from neutronstarlite_tpu.tools.trace_timeline import (
+            request_tracing_report,
+        )
+
+        dirs = (args.trace_dirs or
+                [os.environ.get("NTS_METRICS_DIR", "")])
+        merged = []
+        for p in expand_paths([d for d in dirs if d]):
+            try:
+                merged.extend(load_events(p))
+            except OSError as e:
+                log.warning("serve_bench --trace: cannot read %s (%s)",
+                            p, e)
+        rep = request_tracing_report(merged)
+        if rep is None:
+            log.warning("serve_bench --trace: no request traces found "
+                        "(is NTS_TRACE on in the replicas?)")
+        else:
+            trace_view = {
+                "trace_complete_frac": rep["complete_frac"],
+                "trace_chains": rep["n_traces"],
+                "router_overhead_p50_ms": rep["router_overhead_p50_ms"],
+                "router_overhead_p95_ms": rep["router_overhead_p95_ms"],
+                "router_overhead_p99_ms": rep["router_overhead_p99_ms"],
+            }
     lat = stats["latency_ms"]
     result = {
         "metric": "serve_p99_latency_ms",
@@ -315,8 +364,39 @@ def _run_targets_mode(args) -> int:
             "replicas": stats["replicas"],
             "targets_lost": stats["targets_lost"],
             "wall_s": wall_s,
+            **trace_view,
         },
     }
+    # one kind=serve row (NTS_LEDGER_DIR): targets-mode runs share the
+    # serve trajectory keyed by the target count; with --trace the row
+    # carries router_overhead_* + trace_complete_frac, which
+    # perf_sentinel gates like any serve scalar
+    from neutronstarlite_tpu.obs import ledger
+
+    if ledger.ledger_dir():
+        served = stats["requests"]
+        shed = stats["shed"]
+        total = served + shed
+        ledger.append_row(ledger.serve_row(
+            latency_ms=lat,
+            shed_rate=(shed / total) if total > 0 else None,
+            throughput_rps=stats.get("throughput_rps"),
+            requests=args.requests,
+            cfg_fingerprint=f"targets{len(targets)}",
+            graph_digest=None,
+            mode=args.mode,
+            replicas=stats["replicas"],
+            continuous_batching=False,
+            extra={
+                "clients": (
+                    args.clients if args.mode == "closed" else None
+                ),
+                "rps_offered": (
+                    args.rps if args.mode == "open" else None
+                ),
+                **trace_view,
+            },
+        ))
     print(json.dumps(result))
     return 0
 
@@ -364,6 +444,15 @@ def main(argv=None) -> int:
     ap.add_argument("--v-num", type=int, default=2708,
                     help="seed-id space for --targets mode (the remote "
                     "graph is not introspectable)")
+    ap.add_argument("--trace", action="store_true",
+                    help="targets mode: distributed request tracing — "
+                    "after the load, merge the router's + replicas' span "
+                    "streams (trace_timeline --fleet join) and report "
+                    "complete-chain fraction + router-overhead "
+                    "p50/p95/p99 (requires NTS_TRACE on in the replicas)")
+    ap.add_argument("--trace-dirs", nargs="+", default=None,
+                    help="metrics dirs/files holding the fleet's span "
+                    "streams (default: NTS_METRICS_DIR)")
     args = ap.parse_args(argv)
     if args.targets:
         return _run_targets_mode(args)

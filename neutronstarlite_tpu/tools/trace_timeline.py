@@ -36,9 +36,37 @@ process-local monotonic clocks. This CLI reconstructs one coherent view:
    - elastic time-to-recover — per survivor replan, the time from the
      rank_loss detection record to the first post-replan epoch end.
 
+5. **Cross-PROCESS fleet merge** (``--fleet``): the serve fabric's
+   streams (router + N replica processes) share no epoch barriers, so
+   step 2 cannot align them. They DO share distributed-trace clock
+   pairs: every traced HTTP hop stamps the client's wall clock into the
+   ``X-NTS-Send-Ts`` header and the server's at extraction, so each
+   server-side handler span carries ``(send_ts, recv_ts)`` — two wall
+   clocks taken one network hop apart — and its ``parent_id`` names the
+   client-side span (in a DIFFERENT stream) whose envelope ``ts`` closes
+   the exchange. NTP-style per pair, with t0=send_ts (client),
+   t1=recv_ts (server), t2=server envelope ts (~reply write),
+   t3=client envelope ts (~response received)::
+
+       offset(server-client) = ((t1-t0) + (t2-t3)) / 2
+       rtt                   = (t3-t0) - (t2-t1)
+
+   The estimate's error is bounded by rtt/2 (the classic NTP bound: the
+   true offset lies within ±rtt/2 of the estimate, reached only when the
+   hop is fully asymmetric). Per connected stream the shift applied is
+   the MEDIAN offset over its pairs, chained transitively (bounds add)
+   when a stream only reaches the reference through another process.
+   Streams with no pairs keep their own wall clock and a warning names
+   why (the same warn-not-crash taxonomy as step 2). The fleet-merged
+   Chrome export gives each PROCESS its own pid, and the per-request
+   report joins spans by ``trace_id`` into client->router->replica->
+   engine chains: complete-chain fraction, ``router_overhead_ms =
+   client_latency - replica_stage_sum``, retry/re-route/suspect counts,
+   and the prediction freshness lineage (``graph_seq``/``model_seq``).
+
 Usage:
   python -m neutronstarlite_tpu.tools.trace_timeline <file-or-dir> [...]
-      [--chrome OUT.json] [--json]
+      [--chrome OUT.json] [--json] [--fleet]
 Exit 0 when at least one stream yielded a timeline; 1 otherwise.
 """
 
@@ -63,6 +91,18 @@ from neutronstarlite_tpu.tools.metrics_report import (  # noqa: E402
 
 def _median(vals: List[float]) -> Optional[float]:
     return statistics.median(vals) if vals else None
+
+
+def _quantile(sorted_vals: List[float], q: float) -> Optional[float]:
+    """Linear-interpolation quantile over an ALREADY-SORTED list."""
+    if not sorted_vals:
+        return None
+    if len(sorted_vals) == 1:
+        return sorted_vals[0]
+    pos = q * (len(sorted_vals) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    return sorted_vals[lo] + (sorted_vals[hi] - sorted_vals[lo]) * (pos - lo)
 
 
 def spans_of(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
@@ -102,8 +142,10 @@ class Stream:
         self.path = path
         self.events = events
         self.rank = stream_rank(events, path)
+        self.pid = self.rank  # Chrome pid; fleet mode re-keys per PROCESS
         self.offset = mono_wall_offset(events)  # mono -> wall (step 1)
-        self.align = 0.0  # cross-rank shift (step 2)
+        self.align = 0.0  # cross-rank/process shift (step 2 or 5)
+        self.skew_bound: Optional[float] = None  # fleet_align's rtt/2 bound
         self.align_warning: Optional[str] = None  # set by align_streams
         self.run_id = next(
             (e["run_id"] for e in events if e.get("run_id")), "?"
@@ -181,7 +223,10 @@ def align_streams(streams: List["Stream"]) -> None:
         print(f"{s.path}: warning: {s.align_warning}", file=sys.stderr)
 
 
-def load_streams(paths: List[str]) -> List[Stream]:
+def load_streams(paths: List[str], fleet: bool = False) -> List[Stream]:
+    """Load + align. ``fleet=True`` switches step-2 epoch alignment for
+    the step-5 clock-pair alignment (serve-fabric processes share no
+    epoch barriers, so epoch alignment is meaningless across them)."""
     streams = []
     for p in paths:
         try:
@@ -191,8 +236,119 @@ def load_streams(paths: List[str]) -> List[Stream]:
             continue
         if events:
             streams.append(Stream(p, events))
-    align_streams(streams)
+    if fleet:
+        fleet_align(streams)
+    else:
+        align_streams(streams)
     return streams
+
+
+# ---------------------------------------------------------------------------
+# Cross-process fleet merge (docstring step 5)
+# ---------------------------------------------------------------------------
+
+
+def clock_pairs(streams: List[Stream]) -> Dict[tuple, List[tuple]]:
+    """Collect the distributed-trace clock pairs between streams.
+
+    A pair comes from one traced HTTP hop: the SERVER-side span carries
+    ``send_ts`` (client wall, from the X-NTS-Send-Ts header) and
+    ``recv_ts`` (server wall at extraction) as attributes, and its
+    ``parent_id`` names the CLIENT-side span — which must live in a
+    DIFFERENT stream. (Replica-internal spans inherit the stamps via the
+    handler's context but parent within their own stream, so the
+    different-stream rule keeps them out of the clock estimate.)
+
+    Returns ``{(client_idx, server_idx): [(offset_s, rtt_s), ...]}``
+    with ``offset = server_wall - client_wall``.
+    """
+    # client-span index: (trace_id, span_id) -> stream idx + envelope ts
+    client_idx: Dict[tuple, tuple] = {}
+    for i, st in enumerate(streams):
+        for s in spans_of(st.events):
+            client_idx[(s.get("trace_id"), s["span_id"])] = (i, s["ts"])
+    edges: Dict[tuple, List[tuple]] = {}
+    for j, st in enumerate(streams):
+        for s in spans_of(st.events):
+            send_ts = s.get("send_ts")
+            recv_ts = s.get("recv_ts")
+            if send_ts is None or recv_ts is None or not s.get("parent_id"):
+                continue
+            hit = client_idx.get((s.get("trace_id"), s["parent_id"]))
+            if hit is None or hit[0] == j:
+                continue
+            i, t3 = hit
+            t0, t1, t2 = float(send_ts), float(recv_ts), float(s["ts"])
+            offset = ((t1 - t0) + (t2 - t3)) / 2.0
+            rtt = (t3 - t0) - (t2 - t1)
+            edges.setdefault((i, j), []).append((offset, max(rtt, 0.0)))
+    return edges
+
+
+def fleet_align(streams: List[Stream]) -> Dict[str, Any]:
+    """Clock-pair alignment across PROCESSES, in place.
+
+    The reference is the stream with the most client-side hops (the
+    router — it talks to everyone). Every stream reachable through clock
+    pairs is shifted by the median pair offset onto the reference's wall
+    clock, chaining transitively (BFS; error bounds add per hop, each
+    hop's bound = min rtt/2 over its pairs — the NTP bound). Streams
+    with spans but no pairs keep their own wall clock and get an
+    ``align_warning`` (warn, not crash). Also re-keys ``Stream.pid`` per
+    process so the Chrome export separates processes that share rank 0.
+    """
+    for i, st in enumerate(streams):
+        st.pid = i
+    info: Dict[str, Any] = {"reference": None, "streams": []}
+    edges = clock_pairs(streams)
+    delta: Dict[int, tuple] = {}
+    if edges:
+        # undirected adjacency with a signed median offset per edge
+        adj: Dict[int, Dict[int, tuple]] = {}
+        client_hops = [0] * len(streams)
+        for (i, j), pairs in edges.items():
+            client_hops[i] += len(pairs)
+            med = statistics.median(p[0] for p in pairs)
+            bound = min(p[1] for p in pairs) / 2.0
+            # offset(j - i) = med; store both directions
+            adj.setdefault(i, {})[j] = (med, bound, len(pairs))
+            adj.setdefault(j, {})[i] = (-med, bound, len(pairs))
+        ref = max(range(len(streams)), key=lambda k: client_hops[k])
+        info["reference"] = streams[ref].path
+        # BFS: delta[k] = wall(k) - wall(ref); mapping k onto the
+        # reference timeline subtracts it (align = -delta)
+        delta[ref] = (0.0, 0.0)
+        frontier = [ref]
+        while frontier:
+            nxt = []
+            for u in frontier:
+                du, bu = delta[u]
+                for v, (off, bound, _n) in adj.get(u, {}).items():
+                    if v in delta:
+                        continue
+                    delta[v] = (du + off, bu + bound)
+                    nxt.append(v)
+            frontier = nxt
+        for k, (d, b) in delta.items():
+            st = streams[k]
+            if k != ref:
+                st.align = -d
+            st.skew_bound = b
+            info["streams"].append({
+                "path": st.path, "pid": st.pid,
+                "offset_vs_ref_s": d, "skew_bound_s": b,
+            })
+    for i, st in enumerate(streams):
+        if i in delta:
+            continue
+        if spans_of(st.events):
+            st.align_warning = (
+                "no distributed-trace clock pairs reach this stream: "
+                "fleet alignment skipped (kept on its own wall clock)"
+            )
+            print(f"{st.path}: warning: {st.align_warning}",
+                  file=sys.stderr)
+    return info
 
 
 # ---------------------------------------------------------------------------
@@ -211,9 +367,11 @@ _ENVELOPE_OR_SPAN = (
 def chrome_trace(streams: List[Stream]) -> Dict[str, Any]:
     """Chrome trace-event JSON (the ``traceEvents`` container form).
 
-    pid = rank, tid = one int per (rank, host thread); metadata records
-    name both. Spans become complete ("X") events; fault/recovery/shed
-    records become process-scoped instants ("i")."""
+    pid = rank (or one pid per PROCESS after ``fleet_align`` — serve
+    fabrics share rank 0 across processes), tid = one int per
+    (pid, host thread); metadata records name both. Spans become
+    complete ("X") events; fault/recovery/shed records become
+    process-scoped instants ("i")."""
     events: List[Dict[str, Any]] = []
     starts: List[float] = []
     for st in streams:
@@ -230,7 +388,7 @@ def chrome_trace(streams: List[Stream]) -> Dict[str, Any]:
     tids: Dict[tuple, int] = {}
     for st in streams:
         events.append({
-            "ph": "M", "name": "process_name", "pid": st.rank, "tid": 0,
+            "ph": "M", "name": "process_name", "pid": st.pid, "tid": 0,
             "ts": 0,
             "args": {"name": f"rank {st.rank} · {st.run_id}"},
         })
@@ -238,12 +396,12 @@ def chrome_trace(streams: List[Stream]) -> Dict[str, Any]:
             w = st.span_wall(s)
             if w is None:
                 continue
-            key = (st.rank, s.get("thread") or "main")
+            key = (st.pid, s.get("thread") or "main")
             tid = tids.get(key)
             if tid is None:
                 tid = tids[key] = len(tids) + 1
                 events.append({
-                    "ph": "M", "name": "thread_name", "pid": st.rank,
+                    "ph": "M", "name": "thread_name", "pid": st.pid,
                     "tid": tid, "ts": 0, "args": {"name": key[1]},
                 })
             args = {
@@ -257,7 +415,7 @@ def chrome_trace(streams: List[Stream]) -> Dict[str, Any]:
                 "ph": "X",
                 "name": s["name"],
                 "cat": s.get("cat") or "host",
-                "pid": st.rank,
+                "pid": st.pid,
                 "tid": tid,
                 "ts": (w - t0) * 1e6,
                 "dur": s["dur_s"] * 1e6,
@@ -291,7 +449,7 @@ def chrome_trace(streams: List[Stream]) -> Dict[str, Any]:
                 "ph": "i",
                 "name": f"{e['event']}:{label}",
                 "cat": "marker",
-                "pid": st.rank,
+                "pid": st.pid,
                 "tid": 0,
                 "ts": (e["ts"] + st.align - t0) * 1e6,
                 "s": "p",
@@ -476,6 +634,174 @@ def serve_critical_path(events: List[Dict[str, Any]]) -> Optional[Dict[str, Any]
             abs(r["mismatch_ms"]) for r in requests
         ),
     }
+
+
+def request_chains(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Join the fleet's spans into per-request distributed chains.
+
+    The router stamps every request with trace_id ``run_id:req_id``, so
+    one trace groups: the ``fleet_request`` root, its route/re-route/
+    backoff/suspect/shed decisions, the ``predict_post`` client span
+    (+ ``http_retry`` children), the replica's ``predict_handler`` and
+    ``request``/``queue`` spans — and through the request span's
+    ``(replica run_id, flush_id)`` the engine-side flush stage spans,
+    which carry the replica's OWN trace_id (they serve a whole batch,
+    not one request). A chain is COMPLETE when the client->router->
+    replica->engine legs are all present:
+    root + predict_post + predict_handler + request + an execute stage.
+
+    ``router_overhead_ms = total_ms - replica_stage_sum_ms`` — what the
+    fabric (routing, HTTP, queueing gaps between recorded stages) added
+    on top of the replica's own stage time.
+    """
+    spans = spans_of(events)
+    by_trace: Dict[str, List[Dict[str, Any]]] = {}
+    for s in spans:
+        if s.get("trace_id"):
+            by_trace.setdefault(s["trace_id"], []).append(s)
+    stages_by_flush: Dict[Any, Dict[str, float]] = {}
+    for s in spans:
+        if s["name"] in SERVE_STAGES[1:] and s.get("flush_id") is not None:
+            stages_by_flush.setdefault(
+                (s.get("run_id"), s["flush_id"]), {}
+            )[s["name"]] = s["dur_s"] * 1000.0
+    chains: List[Dict[str, Any]] = []
+    for tid, group in sorted(by_trace.items()):
+        root = next(
+            (s for s in group if s["name"] == "fleet_request"), None
+        )
+        if root is None:
+            continue
+        request = next((s for s in group if s["name"] == "request"), None)
+        queue = next((s for s in group if s["name"] == "queue"), None)
+        posts = [s for s in group if s["name"] == "predict_post"]
+        handlers = [s for s in group if s["name"] == "predict_handler"]
+        stage_ms: Dict[str, float] = {}
+        if request is not None and request.get("flush_id") is not None:
+            stage_ms.update(stages_by_flush.get(
+                (request.get("run_id"), request["flush_id"])
+            ) or {})
+        if queue is not None:
+            stage_ms["queue"] = queue["dur_s"] * 1000.0
+        total_ms = root["dur_s"] * 1000.0
+        complete = bool(
+            posts and handlers and request is not None
+            and "execute" in stage_ms
+        )
+        replica_sum = sum(stage_ms.values()) if stage_ms else None
+        chains.append({
+            "trace_id": tid,
+            "req_id": root.get("req_id"),
+            "status": root.get("status"),
+            "complete": complete,
+            "total_ms": total_ms,
+            "replica_stage_sum_ms": replica_sum,
+            "router_overhead_ms": (
+                total_ms - replica_sum if complete else None
+            ),
+            "stages_ms": stage_ms,
+            "n_posts": len(posts),
+            "n_retries": sum(
+                1 for s in group if s["name"] == "http_retry"
+            ),
+            "n_reroutes": sum(
+                1 for s in group if s["name"] == "re_route"
+            ),
+            "n_suspects": sum(
+                1 for s in group if s["name"] == "suspect"
+            ),
+            "n_sheds": sum(1 for s in group if s["name"] == "shed"),
+            "graph_seq": request.get("graph_seq") if request else None,
+            "model_seq": request.get("model_seq") if request else None,
+            "replica_run_id": (
+                request.get("run_id") if request else None
+            ),
+            "target": root.get("target"),
+        })
+    return chains
+
+
+def request_tracing_report(
+    events: List[Dict[str, Any]],
+) -> Optional[Dict[str, Any]]:
+    """The fleet-merged per-request verdict: complete-chain fraction
+    (over requests answered ok), router-overhead quantiles over complete
+    chains, fabric-event totals, and the freshness lineage summary
+    (which graph/model versions answered)."""
+    chains = request_chains(events)
+    if not chains:
+        return None
+    ok = [c for c in chains if c["status"] == "ok"]
+    complete = [c for c in ok if c["complete"]]
+    overhead = sorted(
+        c["router_overhead_ms"] for c in complete
+        if c["router_overhead_ms"] is not None
+    )
+    return {
+        "n_traces": len(chains),
+        "n_ok": len(ok),
+        "n_complete": len(complete),
+        "complete_frac": (
+            len(complete) / len(ok) if ok else 0.0
+        ),
+        "router_overhead_p50_ms": _quantile(overhead, 0.50),
+        "router_overhead_p95_ms": _quantile(overhead, 0.95),
+        "router_overhead_p99_ms": _quantile(overhead, 0.99),
+        "retries": sum(c["n_retries"] for c in chains),
+        "reroutes": sum(c["n_reroutes"] for c in chains),
+        "suspects": sum(c["n_suspects"] for c in chains),
+        "sheds": sum(c["n_sheds"] for c in chains),
+        "graph_seqs": sorted({
+            c["graph_seq"] for c in chains
+            if c["graph_seq"] is not None
+        }),
+        "model_seqs": sorted({
+            c["model_seq"] for c in chains
+            if c["model_seq"] is not None
+        }),
+        "chains": chains,
+    }
+
+
+def request_tracing_block(events: List[Dict[str, Any]]) -> List[str]:
+    """The "request tracing:" lines tools/metrics_report embeds (and the
+    fleet CLI prints): complete-chain fraction, router-overhead
+    quantiles, fabric-event totals, freshness lineage."""
+    rep = request_tracing_report(events)
+    if rep is None:
+        return []
+
+    def ms(v):
+        return f"{v:.3f}" if v is not None else "n/a"
+
+    lines = ["request tracing:"]
+    lines.append(
+        f"#traces={rep['n_traces']} ok={rep['n_ok']} "
+        f"complete={rep['n_complete']} "
+        f"(complete_chain_frac={rep['complete_frac']:.3f})"
+    )
+    lines.append(
+        f"#router_overhead_ms=p50:{ms(rep['router_overhead_p50_ms'])} "
+        f"p95:{ms(rep['router_overhead_p95_ms'])} "
+        f"p99:{ms(rep['router_overhead_p99_ms'])}"
+    )
+    lines.append(
+        f"#fabric_events=retries:{rep['retries']} "
+        f"reroutes:{rep['reroutes']} suspects:{rep['suspects']} "
+        f"sheds:{rep['sheds']}"
+    )
+    if rep["graph_seqs"] or rep["model_seqs"]:
+        gs = rep["graph_seqs"]
+        lines.append(
+            "#lineage=graph_seq["
+            + (f"{gs[0]}..{gs[-1]}" if len(gs) > 1
+               else (str(gs[0]) if gs else "n/a"))
+            + "] model_seq["
+            + (",".join(str(m) for m in rep["model_seqs"])
+               if rep["model_seqs"] else "n/a")
+            + "]"
+        )
+    return lines
 
 
 def retry_report(events: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
@@ -667,9 +993,15 @@ def main(argv=None) -> int:
                     "(Perfetto / chrome://tracing)")
     ap.add_argument("--json", action="store_true",
                     help="emit the derived metrics as one JSON object")
+    ap.add_argument("--fleet", action="store_true",
+                    help="cross-PROCESS merge: align the router's and "
+                    "each replica's streams via distributed-trace clock "
+                    "pairs (instead of epoch markers), give each "
+                    "process its own Chrome pid, and derive the "
+                    "per-request chain report")
     args = ap.parse_args(argv)
 
-    streams = load_streams(expand_paths(args.paths))
+    streams = load_streams(expand_paths(args.paths), fleet=args.fleet)
     streams = [s for s in streams if spans_of(s.events)]
     if not streams:
         print("no span records found in the given streams",
@@ -686,10 +1018,13 @@ def main(argv=None) -> int:
             {
                 "path": s.path,
                 "rank": s.rank,
+                "pid": s.pid,
                 "run_id": s.run_id,
                 "spans": len(spans_of(s.events)),
                 "mono_wall_offset_s": s.offset,
                 "align_shift_s": s.align,
+                "skew_bound_s": s.skew_bound,
+                "align_warning": s.align_warning,
             }
             for s in streams
         ],
@@ -699,6 +1034,8 @@ def main(argv=None) -> int:
         "elastic": elastic_report(merged),
         "span_inventory": span_inventory(merged),
     }
+    if args.fleet:
+        out["request_tracing"] = request_tracing_report(merged)
     if args.chrome:
         trace = chrome_trace(streams)
         validate_chrome_trace(trace)
@@ -714,13 +1051,19 @@ def main(argv=None) -> int:
 
     for s in out["streams"]:
         off = s["mono_wall_offset_s"]
+        bound = s["skew_bound_s"]
         print(
             f"== stream rank {s['rank']} · {s['run_id']} — {s['path']}\n"
             f"   {s['spans']} spans, mono->wall offset "
             f"{off:.3f}s, align shift {s['align_shift_s'] * 1000:+.3f}ms"
+            + (f", skew bound ±{bound * 1000:.3f}ms"
+               if bound is not None else "")
         )
     for line in timeline_block(merged):
         print(line)
+    if args.fleet:
+        for line in request_tracing_block(merged):
+            print(line)
     serve = out["serve_critical_path"]
     if serve is not None:
         worst = max(serve["requests"], key=lambda r: r["total_ms"])

@@ -1453,6 +1453,140 @@ if [ "${NTS_CI_MICRO_FATAL:-0}" = "1" ] && [ "$xh_adv_rc" -ne 0 ]; then
   crosshost_rc=$xh_adv_rc
 fi
 
+# ---- trace fabric gate (ISSUE 20) ------------------------------------------
+# STRUCTURAL (hard): distributed request tracing across a REAL 3-process
+# fleet — router + spawned serve children over sockets — under open-loop
+# load with one replica SIGKILL'd mid-run:
+# (1) the merged Chrome trace (trace_timeline --fleet: clock-pair join,
+#     NTP-bounded offsets) validates, every process on its own pid;
+# (2) >=95% of ok-answered requests form COMPLETE chains (fleet_request
+#     -> predict_post -> predict_handler -> request -> execute stage),
+#     each carrying graph_seq/model_seq freshness lineage;
+# (3) the killed replica's owed requests re-route, not shed: suspect +
+#     re_route spans present, ZERO shed spans anywhere;
+# (4) per complete chain the replica stage sums reproduce the client
+#     latency within the reported clock-skew bound (router_overhead_ms
+#     never more negative than 2x the NTP bound).
+# The trace env (NTS_TRACE/NTS_METRICS_DIR) must reach the children via
+# the pinned launch recipes — no per-child env plumbing here.
+trace_rc=0
+rm -rf /tmp/_t1_trace
+mkdir -p /tmp/_t1_trace
+if JAX_PLATFORMS=cpu NTS_TRACE=1 NTS_METRICS_DIR=/tmp/_t1_trace/obs \
+    NTS_NO_NATIVE=1 NTS_SAMPLE_WORKERS=0 \
+    NTS_SLO_SPEC='serve_p99_ms<=5000@30s' \
+    timeout -k 10 900 python - > /tmp/_t1_trace.log 2>&1 <<'EOF'
+import glob, os, signal, threading, time
+
+from neutronstarlite_tpu.utils.platform import honor_platform_env
+
+honor_platform_env()
+from neutronstarlite_tpu.serve.crosshost import CrossHostFleet
+from neutronstarlite_tpu.tools import trace_timeline as tt
+from neutronstarlite_tpu.tools.serve_bench import (
+    ensure_checkpoint, run_open_loop,
+)
+from neutronstarlite_tpu.utils.config import InputInfo
+
+TR = "/tmp/_t1_trace"
+cfg_path = "configs/serve_fleet_smoke.cfg"
+cfg = InputInfo.read_from_cfg_file(cfg_path)
+base_dir = os.path.dirname(os.path.abspath(cfg_path))
+cfg.checkpoint_dir = f"{TR}/ckpt"
+ensure_checkpoint(cfg, base_dir, cfg.checkpoint_dir, train=True)
+
+fleet = CrossHostFleet.spawn(
+    cfg_path, cfg.checkpoint_dir, 3, spawn_dir=f"{TR}/spawn",
+    poll_s=0.25, miss_k=2,
+)
+try:
+    assert fleet.tracer.enabled, "router tracer off despite NTS_TRACE=1"
+    for r in fleet.replicas:
+        env = r.recipe.env()
+        assert env.get("NTS_TRACE") == "1" and env.get("NTS_METRICS_DIR"), (
+            f"{r.rid}: launch recipe did not pin the trace env: {env}"
+        )
+    out = {}
+    t = threading.Thread(target=lambda: out.update(
+        e1=run_open_loop(fleet, cfg.vertices, 150, 60.0, 1, 7)))
+    t.start()
+    time.sleep(0.5)
+    # kill the STICKY target: least_burn + hysteresis pins the stream to
+    # one replica, so killing it guarantees an owed in-flight request
+    # hits the dead socket -> suspect + re_route on the router (a
+    # non-sticky victim would only ever surface as a hub-poll loss)
+    vidx = fleet._sticky if fleet._sticky is not None else 0
+    victim = fleet.replicas[vidx]
+    victim.proc.send_signal(signal.SIGKILL)
+    t.join(timeout=300.0)
+    assert out.get("e1") == 0, f"dropped {out.get('e1')} request(s)"
+    deadline = time.time() + 60.0
+    while time.time() < deadline and (
+        victim.restarts == 0 or fleet.hub.targets[vidx].lost
+    ):
+        time.sleep(0.2)
+    assert victim.restarts == 1, "SIGKILL'd replica never respawned"
+finally:
+    fleet.close()
+
+paths = sorted(glob.glob(f"{TR}/obs/*.jsonl"))
+streams = tt.load_streams(paths, fleet=True)
+assert streams, "no span streams under NTS_METRICS_DIR"
+# leg 1: merged Chrome trace validates, one pid per process
+trace = tt.chrome_trace(streams)
+n_chrome = tt.validate_chrome_trace(trace)
+assert n_chrome > 0
+assert len({st.pid for st in streams}) == len(streams)
+bounds = [st.skew_bound for st in streams if st.skew_bound is not None]
+assert bounds, "clock-pair alignment reached no stream"
+
+merged = [e for st in streams for e in st.events]
+rep = tt.request_tracing_report(merged)
+assert rep is not None, "no request traces in the merged streams"
+# leg 2: complete chains + freshness lineage
+assert rep["n_ok"] >= 140, rep
+assert rep["complete_frac"] >= 0.95, (
+    f"complete_chain_frac {rep['complete_frac']:.3f} < 0.95 "
+    f"({rep['n_complete']}/{rep['n_ok']})"
+)
+assert rep["graph_seqs"] and rep["model_seqs"], rep
+# leg 3: the kill shows up as suspect + re_route, never as a shed
+assert rep["suspects"] >= 1 and rep["reroutes"] >= 1, rep
+assert rep["sheds"] == 0, f"fleet shed {rep['sheds']} traced request(s)"
+# leg 4: stage sums reproduce client latency within the skew bound
+tol_ms = 2.0 * max(bounds) * 1000.0 + 1.0
+worst = None
+for c in rep["chains"]:
+    if not c["complete"]:
+        continue
+    oh = c["router_overhead_ms"]
+    assert oh >= -tol_ms, (
+        f"{c['trace_id']}: replica stage sum exceeds client latency "
+        f"by {-oh:.3f} ms (> {tol_ms:.3f} ms skew tolerance)"
+    )
+    assert oh <= c["total_ms"], c
+    worst = oh if worst is None else max(worst, oh)
+print(
+    f"trace fabric gate: {rep['n_complete']}/{rep['n_ok']} complete "
+    f"chains ({rep['complete_frac'] * 100:.1f}%) over {len(streams)} "
+    f"process streams, {rep['suspects']} suspect + {rep['reroutes']} "
+    f"re_route / 0 shed after SIGKILL, router overhead p99 "
+    f"{rep['router_overhead_p99_ms']:.3f} ms (worst {worst:.3f} ms, "
+    f"skew tol {tol_ms:.3f} ms), {n_chrome} chrome events"
+)
+EOF
+then
+  grep "trace fabric gate:" /tmp/_t1_trace.log
+else
+  trace_rc=$?
+  tail -40 /tmp/_t1_trace.log
+fi
+if [ "$trace_rc" -ne 0 ]; then
+  echo "TRACE_FABRIC_GATE=FAIL (rc=$trace_rc)"
+else
+  echo "TRACE_FABRIC_GATE=OK"
+fi
+
 # ---- streaming graph gate (ISSUE 18) ---------------------------------------
 # STRUCTURAL (hard): a 2-writer delta stream into a LIVE serving fleet —
 # (1) after consuming the log, the local engine's graph digest equals a
@@ -1708,5 +1842,6 @@ fi
 [ "$rc" -eq 0 ] && rc=$numerics_rc
 [ "$rc" -eq 0 ] && rc=$hub_rc
 [ "$rc" -eq 0 ] && rc=$crosshost_rc
+[ "$rc" -eq 0 ] && rc=$trace_rc
 [ "$rc" -eq 0 ] && rc=$stream_rc
 exit $rc

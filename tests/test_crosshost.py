@@ -620,3 +620,124 @@ def test_wait_port_file_rejects_dead_child(tmp_path):
         crosshost._wait_port_file(
             str(tmp_path / "p.json"), proc, time.monotonic() + 5.0,
         )
+
+
+# ---- distributed request tracing (router-side spans) -----------------------
+
+
+def test_router_traces_request_with_root_and_route_decision(
+        world, tmp_path):
+    fleet, reg = _mk_fleet(world, tmp_path, n=2)
+    try:
+        fleet.predict([1, 2])
+    finally:
+        fleet.close()
+    spans = _records(reg, tmp_path, "span")
+    root = next(s for s in spans if s["name"] == "fleet_request")
+    assert root["status"] == "ok" and root["n_seeds"] == 2
+    assert root["parent_id"] is None
+    # per-request trace id: run_id:req_id — the fleet-merge join key
+    assert root["trace_id"] == f"{reg.run_id}:{root['req_id']}"
+    route = next(s for s in spans if s["name"] == "route_decision")
+    assert route["trace_id"] == root["trace_id"]
+    assert route["parent_id"] == root["span_id"]
+    assert route["target"] == root["target"]
+
+
+def test_router_traces_suspect_and_reroute_on_death(world, tmp_path):
+    """The owed request's trace shows WHY it was slow: a suspect span
+    (tagged with the error class) + a re_route span, zero sheds, and a
+    root that still says ok."""
+    fleet, reg = _mk_fleet(world, tmp_path, n=2)
+    try:
+        for _ in range(3):
+            fleet.predict([1])
+        world.proc_at(fleet.replicas[0].base_url).kill()
+        world.proc_at(fleet.replicas[1].base_url).kill()
+        # both dead: first attempts refuse; revive r1 via respawn so the
+        # request eventually lands (run the supervision path by hand)
+        fleet._restart_replica(fleet.replicas[1], "test")
+        assert fleet.predict([5]) is not None
+    finally:
+        fleet.close()
+    spans = _records(reg, tmp_path, "span")
+    by_trace = {}
+    for s in spans:
+        by_trace.setdefault(s["trace_id"], []).append(s)
+    # the killed-replica request: its trace holds suspect + re_route
+    traced = [v for v in by_trace.values()
+              if any(s["name"] == "suspect" for s in v)]
+    assert traced, "no trace carries a suspect span"
+    tr = traced[-1]
+    suspects = [s for s in tr if s["name"] == "suspect"]
+    assert all(s["error"] in ("refused", "timeout") for s in suspects)
+    assert all(s["cooldown_s"] > 0 for s in suspects)
+    assert any(s["name"] == "re_route" for s in tr)
+    root = next(s for s in tr if s["name"] == "fleet_request")
+    assert root["status"] == "ok"
+    assert not [s for s in tr if s["name"] == "shed"]
+
+
+def test_shed_verdict_is_traced(world, tmp_path):
+    fleet, reg = _mk_fleet(world, tmp_path, n=2)
+    try:
+        for r in fleet.replicas:
+            world.breaching.add(int(r.base_url.rsplit(":", 1)[1]))
+        fleet.hub.poll_once()
+        req = fleet.submit([1])
+        with pytest.raises(RequestShedError):
+            req.result(timeout=5.0)
+    finally:
+        fleet.close()
+    spans = _records(reg, tmp_path, "span")
+    shed = next(s for s in spans if s["name"] == "shed")
+    root = next(s for s in spans if s["name"] == "fleet_request"
+                and s["trace_id"] == shed["trace_id"])
+    assert root["status"] == "shed"
+    assert "fleet_breach" in root["reason"]
+    assert shed["parent_id"] == root["span_id"]
+
+
+def test_trace_off_router_emits_zero_spans(world, tmp_path, monkeypatch):
+    monkeypatch.setenv("NTS_TRACE", "0")
+    fleet, reg = _mk_fleet(world, tmp_path, n=2)
+    try:
+        fleet.predict([1])
+    finally:
+        fleet.close()
+    assert _records(reg, tmp_path, "span") == []
+
+
+# ---- trace env survives respawn (the restart-then-trace pin) ---------------
+
+
+def test_spawn_pins_trace_env_and_restart_preserves_it(
+        world, tmp_path, monkeypatch):
+    """NTS_TRACE / NTS_METRICS_DIR / NTS_TRACE_STEP are captured into
+    every launch recipe at spawn time and survive a supervised restart —
+    a respawned replica keeps writing spans where the fleet merge looks.
+    Caller-supplied extra_env wins over the snapshot."""
+    monkeypatch.setenv("NTS_TRACE", "1")
+    monkeypatch.setenv("NTS_METRICS_DIR", str(tmp_path / "obs"))
+    monkeypatch.setenv("NTS_TRACE_STEP", "3")
+    fleet, reg = _mk_fleet(world, tmp_path, n=2,
+                           extra_env={"NTS_TRACE_STEP": "7"})
+    try:
+        r0 = fleet.replicas[0]
+        for r in fleet.replicas:
+            ee = r.recipe.extra_env
+            assert ee["NTS_TRACE"] == "1"
+            assert ee["NTS_METRICS_DIR"] == str(tmp_path / "obs")
+            assert ee["NTS_TRACE_STEP"] == "7"  # explicit beats ambient
+        # the ambient env can CHANGE (or vanish) after spawn; the
+        # recipe's snapshot is what the respawn must replay
+        monkeypatch.delenv("NTS_METRICS_DIR")
+        world.proc_at(r0.base_url).kill()
+        assert fleet._restart_replica(r0, "test")
+        env = r0.recipe.env()
+        assert env["NTS_TRACE"] == "1"
+        assert env["NTS_METRICS_DIR"] == str(tmp_path / "obs")
+        assert env["NTS_TRACE_STEP"] == "7"
+        assert r0.restarts == 1
+    finally:
+        fleet.close()

@@ -34,11 +34,16 @@ class _Script:
     def __init__(self):
         self.steps = {}
         self.hits = {}
+        self.headers = {}  # path -> one header dict per hit, in order
         self.lock = threading.Lock()
 
-    def next_step(self, path):
+    def next_step(self, path, headers=None):
         with self.lock:
             self.hits[path] = self.hits.get(path, 0) + 1
+            if headers is not None:
+                # urllib title-cases header names; normalize for lookups
+                self.headers.setdefault(path, []).append(
+                    {k.lower(): v for k, v in headers.items()})
             steps = self.steps.get(path, [(200, "ok")])
             i = min(self.hits[path] - 1, len(steps) - 1)
             return steps[i]
@@ -50,7 +55,7 @@ def server():
 
     class Handler(http.server.BaseHTTPRequestHandler):
         def _serve(self):
-            status, body = script.next_step(self.path)
+            status, body = script.next_step(self.path, self.headers)
             data = body.encode("utf-8")
             self.send_response(status)
             self.send_header("Content-Length", str(len(data)))
@@ -268,3 +273,89 @@ def test_hub_default_fetch_retries_before_missing(server, monkeypatch):
     body = hub_mod._default_fetch(f"{base}/telemetry")
     assert json.loads(body)["event"] == "telemetry"
     assert script.hits["/telemetry"] == 2  # retried within ONE poll
+
+
+# ---- distributed trace propagation over the wire ---------------------------
+
+
+def _tracer(tmp_path, monkeypatch, name="client", trace="1"):
+    from neutronstarlite_tpu.obs import registry
+    from neutronstarlite_tpu.obs.trace import Tracer
+
+    monkeypatch.setenv("NTS_TRACE", trace)
+    path = tmp_path / f"{name}.jsonl"
+    reg = registry.MetricsRegistry(name, algorithm="A", fingerprint="f",
+                                   path=str(path))
+    return reg, Tracer(reg), path
+
+
+def test_trace_headers_injected_and_restamped_per_attempt(
+        server, tmp_path, monkeypatch):
+    """ctx crosses the wire on EVERY attempt: same trace id + parent
+    (the call's pre-allocated span), send_ts re-stamped per retry; the
+    failed attempt leaves an http_retry child tagged with its error
+    class, the call leaves one span under the caller's ctx."""
+    from neutronstarlite_tpu.obs.trace import TraceContext
+
+    base, script = server
+    reg, tr, path = _tracer(tmp_path, monkeypatch)
+    script.steps["/p"] = [(503, "overloaded"), (200, "ok")]
+    ctx = TraceContext("trace-1", "root-1")
+    out = httpc.fetch(f"{base}/p", retries=1, backoff_s=0.001,
+                      tracer=tr, ctx=ctx, span_name="predict_post")
+    assert out == "ok"
+
+    hdrs = script.headers["/p"]
+    assert len(hdrs) == 2
+    assert [h["x-nts-trace-id"] for h in hdrs] == ["trace-1", "trace-1"]
+    sid = hdrs[0]["x-nts-parent-span"]
+    assert sid and sid != "root-1"  # the call's OWN span, not the root
+    assert hdrs[1]["x-nts-parent-span"] == sid
+    assert float(hdrs[1]["x-nts-send-ts"]) > float(hdrs[0]["x-nts-send-ts"])
+
+    reg.close()
+    spans = [json.loads(l) for l in open(path) if l.strip()]
+    spans = [e for e in spans if e.get("event") == "span"]
+    post = next(s for s in spans if s["name"] == "predict_post")
+    assert post["span_id"] == sid            # headers parent to THIS span
+    assert post["trace_id"] == "trace-1"
+    assert post["parent_id"] == "root-1"     # ...which parents to the ctx
+    assert post["outcome"] == "ok" and post["attempts"] == 2
+    retry = next(s for s in spans if s["name"] == "http_retry")
+    assert retry["parent_id"] == sid and retry["trace_id"] == "trace-1"
+    assert retry["error"] == "status" and retry["status"] == 503
+    assert retry["will_retry"] is True
+
+
+def test_retry_spans_tag_error_class_and_final_failure(
+        server, tmp_path, monkeypatch):
+    reg, tr, path = _tracer(tmp_path, monkeypatch)
+    with pytest.raises(httpc.HttpRefused):
+        httpc.fetch("http://127.0.0.1:9", retries=1, backoff_s=0.001,
+                    timeout_s=1.0, tracer=tr)
+    reg.close()
+    spans = [json.loads(l) for l in open(path) if l.strip()]
+    spans = [e for e in spans if e.get("event") == "span"]
+    retries = [s for s in spans if s["name"] == "http_retry"]
+    assert [r["error"] for r in retries] == ["refused", "refused"]
+    assert [r["will_retry"] for r in retries] == [True, False]
+    fetch_span = next(s for s in spans if s["name"] == "http_fetch")
+    assert fetch_span["outcome"] == "refused"
+    assert fetch_span["attempts"] == 2
+
+
+def test_trace_off_means_zero_records_and_clean_wire(
+        server, tmp_path, monkeypatch):
+    """The NTS_TRACE=0 pin: a disabled tracer adds NO headers and the
+    stream holds ZERO span records — the client is byte-identical to the
+    pre-tracing one."""
+    base, script = server
+    reg, tr, path = _tracer(tmp_path, monkeypatch, trace="0")
+    assert not tr.enabled
+    assert httpc.fetch(f"{base}/q", retries=0, tracer=tr) == "ok"
+    reg.close()
+    assert all(k.lower().startswith("x-nts") is False
+               for k in script.headers["/q"][0])
+    events = ([json.loads(l) for l in open(path) if l.strip()]
+              if path.exists() else [])
+    assert [e for e in events if e.get("event") == "span"] == []

@@ -70,6 +70,10 @@ def _emit_all(reg: registry.MetricsRegistry) -> None:
         "span", name="epoch", cat="epoch", span_id="s1",
         trace_id=reg.run_id, parent_id=None, t0=10.0, dur_s=0.5,
         rank=0, thread="MainThread", epoch=0,
+        # remote-parent link stamps + freshness lineage (the distributed
+        # tracing fields a cross-host serve request carries)
+        send_ts=1700000000.25, recv_ts=1700000000.75,
+        graph_seq=3, model_seq=1,
     )
     reg.event("stream_rotated",
               reason="NTS_METRICS_MAX_MB: stream exceeded 1 MB",
@@ -287,6 +291,19 @@ def test_validator_rejects_mutations_per_kind(tmp_path):
         bad = dict(events[kind], **mut)
         with pytest.raises(ValueError):
             schema.validate_event(bad)
+
+    # the span's distributed-tracing fields bite individually too: the
+    # remote-parent stamps must be numbers, the lineage seqs ints
+    span = events["span"]
+    for mut in ({"send_ts": "noon"}, {"recv_ts": [1.0]},
+                {"graph_seq": "3"}, {"model_seq": True},
+                {"graph_seq": 2.5}):
+        with pytest.raises(ValueError):
+            schema.validate_event(dict(span, **mut))
+    # ...while absence stays valid (untraced spans carry none of them)
+    bare = {k: v for k, v in span.items()
+            if k not in ("send_ts", "recv_ts", "graph_seq", "model_seq")}
+    schema.validate_event(bare)
 
 
 def test_stream_only_file_renders_natively(tmp_path, capsys):

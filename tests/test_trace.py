@@ -570,3 +570,61 @@ def test_report_diff_gates_on_regression(tmp_path, capsys):
     rc = report_main(["--diff", str(a), str(a), "--tol", "0"])
     capsys.readouterr()
     assert rc == 0
+
+
+# ---- distributed trace context (cross-process propagation) ------------------
+
+
+def test_trace_context_header_roundtrip():
+    from neutronstarlite_tpu.obs.trace import TraceContext
+
+    ctx = TraceContext("run:q7", "span-3")
+    hdrs = ctx.to_headers(send_ts=1700000000.25)
+    assert hdrs == {
+        "X-NTS-Trace-Id": "run:q7",
+        "X-NTS-Parent-Span": "span-3",
+        "X-NTS-Send-Ts": "1700000000.250000",
+    }
+    back = TraceContext.from_headers(hdrs)
+    assert back.trace_id == "run:q7" and back.span_id == "span-3"
+    assert back.send_ts == pytest.approx(1700000000.25)
+    assert back.recv_ts is not None  # stamped at extraction
+
+    # a root context has no parent span -> the parent header is omitted
+    root = TraceContext("run:q7", None)
+    assert "X-NTS-Parent-Span" not in root.to_headers()
+    # untraced request: no trace header -> no context
+    assert TraceContext.from_headers({}) is None
+    # case-insensitive extraction (http.server lowercases nothing, but
+    # proxies may): the dict-like with .get is all we require
+    assert TraceContext.from_headers(
+        {"X-NTS-Trace-Id": "t"}).trace_id == "t"
+
+
+def test_spans_emitted_under_remote_ctx_carry_link_stamps(tmp_path):
+    """A span completed with ctx= adopts the remote trace id + parent
+    and records the send/recv wall stamps — the join key and the clock
+    pair the fleet merge needs."""
+    from neutronstarlite_tpu.obs.trace import TraceContext
+
+    reg = registry.MetricsRegistry("replica", algorithm="A",
+                                   fingerprint="f",
+                                   path=str(tmp_path / "r.jsonl"))
+    tr = Tracer(reg)
+    ctx = TraceContext.from_headers(
+        TraceContext("router-run:q1", "post-7").to_headers())
+    with tr.span("predict_handler", cat="serve", ctx=ctx):
+        tr.complete("request", dur_s=0.01, graph_seq=5, model_seq=2)
+    reg.close()
+    evs = _events_of(tmp_path / "r.jsonl")
+    handler = next(e for e in evs if e["name"] == "predict_handler")
+    assert handler["trace_id"] == "router-run:q1"
+    assert handler["parent_id"] == "post-7"
+    assert handler["send_ts"] is not None
+    assert handler["recv_ts"] >= handler["send_ts"] - 1e-6
+    # the nested span inherits the remote trace through the stack
+    inner = next(e for e in evs if e["name"] == "request")
+    assert inner["trace_id"] == "router-run:q1"
+    assert inner["parent_id"] == handler["span_id"]
+    assert inner["graph_seq"] == 5 and inner["model_seq"] == 2
+    assert schema.validate_stream(evs) == len(evs)

@@ -1,0 +1,333 @@
+"""tools/trace_timeline --fleet: the cross-process distributed-trace
+merge.
+
+Contract under test (docstring step 5 of trace_timeline):
+
+- clock-pair alignment recovers a deliberately injected cross-process
+  wall-clock skew to within the documented RTT/2 bound, and the skew
+  bound itself is reported per stream;
+- the per-request chain join (trace_id across streams + the request
+  span's (replica run_id, flush_id) hop onto the engine's batch-level
+  stage spans) yields complete chains with
+  ``router_overhead_ms = total - replica_stage_sum`` and the freshness
+  lineage (graph_seq/model_seq);
+- a torn replica stream (crashed writer: truncated final line) and a
+  stream no clock pair reaches WARN instead of crashing, and chains
+  whose replica leg is missing count as incomplete — complete_frac
+  says so instead of silently pretending coverage;
+- ACCEPTANCE: a real in-process router -> HTTP -> exporter -> handler
+  round trip produces 100% complete chains whose spans all join on the
+  router's per-request trace id, and the merged Chrome export
+  validates with one pid per process.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import pytest
+
+from neutronstarlite_tpu.obs import registry, schema
+from neutronstarlite_tpu.tools import trace_timeline
+
+
+W = 1.7e9       # router wall = mono + W
+RTT_S = 0.002   # synthetic network: 1 ms each way
+
+
+def _mk(events, path):
+    assert schema.validate_stream(events) == len(events)
+    with open(path, "w", encoding="utf-8") as fh:
+        for e in events:
+            fh.write(json.dumps(e) + "\n")
+    return str(path)
+
+
+def _env(run_id, seq, ts, **fields):
+    return {"event": "span", "run_id": run_id,
+            "schema": schema.SCHEMA_VERSION, "seq": seq, "ts": ts,
+            "rank": 0, **fields}
+
+
+def _fleet_streams(tmp_path, skew_s, n, router_only_extra=0):
+    """Synthetic router + replica streams for ``n`` traced requests.
+
+    The replica's wall clock runs ``skew_s`` AHEAD of the router's.
+    Per request: 50 ms client latency, 40 ms of recorded replica stages
+    (queue 2 + sample 5 + execute 30 + reply 3) -> 10 ms router
+    overhead. ``router_only_extra`` appends traces whose replica leg
+    never landed (a torn/missing stream) — incomplete by construction.
+    """
+    router, replica = [], []
+    rs = [0]
+
+    def r_ev(**f):
+        rs[0] += 1
+        return _env("router-run", rs[0], W + f["t0"] + f["dur_s"], **f)
+
+    ps = [0]
+
+    def p_ev(**f):
+        ps[0] += 1
+        return _env("replica-run", ps[0],
+                    W + skew_s + f["t0"] + f["dur_s"], **f)
+
+    router.append({"event": "run_start", "run_id": "router-run",
+                   "schema": schema.SCHEMA_VERSION, "seq": 0, "ts": W,
+                   "algorithm": "ROUTER", "fingerprint": "f",
+                   "process_index": 0})
+    replica.append({"event": "run_start", "run_id": "replica-run",
+                    "schema": schema.SCHEMA_VERSION, "seq": 0,
+                    "ts": W + skew_s, "algorithm": "SERVE",
+                    "fingerprint": "f", "process_index": 0})
+    for k in range(n + router_only_extra):
+        tk = 10.0 + k
+        tid = f"router-run:q{k}"
+        root_id, post_id = f"r{k}", f"p{k}"
+        send = W + tk + 0.002
+        router.append(r_ev(
+            name="fleet_request", cat="router", span_id=root_id,
+            trace_id=tid, parent_id=None, t0=tk, dur_s=0.050,
+            req_id=f"q{k}", status="ok", n_seeds=3, target=0))
+        router.append(r_ev(
+            name="route_decision", cat="router", span_id=f"rd{k}",
+            trace_id=tid, parent_id=root_id, t0=tk + 0.001,
+            dur_s=0.0005, req_id=f"q{k}", target=0))
+        router.append(r_ev(
+            name="predict_post", cat="http", span_id=post_id,
+            trace_id=tid, parent_id=root_id, t0=tk + 0.002,
+            dur_s=0.047, outcome="ok", attempts=1, send_ts=send))
+        if k >= n:
+            continue  # router-only trace: the replica leg is missing
+        hid, qid = f"h{k}", f"rq{k}"
+        replica.append(p_ev(
+            name="predict_handler", cat="serve", span_id=hid,
+            trace_id=tid, parent_id=post_id, t0=tk + 0.003,
+            dur_s=0.045, send_ts=send,
+            recv_ts=W + skew_s + tk + 0.003))
+        replica.append(p_ev(
+            name="request", cat="serve", span_id=qid, trace_id=tid,
+            parent_id=hid, t0=tk + 0.004, dur_s=0.043,
+            req_id=f"q{k}", flush_id=k, graph_seq=5 + k, model_seq=2))
+        replica.append(p_ev(
+            name="queue", cat="serve", span_id=f"qu{k}", trace_id=tid,
+            parent_id=qid, t0=tk + 0.004, dur_s=0.002))
+        for name, st0, dur in (("sample", 0.006, 0.005),
+                               ("execute", 0.011, 0.030),
+                               ("reply", 0.041, 0.003)):
+            replica.append(p_ev(
+                name=name, cat="stage", span_id=f"{name[0]}s{k}",
+                trace_id="replica-run", parent_id=None, t0=tk + st0,
+                dur_s=dur, flush_id=k))
+    return (_mk(router, tmp_path / "router.jsonl"),
+            _mk(replica, tmp_path / "replica.jsonl"))
+
+
+# ---- clock-pair alignment ---------------------------------------------------
+
+
+def test_fleet_align_recovers_injected_skew(tmp_path):
+    skew = 5.0
+    paths = _fleet_streams(tmp_path, skew, n=4)
+    streams = trace_timeline.load_streams(list(paths), fleet=True)
+    router = next(s for s in streams if s.run_id == "router-run")
+    rep = next(s for s in streams if s.run_id == "replica-run")
+    # the router (most client hops) is the reference; the replica is
+    # shifted back by exactly the injected skew, bounded by RTT/2
+    assert router.align == 0.0 and router.skew_bound == 0.0
+    assert rep.align == pytest.approx(-skew, abs=1e-6)
+    assert rep.skew_bound == pytest.approx(RTT_S / 2.0, abs=1e-6)
+    assert rep.align_warning is None
+    # distinct Chrome pids even though both streams are rank 0
+    assert router.pid != rep.pid
+    trace = trace_timeline.chrome_trace(streams)
+    assert trace_timeline.validate_chrome_trace(trace) > 0
+    # on the merged timeline the handler sits INSIDE its predict_post:
+    # 5 s of raw skew would put it 5 s away, alignment brings it back
+    evs = trace["traceEvents"]
+    post = next(e for e in evs if e.get("name") == "predict_post")
+    handler = next(e for e in evs if e.get("name") == "predict_handler")
+    assert post["ts"] <= handler["ts"] <= post["ts"] + post["dur"]
+
+
+def test_clock_pairs_exclude_same_stream_links(tmp_path):
+    """Replica-internal spans inherit send/recv stamps via the handler's
+    ctx but parent WITHIN their stream — they must not pollute the
+    clock estimate (their parent is not one hop away)."""
+    paths = _fleet_streams(tmp_path, 2.0, n=2)
+    streams = trace_timeline.load_streams(list(paths), fleet=False)
+    pairs = trace_timeline.clock_pairs(streams)
+    ridx = next(i for i, s in enumerate(streams)
+                if s.run_id == "router-run")
+    pidx = 1 - ridx
+    assert set(pairs) == {(ridx, pidx)}  # only the cross-stream hop
+    assert len(pairs[(ridx, pidx)]) == 2
+
+
+# ---- the per-request chain join --------------------------------------------
+
+
+def test_request_chains_join_overhead_and_lineage(tmp_path):
+    paths = _fleet_streams(tmp_path, 5.0, n=3)
+    streams = trace_timeline.load_streams(list(paths), fleet=True)
+    merged = [e for s in streams for e in s.events]
+    rep = trace_timeline.request_tracing_report(merged)
+    assert rep["n_traces"] == 3 and rep["n_ok"] == 3
+    assert rep["n_complete"] == 3 and rep["complete_frac"] == 1.0
+    for c in rep["chains"]:
+        assert c["complete"]
+        assert c["total_ms"] == pytest.approx(50.0)
+        # queue 2 + sample 5 + execute 30 + reply 3 (the batch stages
+        # joined through (replica run_id, flush_id), NOT the trace id)
+        assert c["replica_stage_sum_ms"] == pytest.approx(40.0)
+        assert c["router_overhead_ms"] == pytest.approx(10.0)
+        assert c["replica_run_id"] == "replica-run"
+        assert c["model_seq"] == 2
+    assert rep["router_overhead_p99_ms"] == pytest.approx(10.0)
+    assert rep["graph_seqs"] == [5, 6, 7]  # lineage: which graph answered
+    block = "\n".join(trace_timeline.request_tracing_block(merged))
+    assert "complete_chain_frac=1.000" in block
+    assert "#lineage=graph_seq[5..7] model_seq[2]" in block
+
+
+# ---- degraded inputs: torn stream, unreachable stream -----------------------
+
+
+def test_torn_replica_stream_and_missing_legs_warn_not_crash(
+        tmp_path, capsys):
+    """A crashed replica writer leaves a torn final line and requests
+    whose replica leg never hit disk: the merge still runs, the torn
+    line is skipped, and complete_frac reports the gap."""
+    router_p, replica_p = _fleet_streams(
+        tmp_path, 0.5, n=2, router_only_extra=2)
+    with open(replica_p, "a", encoding="utf-8") as fh:
+        fh.write('{"event": "span", "run_id": "replica-run", "sch')
+    out_chrome = tmp_path / "fleet.json"
+    rc = trace_timeline.main([router_p, replica_p, "--fleet", "--json",
+                              "--chrome", str(out_chrome)])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    rt = out["request_tracing"]
+    assert rt["n_traces"] == 4 and rt["n_complete"] == 2
+    assert rt["complete_frac"] == pytest.approx(0.5)
+    # incomplete chains contribute NO router_overhead sample
+    assert all(c["router_overhead_ms"] is None
+               for c in rt["chains"] if not c["complete"])
+    assert os.path.exists(out_chrome)
+    rep_row = next(s for s in out["streams"]
+                   if s["run_id"] == "replica-run")
+    assert rep_row["skew_bound_s"] == pytest.approx(RTT_S / 2.0, abs=1e-6)
+
+
+def test_unreached_stream_gets_align_warning(tmp_path, capsys):
+    """A span-bearing stream no clock pair reaches (NTS_TRACE was off on
+    that replica, or it never served a traced request) keeps its own
+    wall clock and carries a warning — never a crash."""
+    router_p, replica_p = _fleet_streams(tmp_path, 1.0, n=2)
+    lone = [
+        {"event": "run_start", "run_id": "lone-run",
+         "schema": schema.SCHEMA_VERSION, "seq": 0, "ts": W,
+         "algorithm": "SERVE", "fingerprint": "f", "process_index": 0},
+        _env("lone-run", 1, W + 10.5, name="execute", cat="stage",
+             span_id="x0", trace_id="lone-run", parent_id=None,
+             t0=10.0, dur_s=0.5, flush_id=0),
+    ]
+    lone_p = _mk(lone, tmp_path / "lone.jsonl")
+    streams = trace_timeline.load_streams(
+        [router_p, replica_p, lone_p], fleet=True)
+    capsys.readouterr()
+    st = next(s for s in streams if s.run_id == "lone-run")
+    assert st.align_warning is not None
+    assert st.align == 0.0  # kept on its own clock, not guessed
+    aligned = [s for s in streams if s.run_id != "lone-run"]
+    assert all(s.align_warning is None for s in aligned)
+
+
+# ---- acceptance: a real HTTP round trip joins end to end --------------------
+
+
+@pytest.fixture()
+def live_fleet_dirs(tmp_path, monkeypatch):
+    """Real router -> urllib -> exporter -> handler chain, in-process:
+    two registries (router / replica) as two 'hosts' on one box."""
+    from neutronstarlite_tpu.obs.exporter import MetricsExporter
+    from neutronstarlite_tpu.obs.trace import Tracer
+    from neutronstarlite_tpu.serve.crosshost import (
+        CrossHostFleet, _RouterReplica,
+    )
+
+    monkeypatch.setenv("NTS_TRACE", "1")
+    router_p = tmp_path / "router.jsonl"
+    replica_p = tmp_path / "replica.jsonl"
+    rep_reg = registry.MetricsRegistry("replica-run", path=str(replica_p))
+    rep_tracer = Tracer(rep_reg)
+    exp = MetricsExporter(rep_reg, port=0)
+    flush = [0]
+
+    def predict(payload, ctx=None):
+        ids = payload.get("node_ids") or []
+        fid = flush[0]
+        flush[0] += 1
+        h = rep_tracer.complete(
+            "request", dur_s=0.004, cat="serve", ctx=ctx,
+            req_id=f"q{fid:x}", status="ok", n_seeds=len(ids),
+            flush_id=fid, graph_seq=7, model_seq=42)
+        rep_tracer.complete("queue", dur_s=0.001, cat="serve", parent=h,
+                            req_id=f"q{fid:x}")
+        for name, d in (("sample", 0.001), ("execute", 0.002),
+                        ("reply", 0.0005)):
+            rep_tracer.complete(name, dur_s=d, cat="serve",
+                                flush_id=fid)
+        return 200, {"status": "ok", "values": [0.5] * len(ids),
+                     "dtype": "float32", "req_id": f"q{fid:x}"}
+
+    exp.bind_predict(predict)
+    router_reg = registry.MetricsRegistry("router-run", path=str(router_p))
+    fleet = CrossHostFleet(
+        [_RouterReplica(0, f"http://127.0.0.1:{exp.port}")],
+        registry=router_reg, start_polling=False,
+    )
+    try:
+        for _ in range(6):
+            assert fleet.predict([1, 2, 3]) is not None
+        fleet.hub.poll_once()
+    finally:
+        fleet.close()
+        rep_reg.close()
+        exp.close()
+    yield str(router_p), str(replica_p)
+
+
+def test_live_round_trip_yields_complete_chains(live_fleet_dirs):
+    router_p, replica_p = live_fleet_dirs
+    streams = trace_timeline.load_streams(
+        [router_p, replica_p], fleet=True)
+    rep_st = next(s for s in streams if s.run_id == "replica-run")
+    # same host: the recovered offset must be (near) zero, and bounded
+    assert rep_st.skew_bound is not None
+    assert abs(rep_st.align) <= max(rep_st.skew_bound, 0.05)
+    merged = [e for s in streams for e in s.events]
+    rep = trace_timeline.request_tracing_report(merged)
+    assert rep["n_ok"] == 6 and rep["complete_frac"] == 1.0
+    assert rep["graph_seqs"] == [7] and rep["model_seqs"] == [42]
+    for c in rep["chains"]:
+        assert c["router_overhead_ms"] is not None
+        assert c["n_sheds"] == 0
+    trace = trace_timeline.chrome_trace(streams)
+    assert trace_timeline.validate_chrome_trace(trace) > 0
+    assert len({e.get("pid") for e in trace["traceEvents"]}) == 2
+
+
+def test_live_streams_render_report_block(live_fleet_dirs, capsys):
+    """tools/metrics_report over the same two streams embeds the
+    'request tracing:' block (cross-stream, printed once)."""
+    from neutronstarlite_tpu.tools.metrics_report import main as report
+
+    router_p, replica_p = live_fleet_dirs
+    assert report([router_p, replica_p]) == 0
+    out = capsys.readouterr().out
+    assert "request tracing:" in out
+    assert "complete_chain_frac=1.000" in out
+    assert "#lineage=graph_seq[7] model_seq[42]" in out
